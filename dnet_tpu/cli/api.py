@@ -1,0 +1,41 @@
+"""`dnet-api` entry point: the API (head) node.
+
+Reference analog: src/cli/api.py. Grows with the build; currently parses args
+and validates config so the console script is functional from day one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dnet_tpu.config import get_settings
+from dnet_tpu.utils.logger import setup_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dnet-api", description=__doc__)
+    s = get_settings()
+    p.add_argument("--host", default=s.api.host)
+    p.add_argument("--http-port", type=int, default=s.api.http_port)
+    p.add_argument("--grpc-port", type=int, default=s.api.grpc_port)
+    p.add_argument("--hostfile", default="", help="static discovery hostfile")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = setup_logger(role="api")
+    log.info("dnet-api starting on %s:%d (grpc %d)", args.host, args.http_port, args.grpc_port)
+    try:
+        from dnet_tpu.api.server import serve  # noqa: PLC0415
+
+        serve(args)
+    except ImportError:
+        log.error("API server not built yet")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,274 @@
+"""Central configuration.
+
+Layered precedence (low to high): built-in defaults -> `.env` file -> process
+environment -> CLI overrides.  Mirrors the reference's ten `DNET_*`
+pydantic-settings groups (reference: src/dnet/config.py:23-263) with a
+dependency-free dataclass implementation (pydantic-settings is not available
+in this image) plus TPU-specific groups (mesh/ICI).
+
+Every field of every group is settable as ``<PREFIX><UPPER_NAME>`` in the
+environment, e.g. ``DNET_GRPC_MAX_MESSAGE_MB=128``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional, Type, TypeVar
+
+T = TypeVar("T", bound="_EnvGroup")
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off", ""}
+
+
+def _parse_bool(raw: str) -> bool:
+    low = raw.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ValueError(f"not a boolean: {raw!r}")
+
+
+def _cast(raw: str, typ: Any) -> Any:
+    # Optional[X] -> X for casting; "none"/"" selects None.
+    import typing
+
+    origin = typing.get_origin(typ)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if raw.strip().lower() in {"none", "null", ""}:
+            return None
+        typ = args[0]
+    if typ is bool:
+        return _parse_bool(raw)
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    if typ is Path:
+        return Path(raw).expanduser()
+    if typ is str:
+        return raw
+    if typing.get_origin(typ) is list or typ is list:
+        return [s.strip() for s in raw.split(",") if s.strip()]
+    return raw
+
+
+@functools.lru_cache(maxsize=8)
+def _load_dotenv_cached(path: str, mtime: float) -> dict[str, str]:
+    result: dict[str, str] = {}
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        result[key.strip()] = value.strip().strip("'\"")
+    return result
+
+
+def load_dotenv(path: str | Path = ".env") -> dict[str, str]:
+    """Parse a KEY=VALUE .env file (comments and blank lines ignored).
+
+    Cached by (path, mtime) so the ten settings groups constructed by
+    ``Settings()`` share one read.
+    """
+    p = Path(path)
+    try:
+        mtime = p.stat().st_mtime
+    except OSError:
+        return {}
+    return _load_dotenv_cached(str(p), mtime)
+
+
+class _EnvGroup:
+    """Mixin: populate dataclass fields from `<env_prefix><FIELD>` vars."""
+
+    env_prefix: str = "DNET_"
+
+    @classmethod
+    def from_env(cls: Type[T], env: Optional[dict[str, str]] = None) -> T:
+        source: dict[str, str] = {}
+        source.update(load_dotenv(os.environ.get("DNET_ENV_FILE", ".env")))
+        source.update(os.environ)
+        if env:
+            source.update(env)
+        kwargs: dict[str, Any] = {}
+        for f in dataclasses.fields(cls):  # type: ignore[arg-type]
+            key = f"{cls.env_prefix}{f.name.upper()}"
+            if key in source:
+                try:
+                    kwargs[f.name] = _cast(source[key], cls.type_hint(f))  # type: ignore[attr-defined]
+                except (ValueError, TypeError) as exc:
+                    raise ValueError(f"bad value for {key}: {exc}") from exc
+        return cls(**kwargs)  # type: ignore[call-arg]
+
+
+# dataclasses stores string annotations under `from __future__ import
+# annotations`; resolve them once per class.
+def _resolve_hints(cls: type) -> None:
+    import typing
+
+    hints = typing.get_type_hints(cls)
+
+    def type_hint(f: dataclasses.Field) -> Any:
+        return hints[f.name]
+
+    cls.type_hint = staticmethod(type_hint)  # type: ignore[attr-defined]
+
+
+def _default_log_dir() -> Path:
+    try:
+        return Path("~/.dnet-tpu/logs").expanduser()
+    except RuntimeError:  # no resolvable home dir (bare container uid)
+        return Path("/tmp/dnet-tpu-logs")
+
+
+@dataclass
+class LogSettings(_EnvGroup):
+    env_prefix = "DNET_LOG_"
+    level: str = "INFO"
+    dir: Path = field(default_factory=_default_log_dir)
+    to_file: bool = True
+
+
+@dataclass
+class ObsSettings(_EnvGroup):
+    """Observability: [PROFILE] log gating and device-sync knobs.
+
+    Reference: src/dnet/core/observability.py:31-83.
+    """
+
+    env_prefix = "DNET_OBS_"
+    enabled: bool = False
+    sync_per_layer: bool = False
+    sync_every_n: int = 0
+
+
+@dataclass
+class KVSettings(_EnvGroup):
+    """KV-cache defaults (bits=0 means unquantized bf16)."""
+
+    env_prefix = "DNET_KV_"
+    bits: int = 0
+    group_size: int = 64
+    max_seq_len: int = 4096
+    ttl_seconds: float = 600.0
+
+
+@dataclass
+class ComputeSettings(_EnvGroup):
+    env_prefix = "DNET_COMPUTE_"
+    wire_dtype: str = "bfloat16"  # activations on the wire (bf16 is TPU-native)
+    compute_dtype: str = "bfloat16"
+    window_size: int = 0  # 0 = all assigned layers in one window
+    residency_windows: int = 2
+    donate_activations: bool = True
+
+
+@dataclass
+class TransportSettings(_EnvGroup):
+    env_prefix = "DNET_TRANSPORT_"
+    compress: bool = False
+    compress_pct: float = 0.5
+    compress_quant_bits: int = 0
+    send_retries: int = 3
+    stream_idle_sweep_s: float = 30.0
+    stream_backoff_s: float = 0.25
+
+
+@dataclass
+class GrpcSettings(_EnvGroup):
+    """gRPC channel tuning (reference: src/dnet/utils/grpc_config.py:29-53)."""
+
+    env_prefix = "DNET_GRPC_"
+    max_message_mb: int = 64
+    max_concurrent_streams: int = 1024
+    keepalive_time_ms: int = 20000
+    keepalive_timeout_ms: int = 10000
+    http2_bdp_probe: bool = False
+
+
+@dataclass
+class ApiSettings(_EnvGroup):
+    env_prefix = "DNET_API_"
+    host: str = "0.0.0.0"
+    http_port: int = 8080
+    grpc_port: int = 58080
+    callback_addr: str = ""  # override for non-loopback token callback
+    request_timeout_s: float = 300.0
+    max_concurrent_requests: int = 8
+    max_batch_size: int = 8
+
+
+@dataclass
+class ShardSettings(_EnvGroup):
+    env_prefix = "DNET_SHARD_"
+    host: str = "0.0.0.0"
+    http_port: int = 8081
+    grpc_port: int = 58081
+    queue_size: int = 256
+    name: str = ""
+
+
+@dataclass
+class TopologySettings(_EnvGroup):
+    env_prefix = "DNET_TOPOLOGY_"
+    solver: str = "auto"  # auto | greedy | milp
+    mip_gap: float = 1e-4
+    seq_len: int = 4096
+
+
+@dataclass
+class MeshSettings(_EnvGroup):
+    """TPU mesh axes used by the in-slice single-program ring / TP / SP."""
+
+    env_prefix = "DNET_MESH_"
+    pp: int = 0  # 0 = infer from device count
+    tp: int = 1
+    dp: int = 1
+    sp: int = 1
+    backend: str = ""  # "" = jax default
+
+
+@dataclass
+class Settings:
+    log: LogSettings = field(default_factory=LogSettings.from_env)
+    obs: ObsSettings = field(default_factory=ObsSettings.from_env)
+    kv: KVSettings = field(default_factory=KVSettings.from_env)
+    compute: ComputeSettings = field(default_factory=ComputeSettings.from_env)
+    transport: TransportSettings = field(default_factory=TransportSettings.from_env)
+    grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
+    api: ApiSettings = field(default_factory=ApiSettings.from_env)
+    shard: ShardSettings = field(default_factory=ShardSettings.from_env)
+    topology: TopologySettings = field(default_factory=TopologySettings.from_env)
+    mesh: MeshSettings = field(default_factory=MeshSettings.from_env)
+
+
+for _cls in (
+    LogSettings,
+    ObsSettings,
+    KVSettings,
+    ComputeSettings,
+    TransportSettings,
+    GrpcSettings,
+    ApiSettings,
+    ShardSettings,
+    TopologySettings,
+    MeshSettings,
+):
+    _resolve_hints(_cls)
+
+
+@functools.lru_cache(maxsize=1)
+def get_settings() -> Settings:
+    return Settings()
+
+
+def reset_settings_cache() -> None:
+    """For tests that mutate the environment."""
+    get_settings.cache_clear()

@@ -1,0 +1,178 @@
+"""Core DTOs shared across API and shard roles.
+
+Covers the reference's core/types/messages.py (ActivationMessage, TokenResult,
+StopCondition) and core/types/topology.py (LayerAssignment, TopologyInfo) with
+a TPU-flavored device model: devices are keyed by (host, slice, chip) so the
+solver can distinguish ICI-adjacent chips from DCN-separated hosts — the
+analog of the reference's Thunderbolt-vs-LAN distinction
+(src/dnet/core/types/topology.py:14-49).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+def now_ms() -> float:
+    return time.time() * 1000.0
+
+
+@dataclass
+class DecodingParams:
+    """Per-request sampling knobs carried alongside every token injection.
+
+    Reference: src/dnet/core/decoding/config.py:4-14.
+    """
+
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    min_p: float = 0.0
+    repetition_penalty: float = 1.0
+    logprobs: bool = False
+    top_logprobs: int = 0
+    seed: Optional[int] = None
+
+
+@dataclass
+class ActivationMessage:
+    """In-memory activation envelope hopping shard-to-shard.
+
+    dtype == "tokens" marks an int32 token-id payload entering layer 0
+    (embedding happens on the shard); anything else is a hidden-state tensor.
+    Reference: src/dnet/core/types/messages.py:50-101.
+    """
+
+    nonce: str
+    layer_id: int  # last layer already applied; -1 = raw tokens
+    seq: int  # per-nonce frame sequence number
+    dtype: str
+    shape: tuple
+    data: Any = None  # np.ndarray | jax.Array | bytes
+    pos: int = 0  # absolute position of first token in this frame
+    callback_url: str = ""
+    decoding: DecodingParams = field(default_factory=DecodingParams)
+    is_final: bool = False
+    token_id: Optional[int] = None
+    logprob: Optional[float] = None
+    top_logprobs: Optional[list] = None
+    # profiling timestamps (perf_counter seconds), reference messages.py:28-32
+    t_recv: float = 0.0
+    t_enq: float = 0.0
+    t_tx_enq: float = 0.0
+
+    @property
+    def is_tokens(self) -> bool:
+        return self.dtype == "tokens"
+
+    def tokens(self) -> np.ndarray:
+        if not self.is_tokens:
+            raise ValueError("not a token message")
+        if isinstance(self.data, (bytes, memoryview)):
+            return np.frombuffer(self.data, dtype=np.int32).reshape(self.shape)
+        return np.asarray(self.data, dtype=np.int32).reshape(self.shape)
+
+
+@dataclass
+class TokenResult:
+    """Sampled token returned from the end shard to the API node."""
+
+    nonce: str
+    token_id: int
+    logprob: Optional[float] = None
+    top_logprobs: Optional[List[tuple]] = None  # [(token_id, logprob), ...]
+    step: int = 0
+    error: str = ""
+
+
+@dataclass
+class StopCondition:
+    max_tokens: int = 256
+    stop_token_ids: tuple = ()
+    stop_sequences: tuple = ()
+
+
+@dataclass
+class DeviceInfo:
+    """A participating device as seen by discovery + the solver."""
+
+    instance: str  # unique shard instance name
+    host: str  # reachable IP/hostname
+    http_port: int
+    grpc_port: int
+    is_manager: bool = False
+    # TPU placement: chips in the same (host, slice_id) share ICI.
+    slice_id: int = 0
+    chip_count: int = 1
+    chip_kind: str = ""
+    hbm_bytes: int = 0
+    host_ram_bytes: int = 0
+    flops_bf16: float = 0.0  # achieved matmul FLOP/s from microbench
+    hbm_bw: float = 0.0  # bytes/s
+    host_to_hbm_bw: float = 0.0  # bytes/s (device_put rate)
+    t_comm: float = 0.0  # median seconds to next device for solver payloads
+
+    def ici_adjacent(self, other: "DeviceInfo") -> bool:
+        """ICI adjacency = same host and same slice (the reference's
+        Thunderbolt-link analog, src/dnet/api/cluster.py:52)."""
+        return self.host == other.host and self.slice_id == other.slice_id
+
+
+@dataclass
+class LayerAssignment:
+    """One device's share of the ring.
+
+    layers: flattened absolute layer ids over all k rounds (contiguous per
+    round).  window_size / residency_size drive the weight-streaming policy.
+    Reference: src/dnet/core/types/topology.py:14-28.
+    """
+
+    instance: str
+    layers: List[int]
+    rounds: List[List[int]] = field(default_factory=list)
+    next_instance: str = ""
+    window_size: int = 0
+    residency_size: int = 0
+
+    @property
+    def min_layer(self) -> int:
+        return min(self.layers) if self.layers else -1
+
+
+@dataclass
+class TopologyInfo:
+    """Solver output: the full ring plan shared API <-> shards.
+
+    Reference: src/dnet/core/types/topology.py:30-49.
+    """
+
+    model: str
+    num_layers: int
+    kv_bits: int
+    devices: List[DeviceInfo]
+    assignments: List[LayerAssignment]
+    solution: dict = field(default_factory=dict)  # solver diagnostics (k, w, n, obj)
+
+    def assignment_for(self, instance: str) -> Optional[LayerAssignment]:
+        for a in self.assignments:
+            if a.instance == instance:
+                return a
+        return None
+
+    def head_instance(self) -> str:
+        """Owner of layer 0 (first hop target for token injection)."""
+        for a in self.assignments:
+            if 0 in a.layers:
+                return a.instance
+        raise ValueError("no assignment owns layer 0")
+
+    def tail_instance(self) -> str:
+        last = self.num_layers - 1
+        for a in self.assignments:
+            if last in a.layers:
+                return a.instance
+        raise ValueError("no assignment owns the last layer")

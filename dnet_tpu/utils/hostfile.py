@@ -1,0 +1,91 @@
+"""Static discovery via hostfile (the CI workhorse in the reference).
+
+Two formats are accepted, mirroring the reference's `load_hostfile`
+(tests/test_static_discovery.py:13-60 in /root/reference):
+
+1. SSH-style lines:  ``<instance> <host> <http_port> <grpc_port> [manager]``
+2. JSON: ``[{"instance": ..., "host": ..., "http_port": ..., "grpc_port": ...,
+   "is_manager": false, "slice_id": 0, "chip_count": 1}, ...]``
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List
+
+from dnet_tpu.core.types import DeviceInfo
+
+
+def load_hostfile(path: str | Path) -> List[DeviceInfo]:
+    text = Path(path).read_text().strip()
+    if not text:
+        return []
+    if text.lstrip().startswith(("[", "{")):
+        return _parse_json(text)
+    return _parse_lines(text)
+
+
+def _parse_json(text: str) -> List[DeviceInfo]:
+    data = json.loads(text)
+    if isinstance(data, dict):
+        data = data.get("devices", [])
+    devices = []
+    for entry in data:
+        devices.append(
+            DeviceInfo(
+                instance=entry["instance"],
+                host=entry["host"],
+                http_port=int(entry["http_port"]),
+                grpc_port=int(entry["grpc_port"]),
+                is_manager=bool(entry.get("is_manager", False)),
+                slice_id=int(entry.get("slice_id", 0)),
+                chip_count=int(entry.get("chip_count", 1)),
+                chip_kind=entry.get("chip_kind", ""),
+            )
+        )
+    return devices
+
+
+def _parse_lines(text: str) -> List[DeviceInfo]:
+    devices = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) < 4:
+            raise ValueError(f"bad hostfile line: {line!r}")
+        devices.append(
+            DeviceInfo(
+                instance=parts[0],
+                host=parts[1],
+                http_port=int(parts[2]),
+                grpc_port=int(parts[3]),
+                is_manager=len(parts) > 4 and parts[4].lower() in {"manager", "true", "1"},
+            )
+        )
+    return devices
+
+
+class StaticDiscovery:
+    """Hostfile-backed peer table with the same surface as live discovery."""
+
+    def __init__(self, devices: List[DeviceInfo]):
+        self._devices = {d.instance: d for d in devices}
+
+    @classmethod
+    def from_hostfile(cls, path: str | Path) -> "StaticDiscovery":
+        return cls(load_hostfile(path))
+
+    def peers(self) -> List[DeviceInfo]:
+        return list(self._devices.values())
+
+    def get(self, instance: str) -> DeviceInfo | None:
+        return self._devices.get(instance)
+
+    def add(self, device: DeviceInfo) -> None:
+        self._devices[device.instance] = device
+
+    def remove(self, instance: str) -> None:
+        self._devices.pop(instance, None)

@@ -1,0 +1,93 @@
+"""Central logger with [PROFILE] gating and per-process file logs.
+
+Reference behavior: src/dnet/utils/logger.py:53-112 — a single "dnet" logger,
+env-driven level, `[PROFILE]`-tagged lines filtered out unless profiling is
+enabled, and per-process file handlers (api vs shard-PID names).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from pathlib import Path
+from typing import Optional
+
+_LOGGER_NAME = "dnet_tpu"
+_configured = False
+
+
+class ProfileFilter(logging.Filter):
+    """Drop `[PROFILE]` lines unless profiling is enabled."""
+
+    def __init__(self, enabled: bool) -> None:
+        super().__init__()
+        self.enabled = enabled
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if self.enabled:
+            return True
+        msg = record.getMessage()
+        return "[PROFILE]" not in msg
+
+
+def setup_logger(
+    role: Optional[str] = None,
+    level: Optional[str] = None,
+    log_dir: Optional[Path] = None,
+    to_file: Optional[bool] = None,
+) -> logging.Logger:
+    """Configure and return the process-wide logger.
+
+    role: "api" or "shard"; file name is dnet-api.log / dnet-shard-<pid>.log.
+    """
+    global _configured
+    logger = logging.getLogger(_LOGGER_NAME)
+    # An explicit role/level call reconfigures (a bare get_logger() at import
+    # time must not lock out the CLI's later role-specific setup).
+    explicit = role is not None or level is not None
+    if _configured and not explicit:
+        return logger
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+
+    from dnet_tpu.config import get_settings
+
+    s = get_settings()
+    level = level or s.log.level
+    log_dir = log_dir or s.log.dir
+    to_file = s.log.to_file if to_file is None else to_file
+    profile_on = s.obs.enabled or os.environ.get("DNET_PROFILE", "") in {"1", "true"}
+
+    logger.setLevel(level.upper())
+    logger.propagate = False
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)-7s %(name)s %(message)s", datefmt="%H:%M:%S"
+    )
+    console = logging.StreamHandler(sys.stderr)
+    console.setFormatter(fmt)
+    console.addFilter(ProfileFilter(profile_on))
+    logger.addHandler(console)
+
+    if to_file and role:
+        try:
+            log_dir.mkdir(parents=True, exist_ok=True)
+            name = (
+                "dnet-api.log" if role == "api" else f"dnet-shard-{os.getpid()}.log"
+            )
+            fh = logging.FileHandler(log_dir / name)
+            fh.setFormatter(fmt)
+            fh.addFilter(ProfileFilter(profile_on))
+            logger.addHandler(fh)
+        except OSError:
+            logger.warning("could not open log file in %s", log_dir)
+
+    _configured = True
+    return logger
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        return setup_logger()
+    return logger

@@ -1,0 +1,118 @@
+"""Tensor <-> wire bytes and canonical dtype maps.
+
+The wire format is raw little-endian bytes plus (dtype, shape) carried in the
+frame header — same scheme as the reference (src/dnet/utils/serialization.py:
+13-123, src/dnet/core/tensor.py:6-48) but numpy/ml_dtypes-based: bfloat16 is a
+first-class dtype here (TPU-native) rather than a uint16 bit-shift fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+# Canonical dtype-name map (wire name -> numpy dtype).
+_WIRE_DTYPES: dict[str, np.dtype] = {
+    "float32": np.dtype(np.float32),
+    "float16": np.dtype(np.float16),
+    "bfloat16": np.dtype(ml_dtypes.bfloat16),
+    "float64": np.dtype(np.float64),
+    "int8": np.dtype(np.int8),
+    "uint8": np.dtype(np.uint8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "bool": np.dtype(np.bool_),
+    "float8_e4m3": np.dtype(ml_dtypes.float8_e4m3fn),
+    "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+}
+
+_ALIASES = {
+    "f32": "float32",
+    "f16": "float16",
+    "bf16": "bfloat16",
+    "f64": "float64",
+    "i8": "int8",
+    "u8": "uint8",
+    "i16": "int16",
+    "i32": "int32",
+    "i64": "int64",
+    "BF16": "bfloat16",
+    "F16": "float16",
+    "F32": "float32",
+    "F64": "float64",
+    "I8": "int8",
+    "I16": "int16",
+    "I32": "int32",
+    "I64": "int64",
+    "U8": "uint8",
+    "BOOL": "bool",
+    "F8_E4M3": "float8_e4m3",
+    "F8_E5M2": "float8_e5m2",
+}
+
+
+def canonical_dtype_name(name: str) -> str:
+    return _ALIASES.get(name, name)
+
+
+def numpy_dtype(name: str) -> np.dtype:
+    canon = canonical_dtype_name(name)
+    if canon not in _WIRE_DTYPES:
+        raise ValueError(f"unsupported wire dtype: {name!r}")
+    return _WIRE_DTYPES[canon]
+
+
+def jax_dtype(name: str) -> jnp.dtype:
+    return jnp.dtype(numpy_dtype(name))
+
+
+def dtype_name(dtype) -> str:
+    """Canonical wire name for a numpy/jax dtype."""
+    nd = np.dtype(dtype)
+    for name, cand in _WIRE_DTYPES.items():
+        if cand == nd:
+            return name
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def tensor_to_bytes(x, wire_dtype: str | None = None) -> Tuple[bytes, str, Tuple[int, ...]]:
+    """Serialize a jax/numpy array to (payload, dtype_name, shape).
+
+    Casts to `wire_dtype` first when given (the decode-path hop casts
+    activations to the configured wire dtype — reference core/tensor.py:26).
+    """
+    if isinstance(x, jax.Array):
+        x = np.asarray(jax.device_get(x))
+    else:
+        x = np.asarray(x)
+    if wire_dtype is not None:
+        target = numpy_dtype(wire_dtype)
+        if x.dtype != target:
+            x = x.astype(target)
+    x = np.ascontiguousarray(x)
+    return x.tobytes(), dtype_name(x.dtype), tuple(x.shape)
+
+
+def bytes_to_tensor(
+    payload: bytes | memoryview, dtype: str, shape: Sequence[int]
+) -> np.ndarray:
+    nd = numpy_dtype(dtype)
+    expected = int(np.prod(shape)) * nd.itemsize if shape else nd.itemsize
+    if len(payload) != expected:
+        raise ValueError(
+            f"payload size mismatch: got {len(payload)} bytes, "
+            f"expected {expected} for {dtype}{tuple(shape)}"
+        )
+    arr = np.frombuffer(payload, dtype=nd)
+    return arr.reshape(tuple(shape))
+
+
+def bytes_to_device(payload: bytes, dtype: str, shape: Sequence[int], device=None):
+    """Deserialize straight onto a device (single host->HBM copy)."""
+    host = bytes_to_tensor(payload, dtype, shape)
+    return jax.device_put(host, device)

@@ -1,0 +1,55 @@
+import os
+
+import pytest
+
+from dnet_tpu.config import (
+    GrpcSettings,
+    KVSettings,
+    Settings,
+    load_dotenv,
+    reset_settings_cache,
+)
+
+
+def test_defaults():
+    s = Settings()
+    assert s.grpc.max_message_mb == 64
+    assert s.grpc.max_concurrent_streams == 1024
+    assert s.kv.bits == 0
+    assert s.compute.wire_dtype == "bfloat16"
+    assert s.api.http_port == 8080
+    assert s.shard.grpc_port == 58081
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("DNET_GRPC_MAX_MESSAGE_MB", "128")
+    monkeypatch.setenv("DNET_KV_BITS", "8")
+    assert GrpcSettings.from_env().max_message_mb == 128
+    assert KVSettings.from_env().bits == 8
+
+
+def test_env_bool_and_bad_value(monkeypatch):
+    monkeypatch.setenv("DNET_GRPC_HTTP2_BDP_PROBE", "true")
+    assert GrpcSettings.from_env().http2_bdp_probe is True
+    monkeypatch.setenv("DNET_GRPC_MAX_MESSAGE_MB", "not-a-number")
+    with pytest.raises(ValueError, match="DNET_GRPC_MAX_MESSAGE_MB"):
+        GrpcSettings.from_env()
+
+
+def test_dotenv(tmp_path, monkeypatch):
+    env_file = tmp_path / ".env"
+    env_file.write_text("# comment\nDNET_KV_BITS=4\nDNET_KV_GROUP_SIZE='32'\n")
+    monkeypatch.setenv("DNET_ENV_FILE", str(env_file))
+    s = KVSettings.from_env()
+    assert s.bits == 4
+    assert s.group_size == 32
+    # process env wins over .env
+    monkeypatch.setenv("DNET_KV_BITS", "8")
+    assert KVSettings.from_env().bits == 8
+
+
+def test_reset_cache():
+    reset_settings_cache()
+    from dnet_tpu.config import get_settings
+
+    assert get_settings() is get_settings()

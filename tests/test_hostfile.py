@@ -1,0 +1,68 @@
+import json
+
+import pytest
+
+from dnet_tpu.utils.hostfile import StaticDiscovery, load_hostfile
+
+
+def test_ssh_style(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text(
+        "# cluster\n"
+        "shard-0 10.0.0.1 8081 58081\n"
+        "shard-1 10.0.0.2 8081 58081 manager\n"
+        "\n"
+    )
+    devs = load_hostfile(hf)
+    assert len(devs) == 2
+    assert devs[0].instance == "shard-0"
+    assert devs[0].host == "10.0.0.1"
+    assert devs[0].grpc_port == 58081
+    assert devs[1].is_manager
+
+
+def test_json_style(tmp_path):
+    hf = tmp_path / "hosts.json"
+    hf.write_text(
+        json.dumps(
+            [
+                {
+                    "instance": "s0",
+                    "host": "127.0.0.1",
+                    "http_port": 8081,
+                    "grpc_port": 58081,
+                    "slice_id": 0,
+                    "chip_count": 4,
+                },
+                {
+                    "instance": "s1",
+                    "host": "127.0.0.1",
+                    "http_port": 8082,
+                    "grpc_port": 58082,
+                    "slice_id": 1,
+                },
+            ]
+        )
+    )
+    devs = load_hostfile(hf)
+    assert devs[0].chip_count == 4
+    assert devs[1].slice_id == 1
+    assert devs[0].ici_adjacent(devs[0])
+    assert not devs[0].ici_adjacent(devs[1])
+
+
+def test_bad_line(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("only two fields\n")
+    with pytest.raises(ValueError, match="bad hostfile line"):
+        load_hostfile(hf)
+
+
+def test_static_discovery(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("s0 127.0.0.1 8081 58081\n")
+    disc = StaticDiscovery.from_hostfile(hf)
+    assert disc.get("s0").http_port == 8081
+    assert len(disc.peers()) == 1
+    disc.remove("s0")
+    assert disc.get("s0") is None

@@ -1,0 +1,67 @@
+import numpy as np
+import pytest
+
+from dnet_tpu.utils.serialization import (
+    bytes_to_tensor,
+    canonical_dtype_name,
+    dtype_name,
+    jax_dtype,
+    numpy_dtype,
+    tensor_to_bytes,
+)
+
+
+def test_roundtrip_f32():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    payload, dt, shape = tensor_to_bytes(x)
+    assert dt == "float32" and shape == (3, 4)
+    y = bytes_to_tensor(payload, dt, shape)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_roundtrip_bf16_cast():
+    import ml_dtypes
+
+    x = np.linspace(-2, 2, 16, dtype=np.float32).reshape(4, 4)
+    payload, dt, shape = tensor_to_bytes(x, wire_dtype="bfloat16")
+    assert dt == "bfloat16"
+    y = bytes_to_tensor(payload, dt, shape)
+    assert y.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_allclose(
+        y.astype(np.float32), x, atol=0.02, rtol=0.02
+    )
+
+
+def test_jax_array_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.ones((2, 5), dtype=jnp.bfloat16)
+    payload, dt, shape = tensor_to_bytes(x)
+    assert dt == "bfloat16" and shape == (2, 5)
+    y = bytes_to_tensor(payload, dt, shape)
+    assert float(y.astype(np.float32).sum()) == 10.0
+
+
+def test_size_mismatch_rejected():
+    with pytest.raises(ValueError, match="size mismatch"):
+        bytes_to_tensor(b"\x00" * 7, "float32", (2,))
+
+
+def test_aliases():
+    assert canonical_dtype_name("BF16") == "bfloat16"
+    assert canonical_dtype_name("F16") == "float16"
+    assert numpy_dtype("i32") == np.dtype(np.int32)
+    assert str(jax_dtype("bf16")) == "bfloat16"
+
+
+def test_dtype_name_unknown():
+    with pytest.raises(ValueError):
+        dtype_name(np.dtype([("a", np.int32)]))
+
+
+def test_tokens_int32():
+    toks = np.array([[1, 2, 3]], dtype=np.int32)
+    payload, dt, shape = tensor_to_bytes(toks)
+    assert dt == "int32"
+    back = bytes_to_tensor(payload, dt, shape)
+    np.testing.assert_array_equal(back, toks)
