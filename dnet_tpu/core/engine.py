@@ -1,0 +1,264 @@
+"""Single-process inference engine: the minimum end-to-end slice.
+
+Runs a model (or a shard's layer range) on the local JAX device(s):
+prefill + token-by-token decode with preallocated KV, bucketed prompt
+padding (static shapes -> no per-request recompiles), donated cache buffers
+(XLA-level reuse standing in for the reference's memory pools,
+src/dnet/core/memory/memory_pool.py), and per-nonce KV sessions with TTL
+expiry (reference: src/dnet/shard/runtime.py:374-396).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from pathlib import Path
+from typing import Dict, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnet_tpu.core.kvcache import init_cache
+from dnet_tpu.core.sampler import SampleParams, SampleResult, sample
+from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.models import ModelConfig, get_ring_model_cls
+from dnet_tpu.utils.checkpoint import Checkpoint
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+def bucket_length(n: int, min_bucket: int = 16) -> int:
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class Session:
+    """Per-nonce decode state."""
+
+    kv: dict
+    pos: int = 0
+    key: jax.Array = None
+    counts: jax.Array = None  # [B, V] int32 seen-token counts (repetition penalty)
+    last_used: float = field(default_factory=time.time)
+
+
+class LocalEngine:
+    """One process, one device (or data-parallel later): full hot path.
+
+    layers=None means the full model (single-shard serving); a sub-range
+    makes this engine a shard's compute core.
+    """
+
+    def __init__(
+        self,
+        model_dir: str | Path,
+        layers: Optional[Sequence[int]] = None,
+        batch: int = 1,
+        max_seq: int = 2048,
+        param_dtype: str = "bfloat16",
+        kv_dtype: Optional[str] = None,
+        kv_ttl_s: float = 600.0,
+    ):
+        self.ckpt = Checkpoint(model_dir)
+        self.config = ModelConfig.from_hf(self.ckpt.config)
+        model_cls = get_ring_model_cls(self.config.model_type)
+        all_layers = list(range(self.config.num_hidden_layers))
+        self.model = model_cls(self.config, layers if layers is not None else all_layers)
+        self.batch = batch
+        self.max_seq = max_seq
+        self.param_dtype = jnp.dtype(param_dtype)
+        self.kv_dtype = kv_dtype or param_dtype
+        self.kv_ttl_s = kv_ttl_s
+        self.sessions: Dict[str, Session] = {}
+
+        self._load_params()
+        self._build_fns()
+
+    # ---- loading ------------------------------------------------------
+    def _cast(self, tree):
+        def cast_leaf(a: np.ndarray):
+            arr = jnp.asarray(a)
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                arr = arr.astype(self.param_dtype)
+            return arr
+
+        return jax.tree.map(cast_leaf, tree)
+
+    def _load_params(self) -> None:
+        t0 = time.perf_counter()
+        m = self.model
+        per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
+        stacked = m.stack_layers(per_layer)
+        self.window_params = self._cast(stacked)
+        edge_raw = m.map_edge(self.ckpt.load_edge_raw())
+        # tied embeddings: lm_project reads edge["embed"] (reference handles
+        # ties in load_weights, src/dnet/core/models/base.py:111-195)
+        self.edge_params = self._cast(edge_raw)
+        log.info(
+            "[PROFILE] loaded %d layers (%s) in %.2fs",
+            len(m.layers),
+            self.config.model_type,
+            time.perf_counter() - t0,
+        )
+
+    # ---- jitted step functions ---------------------------------------
+    def _build_fns(self) -> None:
+        model = self.model
+
+        def full_logits(window_params, edge_params, tokens, kv, pos, last_idx):
+            x = model.embed(edge_params, tokens)
+            x, kv = model.apply_window(window_params, x, kv, pos)
+            x_last = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
+            x_last = model.normalize(edge_params, x_last)
+            logits = model.lm_project(edge_params, x_last)
+            return logits[:, 0], kv
+
+        # donate kv (arg 3): each step reuses the cache buffers in place
+        self._forward = jax.jit(full_logits, donate_argnums=(3,))
+
+        def decode_and_sample(window_params, edge_params, token, kv, pos, sp, key, counts):
+            logits, kv = full_logits(window_params, edge_params, token, kv, pos, 0)
+            res = sample(logits, sp, key, token_counts=counts)
+            counts = counts.at[jnp.arange(counts.shape[0]), res.token].add(1)
+            return res, kv, counts
+
+        self._decode = jax.jit(decode_and_sample, donate_argnums=(3, 7))
+
+        def hidden_step(window_params, x, kv, pos):
+            return model.apply_window(window_params, x, kv, pos)
+
+        # mid-shard path (no embed/head): used by the ring runtime
+        self._hidden = jax.jit(hidden_step, donate_argnums=(2,))
+
+    # ---- sessions -----------------------------------------------------
+    def new_session(self, nonce: str, seed: Optional[int] = None) -> Session:
+        kv = init_cache(
+            self.model.kv_config(len(self.model.layers), self.batch, self.max_seq, self.kv_dtype)
+        )
+        if seed is None:
+            # fresh entropy per unseeded request — two users must not share a stream
+            seed = int.from_bytes(__import__("os").urandom(4), "little")
+        sess = Session(
+            kv=kv,
+            pos=0,
+            key=jax.random.key(seed),
+            counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
+        )
+        self.sessions[nonce] = sess
+        return sess
+
+    def end_session(self, nonce: str) -> None:
+        self.sessions.pop(nonce, None)
+
+    def sweep_sessions(self) -> int:
+        now = time.time()
+        dead = [n for n, s in self.sessions.items() if now - s.last_used > self.kv_ttl_s]
+        for n in dead:
+            del self.sessions[n]
+        return len(dead)
+
+    def reset(self) -> None:
+        self.sessions.clear()
+
+    # ---- inference ----------------------------------------------------
+    def prefill(self, nonce: str, prompt_ids: Sequence[int], seed: Optional[int] = None):
+        """Run the prompt; returns logits at the last real position.
+
+        Reusing a live session continues at sess.pos (chunked prefill).
+        """
+        sess = self.sessions.get(nonce) or self.new_session(nonce, seed)
+        T = len(prompt_ids)
+        if T == 0:
+            raise ValueError("empty prompt")
+        if sess.pos + T > self.max_seq:
+            raise ValueError(
+                f"prompt length {sess.pos + T} exceeds max_seq {self.max_seq}"
+            )
+        Tpad = min(bucket_length(T), self.max_seq)
+        tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
+        tokens[:, :T] = np.asarray(prompt_ids, dtype=np.int32)
+        logits, sess.kv = self._forward(
+            self.window_params, self.edge_params, jnp.asarray(tokens), sess.kv,
+            jnp.int32(sess.pos), jnp.int32(T - 1),
+        )
+        ids = jnp.asarray(np.asarray(prompt_ids, dtype=np.int32))
+        sess.counts = sess.counts.at[:, ids].add(1)
+        sess.pos += T
+        sess.last_used = time.time()
+        return logits
+
+    def decode_step(self, nonce: str, token_id: int, decoding: DecodingParams) -> SampleResult:
+        sess = self.sessions[nonce]
+        if sess.pos >= self.max_seq:
+            raise ValueError(
+                f"sequence length {sess.pos} reached max_seq {self.max_seq}"
+            )
+        sess.key, step_key = jax.random.split(sess.key)
+        sp = SampleParams.from_decoding(decoding)
+        token = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
+        res, sess.kv, sess.counts = self._decode(
+            self.window_params, self.edge_params, token, sess.kv,
+            jnp.int32(sess.pos), sp, step_key, sess.counts,
+        )
+        sess.pos += 1
+        sess.last_used = time.time()
+        return res
+
+    def generate(
+        self,
+        prompt_ids: Sequence[int],
+        decoding: Optional[DecodingParams] = None,
+        max_tokens: int = 256,
+        eos_token_ids: Optional[set[int]] = None,
+        nonce: str = "local",
+    ) -> Iterator[TokenResult]:
+        """Greedy/sampled autoregressive generation, yielding per-token results."""
+        decoding = decoding or DecodingParams()
+        eos = eos_token_ids or set()
+        self.end_session(nonce)
+        sess = self.new_session(nonce, decoding.seed)
+
+        logits = self.prefill(nonce, prompt_ids, decoding.seed)
+        sess.key, k0 = jax.random.split(sess.key)
+        res = sample(logits, SampleParams.from_decoding(decoding), k0, token_counts=sess.counts)
+        token = int(res.token[0])
+        sess.counts = sess.counts.at[:, token].add(1)
+        yield self._token_result(nonce, res, step=0, decoding=decoding)
+        if token in eos:
+            self.end_session(nonce)
+            return
+
+        for step in range(1, max_tokens):
+            if sess.pos >= self.max_seq:
+                break  # cache capacity reached: stop cleanly (finish_reason=length)
+            res = self.decode_step(nonce, token, decoding)
+            token = int(res.token[0])
+            yield self._token_result(nonce, res, step=step, decoding=decoding)
+            if token in eos:
+                break
+        self.end_session(nonce)
+
+    @staticmethod
+    def _token_result(nonce: str, res: SampleResult, step: int, decoding: DecodingParams) -> TokenResult:
+        top = None
+        if decoding.logprobs and decoding.top_logprobs > 0:
+            n = min(decoding.top_logprobs, res.top_tokens.shape[-1])
+            top = list(
+                zip(
+                    np.asarray(res.top_tokens[0, :n]).tolist(),
+                    np.asarray(res.top_logprobs[0, :n]).tolist(),
+                )
+            )
+        return TokenResult(
+            nonce=nonce,
+            token_id=int(res.token[0]),
+            logprob=float(res.logprob[0]) if decoding.logprobs else None,
+            top_logprobs=top,
+            step=step,
+        )

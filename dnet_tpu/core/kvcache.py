@@ -1,0 +1,96 @@
+"""KV cache: preallocated, jit-friendly, layer-stacked.
+
+Layout: k/v are [L_local, B, S_max, KVH, Hd] so a window of layers scans with
+the cache as `lax.scan` xs/ys and a single `dynamic_update_slice` per layer
+writes the new tokens.  Static S_max keeps every decode step the same XLA
+program (the reference recompiles nothing either — mlx grows caches
+imperatively; on TPU preallocation is the idiomatic answer, and S_max is part
+of the solver's memory model exactly like the reference's kv_bits,
+src/dnet/shard/runtime.py:204-214).
+
+Sliding-window layers use a rotating write (pos % window) — the analog of
+mlx-lm's RotatingKVCache used by the reference for GPT-OSS
+(src/dnet/utils/model.py:470-555).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class KVConfig:
+    n_layers: int  # local layers in this cache
+    batch: int
+    max_seq: int
+    n_kv_heads: int
+    head_dim: int
+    dtype: str = "bfloat16"
+    sliding_window: int = 0  # 0 = full cache; >0 = ring buffer of this size
+
+
+def init_cache(cfg: KVConfig) -> dict:
+    seq = cfg.sliding_window if cfg.sliding_window > 0 else cfg.max_seq
+    shape = (cfg.n_layers, cfg.batch, seq, cfg.n_kv_heads, cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    return {
+        "k": jnp.zeros(shape, dtype=dt),
+        "v": jnp.zeros(shape, dtype=dt),
+    }
+
+
+def cache_nbytes(cfg: KVConfig) -> int:
+    seq = cfg.sliding_window if cfg.sliding_window > 0 else cfg.max_seq
+    n = cfg.n_layers * cfg.batch * seq * cfg.n_kv_heads * cfg.head_dim
+    return 2 * n * jnp.dtype(cfg.dtype).itemsize
+
+
+def update_layer(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write k_new/v_new ([B, T, KVH, Hd]) at sequence offset `pos`.
+
+    Single-layer slices ([B, S, KVH, Hd]).  `pos` may be traced.
+    """
+    start = (0, pos, 0, 0)
+    k_cache = lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), start)
+    v_cache = lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), start)
+    return k_cache, v_cache
+
+
+def update_layer_rotating(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+    window: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Ring-buffer write for sliding-window layers (one token at a time in
+    decode; prefill handles arbitrary T by scattering each token)."""
+    T = k_new.shape[1]
+
+    def write_one(i, caches):
+        kc, vc = caches
+        slot = (pos + i) % window
+        k_i = lax.dynamic_slice_in_dim(k_new, i, 1, axis=1)
+        v_i = lax.dynamic_slice_in_dim(v_new, i, 1, axis=1)
+        kc = lax.dynamic_update_slice(kc, k_i.astype(kc.dtype), (0, slot, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v_i.astype(vc.dtype), (0, slot, 0, 0))
+        return kc, vc
+
+    return lax.fori_loop(0, T, write_one, (k_cache, v_cache))
+
+
+def batched_gather_cache(cache: dict, indices: jnp.ndarray) -> dict:
+    """Select batch rows (for future batched scheduling)."""
+    return jax.tree.map(lambda a: a[:, indices], cache)

@@ -1,0 +1,131 @@
+"""On-device token sampling: temperature / top-k / top-p / min-p + logprobs.
+
+One jitted function serves every request: all decoding knobs are traced
+scalars (not static args), so changing temperature or top_p never recompiles
+— the fix for the reference's "end-shard sampling under jit" hard part
+(SURVEY.md §7).  Greedy vs stochastic is a `jnp.where` select, top-k with a
+*traced* k uses a rank threshold over a single descending sort shared by all
+filters.  Functionality mirrors the reference's mlx_lm-based Sampler
+(src/dnet/core/decoding/sampler.py:14-65).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dnet_tpu.core.types import DecodingParams
+
+MAX_TOP_LOGPROBS = 20  # static upper bound (OpenAI API max); request slices host-side
+
+
+class SampleParams(NamedTuple):
+    """Traced sampling knobs (all jnp scalars inside jit)."""
+
+    temperature: jnp.ndarray
+    top_p: jnp.ndarray
+    top_k: jnp.ndarray  # int32; 0 disables
+    min_p: jnp.ndarray
+    repetition_penalty: jnp.ndarray  # 1.0 disables
+
+    @classmethod
+    def from_decoding(cls, d: DecodingParams) -> "SampleParams":
+        return cls(
+            temperature=jnp.float32(d.temperature),
+            top_p=jnp.float32(d.top_p),
+            top_k=jnp.int32(d.top_k),
+            min_p=jnp.float32(d.min_p),
+            repetition_penalty=jnp.float32(d.repetition_penalty),
+        )
+
+
+class SampleResult(NamedTuple):
+    token: jnp.ndarray  # [B] int32
+    logprob: jnp.ndarray  # [B] f32, log-softmax of raw logits at token
+    top_tokens: jnp.ndarray  # [B, MAX_TOP_LOGPROBS] int32
+    top_logprobs: jnp.ndarray  # [B, MAX_TOP_LOGPROBS] f32
+
+
+def sample(
+    logits: jnp.ndarray,
+    params: SampleParams,
+    key: jax.Array,
+    token_counts: Optional[jnp.ndarray] = None,
+) -> SampleResult:
+    """logits [B, V] -> sampled tokens with logprobs.
+
+    Filter semantics (matching mlx_lm's make_sampler composition used by the
+    reference): repetition penalty over seen tokens, scale by temperature,
+    keep top-k, keep smallest prefix with cumulative prob >= top_p, drop
+    tokens below min_p * p_max, sample.  temperature == 0 -> greedy argmax.
+    """
+    if token_counts is not None:
+        logits = apply_repetition_penalty(
+            logits, token_counts, params.repetition_penalty
+        )
+    B, V = logits.shape
+    raw_logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    temp = jnp.maximum(params.temperature, 1e-6)
+    scaled = logits.astype(jnp.float32) / temp
+
+    # One descending sort powers top-k, top-p and min-p.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] desc
+    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[:, ::-1], axis=-1)  # rank of each vocab id
+
+    # top-k: keep ranks < k (k==0 -> keep all)
+    k = jnp.where(params.top_k > 0, params.top_k, V)
+    keep_topk = ranks < k
+
+    # top-p over the sorted distribution: keep the smallest prefix with
+    # cumsum >= top_p (always keep rank 0).
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    prefix_keep_sorted = (cumprobs - sorted_probs) < params.top_p  # exclusive cumsum < p
+    keep_topp = jnp.take_along_axis(prefix_keep_sorted, ranks, axis=-1)
+
+    # min-p: probability >= min_p * max prob
+    probs = jax.nn.softmax(scaled, axis=-1)
+    pmax = jnp.max(probs, axis=-1, keepdims=True)
+    keep_minp = probs >= params.min_p * pmax
+
+    keep = keep_topk & keep_topp & keep_minp
+    # never mask everything: rank-0 always kept
+    keep = keep | (ranks == 0)
+    masked = jnp.where(keep, scaled, -jnp.inf)
+
+    gumbel = jax.random.gumbel(key, masked.shape, dtype=jnp.float32)
+    stochastic = jnp.argmax(masked + gumbel, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    token = jnp.where(params.temperature <= 0.0, greedy, stochastic).astype(jnp.int32)
+
+    logprob = jnp.take_along_axis(raw_logprobs, token[:, None], axis=-1)[:, 0]
+    n_top = min(MAX_TOP_LOGPROBS, V)
+    top_lp, top_ids = jax.lax.top_k(raw_logprobs, n_top)
+    if n_top < MAX_TOP_LOGPROBS:  # tiny-vocab tests: pad to the static width
+        pad = MAX_TOP_LOGPROBS - n_top
+        top_lp = jnp.pad(top_lp, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+        top_ids = jnp.pad(top_ids, ((0, 0), (0, pad)))
+    return SampleResult(token, logprob, top_ids.astype(jnp.int32), top_lp)
+
+
+@partial(jax.jit, static_argnames=())
+def sample_jit(logits: jnp.ndarray, params: SampleParams, key: jax.Array) -> SampleResult:
+    return sample(logits, params, key)
+
+
+def apply_repetition_penalty(
+    logits: jnp.ndarray, token_counts: jnp.ndarray, penalty: jnp.ndarray
+) -> jnp.ndarray:
+    """CTRL-style repetition penalty from a per-vocab count buffer.
+
+    token_counts: [B, V] int32 counts of generated/context tokens.
+    penalty 1.0 = disabled.
+    """
+    seen = token_counts > 0
+    lf = logits.astype(jnp.float32)
+    penalized = jnp.where(lf > 0, lf / penalty, lf * penalty)
+    return jnp.where(seen, penalized, lf).astype(logits.dtype)

@@ -1,0 +1,170 @@
+"""Layer-wise ring model abstraction.
+
+The TPU analog of the reference's `BaseRingModel`
+(src/dnet/core/models/base.py:19-109): a shard constructs a model over only
+its *assigned* absolute layers and exposes edge ops (embed / normalize /
+lm_project) plus windowed layer application.  Unlike the reference's
+stateful mlx modules, everything here is functional: parameters are pytrees
+of arrays, `apply_window` is a pure function scanned over layer-stacked
+params, so it jits/shards/donates cleanly.
+
+Parameter layout:
+  params = {
+    "embed":      {...}            # only on the shard holding layer 0
+    "final_norm": {...}, "lm_head": {...}   # only on the last shard
+    "windows":    {window_start: stacked-layer pytree}
+  }
+Stacked-layer pytrees have a leading layer axis so a window runs as one
+`lax.scan` (MXU-friendly, one compiled program regardless of window size).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dnet_tpu.core.kvcache import KVConfig
+
+
+@dataclass
+class ModelConfig:
+    """Normalized HF config (config.json) subset shared across families."""
+
+    model_type: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_hidden_layers: int
+    num_attention_heads: int
+    num_key_value_heads: int
+    head_dim: int
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[dict] = None
+    tie_word_embeddings: bool = False
+    max_position_embeddings: int = 8192
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    sliding_window: int = 0
+    layer_types: Optional[List[str]] = None  # e.g. ["sliding_attention", "full_attention", ...]
+    # MoE (gpt-oss / mixtral style)
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 0
+    # MLA (deepseek style) and other family-specific extras
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_hf(cls, d: Dict[str, Any]) -> "ModelConfig":
+        heads = d["num_attention_heads"]
+        head_dim = d.get("head_dim") or d["hidden_size"] // heads
+        return cls(
+            model_type=d["model_type"],
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d.get("intermediate_size", 4 * d["hidden_size"]),
+            num_hidden_layers=d["num_hidden_layers"],
+            num_attention_heads=heads,
+            num_key_value_heads=d.get("num_key_value_heads", heads),
+            head_dim=head_dim,
+            rms_norm_eps=d.get("rms_norm_eps", 1e-5),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=d.get("rope_scaling"),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            max_position_embeddings=d.get("max_position_embeddings", 8192),
+            attention_bias=d.get("attention_bias", False),
+            mlp_bias=d.get("mlp_bias", False),
+            sliding_window=d.get("sliding_window") or 0,
+            layer_types=d.get("layer_types"),
+            num_local_experts=d.get("num_local_experts", 0),
+            num_experts_per_tok=d.get("num_experts_per_tok", 0),
+            extra=d,
+        )
+
+
+class RingModel(abc.ABC):
+    """A shard's view of a model: assigned layers + edge ops.
+
+    Subclasses set `model_type` and implement the pure compute functions and
+    the HF-name weight mapping.  Instances hold *no* parameters — params are
+    passed to every call (functional style), so the weight-streaming policy
+    owns residency.
+    """
+
+    model_type: str = ""
+
+    def __init__(self, config: ModelConfig, layers: Sequence[int]):
+        self.config = config
+        self.layers = sorted(set(int(x) for x in layers))
+        self.abs_to_local = {a: i for i, a in enumerate(self.layers)}
+        self.is_first = 0 in self.abs_to_local
+        self.is_last = (config.num_hidden_layers - 1) in self.abs_to_local
+
+    # ---- pure compute -------------------------------------------------
+    @abc.abstractmethod
+    def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        """tokens [B, T] -> hidden [B, T, D]."""
+
+    @abc.abstractmethod
+    def apply_window(
+        self,
+        window_params: dict,
+        x: jnp.ndarray,
+        kv: dict,
+        pos: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        layer_kinds: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, dict]:
+        """Apply a stacked window of layers. kv holds this window's slices."""
+
+    @abc.abstractmethod
+    def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        """Final norm before the LM head."""
+
+    @abc.abstractmethod
+    def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        """hidden [B, T, D] -> logits [B, T, V]."""
+
+    # ---- weight mapping ----------------------------------------------
+    @abc.abstractmethod
+    def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """HF per-layer tensors (prefix `model.layers.{i}.` stripped) -> our
+        per-layer param dict (unstacked)."""
+
+    @abc.abstractmethod
+    def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """HF non-layer tensors -> {"embed": ..., "final_norm": ..., "lm_head": ...}."""
+
+    # ---- cache construction ------------------------------------------
+    def kv_config(
+        self, n_layers: int, batch: int, max_seq: int, dtype: str = "bfloat16"
+    ) -> KVConfig:
+        return KVConfig(
+            n_layers=n_layers,
+            batch=batch,
+            max_seq=max_seq,
+            n_kv_heads=self.config.num_key_value_heads,
+            head_dim=self.config.head_dim,
+            dtype=dtype,
+        )
+
+    # ---- helpers ------------------------------------------------------
+    @staticmethod
+    def stack_layers(per_layer: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        """Stack N per-layer param dicts along a new leading axis."""
+        if not per_layer:
+            return {}
+        keys = per_layer[0].keys()
+        return {k: np.stack([p[k] for p in per_layer], axis=0) for k in keys}
+
+    def local_window(self, start_abs: int, size: int) -> List[int]:
+        """The contiguous run of assigned layers beginning at start_abs."""
+        out = []
+        a = start_abs
+        while a in self.abs_to_local and len(out) < size:
+            out.append(a)
+            a += 1
+        return out

@@ -1,0 +1,118 @@
+"""Llama-family ring model (Llama 2/3.x, Hermes, etc.).
+
+TPU-first re-design of the reference's `LlamaRingModel`
+(src/dnet/core/models/llama.py:41-117): layers are stacked along a leading
+axis and applied with one `lax.scan` per window (one XLA program per window
+size, MXU-sized matmuls), weights live as (in, out)-oriented matrices so the
+hot path is `x @ W` with no transposes, and rotary tables are closed over as
+constants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dnet_tpu.models.base import ModelConfig, RingModel
+from dnet_tpu.ops.attention import attend, causal_mask
+from dnet_tpu.ops.norms import rms_norm
+from dnet_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+class LlamaRingModel(RingModel):
+    model_type = "llama"
+
+    def __init__(self, config: ModelConfig, layers):
+        super().__init__(config, layers)
+        self.inv_freq = jnp.asarray(
+            rope_frequencies(config.head_dim, config.rope_theta, config.rope_scaling)
+        )
+
+    # ---- pure compute -------------------------------------------------
+    def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        return edge_params["embed"]["weight"][tokens]
+
+    def _layer(self, p: dict, x: jnp.ndarray, kc, vc, pos, mask):
+        cfg = self.config
+        B, T, D = x.shape
+        H, KVH, Hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ p["wq"]).reshape(B, T, H, Hd)
+        k = (h @ p["wk"]).reshape(B, T, KVH, Hd)
+        v = (h @ p["wv"]).reshape(B, T, KVH, Hd)
+        positions = pos + jnp.arange(T)
+        q = apply_rope(q, positions, self.inv_freq)
+        k = apply_rope(k, positions, self.inv_freq)
+        kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+        attn = attend(q, kc, vc, mask=mask)
+        x = x + attn.reshape(B, T, H * Hd) @ p["wo"]
+
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        gate = h @ p["w_gate"]
+        up = h @ p["w_up"]
+        x = x + (jax.nn.silu(gate) * up) @ p["w_down"]
+        return x, kc, vc
+
+    def apply_window(
+        self,
+        window_params: dict,
+        x: jnp.ndarray,
+        kv: dict,
+        pos: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        layer_kinds: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, dict]:
+        if mask is None:
+            mask = causal_mask(x.shape[1], kv["k"].shape[2], pos)
+
+        def body(carry, per_layer):
+            xc = carry
+            p, kc, vc = per_layer
+            xc, kc, vc = self._layer(p, xc, kc, vc, pos, mask)
+            return xc, (kc, vc)
+
+        x, (k_out, v_out) = lax.scan(body, x, (window_params, kv["k"], kv["v"]))
+        return x, {"k": k_out, "v": v_out}
+
+    def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)
+
+    def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        if self.config.tie_word_embeddings:
+            w = edge_params["embed"]["weight"].T
+        else:
+            w = edge_params["lm_head"]["weight"]
+        return x @ w
+
+    # ---- weight mapping ----------------------------------------------
+    def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        def t(name: str) -> np.ndarray:
+            return np.ascontiguousarray(raw[name].T)  # HF [out,in] -> (in,out)
+
+        return {
+            "attn_norm": raw["input_layernorm.weight"],
+            "wq": t("self_attn.q_proj.weight"),
+            "wk": t("self_attn.k_proj.weight"),
+            "wv": t("self_attn.v_proj.weight"),
+            "wo": t("self_attn.o_proj.weight"),
+            "mlp_norm": raw["post_attention_layernorm.weight"],
+            "w_gate": t("mlp.gate_proj.weight"),
+            "w_up": t("mlp.up_proj.weight"),
+            "w_down": t("mlp.down_proj.weight"),
+        }
+
+    def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if "model.embed_tokens.weight" in raw:
+            out["embed"] = {"weight": raw["model.embed_tokens.weight"]}
+        if "model.norm.weight" in raw:
+            out["final_norm"] = {"weight": raw["model.norm.weight"]}
+        if "lm_head.weight" in raw:
+            out["lm_head"] = {"weight": np.ascontiguousarray(raw["lm_head.weight"].T)}
+        return out

@@ -1,0 +1,84 @@
+"""Attention ops: GQA scaled-dot-product with causal / sliding-window masks.
+
+Pure-XLA reference path (einsum + f32 softmax — XLA fuses the mask and
+softmax into the matmuls on TPU); a Pallas flash kernel can swap in behind
+`attend` without touching callers.  Covers what the reference gets from
+mlx-lm's `scaled_dot_product_attention` plus the GPT-OSS-style dual
+full/sliding masks (reference: src/dnet/core/models/gpt_oss.py:111-170).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free on fully-masked rows
+
+
+def causal_mask(q_len: int, kv_len: int, q_offset) -> jnp.ndarray:
+    """[q_len, kv_len] boolean mask; True = attend.
+
+    q_offset: absolute position of the first query (traced or static).
+    Query i (absolute q_offset+i) may attend keys at absolute positions
+    <= q_offset+i.
+    """
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return kv_pos <= q_pos
+
+
+def sliding_window_mask(q_len: int, kv_len: int, q_offset, window: int) -> jnp.ndarray:
+    """Causal mask further restricted to the last `window` keys."""
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos <= q_pos) & (kv_pos > q_pos - window)
+
+
+def attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Grouped-query attention.
+
+    q: [B, T, H, Hd];  k, v: [B, S, KVH, Hd] with H % KVH == 0.
+    mask: broadcastable to [B, T, S] or [T, S]; True = attend.
+    sinks: optional per-head attention-sink logits [H] (GPT-OSS style): a
+      virtual key that absorbs probability mass but contributes no value.
+    Returns [B, T, H, Hd] in q.dtype (softmax in f32).
+    """
+    B, T, H, Hd = q.shape
+    S, KVH = k.shape[1], k.shape[2]
+    G = H // KVH
+    scale = scale if scale is not None else Hd**-0.5
+
+    qf = q.reshape(B, T, KVH, G, Hd).astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qf, kf)  # [B, KVH, G, T, S]
+
+    if mask is not None:
+        if mask.ndim == 2:
+            m = mask[None, None, None, :, :]
+        else:  # [B, T, S]
+            m = mask[:, None, None, :, :]
+        scores = jnp.where(m, scores, NEG_INF)
+
+    if sinks is not None:
+        sink = sinks.astype(jnp.float32).reshape(KVH, G)[None, :, :, None, None]
+        sink = jnp.broadcast_to(sink, (B, KVH, G, T, 1))
+        scores = jnp.concatenate([scores, sink], axis=-1)
+        probs = jnp.exp(
+            scores - jnp.max(scores, axis=-1, keepdims=True)
+        )
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        probs = probs[..., :-1]  # drop the sink column (no value)
+    else:
+        probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, T, H, Hd).astype(q.dtype)

@@ -1,0 +1,17 @@
+"""Normalization layers (functional, f32 accumulation on the VPU)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm with float32 accumulation, cast back to x.dtype.
+
+    Matches HF LlamaRMSNorm: y = w * x / sqrt(mean(x^2) + eps).
+    """
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

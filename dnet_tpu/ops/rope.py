@@ -1,0 +1,68 @@
+"""Rotary position embeddings (HF-compatible, incl. Llama-3 scaling).
+
+Frequencies are computed once per model config and closed over by the jitted
+step, so inside jit this is two multiplies and an add on the VPU — no tables
+in HBM.  Covers the rope variants the reference inherits from mlx-lm's llama/
+qwen3 models (reference: src/dnet/core/models/llama.py:106-117 drops HF
+`rotary_emb.inv_freq` and recomputes, as we do).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: Optional[dict[str, Any]] = None,
+) -> np.ndarray:
+    """inv_freq [head_dim//2] with optional HF `rope_scaling` applied."""
+    inv_freq = 1.0 / (
+        theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
+    )
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type", ""))
+        if rope_type == "llama3":
+            factor = scaling.get("factor", 8.0)
+            low_factor = scaling.get("low_freq_factor", 1.0)
+            high_factor = scaling.get("high_freq_factor", 4.0)
+            old_len = scaling.get("original_max_position_embeddings", 8192)
+            low_wavelen = old_len / low_factor
+            high_wavelen = old_len / high_factor
+            wavelen = 2 * math.pi / inv_freq
+            scaled = np.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+            smooth = (old_len / wavelen - low_factor) / (high_factor - low_factor)
+            mid = (1 - smooth) * inv_freq / factor + smooth * inv_freq
+            is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+            inv_freq = np.where(is_mid, mid, scaled)
+        elif rope_type in ("linear",):
+            inv_freq = inv_freq / scaling.get("factor", 1.0)
+        # "default"/None: unscaled
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate q or k.
+
+    x: [B, T, N, head_dim] (head_dim even, half-split convention as in HF).
+    positions: [B, T] or [T] absolute token positions.
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    if angles.ndim == 2:  # [T, D/2] -> broadcast over batch
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

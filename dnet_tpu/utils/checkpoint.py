@@ -1,0 +1,136 @@
+"""Safetensors checkpoint access: lazy, per-layer, mmap-backed.
+
+The TPU analog of the reference's model metadata subsystem
+(src/dnet/utils/model.py:388-467): parse safetensors headers without loading
+data, classify tensors into per-layer / embed / final-norm / lm-head groups,
+and load only what a shard's assignment needs.  `safetensors.safe_open`
+gives zero-copy mmap reads, so "load layer i" touches only that layer's
+byte-ranges — the role madvise/MappedFile plays in the reference
+(src/dnet/utils/layer_manager.py:107-215).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+from safetensors import safe_open
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+
+class Checkpoint:
+    """An HF-format model directory: config.json + *.safetensors [+ index]."""
+
+    def __init__(self, model_dir: str | Path):
+        self.dir = Path(model_dir)
+        cfg_path = self.dir / "config.json"
+        if not cfg_path.is_file():
+            raise FileNotFoundError(f"no config.json in {self.dir}")
+        self.config: dict = json.loads(cfg_path.read_text())
+
+        # tensor name -> file path
+        self.tensor_file: Dict[str, Path] = {}
+        index = self.dir / "model.safetensors.index.json"
+        if index.is_file():
+            weight_map = json.loads(index.read_text())["weight_map"]
+            for name, fname in weight_map.items():
+                self.tensor_file[name] = self.dir / fname
+        else:
+            files = sorted(self.dir.glob("*.safetensors"))
+            if not files:
+                raise FileNotFoundError(f"no .safetensors in {self.dir}")
+            for f in files:
+                with safe_open(f, framework="numpy") as st:
+                    for name in st.keys():
+                        self.tensor_file[name] = f
+
+        # classify
+        self.layer_tensors: Dict[int, Dict[str, str]] = {}  # layer -> suffix -> full name
+        self.edge_tensors: Dict[str, str] = {}
+        for name in self.tensor_file:
+            m = _LAYER_RE.match(name)
+            if m:
+                self.layer_tensors.setdefault(int(m.group(1)), {})[m.group(2)] = name
+            else:
+                self.edge_tensors[name] = name
+
+        self._handles: Dict[Path, object] = {}
+
+    # ---- metadata -----------------------------------------------------
+    @property
+    def num_layers(self) -> int:
+        return int(self.config["num_hidden_layers"])
+
+    def _handle(self, path: Path):
+        h = self._handles.get(path)
+        if h is None:
+            h = safe_open(path, framework="numpy")
+            self._handles[path] = h
+        return h
+
+    def tensor_meta(self, name: str) -> tuple[list[int], str]:
+        sl = self._handle(self.tensor_file[name]).get_slice(name)
+        return list(sl.get_shape()), str(sl.get_dtype())
+
+    def layer_nbytes(self, layer: int) -> int:
+        """Byte size of one layer's tensors, from headers only (solver input)."""
+        total = 0
+        for full in self.layer_tensors.get(layer, {}).values():
+            shape, dtype = self.tensor_meta(full)
+            itemsize = _dtype_size(dtype)
+            n = 1
+            for s in shape:
+                n *= s
+            total += n * itemsize
+        return total
+
+    # ---- loading ------------------------------------------------------
+    def load_tensor(self, name: str) -> np.ndarray:
+        return self._handle(self.tensor_file[name]).get_tensor(name)
+
+    def load_layer_raw(self, layer: int) -> Dict[str, np.ndarray]:
+        """One layer's tensors keyed by suffix (prefix stripped)."""
+        if layer not in self.layer_tensors:
+            raise KeyError(f"layer {layer} not in checkpoint")
+        return {
+            suffix: self.load_tensor(full)
+            for suffix, full in self.layer_tensors[layer].items()
+        }
+
+    def load_edge_raw(self, names: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        """Non-layer tensors (embed/final-norm/lm-head), all or a subset."""
+        keys = names if names is not None else list(self.edge_tensors)
+        return {k: self.load_tensor(k) for k in keys if k in self.edge_tensors}
+
+    def close(self) -> None:
+        self._handles.clear()
+
+
+_SAFETENSOR_SIZES = {
+    "F64": 8, "F32": 4, "F16": 2, "BF16": 2,
+    "I64": 8, "I32": 4, "I16": 2, "I8": 1, "U8": 1, "BOOL": 1,
+    "F8_E4M3": 1, "F8_E5M2": 1, "U32": 4, "U16": 2, "U64": 8,
+}
+
+
+def _dtype_size(dtype: str) -> int:
+    key = dtype.upper().removeprefix("DTYPE.")
+    if key in _SAFETENSOR_SIZES:
+        return _SAFETENSOR_SIZES[key]
+    return np.dtype(dtype.lower()).itemsize
+
+
+def save_checkpoint(
+    model_dir: str | Path, config: dict, tensors: Dict[str, np.ndarray]
+) -> None:
+    """Write an HF-style single-file checkpoint (tests + repack use this)."""
+    from safetensors.numpy import save_file
+
+    d = Path(model_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "config.json").write_text(json.dumps(config, indent=2))
+    save_file(tensors, d / "model.safetensors")

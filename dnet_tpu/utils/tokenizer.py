@@ -1,0 +1,136 @@
+"""Tokenizer access: HF tokenizer from a local dir, byte-level fallback.
+
+The reference loads tokenizers via mlx_lm on the API node
+(src/dnet/api/model_manager.py:169-182).  Here: `transformers.AutoTokenizer`
+when tokenizer files exist locally; otherwise a self-contained byte-level
+tokenizer (vocab 256 + BOS/EOS) so tests and air-gapped runs never need the
+Hub.  Both expose the same minimal surface: encode / decode / chat template /
+eos_token_ids, plus an incremental `Detokenizer` for SSE streaming.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: token = byte value; 256=BOS, 257=EOS."""
+
+    vocab_size = 258
+    bos_token_id = 256
+    eos_token_id = 257
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_token_id] if add_bos else []) + ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def eos_token_ids(self) -> set[int]:
+        return {self.eos_token_id}
+
+    def apply_chat_template(self, messages: List[dict], add_generation_prompt: bool = True) -> str:
+        parts = [f"<|{m['role']}|>\n{m['content']}" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "\n".join(parts)
+
+
+class HFTokenizer:
+    """Thin wrapper over transformers.AutoTokenizer (local files only)."""
+
+    def __init__(self, model_dir: str | Path):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(str(model_dir), local_files_only=True)
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    @property
+    def eos_token_ids(self) -> set[int]:
+        ids = set()
+        if self._tok.eos_token_id is not None:
+            ids.add(int(self._tok.eos_token_id))
+        # llama-3 style generation config may add more; config.json eos can be a list
+        extra = getattr(self._tok, "additional_eos_token_ids", None)
+        if extra:
+            ids.update(int(i) for i in extra)
+        return ids
+
+    def apply_chat_template(self, messages: List[dict], add_generation_prompt: bool = True) -> str:
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            )
+        parts = [f"<|{m['role']}|>\n{m['content']}" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "\n".join(parts)
+
+
+def load_tokenizer(model_dir: Optional[str | Path]):
+    """HF tokenizer if the dir has tokenizer files, else ByteTokenizer.
+
+    When tokenizer files exist but fail to load, that is an error — silently
+    byte-encoding against a real model's vocab would corrupt every request.
+    """
+    if model_dir:
+        d = Path(model_dir)
+        if any(
+            (d / f).is_file()
+            for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json")
+        ):
+            return HFTokenizer(d)
+    return ByteTokenizer()
+
+
+class Detokenizer:
+    """Incremental detokenizer for SSE streaming: feed token ids, get text
+    deltas, holding back bytes that may be a partial multi-byte char.
+
+    Reference analog: the detokenizer incremental-delta loop in
+    src/dnet/api/inference.py:179-212.
+    """
+
+    TAIL = 16  # ids kept in the working window (enough for any multi-byte char run)
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []  # working tail window only — O(1) per token
+        self._done = ""  # text already finalized out of the window
+        self._emitted_len = 0  # chars emitted so far (over done + window text)
+
+    def _window_text(self) -> str:
+        full = self._tok.decode(self._ids)
+        return full[:-1] if full.endswith("�") else full
+
+    def add(self, token_id: int) -> str:
+        self._ids.append(int(token_id))
+        if len(self._ids) > 2 * self.TAIL:
+            # Finalize the old half of the window — but never split inside a
+            # multi-byte char (delay if the head decodes to a partial char).
+            head = self._ids[: self.TAIL]
+            head_text = self._tok.decode(head)
+            if not head_text.endswith("�"):
+                self._ids = self._ids[self.TAIL:]
+                self._done += head_text
+        total = self._done + self._window_text()
+        delta = total[self._emitted_len:]
+        if delta:
+            self._emitted_len = len(total)
+        return delta
+
+    def flush(self) -> str:
+        total = self._done + self._tok.decode(self._ids)
+        delta = total[self._emitted_len:]
+        self._emitted_len = len(total)
+        return delta

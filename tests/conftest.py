@@ -6,12 +6,19 @@ sharding (pp/tp/dp/sp over a Mesh) is exercised without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The image's sitecustomize imports jax and registers the TPU plugin before
+# pytest starts, so env vars alone are too late — force the platform through
+# jax.config before the first backend use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -20,6 +27,16 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def tiny_llama_dir(tmp_path_factory):
+    """Session-scoped tiny random-weight Llama checkpoint."""
+    from tests.fakes.checkpoints import make_tiny_llama
+
+    d = tmp_path_factory.mktemp("tiny_llama")
+    make_tiny_llama(d)
+    return d
 
 
 @pytest.fixture(scope="session")

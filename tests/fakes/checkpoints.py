@@ -1,0 +1,73 @@
+"""Tiny locally-generated HF-format checkpoints (no network, ever).
+
+The analog of the reference's generated-safetensors test fixtures
+(tests/test_layer_manager.py pattern): random-weight models small enough to
+cross-check against `transformers` on CPU.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from dnet_tpu.utils.checkpoint import save_checkpoint
+
+TINY_LLAMA_CONFIG = {
+    "architectures": ["LlamaForCausalLM"],
+    "model_type": "llama",
+    "vocab_size": 261,  # byte tokenizer: 256 bytes + bos/eos + pad to odd size on purpose
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0,
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "attention_bias": False,
+    "mlp_bias": False,
+    "hidden_act": "silu",
+    "torch_dtype": "float32",
+    "bos_token_id": 256,
+    "eos_token_id": 257,
+}
+
+
+def make_tiny_llama(model_dir: str | Path, config: dict | None = None, seed: int = 0) -> dict:
+    """Write a random-weight tiny Llama checkpoint; returns the config."""
+    cfg = dict(TINY_LLAMA_CONFIG)
+    if config:
+        cfg.update(config)
+    rng = np.random.default_rng(seed)
+    D = cfg["hidden_size"]
+    F = cfg["intermediate_size"]
+    V = cfg["vocab_size"]
+    H = cfg["num_attention_heads"]
+    KVH = cfg["num_key_value_heads"]
+    Hd = cfg.get("head_dim", D // H)
+
+    def w(*shape, scale=0.05):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+    }
+    if not cfg["tie_word_embeddings"]:
+        tensors["lm_head.weight"] = w(V, D)
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "self_attn.q_proj.weight"] = w(H * Hd, D)
+        tensors[p + "self_attn.k_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.v_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
+        tensors[p + "mlp.gate_proj.weight"] = w(F, D)
+        tensors[p + "mlp.up_proj.weight"] = w(F, D)
+        tensors[p + "mlp.down_proj.weight"] = w(D, F)
+    save_checkpoint(model_dir, cfg, tensors)
+    return cfg

@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+from dnet_tpu.core.types import DecodingParams
+
+pytestmark = pytest.mark.core
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_llama_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    return LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+
+
+def test_unseeded_requests_differ(engine):
+    ids = [256, 72, 105]
+    a = [r.token_id for r in engine.generate(ids, DecodingParams(temperature=1.5), max_tokens=10)]
+    b = [r.token_id for r in engine.generate(ids, DecodingParams(temperature=1.5), max_tokens=10)]
+    assert a != b  # astronomically unlikely to collide if entropy is fresh
+
+
+def test_seeded_requests_reproduce(engine):
+    ids = [256, 72, 105]
+    dec = DecodingParams(temperature=1.0, seed=7)
+    a = [r.token_id for r in engine.generate(ids, dec, max_tokens=8)]
+    b = [r.token_id for r in engine.generate(ids, dec, max_tokens=8)]
+    assert a == b
+
+
+def test_chunked_prefill_equals_whole(engine):
+    ids = [256, 84, 104, 101, 32, 99, 97, 116]
+    engine.end_session("w")
+    whole = np.asarray(engine.prefill("w", ids), np.float32)
+    engine.end_session("c")
+    engine.prefill("c", ids[:3])
+    chunked = np.asarray(engine.prefill("c", ids[3:]), np.float32)
+    np.testing.assert_allclose(chunked, whole, atol=1e-4, rtol=1e-4)
+    engine.end_session("w")
+    engine.end_session("c")
+
+
+def test_decode_past_capacity_raises(engine):
+    engine.end_session("cap")
+    engine.prefill("cap", list(range(10)))
+    sess = engine.sessions["cap"]
+    sess.pos = engine.max_seq
+    with pytest.raises(ValueError, match="max_seq"):
+        engine.decode_step("cap", 1, DecodingParams())
+    engine.end_session("cap")
+
+
+def test_generate_stops_at_capacity(engine):
+    ids = list(range(60))  # max_seq 64 -> only ~4 decode steps possible
+    toks = [r.token_id for r in engine.generate(ids, DecodingParams(), max_tokens=50)]
+    assert len(toks) <= 5
+
+
+def test_repetition_penalty_changes_output(engine):
+    ids = [256, 72, 105]
+    base = [r.token_id for r in engine.generate(ids, DecodingParams(temperature=0.0), max_tokens=12)]
+    pen = [
+        r.token_id
+        for r in engine.generate(
+            ids, DecodingParams(temperature=0.0, repetition_penalty=5.0), max_tokens=12
+        )
+    ]
+    assert base != pen
+
+
+def test_session_ttl_sweep(tiny_llama_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(tiny_llama_dir, max_seq=32, param_dtype="float32", kv_ttl_s=0.0)
+    eng.new_session("old")
+    import time
+
+    time.sleep(0.01)
+    assert eng.sweep_sessions() == 1
+    assert "old" not in eng.sessions

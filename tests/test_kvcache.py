@@ -1,0 +1,52 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnet_tpu.core.kvcache import (
+    KVConfig,
+    cache_nbytes,
+    init_cache,
+    update_layer,
+    update_layer_rotating,
+)
+
+pytestmark = pytest.mark.core
+
+
+def cfg(**kw):
+    base = dict(n_layers=2, batch=1, max_seq=16, n_kv_heads=2, head_dim=4)
+    base.update(kw)
+    return KVConfig(**base)
+
+
+def test_init_shape_dtype():
+    kv = init_cache(cfg(dtype="bfloat16"))
+    assert kv["k"].shape == (2, 1, 16, 2, 4)
+    assert str(kv["k"].dtype) == "bfloat16"
+
+
+def test_nbytes():
+    c = cfg(dtype="float32")
+    assert cache_nbytes(c) == 2 * 2 * 1 * 16 * 2 * 4 * 4
+
+
+def test_update_and_readback():
+    kv = init_cache(cfg(dtype="float32"))
+    k_new = jnp.ones((1, 3, 2, 4))
+    v_new = 2 * jnp.ones((1, 3, 2, 4))
+    k, v = update_layer(kv["k"][0], kv["v"][0], k_new, v_new, jnp.int32(5))
+    np.testing.assert_array_equal(np.asarray(k[0, 5:8]), np.ones((3, 2, 4)))
+    np.testing.assert_array_equal(np.asarray(k[0, :5]), np.zeros((5, 2, 4)))
+    np.testing.assert_array_equal(np.asarray(v[0, 5:8]), 2 * np.ones((3, 2, 4)))
+
+
+def test_rotating_wraps():
+    c = cfg(sliding_window=4, dtype="float32")
+    kv = init_cache(c)
+    assert kv["k"].shape[2] == 4
+    k_new = jnp.arange(6, dtype=jnp.float32).reshape(1, 6, 1, 1) * jnp.ones((1, 6, 2, 4))
+    v_new = k_new
+    k, v = update_layer_rotating(kv["k"][0], kv["v"][0], k_new, v_new, jnp.int32(0), 4)
+    # tokens 4,5 overwrote slots 0,1; slots 2,3 keep tokens 2,3
+    got = np.asarray(k[0, :, 0, 0])
+    np.testing.assert_array_equal(got, [4.0, 5.0, 2.0, 3.0])

@@ -1,0 +1,86 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnet_tpu.core.sampler import (
+    SampleParams,
+    apply_repetition_penalty,
+    sample,
+)
+from dnet_tpu.core.types import DecodingParams
+
+pytestmark = pytest.mark.core
+
+
+def params(**kw):
+    d = DecodingParams(**kw)
+    return SampleParams.from_decoding(d)
+
+
+def test_greedy():
+    logits = jnp.asarray([[0.1, 3.0, -1.0, 2.0]])
+    res = sample(logits, params(temperature=0.0), jax.random.key(0))
+    assert int(res.token[0]) == 1
+    # logprob is log_softmax at the token
+    ref = jax.nn.log_softmax(logits)[0, 1]
+    assert abs(float(res.logprob[0]) - float(ref)) < 1e-5
+
+
+def test_top_k_restricts_support():
+    logits = jnp.asarray([[5.0, 4.0, 3.0, 2.0, 1.0]])
+    seen = set()
+    for i in range(50):
+        res = sample(logits, params(temperature=2.0, top_k=2), jax.random.key(i))
+        seen.add(int(res.token[0]))
+    assert seen <= {0, 1}
+    assert len(seen) == 2  # with temp 2 both should appear
+
+
+def test_top_p_restricts_support():
+    # probs ~ [0.97, 0.01, ...] -> top_p=0.5 keeps only token 0
+    logits = jnp.asarray([[10.0, 5.0, 4.0, 3.0, 2.0]])
+    for i in range(20):
+        res = sample(logits, params(temperature=1.0, top_p=0.5), jax.random.key(i))
+        assert int(res.token[0]) == 0
+
+
+def test_min_p_restricts_support():
+    logits = jnp.asarray([[5.0, 5.0, 0.0, -5.0]])
+    for i in range(30):
+        res = sample(logits, params(temperature=1.0, min_p=0.5), jax.random.key(i))
+        assert int(res.token[0]) in {0, 1}
+
+
+def test_never_empty_support():
+    # aggressive filters still sample rank-0
+    logits = jnp.asarray([[1.0, 0.9, 0.8]])
+    res = sample(logits, params(temperature=1.0, top_p=1e-9, top_k=1, min_p=1.0), jax.random.key(0))
+    assert int(res.token[0]) == 0
+
+
+def test_top_logprobs_sorted():
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]])
+    res = sample(logits, params(temperature=0.0, logprobs=True, top_logprobs=4), jax.random.key(0))
+    ids = np.asarray(res.top_tokens[0])
+    lps = np.asarray(res.top_logprobs[0])[:4]  # width is padded to 8 with -inf
+    assert ids[0] == 3
+    assert np.all(np.diff(lps) <= 1e-7)
+
+
+def test_sampling_distribution_roughly_matches():
+    logits = jnp.asarray([[np.log(0.7), np.log(0.2), np.log(0.1)]])
+    counts = np.zeros(3)
+    n = 400
+    for i in range(n):
+        res = sample(logits, params(temperature=1.0), jax.random.key(i))
+        counts[int(res.token[0])] += 1
+    freq = counts / n
+    np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.08)
+
+
+def test_repetition_penalty():
+    logits = jnp.asarray([[2.0, -2.0, 1.0]])
+    counts = jnp.asarray([[1, 1, 0]], dtype=jnp.int32)
+    out = apply_repetition_penalty(logits, counts, jnp.float32(2.0))
+    np.testing.assert_allclose(np.asarray(out[0]), [1.0, -4.0, 1.0])

@@ -1,0 +1,60 @@
+import pytest
+
+from dnet_tpu.utils.tokenizer import ByteTokenizer, Detokenizer, load_tokenizer
+
+pytestmark = pytest.mark.core
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("héllo ✓")
+    assert ids[0] == tok.bos_token_id
+    assert tok.decode(ids) == "héllo ✓"
+
+
+def test_chat_template():
+    tok = ByteTokenizer()
+    text = tok.apply_chat_template(
+        [{"role": "user", "content": "hi"}], add_generation_prompt=True
+    )
+    assert "<|user|>" in text and text.endswith("<|assistant|>\n")
+
+
+def test_detokenizer_streaming_multibyte():
+    tok = ByteTokenizer()
+    text = "héllo ✓ wörld — ok"
+    ids = [i for i in text.encode("utf-8")]
+    detok = Detokenizer(tok)
+    out = "".join(detok.add(i) for i in ids) + detok.flush()
+    assert out == text
+
+
+def test_detokenizer_long_stream_windows():
+    """Stream much longer than the working window must still be exact."""
+    tok = ByteTokenizer()
+    text = ("abc déf ✓ " * 40).strip()
+    ids = [i for i in text.encode("utf-8")]
+    detok = Detokenizer(tok)
+    out = "".join(detok.add(i) for i in ids) + detok.flush()
+    assert out == text
+
+
+def test_detokenizer_holds_back_partial_char():
+    tok = ByteTokenizer()
+    detok = Detokenizer(tok)
+    euro = "€".encode("utf-8")  # 3 bytes
+    assert detok.add(euro[0]) == ""
+    assert detok.add(euro[1]) == ""
+    assert detok.add(euro[2]) == "€"
+
+
+def test_load_tokenizer_fallback(tmp_path):
+    tok = load_tokenizer(tmp_path)  # no tokenizer files
+    assert isinstance(tok, ByteTokenizer)
+    assert isinstance(load_tokenizer(None), ByteTokenizer)
+
+
+def test_load_tokenizer_errors_on_corrupt(tmp_path):
+    (tmp_path / "tokenizer_config.json").write_text("{not json")
+    with pytest.raises(Exception):
+        load_tokenizer(tmp_path)
