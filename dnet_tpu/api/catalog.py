@@ -1,0 +1,54 @@
+"""Supported-model catalog.
+
+Reference: src/dnet/api/catalog.py:4-184 — a hardcoded list with arch/quant
+metadata and `ci_test` flags driving the integration matrix.  On TPU the
+quant story differs (bf16 native; int8/int4 weight-only to come), so entries
+carry the checkpoint dtype expectations instead of MLX quant names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class CatalogEntry:
+    id: str  # HF-style repo id or short name
+    arch: str  # model_type
+    params_b: float  # billions of parameters
+    n_layers: int
+    ci_test: bool = False
+    notes: str = ""
+
+
+model_catalog: List[CatalogEntry] = [
+    # Llama family (reference catalog: Llama 3.x 3B-70B, Hermes 70B/405B)
+    CatalogEntry("meta-llama/Llama-3.2-1B-Instruct", "llama", 1.2, 16, ci_test=True),
+    CatalogEntry("meta-llama/Llama-3.2-3B-Instruct", "llama", 3.2, 28, ci_test=True),
+    CatalogEntry("meta-llama/Llama-3.1-8B-Instruct", "llama", 8.0, 32),
+    CatalogEntry("meta-llama/Llama-3.3-70B-Instruct", "llama", 70.6, 80),
+    CatalogEntry("NousResearch/Hermes-3-Llama-3.1-70B", "llama", 70.6, 80),
+    CatalogEntry("NousResearch/Hermes-3-Llama-3.1-405B", "llama", 405.0, 126),
+    # Qwen3 family (4B-32B in reference catalog)
+    CatalogEntry("Qwen/Qwen3-4B", "qwen3", 4.0, 36, ci_test=True),
+    CatalogEntry("Qwen/Qwen3-8B", "qwen3", 8.2, 36),
+    CatalogEntry("Qwen/Qwen3-14B", "qwen3", 14.8, 40),
+    CatalogEntry("Qwen/Qwen3-32B", "qwen3", 32.8, 64),
+    # GPT-OSS MoE (20B/120B in reference catalog)
+    CatalogEntry("openai/gpt-oss-20b", "gpt_oss", 20.9, 24, notes="MoE 32x, SWA alternating"),
+    CatalogEntry("openai/gpt-oss-120b", "gpt_oss", 116.8, 36, notes="MoE 128x, SWA alternating"),
+    # DeepSeek-V2 arch (MLA)
+    CatalogEntry("deepseek-ai/DeepSeek-V2-Lite-Chat", "deepseek_v2", 15.7, 27, notes="MLA"),
+]
+
+
+def find_entry(model_id: str) -> Optional[CatalogEntry]:
+    for e in model_catalog:
+        if e.id == model_id or e.id.split("/")[-1] == model_id:
+            return e
+    return None
+
+
+def get_ci_test_models() -> List[CatalogEntry]:
+    return [e for e in model_catalog if e.ci_test]

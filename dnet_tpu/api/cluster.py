@@ -1,0 +1,58 @@
+"""ClusterManager: discovery, shard health, profiling, topology state.
+
+Reference: src/dnet/api/cluster.py:32-276.  Grows with the two-role split
+(health/latency/profile fan-out) and the solver (profile_cluster); today it
+owns the device table and the current topology.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import httpx
+
+from dnet_tpu.core.types import DeviceInfo, TopologyInfo
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class ClusterManager:
+    def __init__(self, discovery) -> None:
+        self.discovery = discovery
+        self.current_topology: Optional[TopologyInfo] = None
+
+    async def scan_devices(self) -> List[DeviceInfo]:
+        return list(self.discovery.peers())
+
+    async def healthy_devices(self, timeout_s: float = 5.0) -> List[DeviceInfo]:
+        """Parallel health checks; unhealthy shards are filtered before any
+        solve (reference: api/cluster.py:66-109)."""
+        import asyncio
+
+        devices = await self.scan_devices()
+
+        async def check(d: DeviceInfo) -> Optional[DeviceInfo]:
+            url = f"http://{d.host}:{d.http_port}/health"
+            try:
+                async with httpx.AsyncClient(timeout=timeout_s) as client:
+                    r = await client.get(url)
+                    if r.status_code == 200:
+                        return d
+            except httpx.HTTPError:
+                pass
+            log.warning("shard %s unhealthy (%s)", d.instance, url)
+            return None
+
+        results = await asyncio.gather(*(check(d) for d in devices))
+        return [d for d in results if d is not None]
+
+    def head_device(self) -> Optional[DeviceInfo]:
+        """Owner of layer 0 in the current topology."""
+        if self.current_topology is None:
+            return None
+        head = self.current_topology.head_instance()
+        for d in self.current_topology.devices:
+            if d.instance == head:
+                return d
+        return None

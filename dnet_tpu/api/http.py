@@ -1,0 +1,206 @@
+"""API-node HTTP server (aiohttp): OpenAI-compatible /v1 routes.
+
+Routes (reference: src/dnet/api/http_api.py:75-93):
+  POST /v1/chat/completions    — SSE streaming + aggregate
+  GET  /v1/models              — catalog + currently loaded model
+  POST /v1/load_model          — load (single-process or fan-out)
+  POST /v1/unload_model
+  GET  /v1/topology            — current topology (ring mode)
+  GET  /v1/devices             — discovered devices
+  GET  /health
+FastAPI is not available in this image; aiohttp's request handling + a thin
+pydantic validation shim cover the same surface.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from dnet_tpu.api.catalog import model_catalog
+from dnet_tpu.api.inference import (
+    InferenceError,
+    InferenceManager,
+    PromptTooLongError,
+)
+from dnet_tpu.api.schemas import (
+    ChatCompletionRequest,
+    HealthResponse,
+    LoadModelRequest,
+    LoadModelResponse,
+    ModelInfo,
+    ModelList,
+    UnloadModelResponse,
+)
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+def _json_error(status: int, message: str, err_type: str = "invalid_request_error"):
+    return web.json_response(
+        {"error": {"message": message, "type": err_type}}, status=status
+    )
+
+
+class ApiHTTPServer:
+    def __init__(
+        self,
+        inference: InferenceManager,
+        model_manager,
+        cluster_manager=None,
+    ) -> None:
+        self.inference = inference
+        self.model_manager = model_manager
+        self.cluster_manager = cluster_manager
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.router.add_post("/v1/chat/completions", self.chat_completions)
+        self.app.router.add_get("/v1/models", self.list_models)
+        self.app.router.add_post("/v1/load_model", self.load_model)
+        self.app.router.add_post("/v1/unload_model", self.unload_model)
+        self.app.router.add_get("/v1/topology", self.get_topology)
+        self.app.router.add_get("/v1/devices", self.get_devices)
+        self.app.router.add_get("/health", self.health)
+        self._runner: Optional[web.AppRunner] = None
+
+    # ---- lifecycle ----------------------------------------------------
+    async def start(self, host: str, port: int) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        log.info("API HTTP listening on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---- handlers -----------------------------------------------------
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            req = ChatCompletionRequest.model_validate(body)
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return _json_error(400, f"invalid request: {exc}")
+
+        if not self.inference.ready:
+            return _json_error(400, "no model loaded; POST /v1/load_model first")
+
+        if req.stream:
+            resp = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "Connection": "keep-alive",
+                },
+            )
+            await resp.prepare(request)
+            try:
+                async for chunk in self.inference.generate_stream(req):
+                    payload = chunk.model_dump_json(exclude_none=True)
+                    await resp.write(f"data: {payload}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+            except PromptTooLongError as exc:
+                err = json.dumps(
+                    {"error": {"message": str(exc), "type": "invalid_request_error"}}
+                )
+                await resp.write(f"data: {err}\n\n".encode())
+            except InferenceError as exc:
+                err = json.dumps({"error": {"message": str(exc), "type": "server_error"}})
+                await resp.write(f"data: {err}\n\n".encode())
+            except ConnectionResetError:
+                log.info("client disconnected mid-stream")
+            await resp.write_eof()
+            return resp
+
+        try:
+            result = await self.inference.generate(req)
+        except PromptTooLongError as exc:
+            return _json_error(400, str(exc))
+        except InferenceError as exc:
+            return _json_error(500, str(exc), "server_error")
+        return web.json_response(result.model_dump(exclude_none=True))
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        data = [ModelInfo(id=e.id) for e in model_catalog]
+        loaded = self.model_manager.current_model_id
+        if loaded and all(m.id != loaded for m in data):
+            data.append(ModelInfo(id=loaded))
+        return web.json_response(ModelList(data=data).model_dump())
+
+    async def load_model(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            req = LoadModelRequest.model_validate(body)
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return _json_error(400, f"invalid request: {exc}")
+        try:
+            dt = await self.model_manager.load_model(req.model, max_seq=req.max_seq_len)
+        except FileNotFoundError as exc:
+            return _json_error(404, str(exc), "model_not_found")
+        except Exception as exc:
+            log.exception("load_model failed")
+            return _json_error(500, f"load failed: {exc}", "server_error")
+        return web.json_response(
+            LoadModelResponse(model=req.model, load_time_s=dt).model_dump()
+        )
+
+    async def unload_model(self, request: web.Request) -> web.Response:
+        await self.model_manager.unload_model()
+        return web.json_response(UnloadModelResponse(message="unloaded").model_dump())
+
+    async def get_topology(self, request: web.Request) -> web.Response:
+        if self.cluster_manager is None or getattr(self.cluster_manager, "current_topology", None) is None:
+            return web.json_response({"topology": None})
+        topo = self.cluster_manager.current_topology
+        return web.json_response(
+            {
+                "topology": {
+                    "model": topo.model,
+                    "num_layers": topo.num_layers,
+                    "kv_bits": topo.kv_bits,
+                    "assignments": [
+                        {
+                            "instance": a.instance,
+                            "layers": a.layers,
+                            "next_instance": a.next_instance,
+                            "window_size": a.window_size,
+                            "residency_size": a.residency_size,
+                        }
+                        for a in topo.assignments
+                    ],
+                    "solution": topo.solution,
+                }
+            }
+        )
+
+    async def get_devices(self, request: web.Request) -> web.Response:
+        if self.cluster_manager is None:
+            return web.json_response({"devices": []})
+        devices = await self.cluster_manager.scan_devices()
+        return web.json_response(
+            {
+                "devices": [
+                    {
+                        "instance": d.instance,
+                        "host": d.host,
+                        "http_port": d.http_port,
+                        "grpc_port": d.grpc_port,
+                        "is_manager": d.is_manager,
+                        "slice_id": d.slice_id,
+                        "chip_count": d.chip_count,
+                    }
+                    for d in devices
+                ]
+            }
+        )
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            HealthResponse(model=self.model_manager.current_model_id).model_dump()
+        )

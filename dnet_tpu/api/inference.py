@@ -1,0 +1,274 @@
+"""The decode driver: chat request -> token loop -> SSE chunks.
+
+Reference: src/dnet/api/inference.py:66-311 — template/encode, per-request
+nonce, per-token send/await/detokenize loop, EOS + stop-sequence + length
+stops, usage and profile metrics, and non-streaming aggregation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import AsyncIterator, Optional
+
+from dnet_tpu.api.schemas import (
+    ChatChoice,
+    ChatChoiceDelta,
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatMessage,
+    ChatStreamChoice,
+    ChoiceLogprobs,
+    LogprobEntry,
+    RequestMetrics,
+    TopLogprob,
+    Usage,
+    new_request_id,
+)
+from dnet_tpu.api.strategies import ApiAdapterBase
+from dnet_tpu.core.types import DecodingParams
+from dnet_tpu.utils.logger import get_logger
+from dnet_tpu.utils.tokenizer import Detokenizer
+
+log = get_logger()
+
+
+class InferenceError(Exception):
+    pass
+
+
+class PromptTooLongError(InferenceError):
+    """Maps to HTTP 400 (client error) rather than 500."""
+
+
+def _holdback_len(text: str, stop_seqs: list[str]) -> int:
+    """Length of the longest suffix of `text` that is a proper prefix of any
+    stop sequence (must be held back — the next token may complete a stop)."""
+    hold = 0
+    for s in stop_seqs:
+        for k in range(min(len(s) - 1, len(text)), 0, -1):
+            if text.endswith(s[:k]):
+                hold = max(hold, k)
+                break
+    return hold
+
+
+class InferenceManager:
+    def __init__(
+        self,
+        adapter: ApiAdapterBase,
+        request_timeout_s: float = 300.0,
+        max_concurrent: int = 8,
+    ) -> None:
+        self.adapter = adapter
+        self.tokenizer = None  # set by ModelManager on load
+        self.model_id: Optional[str] = None
+        self.request_timeout_s = request_timeout_s
+        self._semaphore = asyncio.Semaphore(max_concurrent)
+
+    @property
+    def ready(self) -> bool:
+        return self.tokenizer is not None and self.model_id is not None
+
+    def _decoding(self, req: ChatCompletionRequest) -> DecodingParams:
+        return DecodingParams(
+            temperature=req.temperature,
+            top_p=req.top_p,
+            top_k=req.top_k,
+            min_p=req.min_p,
+            repetition_penalty=req.repetition_penalty,
+            logprobs=req.logprobs,
+            top_logprobs=req.top_logprobs,
+            seed=req.seed,
+        )
+
+    def _logprob_entry(self, result, text: str) -> LogprobEntry:
+        top = [
+            TopLogprob(
+                token=self.tokenizer.decode([tid]),
+                logprob=lp,
+                bytes=list(self.tokenizer.decode([tid]).encode("utf-8")),
+            )
+            for tid, lp in (result.top_logprobs or [])
+        ]
+        return LogprobEntry(
+            token=text,
+            logprob=result.logprob or 0.0,
+            bytes=list(text.encode("utf-8")),
+            top_logprobs=top,
+        )
+
+    async def generate_stream(
+        self, req: ChatCompletionRequest
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        """Per-token chunks; final chunk carries finish_reason/usage/metrics."""
+        if not self.ready:
+            raise InferenceError("no model loaded")
+        async with self._semaphore:
+            async for chunk in self._run(req):
+                yield chunk
+
+    async def _run(self, req: ChatCompletionRequest) -> AsyncIterator[ChatCompletionChunk]:
+        rid = new_request_id()
+        nonce = rid
+        tok = self.tokenizer
+        prompt = tok.apply_chat_template(
+            [m.model_dump() for m in req.messages], add_generation_prompt=True
+        )
+        prompt_ids = tok.encode(prompt)
+        decoding = self._decoding(req)
+        stop_seqs = req.stop_sequences()
+        eos = tok.eos_token_ids
+        detok = Detokenizer(tok)
+        max_new = req.completion_tokens_limit
+
+        capacity = self.adapter.max_seq()
+        if capacity is not None:
+            if len(prompt_ids) >= capacity:
+                raise PromptTooLongError(
+                    f"prompt is {len(prompt_ids)} tokens but the serving "
+                    f"context is {capacity}"
+                )
+            max_new = min(max_new, capacity - len(prompt_ids))
+
+        t_start = time.perf_counter()
+        t_first: Optional[float] = None
+        generated = 0
+        finish_reason = "length"
+        pending = ""  # emitted-text buffer held back for stop-seq matching
+        stopped_by_seq = False
+
+        await self.adapter.reset_cache(nonce)
+        try:
+            send_ids = list(prompt_ids)
+            for step in range(max_new):
+                await self.adapter.send_tokens(nonce, send_ids, decoding, step)
+                result = await self.adapter.await_token(
+                    nonce, step, self.request_timeout_s
+                )
+                if result.error:
+                    raise InferenceError(result.error)
+                if t_first is None:
+                    t_first = time.perf_counter()
+                generated += 1
+
+                if result.token_id in eos:
+                    finish_reason = "stop"
+                    break
+
+                delta = detok.add(result.token_id)
+                send_ids = [result.token_id]
+
+                # Stop sequences: never emit text at or beyond a match, and
+                # hold back any suffix that could still become one.
+                stopped = False
+                if stop_seqs:
+                    pending += delta
+                    delta = ""
+                    for s in stop_seqs:
+                        idx = pending.find(s)
+                        if idx != -1:
+                            pending = pending[:idx]
+                            stopped = True
+                            break
+                    if stopped:
+                        delta, pending = pending, ""
+                    else:
+                        hold = _holdback_len(pending, stop_seqs)
+                        emit_upto = len(pending) - hold
+                        delta, pending = pending[:emit_upto], pending[emit_upto:]
+
+                if delta or stopped:
+                    logprobs = None
+                    if req.logprobs:
+                        logprobs = ChoiceLogprobs(
+                            content=[self._logprob_entry(result, delta)]
+                        )
+                    yield ChatCompletionChunk(
+                        id=rid,
+                        model=req.model,
+                        choices=[
+                            ChatStreamChoice(
+                                delta=ChatChoiceDelta(content=delta), logprobs=logprobs
+                            )
+                        ],
+                    )
+                if stopped:
+                    finish_reason = "stop"
+                    stopped_by_seq = True
+                    break
+
+            # On EOS/length the held-back text is real content — flush it.
+            # Only a stop-sequence match discards its own matched text.
+            tail = pending + detok.flush() if not stopped_by_seq else ""
+            if tail:
+                yield ChatCompletionChunk(
+                    id=rid,
+                    model=req.model,
+                    choices=[ChatStreamChoice(delta=ChatChoiceDelta(content=tail))],
+                )
+
+            t_end = time.perf_counter()
+            usage = Usage(
+                prompt_tokens=len(prompt_ids),
+                completion_tokens=generated,
+                total_tokens=len(prompt_ids) + generated,
+            )
+            metrics = None
+            if req.profile:
+                total_ms = (t_end - t_start) * 1000
+                ttfb_ms = ((t_first or t_end) - t_start) * 1000
+                gen_ms = max(total_ms - ttfb_ms, 1e-9)
+                metrics = RequestMetrics(
+                    total_ms=total_ms,
+                    ttfb_ms=ttfb_ms,
+                    token_gen_ms=gen_ms,
+                    tokens_generated=generated,
+                    tps_overall=generated / max(total_ms / 1000, 1e-9),
+                    tps_decoding=max(generated - 1, 0) / (gen_ms / 1000),
+                )
+            yield ChatCompletionChunk(
+                id=rid,
+                model=req.model,
+                choices=[ChatStreamChoice(finish_reason=finish_reason)],
+                usage=usage,
+                metrics=metrics,
+            )
+        finally:
+            await self.adapter.reset_cache(nonce)
+
+    async def generate(self, req: ChatCompletionRequest) -> ChatCompletionResponse:
+        """Non-streaming: aggregate the stream (reference inference.py:255-311)."""
+        parts: list[str] = []
+        logprob_entries: list[LogprobEntry] = []
+        usage = Usage()
+        metrics = None
+        finish_reason = "stop"
+        rid = new_request_id()
+        async for chunk in self.generate_stream(req):
+            rid = chunk.id
+            for choice in chunk.choices:
+                if choice.delta.content:
+                    parts.append(choice.delta.content)
+                if choice.logprobs:
+                    logprob_entries.extend(choice.logprobs.content)
+                if choice.finish_reason:
+                    finish_reason = choice.finish_reason
+            if chunk.usage:
+                usage = chunk.usage
+            if chunk.metrics:
+                metrics = chunk.metrics
+        return ChatCompletionResponse(
+            id=rid,
+            model=req.model,
+            choices=[
+                ChatChoice(
+                    message=ChatMessage(role="assistant", content="".join(parts)),
+                    logprobs=ChoiceLogprobs(content=logprob_entries) if req.logprobs else None,
+                    finish_reason=finish_reason,
+                )
+            ],
+            usage=usage,
+            metrics=metrics,
+        )

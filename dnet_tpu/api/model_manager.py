@@ -1,0 +1,114 @@
+"""Model lifecycle on the API node.
+
+Single-process mode: builds a LocalEngine + tokenizer in an executor.
+Ring mode (two-role split) extends this with per-shard /load_model fan-out
+(reference: src/dnet/api/model_manager.py:54-255).
+
+Model resolution is local-only (zero-egress environments are first-class):
+a model id is either a filesystem path or a subdirectory of
+`DNET_API_MODELS_DIR` (repo id slashes replaced by `--`, HF-cache style).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from pathlib import Path
+from typing import Optional
+
+from dnet_tpu.utils.logger import get_logger
+from dnet_tpu.utils.tokenizer import load_tokenizer
+
+log = get_logger()
+
+
+def resolve_model_dir(model_id: str, models_dir: Optional[str | Path] = None) -> Optional[Path]:
+    p = Path(model_id).expanduser()
+    if p.is_dir() and (p / "config.json").is_file():
+        return p
+    if models_dir:
+        base = Path(models_dir).expanduser()
+        for cand in (
+            base / model_id,
+            base / model_id.replace("/", "--"),
+            base / model_id.split("/")[-1],
+        ):
+            if cand.is_dir() and (cand / "config.json").is_file():
+                return cand
+    return None
+
+
+class LocalModelManager:
+    """Owns the engine + tokenizer for single-process serving."""
+
+    def __init__(
+        self,
+        inference_manager,
+        models_dir: Optional[str] = None,
+        max_seq: int = 4096,
+        param_dtype: str = "bfloat16",
+    ) -> None:
+        self.inference = inference_manager
+        self.models_dir = models_dir
+        self.max_seq = max_seq
+        self.param_dtype = param_dtype
+        self.engine = None
+        self.model_dir: Optional[Path] = None
+
+    @property
+    def current_model_id(self) -> Optional[str]:
+        return self.inference.model_id
+
+    def is_model_available(self, model_id: str) -> bool:
+        return resolve_model_dir(model_id, self.models_dir) is not None
+
+    async def load_model(self, model_id: str, max_seq: Optional[int] = None) -> float:
+        """Returns load time in seconds; raises on failure."""
+        model_dir = resolve_model_dir(model_id, self.models_dir)
+        if model_dir is None:
+            raise FileNotFoundError(
+                f"model {model_id!r} not found locally (models_dir={self.models_dir})"
+            )
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+
+        def _build():
+            from dnet_tpu.core.engine import LocalEngine
+
+            engine = LocalEngine(
+                model_dir,
+                max_seq=max_seq or self.max_seq,
+                param_dtype=self.param_dtype,
+            )
+            return engine, load_tokenizer(model_dir)
+
+        engine, tokenizer = await loop.run_in_executor(None, _build)
+
+        # swap adapter engine atomically
+        old_adapter = self.inference.adapter
+        from dnet_tpu.api.strategies import LocalAdapter
+
+        adapter = LocalAdapter(engine)
+        await adapter.start()
+        self.inference.adapter = adapter
+        self.inference.tokenizer = tokenizer
+        self.inference.model_id = model_id
+        self.engine = engine
+        self.model_dir = model_dir
+        if old_adapter is not None:
+            await old_adapter.shutdown()
+        dt = time.perf_counter() - t0
+        log.info("loaded model %s from %s in %.1fs", model_id, model_dir, dt)
+        return dt
+
+    async def unload_model(self) -> None:
+        self.inference.model_id = None
+        self.inference.tokenizer = None
+        adapter = self.inference.adapter
+        if adapter is not None:
+            await adapter.shutdown()
+        self.engine = None
+        self.model_dir = None
+        import gc
+
+        gc.collect()

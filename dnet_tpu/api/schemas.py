@@ -1,0 +1,203 @@
+"""OpenAI-compatible request/response schemas + control-plane models.
+
+Reference: src/dnet/api/models.py:51-236 (chat/completions with validators),
+309-421 (topology prep / load / unload).  pydantic v2.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, Field, field_validator
+
+
+class ChatMessage(BaseModel):
+    role: Literal["system", "user", "assistant", "tool"]
+    content: Union[str, List[Dict[str, Any]], None] = None
+
+    def text(self) -> str:
+        if isinstance(self.content, str):
+            return self.content
+        if self.content is None:
+            return ""
+        parts = []
+        for part in self.content:
+            if isinstance(part, dict) and part.get("type") == "text":
+                parts.append(part.get("text", ""))
+        return "".join(parts)
+
+
+class ChatCompletionRequest(BaseModel):
+    model: str
+    messages: List[ChatMessage]
+    temperature: float = Field(default=1.0, ge=0.0, le=2.0)
+    top_p: float = Field(default=1.0, gt=0.0, le=1.0)
+    top_k: int = Field(default=0, ge=0)
+    min_p: float = Field(default=0.0, ge=0.0, le=1.0)
+    repetition_penalty: float = Field(default=1.0, gt=0.0)
+    max_tokens: Optional[int] = Field(default=None, ge=1)
+    max_completion_tokens: Optional[int] = Field(default=None, ge=1)
+    stream: bool = False
+    stop: Optional[Union[str, List[str]]] = None
+    seed: Optional[int] = None
+    logprobs: bool = False
+    top_logprobs: int = Field(default=0, ge=0, le=20)
+    n: int = Field(default=1, ge=1, le=1)  # >1 unsupported (parity w/ reference)
+    user: Optional[str] = None
+    profile: bool = False  # dnet extension: include perf metrics in final chunk
+
+    @field_validator("messages")
+    @classmethod
+    def _non_empty(cls, v):
+        if not v:
+            raise ValueError("messages must be non-empty")
+        return v
+
+    @property
+    def completion_tokens_limit(self) -> int:
+        return self.max_completion_tokens or self.max_tokens or 256
+
+    def stop_sequences(self) -> List[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class RequestMetrics(BaseModel):
+    """dnet extension returned when profile=true.
+
+    Reference: src/dnet/api/inference.py:216-233.
+    """
+
+    total_ms: float = 0.0
+    ttfb_ms: float = 0.0
+    token_gen_ms: float = 0.0
+    tokens_generated: int = 0
+    tps_overall: float = 0.0
+    tps_decoding: float = 0.0
+
+
+class TopLogprob(BaseModel):
+    token: str
+    logprob: float
+    bytes: Optional[List[int]] = None
+
+
+class LogprobEntry(BaseModel):
+    token: str
+    logprob: float
+    bytes: Optional[List[int]] = None
+    top_logprobs: List[TopLogprob] = Field(default_factory=list)
+
+
+class ChoiceLogprobs(BaseModel):
+    content: List[LogprobEntry] = Field(default_factory=list)
+
+
+class ChatChoiceDelta(BaseModel):
+    role: Optional[str] = None
+    content: Optional[str] = None
+
+
+class ChatStreamChoice(BaseModel):
+    index: int = 0
+    delta: ChatChoiceDelta = Field(default_factory=ChatChoiceDelta)
+    logprobs: Optional[ChoiceLogprobs] = None
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[ChatStreamChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+    metrics: Optional[RequestMetrics] = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage
+    logprobs: Optional[ChoiceLogprobs] = None
+    finish_reason: str = "stop"
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[ChatChoice] = Field(default_factory=list)
+    usage: Usage = Field(default_factory=Usage)
+    metrics: Optional[RequestMetrics] = None
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "dnet-tpu"
+
+
+class ModelList(BaseModel):
+    object: Literal["list"] = "list"
+    data: List[ModelInfo] = Field(default_factory=list)
+
+
+# ---- control plane --------------------------------------------------------
+
+
+class LoadModelRequest(BaseModel):
+    model: str
+    kv_bits: int = 0
+    max_seq_len: Optional[int] = None
+
+
+class LoadModelResponse(BaseModel):
+    status: str = "ok"
+    model: str = ""
+    message: str = ""
+    load_time_s: float = 0.0
+
+
+class UnloadModelResponse(BaseModel):
+    status: str = "ok"
+    message: str = ""
+
+
+class PrepareTopologyRequest(BaseModel):
+    model: str
+    kv_bits: int = 0
+    seq_len: int = 4096
+
+
+class ManualAssignment(BaseModel):
+    instance: str
+    layers: List[int]
+    window_size: int = 0
+    residency_size: int = 0
+
+
+class PrepareTopologyManualRequest(BaseModel):
+    model: str
+    assignments: List[ManualAssignment]
+    kv_bits: int = 0
+
+
+class HealthResponse(BaseModel):
+    status: str = "ok"
+    role: str = "api"
+    model: Optional[str] = None
+
+
+def new_request_id() -> str:
+    return f"chatcmpl-{uuid.uuid4().hex[:24]}"

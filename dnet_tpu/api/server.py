@@ -1,0 +1,66 @@
+"""API-node process wiring: managers + HTTP (+ gRPC in ring mode).
+
+Reference: src/cli/api.py:42-166.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+from dnet_tpu.api.http import ApiHTTPServer
+from dnet_tpu.api.inference import InferenceManager
+from dnet_tpu.api.model_manager import LocalModelManager
+from dnet_tpu.config import get_settings
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+async def serve_async(args) -> None:
+    s = get_settings()
+    inference = InferenceManager(
+        adapter=None,
+        request_timeout_s=s.api.request_timeout_s,
+        max_concurrent=s.api.max_concurrent_requests,
+    )
+    model_manager = LocalModelManager(
+        inference,
+        models_dir=getattr(args, "models_dir", "") or s.api.models_dir,
+        max_seq=s.api.max_seq_len,
+        param_dtype=s.api.param_dtype,
+    )
+
+    cluster_manager = None
+    if getattr(args, "hostfile", ""):
+        from dnet_tpu.api.cluster import ClusterManager
+        from dnet_tpu.utils.hostfile import StaticDiscovery
+
+        discovery = StaticDiscovery.from_hostfile(args.hostfile)
+        cluster_manager = ClusterManager(discovery)
+        log.info("ring mode: %d shard(s) from hostfile", len(discovery.peers()))
+
+    http = ApiHTTPServer(inference, model_manager, cluster_manager)
+    await http.start(args.host, args.http_port)
+
+    preload = getattr(args, "model", "") or ""
+    if preload:
+        await model_manager.load_model(preload)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    log.info("dnet-api ready")
+    await stop.wait()
+    log.info("shutting down")
+    await http.stop()
+    if inference.adapter is not None:
+        await inference.adapter.shutdown()
+
+
+def serve(args) -> None:
+    asyncio.run(serve_async(args))

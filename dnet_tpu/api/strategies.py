@@ -1,0 +1,165 @@
+"""Execution-strategy seam: how the API node reaches the compute.
+
+`ApiAdapterBase` is the contract the decode driver speaks
+(reference: src/dnet/api/strategies/base.py:7-54).  Implementations:
+
+- `LocalAdapter` (here): single-process — the model runs in this process on
+  the local JAX device(s); the "ring" is a thread-pool call.
+- `RingApiAdapter` (dnet_tpu/api/ring.py, task of the two-role split):
+  gRPC streaming to the first shard + token-callback futures.
+
+Because both speak the same surface, InferenceManager and the HTTP layer are
+identical for 1 chip and for a multi-host ring.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class ApiAdapterBase(abc.ABC):
+    """Token-path adapter between the decode driver and the compute plane."""
+
+    @abc.abstractmethod
+    async def start(self) -> None: ...
+
+    @abc.abstractmethod
+    async def shutdown(self) -> None: ...
+
+    @abc.abstractmethod
+    async def reset_cache(self, nonce: str) -> None:
+        """Drop per-nonce state (KV) wherever it lives."""
+
+    @abc.abstractmethod
+    async def send_tokens(
+        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+    ) -> None:
+        """Inject tokens for one decode step (whole prompt on step 0)."""
+
+    @abc.abstractmethod
+    async def await_token(self, nonce: str, step: int, timeout: float) -> TokenResult:
+        """Wait for the sampled token of a specific step to come back."""
+
+    def resolve_token(self, result: TokenResult) -> None:
+        """Called by the transport when a token arrives (default: no-op)."""
+
+    def max_seq(self) -> Optional[int]:
+        """Sequence capacity of the serving path, when known."""
+        return None
+
+
+class _TokenFutures:
+    """Per-nonce, step-keyed future map shared by adapter implementations.
+
+    Futures are keyed by (nonce, step) so a late token from a timed-out step
+    can never be delivered to a later step of the same request.  resolve()
+    may be called from any thread; it never pops — the awaiting side owns
+    cleanup (pop happens in await_token's finally), which closes the race
+    where a fast compute thread resolved before await_token looked up the
+    future.  Reference: RingApiAdapter.await_token/resolve_token
+    (src/dnet/api/strategies/ring.py:198-209).
+    """
+
+    def __init__(self) -> None:
+        self._futures: Dict[tuple[str, int], asyncio.Future] = {}
+
+    def expect(self, nonce: str, step: int) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._futures[(nonce, step)] = fut
+        return fut
+
+    def resolve(self, result: TokenResult) -> bool:
+        fut = self._futures.get((result.nonce, result.step))
+        if fut is None or fut.done():
+            return False
+        fut.get_loop().call_soon_threadsafe(
+            lambda: fut.done() or fut.set_result(result)
+        )
+        return True
+
+    async def wait(self, nonce: str, step: int, timeout: float) -> TokenResult:
+        fut = self._futures.get((nonce, step))
+        if fut is None:
+            raise RuntimeError(f"no pending token for nonce {nonce} step {step}")
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._futures.pop((nonce, step), None)
+
+    def cancel_nonce(self, nonce: str) -> None:
+        for key in [k for k in self._futures if k[0] == nonce]:
+            fut = self._futures.pop(key)
+            if not fut.done():
+                fut.cancel()
+
+
+class LocalAdapter(ApiAdapterBase):
+    """Single-process strategy: the engine *is* the ring.
+
+    Compute runs on a dedicated single-thread executor (the analog of the
+    shard's dedicated compute thread, src/dnet/shard/runtime.py:364-372), so
+    the event loop never blocks on XLA.
+    """
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self._futures = _TokenFutures()
+        self._executor: Optional[ThreadPoolExecutor] = None
+
+    async def start(self) -> None:
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="compute")
+
+    async def shutdown(self) -> None:
+        if self._executor:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+    async def reset_cache(self, nonce: str) -> None:
+        self.engine.end_session(nonce)
+        self._futures.cancel_nonce(nonce)
+
+    def max_seq(self) -> Optional[int]:
+        return self.engine.max_seq
+
+    async def send_tokens(
+        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+    ) -> None:
+        if self._executor is None:
+            raise RuntimeError("adapter not started")
+        self._futures.expect(nonce, step)
+        loop = asyncio.get_running_loop()
+        loop.run_in_executor(
+            self._executor, self._compute_step, nonce, list(token_ids), decoding, step
+        )
+
+    def _compute_step(
+        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+    ) -> None:
+        try:
+            eng = self.engine
+            if step == 0 or nonce not in eng.sessions:
+                res = eng.prefill_and_sample(nonce, token_ids, decoding)
+            else:
+                res = eng.decode_step(nonce, token_ids[-1], decoding)
+            result = eng.token_result(nonce, res, step=step, decoding=decoding)
+            self._futures.resolve(result)
+        except Exception as exc:  # surfaced to await_token as an error result
+            log.exception("local compute step failed")
+            self._futures.resolve(
+                TokenResult(nonce=nonce, token_id=-1, error=str(exc), step=step)
+            )
+
+    async def await_token(self, nonce: str, step: int, timeout: float) -> TokenResult:
+        return await self._futures.wait(nonce, step, timeout)
+
+    def resolve_token(self, result: TokenResult) -> None:
+        self._futures.resolve(result)

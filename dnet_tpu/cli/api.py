@@ -20,6 +20,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--http-port", type=int, default=s.api.http_port)
     p.add_argument("--grpc-port", type=int, default=s.api.grpc_port)
     p.add_argument("--hostfile", default="", help="static discovery hostfile")
+    p.add_argument("--model", default="", help="model to load at startup (path or id)")
+    p.add_argument("--models-dir", default="", help="override DNET_API_MODELS_DIR")
     return p
 
 
@@ -27,13 +29,9 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     log = setup_logger(role="api")
     log.info("dnet-api starting on %s:%d (grpc %d)", args.host, args.http_port, args.grpc_port)
-    try:
-        from dnet_tpu.api.server import serve  # noqa: PLC0415
+    from dnet_tpu.api.server import serve  # noqa: PLC0415
 
-        serve(args)
-    except ImportError:
-        log.error("API server not built yet")
-        return 1
+    serve(args)
     return 0
 
 

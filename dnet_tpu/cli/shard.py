@@ -33,13 +33,9 @@ def main(argv: list[str] | None = None) -> int:
         args.http_port,
         args.grpc_port,
     )
-    try:
-        from dnet_tpu.shard.server import serve  # noqa: PLC0415
+    from dnet_tpu.shard.server import serve  # noqa: PLC0415
 
-        serve(args)
-    except ImportError:
-        log.error("shard server not built yet")
-        return 1
+    serve(args)
     return 0
 
 

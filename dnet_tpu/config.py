@@ -203,6 +203,9 @@ class ApiSettings(_EnvGroup):
     request_timeout_s: float = 300.0
     max_concurrent_requests: int = 8
     max_batch_size: int = 8
+    models_dir: str = "~/.dnet-tpu/models"
+    max_seq_len: int = 4096
+    param_dtype: str = "bfloat16"
 
 
 @dataclass

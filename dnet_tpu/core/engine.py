@@ -224,12 +224,9 @@ class LocalEngine:
         self.end_session(nonce)
         sess = self.new_session(nonce, decoding.seed)
 
-        logits = self.prefill(nonce, prompt_ids, decoding.seed)
-        sess.key, k0 = jax.random.split(sess.key)
-        res = sample(logits, SampleParams.from_decoding(decoding), k0, token_counts=sess.counts)
+        res = self.prefill_and_sample(nonce, prompt_ids, decoding)
         token = int(res.token[0])
-        sess.counts = sess.counts.at[:, token].add(1)
-        yield self._token_result(nonce, res, step=0, decoding=decoding)
+        yield self.token_result(nonce, res, step=0, decoding=decoding)
         if token in eos:
             self.end_session(nonce)
             return
@@ -239,13 +236,27 @@ class LocalEngine:
                 break  # cache capacity reached: stop cleanly (finish_reason=length)
             res = self.decode_step(nonce, token, decoding)
             token = int(res.token[0])
-            yield self._token_result(nonce, res, step=step, decoding=decoding)
+            yield self.token_result(nonce, res, step=step, decoding=decoding)
             if token in eos:
                 break
         self.end_session(nonce)
 
+    def prefill_and_sample(
+        self, nonce: str, prompt_ids: Sequence[int], decoding: DecodingParams
+    ) -> SampleResult:
+        """Prefill the prompt and sample the first token (one place owns the
+        key-split/sample/counts invariants for step 0)."""
+        logits = self.prefill(nonce, prompt_ids, decoding.seed)
+        sess = self.sessions[nonce]
+        sess.key, k0 = jax.random.split(sess.key)
+        res = sample(
+            logits, SampleParams.from_decoding(decoding), k0, token_counts=sess.counts
+        )
+        sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
+        return res
+
     @staticmethod
-    def _token_result(nonce: str, res: SampleResult, step: int, decoding: DecodingParams) -> TokenResult:
+    def token_result(nonce: str, res: SampleResult, step: int, decoding: DecodingParams) -> TokenResult:
         top = None
         if decoding.logprobs and decoding.top_logprobs > 0:
             n = min(decoding.top_logprobs, res.top_tokens.shape[-1])

@@ -1,0 +1,205 @@
+"""In-process API HTTP server tests over the real engine (tiny model, CPU).
+
+The analog of the reference's subsystem tier
+(tests/subsystems/test_api_http_server.py): real routes, no network beyond
+the in-process aiohttp test server.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dnet_tpu.api.http import ApiHTTPServer
+from dnet_tpu.api.inference import InferenceManager
+from dnet_tpu.api.model_manager import LocalModelManager
+
+pytestmark = [pytest.mark.api, pytest.mark.http]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_stack():
+    inference = InferenceManager(adapter=None, request_timeout_s=30.0)
+    manager = LocalModelManager(inference, max_seq=64, param_dtype="float32")
+    server = ApiHTTPServer(inference, manager)
+    return inference, manager, server
+
+
+async def client_for(server: ApiHTTPServer) -> TestClient:
+    client = TestClient(TestServer(server.app))
+    await client.start_server()
+    return client
+
+
+def test_health_and_models():
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        r = await client.get("/health")
+        assert r.status == 200
+        body = await r.json()
+        assert body["status"] == "ok" and body["role"] == "api"
+        r = await client.get("/v1/models")
+        data = await r.json()
+        assert data["object"] == "list" and len(data["data"]) > 0
+        await client.close()
+
+    run(go())
+
+
+def test_chat_requires_loaded_model():
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "x", "messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert r.status == 400
+        body = await r.json()
+        assert "no model loaded" in body["error"]["message"]
+        await client.close()
+
+    run(go())
+
+
+def test_load_unknown_model_404():
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        r = await client.post("/v1/load_model", json={"model": "not/a-model"})
+        assert r.status == 404
+        await client.close()
+
+    run(go())
+
+
+def test_invalid_body_400():
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        r = await client.post("/v1/chat/completions", json={"model": "x", "messages": []})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/chat/completions",
+            data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        assert r.status == 400
+        await client.close()
+
+    run(go())
+
+
+def test_load_and_chat_nonstreaming(tiny_llama_dir):
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        r = await client.post("/v1/load_model", json={"model": str(tiny_llama_dir)})
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        assert body["status"] == "ok" and body["load_time_s"] > 0
+
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": str(tiny_llama_dir),
+                "messages": [{"role": "user", "content": "Say hi"}],
+                "max_tokens": 8,
+                "temperature": 0,
+                "profile": True,
+            },
+        )
+        assert r.status == 200, await r.text()
+        out = await r.json()
+        assert out["object"] == "chat.completion"
+        assert out["choices"][0]["message"]["role"] == "assistant"
+        assert out["usage"]["completion_tokens"] <= 8
+        assert out["usage"]["prompt_tokens"] > 0
+        assert out["metrics"]["tokens_generated"] == out["usage"]["completion_tokens"]
+        assert out["metrics"]["ttfb_ms"] > 0
+
+        r = await client.post("/v1/unload_model", json={})
+        assert r.status == 200
+        r = await client.get("/health")
+        assert (await r.json())["model"] is None
+        await client.close()
+
+    run(go())
+
+
+def test_chat_streaming_sse(tiny_llama_dir):
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        await client.post("/v1/load_model", json={"model": str(tiny_llama_dir)})
+
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 5,
+                "stream": True,
+                "logprobs": True,
+                "top_logprobs": 2,
+            },
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = (await r.read()).decode()
+        events = [line[6:] for line in raw.splitlines() if line.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+        final = chunks[-1]
+        assert final["choices"][0]["finish_reason"] in {"stop", "length"}
+        assert final["usage"]["completion_tokens"] <= 5
+        content_chunks = [c for c in chunks if c["choices"][0]["delta"].get("content")]
+        assert content_chunks, "no content chunks streamed"
+        assert any(c["choices"][0].get("logprobs") for c in content_chunks)
+        await client.close()
+
+    run(go())
+
+
+def test_stop_sequence(tiny_llama_dir):
+    async def go():
+        inference, manager, server = make_stack()
+        client = await client_for(server)
+        await client.post("/v1/load_model", json={"model": str(tiny_llama_dir)})
+
+        # find what greedy decoding produces, then stop on an early substring
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "t",
+                "messages": [{"role": "user", "content": "abc"}],
+                "max_tokens": 10,
+                "temperature": 0,
+            },
+        )
+        full = (await r.json())["choices"][0]["message"]["content"]
+        if len(full) >= 3:
+            stop = full[1:3]
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "t",
+                    "messages": [{"role": "user", "content": "abc"}],
+                    "max_tokens": 10,
+                    "temperature": 0,
+                    "stop": stop,
+                },
+            )
+            body = await r.json()
+            out = body["choices"][0]["message"]["content"]
+            assert stop not in out
+            assert body["choices"][0]["finish_reason"] == "stop"
+        await client.close()
+
+    run(go())

@@ -1,0 +1,146 @@
+"""InferenceManager against a fake adapter (no engine, no network)."""
+
+import asyncio
+
+import pytest
+
+from dnet_tpu.api.inference import InferenceManager, PromptTooLongError, _holdback_len
+from dnet_tpu.api.schemas import ChatCompletionRequest
+from dnet_tpu.api.strategies import ApiAdapterBase, _TokenFutures
+from dnet_tpu.core.types import TokenResult
+from dnet_tpu.utils.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.api
+
+
+class FakeAdapter(ApiAdapterBase):
+    """Feeds a scripted token stream (analog of tests/fakes FakeStrategyAdapter)."""
+
+    def __init__(self, script: list[int], capacity: int | None = None):
+        self.script = list(script)
+        self.capacity = capacity
+        self.sent: list[tuple[int, list[int]]] = []
+        self._futures = _TokenFutures()
+
+    async def start(self):
+        pass
+
+    async def shutdown(self):
+        pass
+
+    async def reset_cache(self, nonce):
+        pass
+
+    def max_seq(self):
+        return self.capacity
+
+    async def send_tokens(self, nonce, token_ids, decoding, step):
+        self.sent.append((step, list(token_ids)))
+        fut = self._futures.expect(nonce, step)
+        tok = self.script.pop(0) if self.script else 257  # EOS when exhausted
+        fut.get_loop().call_soon(
+            lambda: self._futures.resolve(TokenResult(nonce=nonce, token_id=tok, step=step))
+        )
+
+    async def await_token(self, nonce, step, timeout):
+        return await self._futures.wait(nonce, step, timeout)
+
+
+def make_manager(adapter):
+    m = InferenceManager(adapter, request_timeout_s=5.0)
+    m.tokenizer = ByteTokenizer()
+    m.model_id = "fake"
+    return m
+
+
+def req(**kw):
+    base = dict(model="fake", messages=[{"role": "user", "content": "hi"}])
+    base.update(kw)
+    return ChatCompletionRequest.model_validate(base)
+
+
+def collect(manager, request):
+    return asyncio.run(manager.generate(request))
+
+
+def test_basic_flow_first_step_sends_whole_prompt():
+    text = b"hello"
+    adapter = FakeAdapter(list(text))
+    m = make_manager(adapter)
+    out = collect(m, req(max_tokens=10))
+    assert out.choices[0].message.content == "hello"
+    assert out.choices[0].finish_reason == "stop"  # EOS after script
+    assert out.usage.completion_tokens == len(text) + 1  # + EOS
+    step0, ids0 = adapter.sent[0]
+    assert step0 == 0 and len(ids0) > 1  # whole prompt on step 0
+    assert all(len(ids) == 1 for _, ids in adapter.sent[1:])
+
+
+def test_max_tokens_length_stop():
+    adapter = FakeAdapter(list(b"abcdefghij"))
+    m = make_manager(adapter)
+    out = collect(m, req(max_tokens=3))
+    assert out.usage.completion_tokens == 3
+    assert out.choices[0].finish_reason == "length"
+    assert out.choices[0].message.content == "abc"
+
+
+def test_stop_sequence_split_across_tokens_is_excluded():
+    # stream: "helloENDworld" one byte at a time; stop="END"
+    adapter = FakeAdapter(list(b"helloENDworld"))
+    m = make_manager(adapter)
+    out = collect(m, req(max_tokens=20, stop="END"))
+    assert out.choices[0].message.content == "hello"
+    assert out.choices[0].finish_reason == "stop"
+
+
+def test_stop_sequence_partial_prefix_is_emitted_when_no_match():
+    # "helloEN" then EOS: held-back "EN" must flush at the end
+    adapter = FakeAdapter(list(b"helloEN"))
+    m = make_manager(adapter)
+    out = collect(m, req(max_tokens=20, stop="END"))
+    assert out.choices[0].message.content == "helloEN"
+
+
+def test_prompt_too_long_raises():
+    adapter = FakeAdapter([], capacity=4)
+    m = make_manager(adapter)
+
+    async def go():
+        with pytest.raises(PromptTooLongError):
+            async for _ in m.generate_stream(req(max_tokens=5)):
+                pass
+
+    asyncio.run(go())
+
+
+def test_max_tokens_clamped_to_capacity():
+    adapter = FakeAdapter(list(b"abcdefghij"), capacity=32)
+    m = make_manager(adapter)
+    out = collect(m, req(max_tokens=1000))
+    assert out.usage.completion_tokens <= 32
+
+
+def test_holdback_len():
+    assert _holdback_len("helloE", ["END"]) == 1
+    assert _holdback_len("helloEN", ["END"]) == 2
+    assert _holdback_len("hello", ["END"]) == 0
+    assert _holdback_len("xEN", ["END", "Nx"]) == 2
+    assert _holdback_len("", ["END"]) == 0
+
+
+def test_error_result_surfaces():
+    class ErrAdapter(FakeAdapter):
+        async def send_tokens(self, nonce, token_ids, decoding, step):
+            fut = self._futures.expect(nonce, step)
+            fut.get_loop().call_soon(
+                lambda: self._futures.resolve(
+                    TokenResult(nonce=nonce, token_id=-1, error="boom", step=step)
+                )
+            )
+
+    m = make_manager(ErrAdapter([]))
+    from dnet_tpu.api.inference import InferenceError
+
+    with pytest.raises(InferenceError, match="boom"):
+        collect(m, req())
