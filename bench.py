@@ -1,0 +1,102 @@
+"""Headline benchmark: decode tokens/sec on the flagship model, real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config: Llama-3.2-1B-class (first BASELINE.md config), bf16, synthetic
+weights (zero-egress: no checkpoint downloads), batch 1, greedy decode.
+vs_baseline is the fraction of the single-chip HBM-bandwidth roofline
+(weights_bytes / HBM_BW bounds decode tok/s for batch 1): an honest
+hardware-relative score while the reference publishes no numbers
+(BASELINE.md "none published").
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dnet_tpu.core.kvcache import init_cache
+    from dnet_tpu.models.base import ModelConfig
+    from dnet_tpu.models.llama import LlamaRingModel
+    from dnet_tpu.utils.random_init import LLAMA_3_2_1B_CONFIG, random_llama_params
+
+    cfg = ModelConfig.from_hf({**LLAMA_3_2_1B_CONFIG, "architectures": []})
+    layers = list(range(cfg.num_hidden_layers))
+    model = LlamaRingModel(cfg, layers)
+    window, edge = random_llama_params(cfg, layers, dtype="bfloat16")
+    max_seq = 1024
+    kv = init_cache(model.kv_config(len(layers), 1, max_seq, "bfloat16"))
+
+    def decode_step(window_params, edge_params, token, kv, pos):
+        x = model.embed(edge_params, token)
+        x, kv = model.apply_window(window_params, x, kv, pos)
+        x = model.normalize(edge_params, x)
+        logits = model.lm_project(edge_params, x)[:, 0]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+    n_steps = 64
+
+    def decode_scan(window_params, edge_params, token, kv, pos0):
+        """n_steps greedy decode steps fused into ONE XLA program: the
+        sampled token feeds back on-device (no host round-trip per token)."""
+
+        def body(carry, _):
+            tok, kv, pos = carry
+            tok, kv = decode_step(window_params, edge_params, tok, kv, pos)
+            return (tok[:, None], kv, pos + 1), tok
+
+        (_, kv, _), toks = jax.lax.scan(
+            body, (token, kv, pos0), None, length=n_steps
+        )
+        return toks, kv
+
+    step = jax.jit(decode_scan, donate_argnums=(3,))
+
+    token = jnp.ones((1, 1), dtype=jnp.int32)
+    # warmup / compile
+    toks, kv = step(window, edge, token, kv, jnp.int32(0))
+    toks.block_until_ready()
+
+    t0 = time.perf_counter()
+    toks, kv = step(window, edge, token, kv, jnp.int32(n_steps))
+    toks.block_until_ready()
+    dt = time.perf_counter() - t0
+    tok_s = n_steps / dt
+
+    # single-chip HBM roofline for batch-1 decode: read all weights per token
+    param_bytes = sum(
+        int(a.size) * a.dtype.itemsize
+        for a in jax.tree.leaves((window, edge))
+    )
+    dev = jax.devices()[0]
+    hbm_bw = {"v5e": 819e9, "v5litepod": 819e9, "v6e": 1640e9, "v4": 1228e9}.get(
+        _chip_gen(dev), 819e9
+    )
+    roofline = hbm_bw / param_bytes
+    print(
+        json.dumps(
+            {
+                "metric": "decode_tok_s_llama1b_bf16_1chip",
+                "value": round(tok_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / roofline, 4),
+            }
+        )
+    )
+
+
+def _chip_gen(dev) -> str:
+    kind = getattr(dev, "device_kind", "").lower()
+    for gen in ("v6e", "v5e", "v5litepod", "v4"):
+        if gen in kind:
+            return gen
+    return "v5e"
+
+
+if __name__ == "__main__":
+    main()

@@ -117,8 +117,16 @@ class RingModel(abc.ABC):
         pos: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
         layer_kinds: Optional[jnp.ndarray] = None,
+        tp_axis: Optional[str] = None,
+        kv_commit=None,
     ) -> Tuple[jnp.ndarray, dict]:
-        """Apply a stacked window of layers. kv holds this window's slices."""
+        """Apply a stacked window of layers. kv holds this window's slices.
+
+        tp_axis: mesh axis name when running tensor-parallel inside
+        shard_map (params are per-device slices; reductions psum over it).
+        kv_commit: optional traced bool gating cache writes (pipeline ranks
+        processing a not-their-turn copy pass False).
+        """
 
     @abc.abstractmethod
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:

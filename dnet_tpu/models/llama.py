@@ -36,10 +36,18 @@ class LlamaRingModel(RingModel):
     def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
         return edge_params["embed"]["weight"][tokens]
 
-    def _layer(self, p: dict, x: jnp.ndarray, kc, vc, pos, mask):
+    def _layer(self, p: dict, x: jnp.ndarray, kc, vc, pos, mask, tp_axis=None, kv_commit=None):
+        """One decoder layer.  Works on full params or tensor-parallel slices:
+        local head counts come from the (possibly sharded) param shapes, and
+        `tp_axis` inserts the two Megatron-style psums (after o-proj and
+        down-proj) when running inside shard_map.  kv_commit (scalar bool)
+        gates the cache write O(T)-cheaply — a pipeline rank processing a
+        not-its-turn copy must not pollute its cache."""
         cfg = self.config
         B, T, D = x.shape
-        H, KVH, Hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        Hd = cfg.head_dim
+        H = p["wq"].shape[-1] // Hd  # local heads (== cfg heads / tp)
+        KVH = p["wk"].shape[-1] // Hd
 
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
         q = (h @ p["wq"]).reshape(B, T, H, Hd)
@@ -48,15 +56,29 @@ class LlamaRingModel(RingModel):
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq)
         k = apply_rope(k, positions, self.inv_freq)
-        kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+        k = k.astype(kc.dtype)
+        v = v.astype(vc.dtype)
+        if kv_commit is not None:
+            # select against the old slice (O(T)), not the whole cache (O(S))
+            k_old = lax.dynamic_slice(kc, (0, pos, 0, 0), k.shape)
+            v_old = lax.dynamic_slice(vc, (0, pos, 0, 0), v.shape)
+            k = jnp.where(kv_commit, k, k_old)
+            v = jnp.where(kv_commit, v, v_old)
+        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
         attn = attend(q, kc, vc, mask=mask)
-        x = x + attn.reshape(B, T, H * Hd) @ p["wo"]
+        attn_out = attn.reshape(B, T, H * Hd) @ p["wo"]
+        if tp_axis is not None:
+            attn_out = lax.psum(attn_out, tp_axis)
+        x = x + attn_out
 
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
         gate = h @ p["w_gate"]
         up = h @ p["w_up"]
-        x = x + (jax.nn.silu(gate) * up) @ p["w_down"]
+        mlp_out = (jax.nn.silu(gate) * up) @ p["w_down"]
+        if tp_axis is not None:
+            mlp_out = lax.psum(mlp_out, tp_axis)
+        x = x + mlp_out
         return x, kc, vc
 
     def apply_window(
@@ -67,6 +89,8 @@ class LlamaRingModel(RingModel):
         pos: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
         layer_kinds: Optional[jnp.ndarray] = None,
+        tp_axis: Optional[str] = None,
+        kv_commit=None,
     ) -> Tuple[jnp.ndarray, dict]:
         if mask is None:
             mask = causal_mask(x.shape[1], kv["k"].shape[2], pos)
@@ -74,7 +98,9 @@ class LlamaRingModel(RingModel):
         def body(carry, per_layer):
             xc = carry
             p, kc, vc = per_layer
-            xc, kc, vc = self._layer(p, xc, kc, vc, pos, mask)
+            xc, kc, vc = self._layer(
+                p, xc, kc, vc, pos, mask, tp_axis=tp_axis, kv_commit=kv_commit
+            )
             return xc, (kc, vc)
 
         x, (k_out, v_out) = lax.scan(body, x, (window_params, kv["k"], kv["v"]))

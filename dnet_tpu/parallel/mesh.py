@@ -1,0 +1,78 @@
+"""Device-mesh construction and parameter sharding rules.
+
+The TPU-native replacement for the reference's process-ring topology: instead
+of N OS processes connected by gRPC (src/dnet/shard/adapters/ring.py), chips
+in one slice form a `jax.sharding.Mesh` with axes
+
+  dp — data parallel (replicated params, sharded batch)
+  pp — pipeline stages around the ring (layer axis of stacked params)
+  tp — tensor parallel within a stage (Megatron column/row split)
+  sp — sequence/context parallel (ring attention; KV sequence axis)
+
+and the activation hop is `lax.ppermute` over `pp` inside one XLA program —
+zero serialization, ICI bandwidth (SURVEY.md §2.9 north star).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP = "dp", "pp", "tp", "sp"
+
+
+def build_mesh(
+    pp: int = 1,
+    tp: int = 1,
+    dp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * pp * tp * sp
+    if need > len(devices):
+        raise ValueError(f"mesh {dp}x{pp}x{tp}x{sp} needs {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(dp, pp, tp, sp)
+    return Mesh(grid, (AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP))
+
+
+# ---- sharding rules for stacked layer params ------------------------------
+# Stacked params have a leading layer axis; pp shards it.  Within a layer,
+# column-parallel weights shard their output dim over tp, row-parallel their
+# input dim.  Norm vectors replicate.
+
+_COL_PARALLEL = {"wq", "wk", "wv", "w_gate", "w_up"}  # [.., D, out] -> out/tp
+_ROW_PARALLEL = {"wo", "w_down"}  # [.., in, D] -> in/tp
+
+
+def layer_param_spec(name: str) -> P:
+    if name in _COL_PARALLEL:
+        return P(AXIS_PP, None, AXIS_TP)
+    if name in _ROW_PARALLEL:
+        return P(AXIS_PP, AXIS_TP, None)
+    return P(AXIS_PP)  # norms and other per-layer vectors: shard layer axis only
+
+
+def window_param_specs(window_params: Dict) -> Dict[str, P]:
+    return {k: layer_param_spec(k) for k in window_params}
+
+
+def shard_window_params(window_params: Dict, mesh: Mesh) -> Dict:
+    """Place stacked layer params onto the mesh per the TP/PP rules."""
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, layer_param_spec(k)))
+        for k, v in window_params.items()
+    }
+
+
+def replicate(tree, mesh: Mesh):
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def kv_spec() -> P:
+    """KV cache [L, B, S, KVH, Hd]: layers over pp, kv-heads over tp, batch
+    over dp, (sequence over sp when ring attention is active)."""
+    return P(AXIS_PP, AXIS_DP, None, AXIS_TP, None)

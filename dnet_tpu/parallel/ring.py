@@ -1,0 +1,109 @@
+"""In-slice pipelined-ring execution: the whole ring in ONE XLA program.
+
+The reference moves activations shard-to-shard with gRPC frames
+(src/dnet/shard/adapters/ring.py:241-299).  When the "shards" are chips of
+one TPU slice, the entire per-token pipeline compiles into a single
+shard_map program: each pp-rank applies its contiguous stage of layers, and
+the hidden state hops to the next rank with `lax.ppermute` over ICI — no
+serialization, no host round-trips.  Tensor parallelism nests inside each
+stage (psum seams in the model), data parallelism replicates the whole ring.
+
+Pipelining model: for a single in-flight token the ring runs PP sequential
+stage-steps (other ranks compute garbage that is masked out of KV); with S
+concurrent sequences the same program reaches steady state where every rank
+does real work every step (classic pipelined-ring round-robin, the analog of
+the reference's k-round schedule, src/dnet/api/utils.py:62-131).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dnet_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, kv_spec, layer_param_spec
+
+
+def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
+    """Build a jitted single-program ring decode step.
+
+    Signature of the returned fn:
+      (window_params, edge_params, tokens[B,1] int32, kv, pos) -> (logits[B,V], kv)
+
+    window_params: stacked over ALL model layers [L, ...], sharded
+      (pp shards the layer axis into contiguous stages, tp the head/ffn dims).
+    kv: {"k","v"} [L, B, S, KVH, Hd] sharded the same way.
+    param_keys: keys of the stacked window-param dict (spec construction).
+    """
+    PP = mesh.shape[AXIS_PP]
+
+    in_specs = (
+        {k: layer_param_spec(k) for k in param_keys},
+        P(),  # edge params replicated
+        P(AXIS_DP, None),  # tokens [B, 1]
+        {"k": kv_spec(), "v": kv_spec()},
+        P(),  # pos scalar
+    )
+    out_specs = (P(AXIS_DP, None), {"k": kv_spec(), "v": kv_spec()})
+
+    def spmd(window_params, edge_params, tokens, kv, pos):
+        my_pp = lax.axis_index(AXIS_PP)
+
+        # Stage 0 embeds; everyone runs the embed (cheap for T=1) but only
+        # rank 0's x is "real" at iteration 0.
+        x = model.embed(edge_params, tokens)
+        # x becomes device-varying over pp once layer-sharded params touch
+        # it (over tp it stays value-invariant thanks to the psum seams);
+        # mark the loop carry so the carry types line up.
+        x = lax.pcast(x, AXIS_PP, to="varying")
+
+        def stage_iter(i, carry):
+            x, kv = carry
+            # KV only commits on the rank whose turn it is (garbage copies
+            # on other ranks must not pollute their caches); the gate is
+            # O(T) inside the layer, not an O(S) whole-cache select.
+            x_new, kv = model.apply_window(
+                window_params, x, kv, pos, tp_axis=AXIS_TP, kv_commit=(i == my_pp)
+            )
+            # hand the hidden state to the next pipeline rank (ICI hop)
+            x_next = lax.ppermute(
+                x_new, AXIS_PP, [(p, (p + 1) % PP) for p in range(PP)]
+            )
+            return (x_next, kv)
+
+        x, kv = lax.fori_loop(0, PP, stage_iter, (x, kv))
+        # after PP hops the processed x is back on rank 0; ranks agree via
+        # the ppermute ring, and rank 0 holds the final hidden state.
+        x = model.normalize(edge_params, x)
+        logits = model.lm_project(edge_params, x)
+        # Replicate rank 0's logits across pp (out_specs say logits are not
+        # sharded over pp; only rank 0 holds the real value after the loop).
+        logits = _bcast_from_rank0(logits, AXIS_PP)
+        return logits[:, 0], kv
+
+    fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    donate = (3,) if donate_kv else ()
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def _bcast_from_rank0(x, axis_name: str):
+    """Replicate rank 0's value across the axis (psum of masked value)."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == 0, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def place_ring_state(window_params, edge_params, kv, mesh: Mesh):
+    """Device_put params/caches with ring shardings (host -> mesh)."""
+    from dnet_tpu.parallel.mesh import replicate, shard_window_params
+
+    wp = shard_window_params(window_params, mesh)
+    ep = replicate(edge_params, mesh)
+    kvp = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, kv_spec())), kv
+    )
+    return wp, ep, kvp

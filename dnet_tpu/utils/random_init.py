@@ -1,0 +1,90 @@
+"""Random parameter initialization (bench/dryrun/test fixtures).
+
+Builds the same stacked-window + edge param pytrees the checkpoint loader
+produces, but from a config alone — no weights on disk.  Zero-egress
+benchmarking runs on synthetic weights with real model shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dnet_tpu.models.base import ModelConfig
+
+LLAMA_3_2_1B_CONFIG = {
+    "model_type": "llama",
+    "vocab_size": 128256,
+    "hidden_size": 2048,
+    "intermediate_size": 8192,
+    "num_hidden_layers": 16,
+    "num_attention_heads": 32,
+    "num_key_value_heads": 8,
+    "head_dim": 64,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 500000.0,
+    "rope_scaling": {
+        "rope_type": "llama3",
+        "factor": 32.0,
+        "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0,
+        "original_max_position_embeddings": 8192,
+    },
+    "max_position_embeddings": 131072,
+    "tie_word_embeddings": True,
+}
+
+LLAMA_3_8B_CONFIG = {
+    "model_type": "llama",
+    "vocab_size": 128256,
+    "hidden_size": 4096,
+    "intermediate_size": 14336,
+    "num_hidden_layers": 32,
+    "num_attention_heads": 32,
+    "num_key_value_heads": 8,
+    "head_dim": 128,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 500000.0,
+    "max_position_embeddings": 8192,
+    "tie_word_embeddings": False,
+}
+
+
+def random_llama_params(
+    cfg: ModelConfig,
+    layers: Sequence[int],
+    dtype: str = "bfloat16",
+    seed: int = 0,
+) -> Tuple[Dict, Dict]:
+    """(stacked window params, edge params) with real shapes, random values."""
+    L = len(list(layers))
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    H, KVH, Hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    V = cfg.vocab_size
+    dt = jnp.dtype(dtype)
+    key = jax.random.key(seed)
+    ks = iter(jax.random.split(key, 16))
+
+    def w(*shape, scale=0.02):
+        return (jax.random.normal(next(ks), shape, dtype=jnp.float32) * scale).astype(dt)
+
+    window = {
+        "attn_norm": jnp.ones((L, D), dtype=dt),
+        "wq": w(L, D, H * Hd),
+        "wk": w(L, D, KVH * Hd),
+        "wv": w(L, D, KVH * Hd),
+        "wo": w(L, H * Hd, D),
+        "mlp_norm": jnp.ones((L, D), dtype=dt),
+        "w_gate": w(L, D, F),
+        "w_up": w(L, D, F),
+        "w_down": w(L, F, D),
+    }
+    edge = {
+        "embed": {"weight": w(V, D)},
+        "final_norm": {"weight": jnp.ones((D,), dtype=dt)},
+    }
+    if not cfg.tie_word_embeddings:
+        edge["lm_head"] = {"weight": w(D, V)}
+    return window, edge
