@@ -61,6 +61,7 @@ class ApiHTTPServer:
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_post("/v1/load_model", self.load_model)
         self.app.router.add_post("/v1/unload_model", self.unload_model)
+        self.app.router.add_post("/v1/prepare_topology_manual", self.prepare_topology_manual)
         self.app.router.add_get("/v1/topology", self.get_topology)
         self.app.router.add_get("/v1/devices", self.get_devices)
         self.app.router.add_get("/health", self.health)
@@ -153,6 +154,59 @@ class ApiHTTPServer:
     async def unload_model(self, request: web.Request) -> web.Response:
         await self.model_manager.unload_model()
         return web.json_response(UnloadModelResponse(message="unloaded").model_dump())
+
+    async def prepare_topology_manual(self, request: web.Request) -> web.Response:
+        """Manual layer assignment -> ring topology (reference
+        http_api.py:305-403).  Requires ring mode (a cluster manager)."""
+        from dnet_tpu.api.schemas import PrepareTopologyManualRequest
+
+        if self.cluster_manager is None:
+            return _json_error(400, "not in ring mode (no discovery configured)")
+        try:
+            req = PrepareTopologyManualRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return _json_error(400, f"invalid request: {exc}")
+
+        from dnet_tpu.api.model_manager import resolve_model_dir
+        from dnet_tpu.api.ring_manager import build_manual_topology
+
+        model_dir = resolve_model_dir(
+            req.model, getattr(self.model_manager, "models_dir", None)
+        )
+        if model_dir is None:
+            return _json_error(404, f"model {req.model!r} not found locally", "model_not_found")
+        num_layers = json.loads((model_dir / "config.json").read_text())[
+            "num_hidden_layers"
+        ]
+        devices = await self.cluster_manager.healthy_devices()
+        try:
+            topo = build_manual_topology(
+                req.model,
+                num_layers,
+                [a.model_dump() for a in req.assignments],
+                devices,
+                kv_bits=req.kv_bits,
+            )
+        except ValueError as exc:
+            return _json_error(400, str(exc))
+        self.cluster_manager.current_topology = topo
+        return web.json_response(
+            {
+                "status": "ok",
+                "topology": {
+                    "model": topo.model,
+                    "num_layers": topo.num_layers,
+                    "assignments": [
+                        {
+                            "instance": a.instance,
+                            "layers": a.layers,
+                            "next_instance": a.next_instance,
+                        }
+                        for a in topo.assignments
+                    ],
+                },
+            }
+        )
 
     async def get_topology(self, request: web.Request) -> web.Response:
         if self.cluster_manager is None or getattr(self.cluster_manager, "current_topology", None) is None:

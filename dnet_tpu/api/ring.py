@@ -1,0 +1,156 @@
+"""Ring strategy on the API node: token injection + token-callback receipt.
+
+Reference: RingApiAdapter (src/dnet/api/strategies/ring.py:125-209) and the
+ShardApi gRPC servicer (src/dnet/api/grpc_servicer/servicer.py:19-37).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import asdict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dnet_tpu.api.strategies import ApiAdapterBase, _TokenFutures
+from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.transport.protocol import ActivationFrame, Empty, TokenPayload
+from dnet_tpu.transport.stream_manager import StreamManager
+from dnet_tpu.utils.logger import get_logger
+from dnet_tpu.utils.serialization import tensor_to_bytes
+
+log = get_logger()
+
+
+class RingApiAdapter(ApiAdapterBase):
+    """Streams token frames to the head shard; resolves tokens arriving at
+    the API gRPC servicer."""
+
+    def __init__(
+        self,
+        head_addr: str,
+        callback_url: str,
+        shard_grpc_addrs: Optional[List[str]] = None,
+        ring_client_factory: Optional[Callable[[str], object]] = None,
+        max_seq_len: Optional[int] = None,
+        stream_idle_s: float = 300.0,
+    ) -> None:
+        from dnet_tpu.transport.grpc_transport import RingClient
+
+        self.head_addr = head_addr
+        self.callback_url = callback_url
+        self.shard_addrs = shard_grpc_addrs or [head_addr]
+        self._make_client = ring_client_factory or (lambda addr: RingClient(addr))
+        self._head_client = None
+        self._streams: Optional[StreamManager] = None
+        self._futures = _TokenFutures()
+        self._max_seq = max_seq_len
+        self._stream_idle_s = stream_idle_s
+        self._sweeper: Optional[asyncio.Task] = None
+        self._pos_state: Dict[str, int] = {}  # nonce -> next absolute position
+        self._shard_clients: Dict[str, object] = {}
+
+    async def start(self) -> None:
+        self._head_client = self._make_client(self.head_addr)
+        self._streams = StreamManager(
+            self._head_client.open_stream, idle_timeout_s=self._stream_idle_s
+        )
+        # persistent control channels to every shard (reset fan-out per
+        # request must not pay N channel handshakes)
+        self._shard_clients = {
+            addr: self._make_client(addr) for addr in self.shard_addrs
+        }
+        self._sweeper = asyncio.ensure_future(self._idle_sweep())
+
+    async def shutdown(self) -> None:
+        if self._sweeper:
+            self._sweeper.cancel()
+            self._sweeper = None
+        if self._streams:
+            await self._streams.shutdown()
+            self._streams = None
+        for client in self._shard_clients.values():
+            await client.close()
+        self._shard_clients = {}
+        if self._head_client is not None:
+            await self._head_client.close()
+            self._head_client = None
+
+    def max_seq(self) -> Optional[int]:
+        return self._max_seq
+
+    async def reset_cache(self, nonce: str) -> None:
+        """Reset per-nonce KV on every shard (gRPC fan-out, reference
+        inference.py:118)."""
+        self._futures.cancel_nonce(nonce)
+        self._pos_state.pop(nonce, None)
+        if self._streams is not None:
+            await self._streams.end_stream(nonce)
+
+        async def _reset(addr: str, client) -> None:
+            try:
+                await client.reset_cache(nonce)
+            except Exception as exc:
+                log.warning("reset_cache on %s failed: %s", addr, exc)
+
+        await asyncio.gather(
+            *(_reset(a, c) for a, c in self._shard_clients.items())
+        )
+
+    async def send_tokens(
+        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+    ) -> None:
+        if self._streams is None:
+            raise RuntimeError("adapter not started")
+        self._futures.expect(nonce, step)
+        payload, dtype, shape = tensor_to_bytes(
+            np.asarray([token_ids], dtype=np.int32)
+        )
+        frame = ActivationFrame(
+            nonce=nonce,
+            seq=step,
+            layer_id=-1,
+            pos=self._pos_for(nonce, step, len(token_ids)),
+            dtype="tokens",
+            shape=shape,
+            payload=payload,
+            callback_url=self.callback_url,
+            decoding=asdict(decoding),
+            t_sent=time.time(),
+        )
+        await self._streams.send(nonce, frame)
+
+    # positions: step 0 injects the whole prompt at pos 0; each later step
+    # appends exactly one token.
+    def _pos_for(self, nonce: str, step: int, n_tokens: int) -> int:
+        if step == 0:
+            self._pos_state[nonce] = n_tokens
+            return 0
+        pos = self._pos_state.get(nonce, 0)
+        self._pos_state[nonce] = pos + n_tokens
+        return pos
+
+    async def await_token(self, nonce: str, step: int, timeout: float) -> TokenResult:
+        return await self._futures.wait(nonce, step, timeout)
+
+    def resolve_token(self, result: TokenResult) -> None:
+        if not self._futures.resolve(result):
+            log.warning("unmatched token for nonce %s step %d", result.nonce, result.step)
+
+    async def _idle_sweep(self) -> None:
+        while True:
+            await asyncio.sleep(self._stream_idle_s)
+            if self._streams is not None:
+                await self._streams.cleanup_idle()
+
+
+class ApiTokenServicer:
+    """gRPC ShardApi service: receives the sampled token from the end shard."""
+
+    def __init__(self, resolve: Callable[[TokenResult], None]) -> None:
+        self._resolve = resolve
+
+    async def send_token(self, payload: TokenPayload, context) -> Empty:
+        self._resolve(payload.to_result())
+        return Empty()

@@ -1,0 +1,174 @@
+"""Ring-mode model lifecycle: per-shard /load_model fan-out + ring wiring.
+
+Reference: src/dnet/api/model_manager.py:54-255 and the manual-topology
+post-processing in src/dnet/api/http_api.py:305-403 / api/utils.py:62-131.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional
+
+import httpx
+
+from dnet_tpu.api.model_manager import resolve_model_dir
+from dnet_tpu.core.types import DeviceInfo, LayerAssignment, TopologyInfo
+from dnet_tpu.utils.logger import get_logger
+from dnet_tpu.utils.tokenizer import load_tokenizer
+
+log = get_logger()
+
+
+def build_manual_topology(
+    model: str,
+    num_layers: int,
+    assignments: List[dict],
+    devices: List[DeviceInfo],
+    kv_bits: int = 0,
+) -> TopologyInfo:
+    """Order assignments into a ring by min layer, set next pointers, and
+    validate full contiguous coverage (reference http_api.py:305-403)."""
+    by_instance = {d.instance: d for d in devices}
+    las: List[LayerAssignment] = []
+    for a in assignments:
+        if a["instance"] not in by_instance:
+            raise ValueError(f"unknown instance {a['instance']!r}")
+        if not a["layers"]:
+            raise ValueError(f"empty layer list for {a['instance']!r}")
+        las.append(
+            LayerAssignment(
+                instance=a["instance"],
+                layers=sorted(a["layers"]),
+                window_size=a.get("window_size", 0),
+                residency_size=a.get("residency_size", 0),
+            )
+        )
+    las.sort(key=lambda a: a.min_layer)
+    covered = [l for a in las for l in a.layers]
+    if sorted(covered) != list(range(num_layers)):
+        raise ValueError(
+            f"assignments must cover layers 0..{num_layers - 1} exactly once; "
+            f"got {sorted(covered)}"
+        )
+    for i, a in enumerate(las):
+        a.next_instance = las[(i + 1) % len(las)].instance
+    used = [by_instance[a.instance] for a in las]
+    return TopologyInfo(
+        model=model,
+        num_layers=num_layers,
+        kv_bits=kv_bits,
+        devices=used,
+        assignments=las,
+    )
+
+
+class RingModelManager:
+    """Drives shard /load_model fan-out and owns the ring adapter."""
+
+    def __init__(
+        self,
+        inference,
+        cluster_manager,
+        models_dir: Optional[str] = None,
+        api_callback_addr: str = "",
+        max_seq: int = 4096,
+        param_dtype: str = "bfloat16",
+        request_timeout_s: float = 600.0,
+    ) -> None:
+        self.inference = inference
+        self.cluster = cluster_manager
+        self.models_dir = models_dir
+        self.api_callback_addr = api_callback_addr  # host:grpc_port for SendToken
+        self.max_seq = max_seq
+        self.param_dtype = param_dtype
+        self.request_timeout_s = request_timeout_s
+
+    @property
+    def current_model_id(self) -> Optional[str]:
+        return self.inference.model_id
+
+    def is_model_available(self, model_id: str) -> bool:
+        return resolve_model_dir(model_id, self.models_dir) is not None
+
+    async def load_model(self, model_id: str, max_seq: Optional[int] = None) -> float:
+        topo = self.cluster.current_topology
+        if topo is None:
+            raise RuntimeError("no topology; POST /v1/prepare_topology_manual first")
+        model_dir = resolve_model_dir(model_id, self.models_dir)
+        if model_dir is None:
+            raise FileNotFoundError(f"model {model_id!r} not found locally")
+        t0 = time.perf_counter()
+        by_instance = {d.instance: d for d in topo.devices}
+        max_seq = max_seq or self.max_seq
+
+        async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
+            for a in topo.assignments:
+                dev = by_instance[a.instance]
+                nxt = by_instance.get(a.next_instance)
+                is_last_hop = a.next_instance == topo.assignments[0].instance
+                body = {
+                    "model_path": model_id,
+                    "layers": a.layers,
+                    # the last shard calls back to the API; it has no ring next
+                    "next_node": None
+                    if is_last_hop
+                    else {"host": nxt.host, "grpc_port": nxt.grpc_port},
+                    "window_size": a.window_size,
+                    "residency_size": a.residency_size,
+                    "kv_bits": topo.kv_bits,
+                    "max_seq_len": max_seq,
+                    "api_callback_address": f"grpc://{self.api_callback_addr}",
+                    "param_dtype": self.param_dtype,
+                }
+                url = f"http://{dev.host}:{dev.http_port}/load_model"
+                r = await client.post(url, json=body)
+                if r.status_code != 200:
+                    raise RuntimeError(
+                        f"shard {a.instance} load failed ({r.status_code}): {r.text}"
+                    )
+
+        # tokenizer API-side (reference model_manager.py:169-182)
+        tokenizer = load_tokenizer(model_dir)
+
+        head = by_instance[topo.head_instance()]
+        from dnet_tpu.api.ring import RingApiAdapter
+
+        old = self.inference.adapter
+        adapter = RingApiAdapter(
+            head_addr=f"{head.host}:{head.grpc_port}",
+            callback_url=f"grpc://{self.api_callback_addr}",
+            shard_grpc_addrs=[
+                f"{by_instance[a.instance].host}:{by_instance[a.instance].grpc_port}"
+                for a in topo.assignments
+            ],
+            max_seq_len=max_seq,
+        )
+        await adapter.start()
+        self.inference.adapter = adapter
+        self.inference.tokenizer = tokenizer
+        self.inference.model_id = model_id
+        if old is not None:
+            await old.shutdown()
+        dt = time.perf_counter() - t0
+        log.info("ring model %s loaded across %d shard(s) in %.1fs", model_id, len(topo.assignments), dt)
+        return dt
+
+    async def unload_model(self) -> None:
+        topo = self.cluster.current_topology
+        self.inference.model_id = None
+        self.inference.tokenizer = None
+        adapter = self.inference.adapter
+        if adapter is not None:
+            await adapter.shutdown()
+            self.inference.adapter = None
+        if topo is None:
+            return
+        by_instance = {d.instance: d for d in topo.devices}
+        async with httpx.AsyncClient(timeout=60.0) as client:
+            for a in topo.assignments:
+                dev = by_instance[a.instance]
+                try:
+                    await client.post(f"http://{dev.host}:{dev.http_port}/unload_model")
+                except httpx.HTTPError as exc:
+                    log.warning("unload on %s failed: %s", a.instance, exc)

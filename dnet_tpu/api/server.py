@@ -32,12 +32,46 @@ async def serve_async(args) -> None:
     )
 
     cluster_manager = None
+    grpc_server = None
     if getattr(args, "hostfile", ""):
         from dnet_tpu.api.cluster import ClusterManager
+        from dnet_tpu.api.ring import ApiTokenServicer
+        from dnet_tpu.api.ring_manager import RingModelManager
+        from dnet_tpu.transport.grpc_transport import (
+            api_service_handlers,
+            start_grpc_server,
+        )
         from dnet_tpu.utils.hostfile import StaticDiscovery
 
         discovery = StaticDiscovery.from_hostfile(args.hostfile)
         cluster_manager = ClusterManager(discovery)
+        # callback address shards dial for SendToken: explicit override, else
+        # the interface facing the shards (reference http_api.py:188-196)
+        from dnet_tpu.utils.network import primary_ip
+
+        callback_addr = s.api.callback_addr or (
+            f"{primary_ip(d.host for d in discovery.peers())}:{args.grpc_port}"
+        )
+        model_manager = RingModelManager(
+            inference,
+            cluster_manager,
+            models_dir=getattr(args, "models_dir", "") or s.api.models_dir,
+            api_callback_addr=callback_addr,
+            max_seq=s.api.max_seq_len,
+            param_dtype=s.api.param_dtype,
+        )
+        # token-callback receiver: shards resolve decode futures through here
+        grpc_server = await start_grpc_server(
+            args.host,
+            args.grpc_port,
+            api_service_handlers(
+                ApiTokenServicer(
+                    lambda r: inference.adapter.resolve_token(r)
+                    if inference.adapter is not None
+                    else log.warning("token for %s before model load", r.nonce)
+                )
+            ),
+        )
         log.info("ring mode: %d shard(s) from hostfile", len(discovery.peers()))
 
     http = ApiHTTPServer(inference, model_manager, cluster_manager)
@@ -45,7 +79,12 @@ async def serve_async(args) -> None:
 
     preload = getattr(args, "model", "") or ""
     if preload:
-        await model_manager.load_model(preload)
+        try:
+            await model_manager.load_model(preload)
+        except Exception:
+            # ring mode has no topology until the operator prepares one; a
+            # failed preload must not kill the server
+            log.exception("preload of %s failed; continuing without a model", preload)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -58,6 +97,8 @@ async def serve_async(args) -> None:
     await stop.wait()
     log.info("shutting down")
     await http.stop()
+    if grpc_server is not None:
+        await grpc_server.stop(grace=2)
     if inference.adapter is not None:
         await inference.adapter.shutdown()
 

@@ -216,6 +216,7 @@ class ShardSettings(_EnvGroup):
     grpc_port: int = 58081
     queue_size: int = 256
     name: str = ""
+    models_dir: str = "~/.dnet-tpu/models"
 
 
 @dataclass

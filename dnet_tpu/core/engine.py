@@ -64,6 +64,7 @@ class LocalEngine:
         param_dtype: str = "bfloat16",
         kv_dtype: Optional[str] = None,
         kv_ttl_s: float = 600.0,
+        shard_mode: bool = False,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -75,6 +76,10 @@ class LocalEngine:
         self.param_dtype = jnp.dtype(param_dtype)
         self.kv_dtype = kv_dtype or param_dtype
         self.kv_ttl_s = kv_ttl_s
+        # shard_mode: load only the edge weights this layer range needs
+        # (reference: edge tensors loaded iff shard holds layer 0 / the last
+        # layer, src/dnet/shard/runtime.py:262-286)
+        self.shard_mode = shard_mode
         self.sessions: Dict[str, Session] = {}
 
         self._load_params()
@@ -97,6 +102,13 @@ class LocalEngine:
         stacked = m.stack_layers(per_layer)
         self.window_params = self._cast(stacked)
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())
+        if self.shard_mode:
+            tied = self.config.tie_word_embeddings
+            if not (m.is_first or (m.is_last and tied)):
+                edge_raw.pop("embed", None)
+            if not m.is_last:
+                edge_raw.pop("final_norm", None)
+                edge_raw.pop("lm_head", None)
         # tied embeddings: lm_project reads edge["embed"] (reference handles
         # ties in load_weights, src/dnet/core/models/base.py:111-195)
         self.edge_params = self._cast(edge_raw)
@@ -135,6 +147,25 @@ class LocalEngine:
 
         # mid-shard path (no embed/head): used by the ring runtime
         self._hidden = jax.jit(hidden_step, donate_argnums=(2,))
+
+        def embed_window(window_params, edge_params, tokens, kv, pos):
+            """First-shard path: embed + this shard's window, hidden out."""
+            x = model.embed(edge_params, tokens)
+            return model.apply_window(window_params, x, kv, pos)
+
+        self._embed_window = jax.jit(embed_window, donate_argnums=(3,))
+
+        def hidden_tail(window_params, edge_params, x, kv, pos, last_idx, sp, key, counts):
+            """Last-shard path: window + normalize + head + sample."""
+            x, kv = model.apply_window(window_params, x, kv, pos)
+            x_last = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
+            x_last = model.normalize(edge_params, x_last)
+            logits = model.lm_project(edge_params, x_last)[:, 0]
+            res = sample(logits, sp, key, token_counts=counts)
+            counts = counts.at[jnp.arange(counts.shape[0]), res.token].add(1)
+            return res, kv, counts
+
+        self._hidden_tail = jax.jit(hidden_tail, donate_argnums=(3, 8))
 
     # ---- sessions -----------------------------------------------------
     def new_session(self, nonce: str, seed: Optional[int] = None) -> Session:
@@ -187,8 +218,9 @@ class LocalEngine:
             self.window_params, self.edge_params, jnp.asarray(tokens), sess.kv,
             jnp.int32(sess.pos), jnp.int32(T - 1),
         )
-        ids = jnp.asarray(np.asarray(prompt_ids, dtype=np.int32))
-        sess.counts = sess.counts.at[:, ids].add(1)
+        # repetition penalty counts GENERATED tokens only (prompt tokens are
+        # not seeded): the ring's sampling shard never sees prompt ids, so
+        # both serving paths must share this definition to stay equivalent.
         sess.pos += T
         sess.last_used = time.time()
         return logits

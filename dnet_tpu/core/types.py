@@ -60,6 +60,7 @@ class ActivationMessage:
     token_id: Optional[int] = None
     logprob: Optional[float] = None
     top_logprobs: Optional[list] = None
+    error: str = ""
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0

@@ -1,0 +1,196 @@
+"""RingAdapter: the shard's transport glue.
+
+Faithful to the reference's four-worker design
+(src/dnet/shard/adapters/ring.py:88-299): an ingress path that either admits
+a frame to local compute or relays it toward the owner of the next layer, an
+egress task routing computed results (hidden-state -> next hop stream;
+final token -> unary callback to the API), lazy next-hop connection, and an
+idle-stream sweeper.  Channel factories are injectable so tests run the whole
+adapter with fakes (tests/fakes pattern, no network).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Dict, Optional
+
+from dnet_tpu.core.types import ActivationMessage, TokenResult
+from dnet_tpu.transport.protocol import ActivationFrame, TokenPayload
+from dnet_tpu.transport.stream_manager import StreamManager
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+def parse_callback(url: str) -> str:
+    """grpc://host:port -> host:port (reference ring.py:301-408 parses the
+    same scheme)."""
+    if url.startswith("grpc://"):
+        return url[len("grpc://"):]
+    return url
+
+
+class RingAdapter:
+    def __init__(
+        self,
+        runtime,
+        ring_client_factory: Optional[Callable[[str], object]] = None,
+        callback_client_factory: Optional[Callable[[str], object]] = None,
+        stream_idle_s: float = 30.0,
+        backoff_s: float = 0.25,
+    ) -> None:
+        from dnet_tpu.transport.grpc_transport import ApiCallbackClient, RingClient
+
+        self.runtime = runtime
+        self._make_ring_client = ring_client_factory or (lambda addr: RingClient(addr))
+        self._make_cb_client = callback_client_factory or (
+            lambda addr: ApiCallbackClient(addr)
+        )
+        self.next_addr: str = ""
+        self._next_client = None
+        self._streams: Optional[StreamManager] = None
+        self._cb_clients: Dict[str, object] = {}  # callback addr -> client
+        self._tasks: list[asyncio.Task] = []
+        self._stream_idle_s = stream_idle_s
+        self._backoff_s = backoff_s
+
+    # ---- lifecycle ------------------------------------------------------
+    async def start(self) -> None:
+        self._tasks = [
+            asyncio.ensure_future(self._egress_worker()),
+            asyncio.ensure_future(self._idle_sweeper()),
+        ]
+
+    async def shutdown(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
+        await self.reset_topology()
+
+    # ---- topology -------------------------------------------------------
+    def configure_topology(self, next_addr: str) -> None:
+        """next_addr: 'host:grpc_port' of the next shard; '' if we are last."""
+        self.next_addr = next_addr
+        self._next_client = None
+        self._streams = None
+
+    async def reset_topology(self) -> None:
+        if self._streams:
+            await self._streams.shutdown()
+            self._streams = None
+        if self._next_client is not None:
+            await self._next_client.close()
+            self._next_client = None
+        for client in self._cb_clients.values():
+            await client.close()
+        self._cb_clients.clear()
+        self.next_addr = ""
+
+    def _ensure_next(self):
+        if self._next_client is None:
+            if not self.next_addr:
+                raise RuntimeError("no next hop configured")
+            self._next_client = self._make_ring_client(self.next_addr)
+            self._streams = StreamManager(
+                self._next_client.open_stream,
+                backoff_s=self._backoff_s,
+                idle_timeout_s=self._stream_idle_s,
+            )
+        return self._streams
+
+    # ---- ingress ----------------------------------------------------------
+    async def ingress_frame(self, frame: ActivationFrame) -> tuple[bool, str]:
+        """Admit a frame: local compute if the next layer is ours, else relay.
+        Returns (ok, message) for the ACK."""
+        compute = self.runtime.compute
+        if compute is not None and compute.wants(frame.layer_id):
+            msg = frame.to_message()
+            msg.t_recv = time.perf_counter()
+            if not self.runtime.submit(msg, timeout=0.0 if self.runtime.queue_depth else 5.0):
+                return False, "backpressure"
+            return True, ""
+        # relay toward the owner (reference ring.py:161-206)
+        try:
+            streams = self._ensure_next()
+            await streams.send(frame.nonce, frame)
+            return True, "relayed"
+        except Exception as exc:
+            log.error("relay failed for %s: %s", frame.nonce, exc)
+            return False, f"relay failed: {exc}"
+
+    # ---- egress -------------------------------------------------------------
+    async def _egress_worker(self) -> None:
+        while True:
+            out: ActivationMessage = await self.runtime.out_q.get()
+            try:
+                if out.is_final:
+                    await self._send_token(out)
+                else:
+                    await self._send_activation(out)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("egress failed for %s", out.nonce)
+
+    async def _send_activation(self, msg: ActivationMessage) -> None:
+        streams = self._ensure_next()
+        frame = ActivationFrame(
+            nonce=msg.nonce,
+            seq=msg.seq,
+            layer_id=msg.layer_id,
+            pos=msg.pos,
+            dtype=msg.dtype,
+            shape=tuple(msg.shape),
+            payload=msg.data if isinstance(msg.data, bytes) else bytes(msg.data),
+            callback_url=msg.callback_url,
+            decoding=_decoding_dict(msg),
+            t_sent=time.time(),
+        )
+        await streams.send(msg.nonce, frame)
+
+    async def _send_token(self, msg: ActivationMessage) -> None:
+        addr = parse_callback(msg.callback_url)
+        if not addr:
+            log.error("final token for %s has no callback", msg.nonce)
+            return
+        client = self._cb_clients.get(addr)
+        if client is None:
+            client = self._make_cb_client(addr)
+            self._cb_clients[addr] = client
+        payload = TokenPayload(
+            nonce=msg.nonce,
+            step=msg.seq,
+            token_id=int(msg.token_id if msg.token_id is not None else -1),
+            logprob=msg.logprob,
+            top_ids=[t for t, _ in (msg.top_logprobs or [])],
+            top_logprobs=[lp for _, lp in (msg.top_logprobs or [])],
+            error=msg.error,
+        )
+        t0 = time.perf_counter()
+        await client.send_token(payload)
+        log.info(
+            "[PROFILE] token step=%d nonce=%s rpc=%.2fms",
+            msg.seq,
+            msg.nonce,
+            (time.perf_counter() - t0) * 1e3,
+        )
+
+    # ---- cache / sweeping ----------------------------------------------------
+    async def reset_cache(self, nonce: str = "") -> None:
+        if self.runtime.compute is not None:
+            self.runtime.compute.reset(nonce)
+        if self._streams is not None and nonce:
+            await self._streams.end_stream(nonce)
+
+    async def _idle_sweeper(self) -> None:
+        while True:
+            await asyncio.sleep(self._stream_idle_s)
+            if self._streams is not None:
+                await self._streams.cleanup_idle()
+
+
+def _decoding_dict(msg: ActivationMessage) -> dict:
+    from dataclasses import asdict
+
+    return asdict(msg.decoding)

@@ -1,0 +1,173 @@
+"""Shard compute core: one ActivationMessage in, one out.
+
+The policy-level hot loop of the reference's FitInMemoryPolicy
+(src/dnet/shard/policies/fit_in_memory.py:34-209), built on LocalEngine's
+jitted shard paths: embed+window (first shard), hidden window (mid), window+
+head+sample (last).  Incoming hidden states are padded to power-of-two
+buckets so every frame length reuses a compiled program.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnet_tpu.core.engine import LocalEngine, bucket_length
+from dnet_tpu.core.sampler import SampleParams
+from dnet_tpu.core.types import ActivationMessage, DecodingParams, TokenResult
+from dnet_tpu.utils.logger import get_logger
+from dnet_tpu.utils.serialization import bytes_to_tensor, tensor_to_bytes
+
+log = get_logger()
+
+
+class ShardCompute:
+    """Owns the engine for this shard's layer range."""
+
+    def __init__(
+        self,
+        model_dir: str | Path,
+        layers: Sequence[int],
+        max_seq: int = 4096,
+        param_dtype: str = "bfloat16",
+        wire_dtype: str = "bfloat16",
+        kv_ttl_s: float = 600.0,
+    ) -> None:
+        self.engine = LocalEngine(
+            model_dir,
+            layers=layers,
+            max_seq=max_seq,
+            param_dtype=param_dtype,
+            kv_ttl_s=kv_ttl_s,
+            shard_mode=True,
+        )
+        self.layers = self.engine.model.layers
+        self.wire_dtype = wire_dtype
+        self.is_first = self.engine.model.is_first
+        self.is_last = self.engine.model.is_last
+
+    @property
+    def max_layer(self) -> int:
+        return max(self.layers)
+
+    def wants(self, layer_id: int) -> bool:
+        """Is the layer after `layer_id` ours?  (layer_id -1 = raw tokens.)"""
+        return (layer_id + 1) in self.engine.model.abs_to_local
+
+    def reset(self, nonce: str = "") -> None:
+        if nonce:
+            self.engine.end_session(nonce)
+        else:
+            self.engine.reset()
+
+    def process(self, msg: ActivationMessage) -> ActivationMessage:
+        """Run this shard's window; returns the outgoing message
+        (hidden-state hop or final sampled token)."""
+        eng = self.engine
+        nonce = msg.nonce
+        sess = eng.sessions.get(nonce) or eng.new_session(nonce, msg.decoding.seed)
+        pos = msg.pos
+
+        if msg.is_tokens:
+            if not self.is_first:
+                raise ValueError("token frame arrived at a non-first shard")
+            ids = msg.tokens()
+            T = ids.shape[-1]
+            # T==1 is the steady-state decode hop: no bucket padding (a
+            # dedicated (B,1) program, like the local path's _decode)
+            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq)
+            if pos + T > eng.max_seq:
+                raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
+            tokens = np.zeros((eng.batch, Tpad), dtype=np.int32)
+            tokens[:, :T] = ids.reshape(1, -1)
+            x, sess.kv = eng._embed_window(
+                eng.window_params, eng.edge_params, jnp.asarray(tokens),
+                sess.kv, jnp.int32(pos),
+            )
+        else:
+            hidden = bytes_to_tensor(msg.data, msg.dtype, msg.shape)
+            T = hidden.shape[1]
+            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq)
+            if pos + T > eng.max_seq:
+                raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
+            if Tpad != T:
+                pad = np.zeros(
+                    (hidden.shape[0], Tpad - T, hidden.shape[2]), dtype=hidden.dtype
+                )
+                hidden = np.concatenate([hidden, pad], axis=1)
+            x = jnp.asarray(hidden).astype(eng.param_dtype)
+            if self.is_last:
+                sess.key, step_key = jax.random.split(sess.key)
+                sp = SampleParams.from_decoding(msg.decoding)
+                res, sess.kv, sess.counts = eng._hidden_tail(
+                    eng.window_params, eng.edge_params, x, sess.kv,
+                    jnp.int32(pos), jnp.int32(T - 1), sp, step_key, sess.counts,
+                )
+                sess.pos = pos + T
+                sess.last_used = time.time()
+                return self._final_message(msg, res)
+            x, sess.kv = eng._hidden(eng.window_params, x, sess.kv, jnp.int32(pos))
+
+        sess.pos = pos + T
+        sess.last_used = time.time()
+
+        if self.is_last and msg.is_tokens:
+            # single-shard ring: embed+window above, tail here
+            sess.key, step_key = jax.random.split(sess.key)
+            sp = SampleParams.from_decoding(msg.decoding)
+            x_last = jax.lax.dynamic_slice_in_dim(x, T - 1, 1, axis=1)
+            x_last = eng.model.normalize(eng.edge_params, x_last)
+            logits = eng.model.lm_project(eng.edge_params, x_last)[:, 0]
+            from dnet_tpu.core.sampler import sample
+
+            res = sample(logits, sp, step_key, token_counts=sess.counts)
+            sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
+            return self._final_message(msg, res)
+
+        # hidden hop to the next shard: slice off the padding, cast to wire
+        out = np.asarray(x[:, :T])
+        payload, dtype, shape = tensor_to_bytes(out, wire_dtype=self.wire_dtype)
+        return ActivationMessage(
+            nonce=nonce,
+            layer_id=self.max_layer,
+            seq=msg.seq,
+            dtype=dtype,
+            shape=shape,
+            data=payload,
+            pos=pos,
+            callback_url=msg.callback_url,
+            decoding=msg.decoding,
+        )
+
+    def _final_message(self, msg: ActivationMessage, res) -> ActivationMessage:
+        decoding = msg.decoding
+        token_result = LocalEngine.token_result(msg.nonce, res, step=msg.seq, decoding=decoding)
+        out = ActivationMessage(
+            nonce=msg.nonce,
+            layer_id=self.max_layer,
+            seq=msg.seq,
+            dtype="token",
+            shape=(1,),
+            pos=msg.pos,
+            callback_url=msg.callback_url,
+            decoding=decoding,
+            is_final=True,
+            token_id=token_result.token_id,
+            logprob=token_result.logprob,
+            top_logprobs=token_result.top_logprobs,
+        )
+        return out
+
+    def sweep_sessions(self) -> int:
+        return self.engine.sweep_sessions()
+
+    def health(self) -> dict:
+        return {
+            "layers": list(self.layers),
+            "sessions": len(self.engine.sessions),
+        }

@@ -1,0 +1,61 @@
+"""Shard gRPC servicer: the ring data-plane endpoints.
+
+Reference: src/dnet/shard/grpc_servicer/servicer.py:21-161 — bidi
+StreamActivations with per-frame ACKs and nonce validation, unary
+SendActivation, HealthCheck with assigned layers + queue depth, ResetCache,
+MeasureLatency echo.
+"""
+
+from __future__ import annotations
+
+import time
+
+from dnet_tpu.transport.protocol import (
+    ActivationFrame,
+    Empty,
+    HealthInfo,
+    LatencyProbe,
+    ResetCacheRequest,
+    StreamAck,
+)
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class ShardRingServicer:
+    def __init__(self, adapter, runtime) -> None:
+        self.adapter = adapter
+        self.runtime = runtime
+
+    async def stream_activations(self, request_iterator, context):
+        async for frame in request_iterator:
+            ok, message = await self.adapter.ingress_frame(frame)
+            yield StreamAck(
+                nonce=frame.nonce,
+                seq=frame.seq,
+                ok=ok,
+                backpressure=(message == "backpressure"),
+                message=message,
+            )
+
+    async def send_activation(self, frame: ActivationFrame, context) -> StreamAck:
+        ok, message = await self.adapter.ingress_frame(frame)
+        return StreamAck(nonce=frame.nonce, seq=frame.seq, ok=ok, message=message)
+
+    async def health_check(self, request: Empty, context) -> HealthInfo:
+        compute = self.runtime.compute
+        return HealthInfo(
+            ok=True,
+            model=self.runtime.model_path,
+            layers=list(compute.layers) if compute else [],
+            queue_depth=self.runtime.queue_depth,
+        )
+
+    async def reset_cache(self, request: ResetCacheRequest, context) -> Empty:
+        await self.adapter.reset_cache(request.nonce)
+        return Empty()
+
+    async def measure_latency(self, probe: LatencyProbe, context) -> LatencyProbe:
+        # echo with the same payload; caller computes RTT vs payload size
+        return LatencyProbe(t_sent=probe.t_sent, payload=probe.payload)

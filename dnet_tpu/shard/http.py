@@ -1,0 +1,157 @@
+"""Shard control-plane HTTP server (aiohttp).
+
+Reference: src/dnet/shard/http_api.py:222-336 — /health, /load_model,
+/unload_model, /measure_latency (gRPC probes to peers per payload size),
+/profile (device microbench).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import List, Optional
+
+from aiohttp import web
+from pydantic import BaseModel, Field, ValidationError
+
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class NextNode(BaseModel):
+    host: str
+    grpc_port: int
+
+
+class ShardLoadModelRequest(BaseModel):
+    """Reference: ShardLoadModelRequest (src/dnet/shard/models.py:10-33)."""
+
+    model_path: str
+    layers: List[int]
+    next_node: Optional[NextNode] = None
+    window_size: int = 0
+    residency_size: int = 0
+    kv_bits: int = 0
+    max_seq_len: int = 4096
+    api_callback_address: str = ""
+    param_dtype: str = "bfloat16"
+    wire_dtype: str = "bfloat16"
+
+
+class MeasureLatencyRequest(BaseModel):
+    peers: List[str]  # "host:grpc_port"
+    payload_sizes: List[int] = Field(default_factory=lambda: [1024, 65536, 1048576])
+    rounds: int = 3
+
+
+class ShardHTTPServer:
+    def __init__(self, shard) -> None:
+        self.shard = shard  # Shard facade (runtime + adapter)
+        self.app = web.Application(client_max_size=16 * 1024 * 1024)
+        self.app.router.add_get("/health", self.health)
+        self.app.router.add_post("/load_model", self.load_model)
+        self.app.router.add_post("/unload_model", self.unload_model)
+        self.app.router.add_post("/measure_latency", self.measure_latency)
+        self.app.router.add_post("/profile", self.profile)
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self, host: str, port: int) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        log.info("shard HTTP listening on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---- handlers -----------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        rt = self.shard.runtime
+        compute = rt.compute
+        return web.json_response(
+            {
+                "status": "ok",
+                "role": "shard",
+                "shard_id": rt.shard_id,
+                "model": rt.model_path or None,
+                "layers": list(compute.layers) if compute else [],
+                "queue_depth": rt.queue_depth,
+            }
+        )
+
+    async def load_model(self, request: web.Request) -> web.Response:
+        try:
+            req = ShardLoadModelRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return web.json_response(
+                {"status": "error", "message": f"invalid request: {exc}"}, status=400
+            )
+        t0 = time.perf_counter()
+        try:
+            await self.shard.load_model(req)
+        except FileNotFoundError as exc:
+            return web.json_response(
+                {"status": "error", "message": str(exc)}, status=404
+            )
+        except Exception as exc:
+            log.exception("shard load_model failed")
+            return web.json_response(
+                {"status": "error", "message": str(exc)}, status=500
+            )
+        return web.json_response(
+            {"status": "ok", "load_time_s": time.perf_counter() - t0}
+        )
+
+    async def unload_model(self, request: web.Request) -> web.Response:
+        await self.shard.unload_model()
+        return web.json_response({"status": "ok"})
+
+    async def measure_latency(self, request: web.Request) -> web.Response:
+        """Probe each peer over gRPC with increasing payloads; return
+        median RTT seconds per (peer, size) (reference shard/http_api.py:85-204)."""
+        try:
+            req = MeasureLatencyRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return web.json_response(
+                {"status": "error", "message": f"invalid request: {exc}"}, status=400
+            )
+        from dnet_tpu.transport.grpc_transport import RingClient
+        from dnet_tpu.transport.protocol import LatencyProbe
+
+        results = {}
+        for peer in req.peers:
+            client = RingClient(peer)
+            peer_res = {}
+            try:
+                for size in req.payload_sizes:
+                    rtts = []
+                    payload = b"\x00" * size
+                    for _ in range(req.rounds):
+                        t0 = time.perf_counter()
+                        try:
+                            await client.measure_latency(
+                                LatencyProbe(t_sent=time.time(), payload=payload)
+                            )
+                            rtts.append(time.perf_counter() - t0)
+                        except Exception as exc:
+                            log.warning("latency probe to %s failed: %s", peer, exc)
+                    if rtts:
+                        rtts.sort()
+                        peer_res[str(size)] = rtts[len(rtts) // 2]
+            finally:
+                await client.close()
+            results[peer] = peer_res
+        return web.json_response({"status": "ok", "latency": results})
+
+    async def profile(self, request: web.Request) -> web.Response:
+        """Device microbenchmark (subprocess isolation lands with the solver)."""
+        from dnet_tpu.parallel.profiler import profile_device_quick
+
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(None, profile_device_quick)
+        return web.json_response({"status": "ok", "profile": result})

@@ -1,0 +1,165 @@
+"""ShardRuntime: queues + dedicated compute thread + model lifecycle.
+
+Mirrors the reference's topology-agnostic runtime
+(src/dnet/shard/runtime.py): a bounded ingress queue feeds ONE compute
+thread (XLA dispatch never blocks the event loop), results flow to an
+asyncio-side output queue, per-nonce KV sessions expire by TTL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from typing import Optional
+
+from dnet_tpu.core.types import ActivationMessage
+from dnet_tpu.shard.compute import ShardCompute
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class ShardRuntime:
+    def __init__(self, shard_id: str, queue_size: int = 256) -> None:
+        self.shard_id = shard_id
+        self.compute: Optional[ShardCompute] = None
+        self.model_path: str = ""
+        self.recv_q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self.out_q: Optional[asyncio.Queue] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._model_lock = threading.Lock()
+        self._sweeper_task = None
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+        self.out_q = asyncio.Queue(maxsize=1024)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._compute_worker, name="shard-compute", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.recv_q.put(None)  # wake the worker
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ---- model ----------------------------------------------------------
+    def load_model_core(
+        self,
+        model_dir: str,
+        layers: list[int],
+        max_seq: int = 4096,
+        param_dtype: str = "bfloat16",
+        wire_dtype: str = "bfloat16",
+        kv_ttl_s: float = 600.0,
+    ) -> None:
+        """Blocking (call from an executor)."""
+        with self._model_lock:
+            t0 = time.perf_counter()
+            self.compute = ShardCompute(
+                model_dir,
+                layers,
+                max_seq=max_seq,
+                param_dtype=param_dtype,
+                wire_dtype=wire_dtype,
+                kv_ttl_s=kv_ttl_s,
+            )
+            self.model_path = str(model_dir)
+            log.info(
+                "shard %s loaded layers %s..%s in %.1fs",
+                self.shard_id,
+                min(layers),
+                max(layers),
+                time.perf_counter() - t0,
+            )
+
+    def unload_model_core(self) -> None:
+        with self._model_lock:
+            self._drain_queue()
+            self.compute = None
+            self.model_path = ""
+            import gc
+
+            gc.collect()
+
+    def _drain_queue(self) -> None:
+        try:
+            while True:
+                self.recv_q.get_nowait()
+        except queue.Empty:
+            pass
+
+    # ---- data path --------------------------------------------------------
+    def submit(self, msg: ActivationMessage, timeout: float = 5.0) -> bool:
+        """Called from the event loop / gRPC thread; bounded for backpressure."""
+        try:
+            self.recv_q.put(msg, timeout=timeout)
+            return True
+        except queue.Full:
+            return False
+
+    @property
+    def queue_depth(self) -> int:
+        return self.recv_q.qsize()
+
+    def _compute_worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self.recv_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if msg is None:
+                continue
+            compute = self.compute
+            if compute is None:
+                log.warning("dropping frame for %s: no model loaded", msg.nonce)
+                continue
+            try:
+                msg.t_enq = time.perf_counter()
+                out = compute.process(msg)
+                self._emit(out)
+            except Exception as exc:
+                log.exception("compute failed for nonce %s", msg.nonce)
+                self._emit(
+                    ActivationMessage(
+                        nonce=msg.nonce,
+                        layer_id=msg.layer_id,
+                        seq=msg.seq,
+                        dtype="error",
+                        shape=(),
+                        pos=msg.pos,
+                        callback_url=msg.callback_url,
+                        is_final=True,
+                        token_id=-1,
+                        error=str(exc),
+                    )
+                )
+
+    def _emit(self, out: ActivationMessage) -> None:
+        if self._loop is None or self.out_q is None:
+            return
+        out.t_tx_enq = time.perf_counter()
+        self._loop.call_soon_threadsafe(self._put_out, out)
+
+    def _put_out(self, out: ActivationMessage) -> None:
+        try:
+            self.out_q.put_nowait(out)
+        except asyncio.QueueFull:
+            log.error("output queue full; dropping frame for %s", out.nonce)
+
+    # ---- maintenance ------------------------------------------------------
+    async def sweeper(self, interval_s: float = 30.0) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            if self.compute is not None:
+                n = self.compute.sweep_sessions()
+                if n:
+                    log.info("swept %d expired KV sessions", n)

@@ -1,0 +1,110 @@
+"""Shard-node process wiring (reference: src/cli/shard.py:18-136).
+
+Composes ShardRuntime + RingAdapter + gRPC + HTTP with ordered shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+import socket
+from typing import Optional
+
+from dnet_tpu.config import get_settings
+from dnet_tpu.shard.adapter import RingAdapter
+from dnet_tpu.shard.grpc_servicer import ShardRingServicer
+from dnet_tpu.shard.http import ShardHTTPServer, ShardLoadModelRequest
+from dnet_tpu.shard.runtime import ShardRuntime
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class Shard:
+    """Facade over runtime + adapter (reference: src/dnet/shard/shard.py)."""
+
+    def __init__(self, shard_id: str, runtime: ShardRuntime, adapter: RingAdapter) -> None:
+        self.shard_id = shard_id
+        self.runtime = runtime
+        self.adapter = adapter
+
+    async def start(self) -> None:
+        self.runtime.start(asyncio.get_running_loop())
+        await self.adapter.start()
+
+    async def stop(self) -> None:
+        await self.adapter.shutdown()
+        self.runtime.stop()
+
+    async def load_model(self, req: ShardLoadModelRequest) -> None:
+        from dnet_tpu.api.model_manager import resolve_model_dir
+
+        model_dir = resolve_model_dir(
+            req.model_path, get_settings().shard.models_dir
+        )
+        if model_dir is None:
+            raise FileNotFoundError(f"model {req.model_path!r} not found on shard")
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None,
+            lambda: self.runtime.load_model_core(
+                str(model_dir),
+                req.layers,
+                max_seq=req.max_seq_len,
+                param_dtype=req.param_dtype,
+                wire_dtype=req.wire_dtype,
+            ),
+        )
+        next_addr = f"{req.next_node.host}:{req.next_node.grpc_port}" if req.next_node else ""
+        self.adapter.configure_topology(next_addr)
+
+    async def unload_model(self) -> None:
+        await self.adapter.reset_topology()
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.runtime.unload_model_core)
+
+
+async def serve_async(args) -> None:
+    s = get_settings()
+    shard_id = args.shard_name or f"shard-{socket.gethostname()}-{args.grpc_port}"
+    runtime = ShardRuntime(shard_id, queue_size=args.queue_size)
+    adapter = RingAdapter(
+        runtime,
+        stream_idle_s=s.transport.stream_idle_sweep_s,
+        backoff_s=s.transport.stream_backoff_s,
+    )
+    shard = Shard(shard_id, runtime, adapter)
+
+    from dnet_tpu.transport.grpc_transport import (
+        ring_service_handlers,
+        start_grpc_server,
+    )
+
+    await shard.start()
+    grpc_server = await start_grpc_server(
+        args.host, args.grpc_port, ring_service_handlers(ShardRingServicer(adapter, runtime))
+    )
+    http = ShardHTTPServer(shard)
+    await http.start(args.host, args.http_port)
+
+    sweeper = asyncio.ensure_future(runtime.sweeper())
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    log.info("dnet-shard %s ready (grpc %d, http %d)", shard_id, args.grpc_port, args.http_port)
+    await stop.wait()
+
+    log.info("shard shutting down")
+    sweeper.cancel()
+    await http.stop()
+    await grpc_server.stop(grace=2)
+    await shard.stop()
+
+
+def serve(args) -> None:
+    asyncio.run(serve_async(args))

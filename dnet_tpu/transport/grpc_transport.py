@@ -1,0 +1,168 @@
+"""gRPC channel/server glue for the ring data plane (generic methods).
+
+Channel tuning mirrors the reference (src/dnet/utils/grpc_config.py:29-53):
+64 MiB messages, 1024 streams, conservative keepalive, BDP probe off,
+no proxy.  Services register via grpc generic handlers (no codegen).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+import grpc.aio as aio_grpc
+
+from dnet_tpu.config import get_settings
+from dnet_tpu.transport import protocol as proto
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+def channel_options(settings=None) -> list:
+    s = settings or get_settings()
+    mb = s.grpc.max_message_mb * 1024 * 1024
+    return [
+        ("grpc.max_send_message_length", mb),
+        ("grpc.max_receive_message_length", mb),
+        ("grpc.max_concurrent_streams", s.grpc.max_concurrent_streams),
+        ("grpc.keepalive_time_ms", s.grpc.keepalive_time_ms),
+        ("grpc.keepalive_timeout_ms", s.grpc.keepalive_timeout_ms),
+        ("grpc.http2.bdp_probe", int(s.grpc.http2_bdp_probe)),
+        ("grpc.enable_http_proxy", 0),
+    ]
+
+
+def make_channel(addr: str) -> aio_grpc.Channel:
+    return aio_grpc.insecure_channel(addr, options=channel_options())
+
+
+class RingClient:
+    """Client side of the ring data plane: streams to a peer shard and the
+    unary control RPCs."""
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr
+        self.channel = make_channel(addr)
+        self._stream_stream = self.channel.stream_stream(
+            proto.M_STREAM_ACTIVATIONS,
+            request_serializer=lambda f: f.to_bytes(),
+            response_deserializer=proto.StreamAck.from_bytes,
+        )
+        self._send_activation = self.channel.unary_unary(
+            proto.M_SEND_ACTIVATION,
+            request_serializer=lambda f: f.to_bytes(),
+            response_deserializer=proto.StreamAck.from_bytes,
+        )
+        self._health = self.channel.unary_unary(
+            proto.M_HEALTH_CHECK,
+            request_serializer=lambda m: m.to_bytes(),
+            response_deserializer=proto.HealthInfo.from_bytes,
+        )
+        self._reset = self.channel.unary_unary(
+            proto.M_RESET_CACHE,
+            request_serializer=lambda m: m.to_bytes(),
+            response_deserializer=proto.Empty.from_bytes,
+        )
+        self._latency = self.channel.unary_unary(
+            proto.M_MEASURE_LATENCY,
+            request_serializer=lambda m: m.to_bytes(),
+            response_deserializer=proto.LatencyProbe.from_bytes,
+        )
+
+    def open_stream(self):
+        return self._stream_stream()
+
+    async def send_activation(self, frame: proto.ActivationFrame, timeout: float = 10.0):
+        return await self._send_activation(frame, timeout=timeout)
+
+    async def health_check(self, timeout: float = 5.0) -> proto.HealthInfo:
+        return await self._health(proto.Empty(), timeout=timeout)
+
+    async def reset_cache(self, nonce: str = "", timeout: float = 10.0):
+        return await self._reset(proto.ResetCacheRequest(nonce=nonce), timeout=timeout)
+
+    async def measure_latency(self, probe: proto.LatencyProbe, timeout: float = 30.0):
+        return await self._latency(probe, timeout=timeout)
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+
+class ApiCallbackClient:
+    """Shard -> API unary token callback (shard_api_comm semantics)."""
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr
+        self.channel = make_channel(addr)
+        self._send_token = self.channel.unary_unary(
+            proto.M_SEND_TOKEN,
+            request_serializer=lambda m: m.to_bytes(),
+            response_deserializer=proto.Empty.from_bytes,
+        )
+
+    async def send_token(self, payload: proto.TokenPayload, timeout: float = 3.0):
+        return await self._send_token(payload, timeout=timeout)
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+
+# ---- server-side registration ----------------------------------------------
+
+
+def ring_service_handlers(servicer) -> grpc.GenericRpcHandler:
+    """servicer must provide: stream_activations(iterator, context) async gen,
+    send_activation, health_check, reset_cache, measure_latency coroutines."""
+    return grpc.method_handlers_generic_handler(
+        proto.RING_SERVICE,
+        {
+            "StreamActivations": grpc.stream_stream_rpc_method_handler(
+                servicer.stream_activations,
+                request_deserializer=proto.ActivationFrame.from_bytes,
+                response_serializer=lambda m: m.to_bytes(),
+            ),
+            "SendActivation": grpc.unary_unary_rpc_method_handler(
+                servicer.send_activation,
+                request_deserializer=proto.ActivationFrame.from_bytes,
+                response_serializer=lambda m: m.to_bytes(),
+            ),
+            "HealthCheck": grpc.unary_unary_rpc_method_handler(
+                servicer.health_check,
+                request_deserializer=proto.Empty.from_bytes,
+                response_serializer=lambda m: m.to_bytes(),
+            ),
+            "ResetCache": grpc.unary_unary_rpc_method_handler(
+                servicer.reset_cache,
+                request_deserializer=proto.ResetCacheRequest.from_bytes,
+                response_serializer=lambda m: m.to_bytes(),
+            ),
+            "MeasureLatency": grpc.unary_unary_rpc_method_handler(
+                servicer.measure_latency,
+                request_deserializer=proto.LatencyProbe.from_bytes,
+                response_serializer=lambda m: m.to_bytes(),
+            ),
+        },
+    )
+
+
+def api_service_handlers(servicer) -> grpc.GenericRpcHandler:
+    return grpc.method_handlers_generic_handler(
+        proto.API_SERVICE,
+        {
+            "SendToken": grpc.unary_unary_rpc_method_handler(
+                servicer.send_token,
+                request_deserializer=proto.TokenPayload.from_bytes,
+                response_serializer=lambda m: m.to_bytes(),
+            ),
+        },
+    )
+
+
+async def start_grpc_server(host: str, port: int, *handlers) -> aio_grpc.Server:
+    server = aio_grpc.server(options=channel_options())
+    server.add_generic_rpc_handlers(tuple(handlers))
+    server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    log.info("gRPC listening on %s:%d", host, port)
+    return server

@@ -1,0 +1,201 @@
+"""Ring wire protocol: msgpack-framed messages over gRPC generic methods.
+
+The reference defines three .proto files compiled with protoc
+(src/dnet/protos/dnet_ring.proto, shard_api_comm.proto); this image has no
+grpc codegen plugin, and protobuf offers nothing on this hot path anyway —
+frames are a tiny header + one opaque tensor-bytes blob.  So the wire format
+is msgpack (schema below) and services are registered with grpc generic
+handlers.  Semantics mirror the reference exactly: nonce+seq framed
+activation streaming with per-frame ACKs (dnet_ring.proto:57-68), unary
+token callback (shard_api_comm.proto:34-40), health/reset/latency RPCs.
+
+Every message type has a dataclass + pack/unpack pair; `payload` fields are
+raw little-endian tensor bytes described by (dtype, shape) — same convention
+as dnet_tpu.utils.serialization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional, Tuple
+
+import msgpack
+
+from dnet_tpu.core.types import ActivationMessage, DecodingParams, TokenResult
+
+# gRPC method paths (service namespacing mirrors the reference protos)
+RING_SERVICE = "dnet.DnetRing"
+M_STREAM_ACTIVATIONS = f"/{RING_SERVICE}/StreamActivations"
+M_SEND_ACTIVATION = f"/{RING_SERVICE}/SendActivation"
+M_HEALTH_CHECK = f"/{RING_SERVICE}/HealthCheck"
+M_RESET_CACHE = f"/{RING_SERVICE}/ResetCache"
+M_MEASURE_LATENCY = f"/{RING_SERVICE}/MeasureLatency"
+
+API_SERVICE = "dnet.ShardApi"
+M_SEND_TOKEN = f"/{API_SERVICE}/SendToken"
+
+
+def pack(obj: dict) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> dict:
+    return msgpack.unpackb(data, raw=False)
+
+
+# ---- frames ---------------------------------------------------------------
+
+
+@dataclass
+class ActivationFrame:
+    """One hop of the ring: token injection, hidden-state, or relay."""
+
+    nonce: str
+    seq: int
+    layer_id: int  # last layer applied; -1 = raw tokens entering layer 0
+    pos: int  # absolute sequence offset of this frame's first token
+    dtype: str  # "tokens" | wire dtype name (may carry compression tags)
+    shape: Tuple[int, ...]
+    payload: bytes
+    callback_url: str = ""  # grpc://host:port for the final token
+    decoding: dict = field(default_factory=dict)
+    t_sent: float = 0.0
+
+    def to_bytes(self) -> bytes:
+        d = asdict(self)
+        d["shape"] = list(self.shape)
+        return pack(d)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ActivationFrame":
+        d = unpack(data)
+        d["shape"] = tuple(d["shape"])
+        return cls(**d)
+
+    def to_message(self) -> ActivationMessage:
+        dec = DecodingParams(**self.decoding) if self.decoding else DecodingParams()
+        return ActivationMessage(
+            nonce=self.nonce,
+            layer_id=self.layer_id,
+            seq=self.seq,
+            dtype=self.dtype,
+            shape=self.shape,
+            data=self.payload,
+            pos=self.pos,
+            callback_url=self.callback_url,
+            decoding=dec,
+        )
+
+
+@dataclass
+class StreamAck:
+    nonce: str
+    seq: int
+    ok: bool = True
+    backpressure: bool = False
+    message: str = ""
+
+    def to_bytes(self) -> bytes:
+        return pack(asdict(self))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "StreamAck":
+        return cls(**unpack(data))
+
+
+@dataclass
+class TokenPayload:
+    """Last shard -> API: the sampled token (shard_api_comm.proto:34-40)."""
+
+    nonce: str
+    step: int
+    token_id: int
+    logprob: Optional[float] = None
+    top_ids: List[int] = field(default_factory=list)
+    top_logprobs: List[float] = field(default_factory=list)
+    error: str = ""
+
+    def to_bytes(self) -> bytes:
+        return pack(asdict(self))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "TokenPayload":
+        return cls(**unpack(data))
+
+    def to_result(self) -> TokenResult:
+        top = list(zip(self.top_ids, self.top_logprobs)) if self.top_ids else None
+        return TokenResult(
+            nonce=self.nonce,
+            token_id=self.token_id,
+            logprob=self.logprob,
+            top_logprobs=top,
+            step=self.step,
+            error=self.error,
+        )
+
+    @classmethod
+    def from_result(cls, r: TokenResult) -> "TokenPayload":
+        top = r.top_logprobs or []
+        return cls(
+            nonce=r.nonce,
+            step=r.step,
+            token_id=r.token_id,
+            logprob=r.logprob,
+            top_ids=[t for t, _ in top],
+            top_logprobs=[lp for _, lp in top],
+            error=r.error,
+        )
+
+
+@dataclass
+class HealthInfo:
+    ok: bool = True
+    model: str = ""
+    layers: List[int] = field(default_factory=list)
+    queue_depth: int = 0
+
+    def to_bytes(self) -> bytes:
+        return pack(asdict(self))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "HealthInfo":
+        return cls(**unpack(data))
+
+
+@dataclass
+class ResetCacheRequest:
+    nonce: str = ""  # empty = reset all
+
+    def to_bytes(self) -> bytes:
+        return pack(asdict(self))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ResetCacheRequest":
+        return cls(**unpack(data))
+
+
+@dataclass
+class LatencyProbe:
+    """Echo RPC for link profiling (dnet_ring.proto MeasureLatency)."""
+
+    t_sent: float
+    payload: bytes = b""
+
+    def to_bytes(self) -> bytes:
+        return pack(asdict(self))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "LatencyProbe":
+        return cls(**unpack(data))
+
+
+@dataclass
+class Empty:
+    ok: bool = True
+
+    def to_bytes(self) -> bytes:
+        return pack(asdict(self))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Empty":
+        return cls(**unpack(data))

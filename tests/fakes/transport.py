@@ -1,0 +1,90 @@
+"""Transport-layer fakes: no real sockets (reference tests/fakes pattern)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, List, Optional
+
+from dnet_tpu.transport.protocol import (
+    ActivationFrame,
+    Empty,
+    HealthInfo,
+    LatencyProbe,
+    StreamAck,
+    TokenPayload,
+)
+
+
+class FakeStreamCall:
+    """Stands in for a grpc aio stream-stream call."""
+
+    def __init__(self, on_frame: Optional[Callable] = None):
+        self.written: List[ActivationFrame] = []
+        self.acks: asyncio.Queue = asyncio.Queue()
+        self.on_frame = on_frame
+        self.closed = False
+
+    async def write(self, frame: ActivationFrame) -> None:
+        self.written.append(frame)
+        if self.on_frame:
+            result = self.on_frame(frame)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if isinstance(result, StreamAck):
+                await self.acks.put(result)
+
+    async def read(self):
+        return await self.acks.get()
+
+    async def done_writing(self) -> None:
+        self.closed = True
+
+
+class FakeRingClient:
+    """Stands in for transport.grpc_transport.RingClient."""
+
+    def __init__(self, addr: str, on_frame: Optional[Callable] = None):
+        self.addr = addr
+        self.on_frame = on_frame
+        self.streams: List[FakeStreamCall] = []
+        self.unary_frames: List[ActivationFrame] = []
+        self.resets: List[str] = []
+        self.closed = False
+
+    def open_stream(self) -> FakeStreamCall:
+        call = FakeStreamCall(self.on_frame)
+        self.streams.append(call)
+        return call
+
+    async def send_activation(self, frame, timeout=10.0):
+        self.unary_frames.append(frame)
+        return StreamAck(nonce=frame.nonce, seq=frame.seq, ok=True)
+
+    async def health_check(self, timeout=5.0):
+        return HealthInfo(ok=True)
+
+    async def reset_cache(self, nonce="", timeout=10.0):
+        self.resets.append(nonce)
+        return Empty()
+
+    async def measure_latency(self, probe, timeout=30.0):
+        return LatencyProbe(t_sent=probe.t_sent, payload=probe.payload)
+
+    async def close(self):
+        self.closed = True
+
+
+class FakeCallbackClient:
+    """Stands in for ApiCallbackClient; records tokens."""
+
+    def __init__(self, addr: str, sink: Optional[list] = None):
+        self.addr = addr
+        self.tokens: List[TokenPayload] = sink if sink is not None else []
+        self.closed = False
+
+    async def send_token(self, payload: TokenPayload, timeout=3.0):
+        self.tokens.append(payload)
+        return Empty()
+
+    async def close(self):
+        self.closed = True

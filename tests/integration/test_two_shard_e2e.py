@@ -1,0 +1,175 @@
+"""Real two-process ring on localhost: 2 dnet-shard + 1 dnet-api.
+
+The analog of the reference's integration tier
+(tests/integration/test_model_catalog.py:139-230 + run_two_shards_one_api.sh):
+real gRPC activation streaming, real HTTP control plane, manual topology
+split [0,1]/[2,3], chat completion asserted non-empty and deterministic.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+pytestmark = pytest.mark.integration
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_health(url: str, timeout: float = 60.0) -> dict:
+    t0 = time.monotonic()
+    last = None
+    while time.monotonic() - t0 < timeout:
+        try:
+            r = httpx.get(url, timeout=2.0)
+            if r.status_code == 200:
+                return r.json()
+        except httpx.HTTPError as exc:
+            last = exc
+        time.sleep(0.5)
+    raise TimeoutError(f"{url} not healthy after {timeout}s: {last}")
+
+
+@pytest.fixture(scope="module")
+def cluster(tiny_llama_dir, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cluster")
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+        "DNET_API_PARAM_DTYPE": "float32",
+        "DNET_LOG_TO_FILE": "0",
+    }
+    # shards resolve the model path directly (absolute), no models_dir needed
+    ports = {
+        "s0_http": free_port(), "s0_grpc": free_port(),
+        "s1_http": free_port(), "s1_grpc": free_port(),
+        "api_http": free_port(), "api_grpc": free_port(),
+    }
+    hostfile = tmp / "hostfile"
+    hostfile.write_text(
+        f"s0 127.0.0.1 {ports['s0_http']} {ports['s0_grpc']}\n"
+        f"s1 127.0.0.1 {ports['s1_http']} {ports['s1_grpc']}\n"
+    )
+    procs = []
+    logs = []
+
+    def spawn(name, *argv):
+        lf = open(tmp / f"{name}.log", "w")
+        logs.append((name, tmp / f"{name}.log"))
+        p = subprocess.Popen(
+            [sys.executable, "-m", *argv],
+            env=env, stdout=lf, stderr=subprocess.STDOUT, cwd=str(tmp),
+        )
+        procs.append(p)
+        return p
+
+    spawn(
+        "s0", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+        "--http-port", str(ports["s0_http"]), "--grpc-port", str(ports["s0_grpc"]),
+        "--shard-name", "s0",
+    )
+    spawn(
+        "s1", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+        "--http-port", str(ports["s1_http"]), "--grpc-port", str(ports["s1_grpc"]),
+        "--shard-name", "s1",
+    )
+    spawn(
+        "api", "dnet_tpu.cli.api", "--host", "127.0.0.1",
+        "--http-port", str(ports["api_http"]), "--grpc-port", str(ports["api_grpc"]),
+        "--hostfile", str(hostfile),
+    )
+    try:
+        wait_health(f"http://127.0.0.1:{ports['s0_http']}/health")
+        wait_health(f"http://127.0.0.1:{ports['s1_http']}/health")
+        wait_health(f"http://127.0.0.1:{ports['api_http']}/health")
+        yield ports, tiny_llama_dir
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for name, path in logs:
+            tail = path.read_text()[-2000:]
+            print(f"\n===== {name} log tail =====\n{tail}")
+
+
+def test_two_shard_chat(cluster):
+    ports, model_dir = cluster
+    base = f"http://127.0.0.1:{ports['api_http']}"
+
+    r = httpx.post(
+        f"{base}/v1/prepare_topology_manual",
+        json={
+            "model": str(model_dir),
+            "assignments": [
+                {"instance": "s0", "layers": [0, 1]},
+                {"instance": "s1", "layers": [2, 3]},
+            ],
+        },
+        timeout=30.0,
+    )
+    assert r.status_code == 200, r.text
+    topo = r.json()["topology"]
+    assert topo["assignments"][0]["instance"] == "s0"
+    assert topo["assignments"][0]["next_instance"] == "s1"
+
+    r = httpx.post(
+        f"{base}/v1/load_model", json={"model": str(model_dir)}, timeout=300.0
+    )
+    assert r.status_code == 200, r.text
+
+    # shard health should now report assigned layers
+    h0 = httpx.get(f"http://127.0.0.1:{ports['s0_http']}/health", timeout=5).json()
+    h1 = httpx.get(f"http://127.0.0.1:{ports['s1_http']}/health", timeout=5).json()
+    assert h0["layers"] == [0, 1] and h1["layers"] == [2, 3]
+
+    body = {
+        "model": str(model_dir),
+        "messages": [{"role": "user", "content": "Say hi"}],
+        "max_tokens": 6,
+        "temperature": 0,
+        "profile": True,
+    }
+    r = httpx.post(f"{base}/v1/chat/completions", json=body, timeout=120.0)
+    assert r.status_code == 200, r.text
+    out = r.json()
+    content = out["choices"][0]["message"]["content"]
+    assert out["usage"]["completion_tokens"] >= 1
+    assert out["metrics"]["tokens_generated"] == out["usage"]["completion_tokens"]
+
+    # determinism: same request twice -> same bytes (greedy)
+    r2 = httpx.post(f"{base}/v1/chat/completions", json=body, timeout=120.0)
+    assert r2.json()["choices"][0]["message"]["content"] == content
+
+    # streaming over the real ring
+    with httpx.stream(
+        "POST", f"{base}/v1/chat/completions", json={**body, "stream": True}, timeout=120.0
+    ) as resp:
+        assert resp.status_code == 200
+        lines = [l for l in resp.iter_lines() if l.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    chunks = [json.loads(l[6:]) for l in lines[:-1]]
+    assert chunks[-1]["choices"][0]["finish_reason"] in {"stop", "length"}
+
+    # unload cleans both shards
+    r = httpx.post(f"{base}/v1/unload_model", timeout=60.0)
+    assert r.status_code == 200
+    h0 = httpx.get(f"http://127.0.0.1:{ports['s0_http']}/health", timeout=5).json()
+    assert h0["model"] is None and h0["layers"] == []

@@ -1,0 +1,61 @@
+import numpy as np
+import pytest
+
+from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.transport.protocol import (
+    ActivationFrame,
+    HealthInfo,
+    LatencyProbe,
+    StreamAck,
+    TokenPayload,
+)
+
+pytestmark = pytest.mark.grpc
+
+
+def test_activation_frame_roundtrip():
+    payload = np.arange(6, dtype=np.int32).tobytes()
+    f = ActivationFrame(
+        nonce="n1",
+        seq=3,
+        layer_id=-1,
+        pos=0,
+        dtype="tokens",
+        shape=(1, 6),
+        payload=payload,
+        callback_url="grpc://1.2.3.4:58080",
+        decoding={"temperature": 0.5, "top_k": 10},
+    )
+    g = ActivationFrame.from_bytes(f.to_bytes())
+    assert g.nonce == "n1" and g.seq == 3 and g.layer_id == -1
+    assert g.shape == (1, 6)
+    assert g.payload == payload
+    msg = g.to_message()
+    assert msg.is_tokens
+    np.testing.assert_array_equal(msg.tokens(), [[0, 1, 2, 3, 4, 5]])
+    assert msg.decoding.temperature == 0.5
+    assert msg.decoding.top_k == 10
+
+
+def test_stream_ack_roundtrip():
+    a = StreamAck(nonce="n", seq=9, ok=False, backpressure=True, message="busy")
+    b = StreamAck.from_bytes(a.to_bytes())
+    assert b.backpressure and not b.ok and b.message == "busy"
+
+
+def test_token_payload_roundtrip():
+    r = TokenResult(
+        nonce="x", token_id=42, logprob=-0.5, top_logprobs=[(42, -0.5), (7, -1.2)], step=4
+    )
+    p = TokenPayload.from_result(r)
+    q = TokenPayload.from_bytes(p.to_bytes())
+    r2 = q.to_result()
+    assert r2.token_id == 42 and r2.step == 4
+    assert r2.top_logprobs == [(42, -0.5), (7, -1.2)]
+
+
+def test_health_latency_roundtrip():
+    h = HealthInfo.from_bytes(HealthInfo(model="m", layers=[0, 1], queue_depth=2).to_bytes())
+    assert h.layers == [0, 1]
+    p = LatencyProbe.from_bytes(LatencyProbe(t_sent=1.0, payload=b"xy").to_bytes())
+    assert p.payload == b"xy"

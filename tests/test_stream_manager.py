@@ -1,0 +1,71 @@
+import asyncio
+import time
+
+import pytest
+
+from dnet_tpu.transport.protocol import ActivationFrame, StreamAck
+from dnet_tpu.transport.stream_manager import StreamManager
+from tests.fakes.transport import FakeStreamCall
+
+pytestmark = pytest.mark.grpc
+
+
+def frame(nonce="n", seq=0):
+    return ActivationFrame(
+        nonce=nonce, seq=seq, layer_id=-1, pos=0, dtype="tokens", shape=(1, 1), payload=b"\x01\x00\x00\x00"
+    )
+
+
+def test_lazy_stream_and_seq_assignment():
+    async def go():
+        calls = []
+
+        def opener():
+            call = FakeStreamCall()
+            calls.append(call)
+            return call
+
+        sm = StreamManager(opener)
+        await sm.send("a", frame("a", seq=5))
+        await sm.send("a", frame("a", seq=6))
+        await sm.send("b", frame("b", seq=0))
+        assert len(calls) == 2  # one stream per nonce
+        # caller-assigned seq is the end-to-end step identity: preserved
+        assert [f.seq for f in calls[0].written] == [5, 6]
+        assert [f.seq for f in calls[1].written] == [0]
+        await sm.shutdown()
+        assert calls[0].closed and calls[1].closed
+
+    asyncio.run(go())
+
+
+def test_backpressure_pauses_sends():
+    async def go():
+        def on_frame(f):
+            if f.seq == 0:
+                return StreamAck(nonce=f.nonce, seq=f.seq, ok=True, backpressure=True)
+            return StreamAck(nonce=f.nonce, seq=f.seq, ok=True)
+
+        call = FakeStreamCall(on_frame)
+        sm = StreamManager(lambda: call, backoff_s=0.15)
+        await sm.send("n", frame())
+        await asyncio.sleep(0.05)  # let the ack reader see the backpressure ack
+        t0 = time.monotonic()
+        await sm.send("n", frame())
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.08, f"send was not delayed by backpressure ({elapsed:.3f}s)"
+        await sm.shutdown()
+
+    asyncio.run(go())
+
+
+def test_idle_cleanup():
+    async def go():
+        sm = StreamManager(lambda: FakeStreamCall(), idle_timeout_s=0.01)
+        await sm.send("x", frame("x"))
+        await asyncio.sleep(0.05)
+        closed = await sm.cleanup_idle()
+        assert closed == 1
+        await sm.shutdown()
+
+    asyncio.run(go())
