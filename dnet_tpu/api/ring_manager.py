@@ -51,6 +51,13 @@ def build_manual_topology(
             f"assignments must cover layers 0..{num_layers - 1} exactly once; "
             f"got {sorted(covered)}"
         )
+    for a in las:
+        # each shard applies its layers as one contiguous window; a gap would
+        # silently run layers out of order
+        if a.layers != list(range(a.layers[0], a.layers[-1] + 1)):
+            raise ValueError(
+                f"layers for {a.instance!r} must be contiguous; got {a.layers}"
+            )
     for i, a in enumerate(las):
         a.next_instance = las[(i + 1) % len(las)].instance
     used = [by_instance[a.instance] for a in las]

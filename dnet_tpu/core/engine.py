@@ -41,7 +41,8 @@ def bucket_length(n: int, min_bucket: int = 16) -> int:
 class Session:
     """Per-nonce decode state."""
 
-    kv: dict
+    kv: dict = None  # stacked [L, ...] cache (fit policy)
+    kv_list: list = None  # per-layer [1, ...] caches (offload policies)
     pos: int = 0
     key: jax.Array = None
     counts: jax.Array = None  # [B, V] int32 seen-token counts (repetition penalty)
@@ -65,6 +66,9 @@ class LocalEngine:
         kv_dtype: Optional[str] = None,
         kv_ttl_s: float = 600.0,
         shard_mode: bool = False,
+        window_size: int = 0,
+        residency_size: int = 0,
+        repack_dir: Optional[str] = None,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -82,6 +86,15 @@ class LocalEngine:
         self.shard_mode = shard_mode
         self.sessions: Dict[str, Session] = {}
 
+        from dnet_tpu.core.weights import plan_policy
+
+        self.plan = plan_policy(
+            len(self.model.layers), window_size, residency_size
+        )
+        self._repack_dir = repack_dir
+        self.weight_cache = None
+        self._windows: list[list[int]] = []
+
         self._load_params()
         self._build_fns()
 
@@ -98,9 +111,27 @@ class LocalEngine:
     def _load_params(self) -> None:
         t0 = time.perf_counter()
         m = self.model
-        per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
-        stacked = m.stack_layers(per_layer)
-        self.window_params = self._cast(stacked)
+        if self.plan.streams_weights:
+            # offload / sliding_fit: layers stream host<->HBM via WeightCache
+            from dnet_tpu.core.weights import HostLayerStore, WeightCache
+
+            store = HostLayerStore(
+                self.ckpt,
+                m,
+                param_dtype=str(self.param_dtype),
+                repack_dir=self._repack_dir,
+            )
+            self.weight_cache = WeightCache(store, max_resident=self.plan.residency)
+            w = self.plan.window_size
+            self._windows = [
+                m.layers[i : i + w] for i in range(0, len(m.layers), w)
+            ]
+            self.window_params = None
+            self.weight_cache.prefetch(self._windows[0])
+        else:
+            per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
+            stacked = m.stack_layers(per_layer)
+            self.window_params = self._cast(stacked)
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())
         if self.shard_mode:
             tied = self.config.tie_word_embeddings
@@ -167,16 +198,60 @@ class LocalEngine:
 
         self._hidden_tail = jax.jit(hidden_tail, donate_argnums=(3, 8))
 
+    # ---- offload execution --------------------------------------------
+    def run_layers(self, sess: "Session", x: jnp.ndarray, pos: int) -> jnp.ndarray:
+        """Apply this engine's layers to x under the active policy.
+
+        Fit: one fused scan over the resident stack.  Offload/sliding_fit:
+        window-at-a-time — wait on the current window's prefetch, compute
+        per-layer (one compiled program reused for every layer), prefetch
+        the next window during compute, release+evict behind us, and wrap
+        the prefetch to window 0 for the next token
+        (reference offload.py:183-421)."""
+        if not self.plan.streams_weights:
+            x, sess.kv = self._hidden(self.window_params, x, sess.kv, jnp.int32(pos))
+            return x
+        windows = self._windows
+        sliding = self.plan.name == "sliding_fit"
+        for wi, window in enumerate(windows):
+            nxt = windows[(wi + 1) % len(windows)]
+            if len(windows) > 1:
+                self.weight_cache.prefetch(nxt)
+            for layer in window:
+                p = self.weight_cache.get(layer)
+                li = self.model.abs_to_local[layer]
+                x, sess.kv_list[li] = self._hidden(
+                    p, x, sess.kv_list[li], jnp.int32(pos)
+                )
+                # unpin immediately so the residency budget can evict behind
+                # us; sliding_fit (residency < window) delta-swaps eagerly
+                self.weight_cache.release([layer])
+                if sliding:
+                    self.weight_cache.evict([layer])
+            if len(windows) > 1 and not sliding:
+                self.weight_cache.evict(window)  # make room for what's coming
+        return x
+
     # ---- sessions -----------------------------------------------------
     def new_session(self, nonce: str, seed: Optional[int] = None) -> Session:
-        kv = init_cache(
-            self.model.kv_config(len(self.model.layers), self.batch, self.max_seq, self.kv_dtype)
-        )
         if seed is None:
             # fresh entropy per unseeded request — two users must not share a stream
             seed = int.from_bytes(__import__("os").urandom(4), "little")
+        if self.plan.streams_weights:
+            kv, kv_list = None, [
+                init_cache(self.model.kv_config(1, self.batch, self.max_seq, self.kv_dtype))
+                for _ in self.model.layers
+            ]
+        else:
+            kv = init_cache(
+                self.model.kv_config(
+                    len(self.model.layers), self.batch, self.max_seq, self.kv_dtype
+                )
+            )
+            kv_list = None
         sess = Session(
             kv=kv,
+            kv_list=kv_list,
             pos=0,
             key=jax.random.key(seed),
             counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
@@ -197,6 +272,11 @@ class LocalEngine:
     def reset(self) -> None:
         self.sessions.clear()
 
+    def close(self) -> None:
+        self.sessions.clear()
+        if self.weight_cache is not None:
+            self.weight_cache.shutdown()
+
     # ---- inference ----------------------------------------------------
     def prefill(self, nonce: str, prompt_ids: Sequence[int], seed: Optional[int] = None):
         """Run the prompt; returns logits at the last real position.
@@ -214,10 +294,17 @@ class LocalEngine:
         Tpad = min(bucket_length(T), self.max_seq)
         tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
         tokens[:, :T] = np.asarray(prompt_ids, dtype=np.int32)
-        logits, sess.kv = self._forward(
-            self.window_params, self.edge_params, jnp.asarray(tokens), sess.kv,
-            jnp.int32(sess.pos), jnp.int32(T - 1),
-        )
+        if self.plan.streams_weights:
+            x = self.model.embed(self.edge_params, jnp.asarray(tokens))
+            x = self.run_layers(sess, x, sess.pos)
+            x_last = jax.lax.dynamic_slice_in_dim(x, T - 1, 1, axis=1)
+            x_last = self.model.normalize(self.edge_params, x_last)
+            logits = self.model.lm_project(self.edge_params, x_last)[:, 0]
+        else:
+            logits, sess.kv = self._forward(
+                self.window_params, self.edge_params, jnp.asarray(tokens), sess.kv,
+                jnp.int32(sess.pos), jnp.int32(T - 1),
+            )
         # repetition penalty counts GENERATED tokens only (prompt tokens are
         # not seeded): the ring's sampling shard never sees prompt ids, so
         # both serving paths must share this definition to stay equivalent.
@@ -234,10 +321,18 @@ class LocalEngine:
         sess.key, step_key = jax.random.split(sess.key)
         sp = SampleParams.from_decoding(decoding)
         token = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
-        res, sess.kv, sess.counts = self._decode(
-            self.window_params, self.edge_params, token, sess.kv,
-            jnp.int32(sess.pos), sp, step_key, sess.counts,
-        )
+        if self.plan.streams_weights:
+            x = self.model.embed(self.edge_params, token)
+            x = self.run_layers(sess, x, sess.pos)
+            x = self.model.normalize(self.edge_params, x)
+            logits = self.model.lm_project(self.edge_params, x)[:, 0]
+            res = sample(logits, sp, step_key, token_counts=sess.counts)
+            sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
+        else:
+            res, sess.kv, sess.counts = self._decode(
+                self.window_params, self.edge_params, token, sess.kv,
+                jnp.int32(sess.pos), sp, step_key, sess.counts,
+            )
         sess.pos += 1
         sess.last_used = time.time()
         return res

@@ -37,14 +37,33 @@ class ShardCompute:
         param_dtype: str = "bfloat16",
         wire_dtype: str = "bfloat16",
         kv_ttl_s: float = 600.0,
+        window_size: int = 0,
+        residency_size: int = 0,
+        repack_dir: Optional[str] = None,
+        kv_bits: int = 0,
     ) -> None:
+        kv_dtype = None
+        if kv_bits == 16:
+            kv_dtype = "bfloat16"
+        elif kv_bits in (4, 8):
+            # int8/int4 quantized KV lands with the quantization subsystem;
+            # fail loud rather than silently blowing the memory plan
+            log.warning(
+                "kv_bits=%d not yet implemented on TPU backend; using bf16 KV "
+                "(memory use will be higher than the solver planned)", kv_bits
+            )
+            kv_dtype = "bfloat16"
         self.engine = LocalEngine(
             model_dir,
             layers=layers,
             max_seq=max_seq,
             param_dtype=param_dtype,
+            kv_dtype=kv_dtype,
             kv_ttl_s=kv_ttl_s,
             shard_mode=True,
+            window_size=window_size,
+            residency_size=residency_size,
+            repack_dir=repack_dir,
         )
         self.layers = self.engine.model.layers
         self.wire_dtype = wire_dtype
@@ -73,6 +92,8 @@ class ShardCompute:
         sess = eng.sessions.get(nonce) or eng.new_session(nonce, msg.decoding.seed)
         pos = msg.pos
 
+        streams = eng.plan.streams_weights
+
         if msg.is_tokens:
             if not self.is_first:
                 raise ValueError("token frame arrived at a non-first shard")
@@ -85,10 +106,14 @@ class ShardCompute:
                 raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
             tokens = np.zeros((eng.batch, Tpad), dtype=np.int32)
             tokens[:, :T] = ids.reshape(1, -1)
-            x, sess.kv = eng._embed_window(
-                eng.window_params, eng.edge_params, jnp.asarray(tokens),
-                sess.kv, jnp.int32(pos),
-            )
+            if streams:
+                x = eng.model.embed(eng.edge_params, jnp.asarray(tokens))
+                x = eng.run_layers(sess, x, pos)
+            else:
+                x, sess.kv = eng._embed_window(
+                    eng.window_params, eng.edge_params, jnp.asarray(tokens),
+                    sess.kv, jnp.int32(pos),
+                )
         else:
             hidden = bytes_to_tensor(msg.data, msg.dtype, msg.shape)
             T = hidden.shape[1]
@@ -101,7 +126,10 @@ class ShardCompute:
                 )
                 hidden = np.concatenate([hidden, pad], axis=1)
             x = jnp.asarray(hidden).astype(eng.param_dtype)
-            if self.is_last:
+            if streams:
+                x = eng.run_layers(sess, x, pos)
+            elif self.is_last:
+                # fused window+head+sample fast path
                 sess.key, step_key = jax.random.split(sess.key)
                 sp = SampleParams.from_decoding(msg.decoding)
                 res, sess.kv, sess.counts = eng._hidden_tail(
@@ -111,13 +139,14 @@ class ShardCompute:
                 sess.pos = pos + T
                 sess.last_used = time.time()
                 return self._final_message(msg, res)
-            x, sess.kv = eng._hidden(eng.window_params, x, sess.kv, jnp.int32(pos))
+            else:
+                x, sess.kv = eng._hidden(eng.window_params, x, sess.kv, jnp.int32(pos))
 
         sess.pos = pos + T
         sess.last_used = time.time()
 
-        if self.is_last and msg.is_tokens:
-            # single-shard ring: embed+window above, tail here
+        if self.is_last:
+            # tail after a streamed window pass or a single-shard token frame
             sess.key, step_key = jax.random.split(sess.key)
             sp = SampleParams.from_decoding(msg.decoding)
             x_last = jax.lax.dynamic_slice_in_dim(x, T - 1, 1, axis=1)

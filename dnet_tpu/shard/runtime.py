@@ -46,7 +46,10 @@ class ShardRuntime:
 
     def stop(self) -> None:
         self._stop.set()
-        self.recv_q.put(None)  # wake the worker
+        try:
+            self.recv_q.put_nowait(None)  # wake the worker; full queue is fine,
+        except queue.Full:  # the worker exits on the next timeout poll
+            pass
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
@@ -60,10 +63,17 @@ class ShardRuntime:
         param_dtype: str = "bfloat16",
         wire_dtype: str = "bfloat16",
         kv_ttl_s: float = 600.0,
+        window_size: int = 0,
+        residency_size: int = 0,
+        repack_dir: str | None = None,
+        kv_bits: int = 0,
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
             t0 = time.perf_counter()
+            if self.compute is not None:  # reload: free the old engine first
+                self.compute.engine.close()
+                self.compute = None
             self.compute = ShardCompute(
                 model_dir,
                 layers,
@@ -71,6 +81,10 @@ class ShardRuntime:
                 param_dtype=param_dtype,
                 wire_dtype=wire_dtype,
                 kv_ttl_s=kv_ttl_s,
+                window_size=window_size,
+                residency_size=residency_size,
+                repack_dir=repack_dir,
+                kv_bits=kv_bits,
             )
             self.model_path = str(model_dir)
             log.info(
@@ -84,6 +98,8 @@ class ShardRuntime:
     def unload_model_core(self) -> None:
         with self._model_lock:
             self._drain_queue()
+            if self.compute is not None:
+                self.compute.engine.close()
             self.compute = None
             self.model_path = ""
             import gc

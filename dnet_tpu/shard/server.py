@@ -53,6 +53,9 @@ class Shard:
                 max_seq=req.max_seq_len,
                 param_dtype=req.param_dtype,
                 wire_dtype=req.wire_dtype,
+                window_size=req.window_size,
+                residency_size=req.residency_size,
+                kv_bits=req.kv_bits,
             ),
         )
         next_addr = f"{req.next_node.host}:{req.next_node.grpc_port}" if req.next_node else ""

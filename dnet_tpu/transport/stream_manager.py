@@ -72,7 +72,13 @@ class StreamManager:
         while ctx.disabled:
             await asyncio.sleep(max(ctx.disabled_until - time.monotonic(), 0.01))
         ctx.seq += 1
-        await ctx.call.write(frame)
+        try:
+            await ctx.call.write(frame)
+        except Exception:
+            # dead stream (peer restarted, channel reset): drop the context so
+            # the next frame reopens a fresh stream instead of failing forever
+            await self.end_stream(nonce)
+            raise
         ctx.last_used = time.monotonic()
 
     async def _ack_reader(self, ctx: StreamContext) -> None:
