@@ -69,6 +69,7 @@ class LocalEngine:
         window_size: int = 0,
         residency_size: int = 0,
         repack_dir: Optional[str] = None,
+        kv_quant_bits: int = 0,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -79,6 +80,7 @@ class LocalEngine:
         self.max_seq = max_seq
         self.param_dtype = jnp.dtype(param_dtype)
         self.kv_dtype = kv_dtype or param_dtype
+        self.kv_quant_bits = kv_quant_bits
         self.kv_ttl_s = kv_ttl_s
         # shard_mode: load only the edge weights this layer range needs
         # (reference: edge tensors loaded iff shard holds layer 0 / the last
@@ -173,10 +175,11 @@ class LocalEngine:
 
         self._decode = jax.jit(decode_and_sample, donate_argnums=(3, 7))
 
-        def hidden_step(window_params, x, kv, pos):
-            return model.apply_window(window_params, x, kv, pos)
+        def hidden_step(window_params, x, kv, pos, kinds=None):
+            return model.apply_window(window_params, x, kv, pos, layer_kinds=kinds)
 
-        # mid-shard path (no embed/head): used by the ring runtime
+        # mid-shard path (no embed/head): used by the ring runtime and the
+        # offload per-layer loop (kinds slices the mixed-attention array)
         self._hidden = jax.jit(hidden_step, donate_argnums=(2,))
 
         def embed_window(window_params, edge_params, tokens, kv, pos):
@@ -220,8 +223,13 @@ class LocalEngine:
             for layer in window:
                 p = self.weight_cache.get(layer)
                 li = self.model.abs_to_local[layer]
+                kinds = (
+                    None
+                    if self.model.layer_kinds is None
+                    else self.model.layer_kinds[li : li + 1]
+                )
                 x, sess.kv_list[li] = self._hidden(
-                    p, x, sess.kv_list[li], jnp.int32(pos)
+                    p, x, sess.kv_list[li], jnp.int32(pos), kinds
                 )
                 # unpin immediately so the residency budget can evict behind
                 # us; sliding_fit (residency < window) delta-swaps eagerly
@@ -239,13 +247,19 @@ class LocalEngine:
             seed = int.from_bytes(__import__("os").urandom(4), "little")
         if self.plan.streams_weights:
             kv, kv_list = None, [
-                init_cache(self.model.kv_config(1, self.batch, self.max_seq, self.kv_dtype))
+                init_cache(
+                    self.model.kv_config(
+                        1, self.batch, self.max_seq, self.kv_dtype,
+                        quant_bits=self.kv_quant_bits,
+                    )
+                )
                 for _ in self.model.layers
             ]
         else:
             kv = init_cache(
                 self.model.kv_config(
-                    len(self.model.layers), self.batch, self.max_seq, self.kv_dtype
+                    len(self.model.layers), self.batch, self.max_seq, self.kv_dtype,
+                    quant_bits=self.kv_quant_bits,
                 )
             )
             kv_list = None

@@ -29,25 +29,88 @@ class KVConfig:
     batch: int
     max_seq: int
     n_kv_heads: int
-    head_dim: int
+    head_dim: int  # key head dim
     dtype: str = "bfloat16"
     sliding_window: int = 0  # 0 = full cache; >0 = ring buffer of this size
+    v_head_dim: int = 0  # 0 = same as head_dim (MLA caches differ: k=nope+rope, v=v_head)
+    quant_bits: int = 0  # 0 = dtype as-is; 8 = int8 + per-(pos,head) scales
 
 
 def init_cache(cfg: KVConfig) -> dict:
     seq = cfg.sliding_window if cfg.sliding_window > 0 else cfg.max_seq
-    shape = (cfg.n_layers, cfg.batch, seq, cfg.n_kv_heads, cfg.head_dim)
+    vd = cfg.v_head_dim or cfg.head_dim
+    k_shape = (cfg.n_layers, cfg.batch, seq, cfg.n_kv_heads, cfg.head_dim)
+    v_shape = (cfg.n_layers, cfg.batch, seq, cfg.n_kv_heads, vd)
+    if cfg.quant_bits == 8:
+        scale_shape = (cfg.n_layers, cfg.batch, seq, cfg.n_kv_heads, 1)
+        return {
+            "k": jnp.zeros(k_shape, dtype=jnp.int8),
+            "v": jnp.zeros(v_shape, dtype=jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
+        }
+    if cfg.quant_bits not in (0, 16):
+        raise NotImplementedError(f"kv quant_bits={cfg.quant_bits} (only 0/8/16)")
     dt = jnp.dtype(cfg.dtype)
-    return {
-        "k": jnp.zeros(shape, dtype=dt),
-        "v": jnp.zeros(shape, dtype=dt),
-    }
+    return {"k": jnp.zeros(k_shape, dtype=dt), "v": jnp.zeros(v_shape, dtype=dt)}
 
 
 def cache_nbytes(cfg: KVConfig) -> int:
     seq = cfg.sliding_window if cfg.sliding_window > 0 else cfg.max_seq
-    n = cfg.n_layers * cfg.batch * seq * cfg.n_kv_heads * cfg.head_dim
-    return 2 * n * jnp.dtype(cfg.dtype).itemsize
+    base = cfg.n_layers * cfg.batch * seq * cfg.n_kv_heads
+    vd = cfg.v_head_dim or cfg.head_dim
+    if cfg.quant_bits == 8:
+        return base * (cfg.head_dim + vd) + base * 2 * 4  # int8 + f32 scales
+    return base * (cfg.head_dim + vd) * jnp.dtype(cfg.dtype).itemsize
+
+
+# ---- quantized read/write ---------------------------------------------------
+
+
+def _quantize_q8(x: jnp.ndarray):
+    """Per-(..., head) symmetric int8: scale over the last axis."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def write_kv(kvs: dict, k_new: jnp.ndarray, v_new: jnp.ndarray, pos, kv_commit=None) -> dict:
+    """Write new k/v ([B, T, KVH, Hd]) at `pos` into one layer's cache slices,
+    quantizing when the cache carries scales.  kv_commit gates O(T)."""
+    quant = "k_scale" in kvs
+
+    def gate(new, cache_arr):
+        if kv_commit is None:
+            return new
+        old = lax.dynamic_slice(cache_arr, (0, pos, 0, 0), new.shape)
+        return jnp.where(kv_commit, new, old)
+
+    out = dict(kvs)
+    if quant:
+        kq, ks = _quantize_q8(k_new)
+        vq, vs = _quantize_q8(v_new)
+        for name, val in (("k", kq), ("k_scale", ks), ("v", vq), ("v_scale", vs)):
+            val = gate(val.astype(kvs[name].dtype), kvs[name])
+            out[name] = lax.dynamic_update_slice(kvs[name], val, (0, pos, 0, 0))
+    else:
+        for name, val in (("k", k_new), ("v", v_new)):
+            val = gate(val.astype(kvs[name].dtype), kvs[name])
+            out[name] = lax.dynamic_update_slice(kvs[name], val, (0, pos, 0, 0))
+    return out
+
+
+def read_kv(kvs: dict, dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-cache k/v for attention, dequantizing if needed.
+
+    Quantized path stays f32 (attend computes its softmax/matmuls in f32
+    anyway — a round-trip through bf16 would only add a cast and lose bits).
+    """
+    if "k_scale" in kvs:
+        k = kvs["k"].astype(jnp.float32) * kvs["k_scale"]
+        v = kvs["v"].astype(jnp.float32) * kvs["v_scale"]
+        return k, v
+    return kvs["k"], kvs["v"]
 
 
 def update_layer(

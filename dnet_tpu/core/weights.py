@@ -95,7 +95,7 @@ class HostLayerStore:
         if repack_dir is not None:
             tag = Path(ckpt.dir).name
             key = hashlib.sha1(
-                f"{param_dtype}:{','.join(map(str, model.layers))}".encode()
+                f"v2:{param_dtype}:{','.join(map(str, model.layers))}".encode()
             ).hexdigest()[:10]
             self.repack_path = Path(repack_dir).expanduser() / tag / key
             self.repack_path.mkdir(parents=True, exist_ok=True)
@@ -108,26 +108,25 @@ class HostLayerStore:
             out[k] = v
         return out
 
-    def layer_host(self, layer: int) -> Dict[str, np.ndarray]:
-        """Mapped host params for one layer, with a leading [1, ...] axis so
-        device copies bind directly into single-layer window programs."""
+    def layer_host(self, layer: int):
+        """ONE layer's host params shaped as a single-layer window pytree
+        (model.wrap_offload_layer), ready for device placement."""
         with self._lock:
             if layer in self._cache:
                 return self._cache[layer]
-        params = self._load_layer(layer)
+        params = self.model.wrap_offload_layer(self._load_layer_flat(layer))
         with self._lock:
             self._cache[layer] = params
         return params
 
-    def _load_layer(self, layer: int) -> Dict[str, np.ndarray]:
+    def _load_layer_flat(self, layer: int) -> Dict[str, np.ndarray]:
         if self.repack_path is not None:
             f = self.repack_path / f"layer_{layer}.npz"
             if f.is_file():
                 z = np.load(f)
-                return {k: z[k] for k in z.files}
+                return {k: _bf16_view(z[k]) for k in z.files}
         t0 = time.perf_counter()
-        mapped = self.model.map_layer(self.ckpt.load_layer_raw(layer))
-        mapped = self._cast({k: v[None] for k, v in mapped.items()})
+        mapped = self._cast(self.model.map_layer(self.ckpt.load_layer_raw(layer)))
         log.info(
             "[PROFILE] host-load layer %d in %.1fms", layer, (time.perf_counter() - t0) * 1e3
         )
@@ -174,10 +173,10 @@ class WeightCache:
     def _load_to_device(self, layer: int) -> dict:
         host = self.store.layer_host(layer)
         t0 = time.perf_counter()
-        dev = {
-            k: jax.device_put(_bf16_view(v), self.device) for k, v in host.items()
-        }
-        jax.block_until_ready(list(dev.values()))
+        dev = jax.tree.map(
+            lambda v: jax.device_put(_bf16_view(v), self.device), host
+        )
+        jax.block_until_ready(dev)
         log.info(
             "[PROFILE] HBM-load layer %d in %.1fms", layer, (time.perf_counter() - t0) * 1e3
         )

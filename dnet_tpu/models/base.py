@@ -102,6 +102,9 @@ class RingModel(abc.ABC):
         self.abs_to_local = {a: i for i, a in enumerate(self.layers)}
         self.is_first = 0 in self.abs_to_local
         self.is_last = (config.num_hidden_layers - 1) in self.abs_to_local
+        # per-assigned-layer attention-kind array (models with mixed layer
+        # kinds, e.g. gpt_oss SWA/full, set this; None = homogeneous)
+        self.layer_kinds = None
 
     # ---- pure compute -------------------------------------------------
     @abc.abstractmethod
@@ -148,7 +151,12 @@ class RingModel(abc.ABC):
 
     # ---- cache construction ------------------------------------------
     def kv_config(
-        self, n_layers: int, batch: int, max_seq: int, dtype: str = "bfloat16"
+        self,
+        n_layers: int,
+        batch: int,
+        max_seq: int,
+        dtype: str = "bfloat16",
+        quant_bits: int = 0,
     ) -> KVConfig:
         return KVConfig(
             n_layers=n_layers,
@@ -157,16 +165,26 @@ class RingModel(abc.ABC):
             n_kv_heads=self.config.num_key_value_heads,
             head_dim=self.config.head_dim,
             dtype=dtype,
+            quant_bits=quant_bits,
         )
 
     # ---- helpers ------------------------------------------------------
     @staticmethod
     def stack_layers(per_layer: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
-        """Stack N per-layer param dicts along a new leading axis."""
+        """Stack N per-layer param dicts along a new leading axis.
+
+        Models with heterogeneous layer structures (deepseek dense-vs-MoE)
+        override this (and wrap_offload_layer) with a list layout.
+        """
         if not per_layer:
             return {}
         keys = per_layer[0].keys()
         return {k: np.stack([p[k] for p in per_layer], axis=0) for k in keys}
+
+    def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
+        """Shape ONE layer's mapped host params as a single-layer window (the
+        weight-streaming unit).  Default: add the leading stack axis."""
+        return {k: v[None] for k, v in mapped.items()}
 
     def local_window(self, start_abs: int, size: int) -> List[int]:
         """The contiguous run of assigned layers beginning at start_abs."""

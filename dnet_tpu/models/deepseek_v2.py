@@ -1,0 +1,281 @@
+"""DeepSeek-V2-family ring model: MLA attention + shared/routed MoE.
+
+Reference analog: src/dnet/core/models/deepseek_v2.py (MLA-style model,
+asymmetric head dims).  Architecture (matching transformers' DeepseekV2*):
+
+- MLA: queries via optional LoRA (q_a -> norm -> q_b), KV via a compressed
+  latent (kv_a -> norm -> kv_b) plus a SHARED per-token rope key (MQA-style);
+  rope uses the interleaved/complex-pair convention; K caches nope+rope
+  (qk_head_dim) while V caches v_head_dim — the KV cache is asymmetric.
+- Layers < first_k_dense_replace use a dense swiglu MLP; the rest use MoE:
+  softmax-then-topk routing (greedy or group-limited), routed_scaling_factor,
+  plus always-on shared experts.
+- Dense vs MoE layers have different param structures, so the stacked window
+  is a LIST of per-layer dicts (python-unrolled inside jit) instead of a
+  lax.scan — correctness first; two-segment scans are the planned
+  optimization.  MoE expert compute is dense-weighted (exact numerics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dnet_tpu.core.kvcache import KVConfig, read_kv, write_kv
+from dnet_tpu.models.base import ModelConfig, RingModel
+from dnet_tpu.ops.attention import attend, causal_mask
+from dnet_tpu.ops.norms import rms_norm
+from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
+
+
+class DeepseekV2RingModel(RingModel):
+    model_type = "deepseek_v2"
+
+    def __init__(self, config: ModelConfig, layers):
+        super().__init__(config, layers)
+        x = config.extra
+        self.q_lora_rank = x.get("q_lora_rank")
+        self.qk_nope_head_dim = x.get("qk_nope_head_dim", 128)
+        self.qk_rope_head_dim = x.get("qk_rope_head_dim", 64)
+        self.kv_lora_rank = x.get("kv_lora_rank", 512)
+        self.v_head_dim = x.get("v_head_dim", 128)
+        self.qk_head_dim = self.qk_nope_head_dim + self.qk_rope_head_dim
+        self.n_routed_experts = x.get("n_routed_experts", 0)
+        self.n_shared_experts = x.get("n_shared_experts", 0)
+        self.moe_intermediate_size = x.get("moe_intermediate_size", 0)
+        self.first_k_dense_replace = x.get("first_k_dense_replace", 0)
+        self.routed_scaling_factor = x.get("routed_scaling_factor", 1.0)
+        self.topk_method = x.get("topk_method", "greedy")
+        self.n_group = x.get("n_group", 1)
+        self.topk_group = x.get("topk_group", 1)
+        self.norm_topk_prob = x.get("norm_topk_prob", False)
+        self.num_experts_per_tok = x.get("num_experts_per_tok", 0)
+
+        inv_freq, self.rope_scale = rope_frequencies(
+            self.qk_rope_head_dim,
+            config.rope_theta,
+            config.rope_scaling,
+            config.max_position_embeddings,
+        )
+        self.inv_freq = jnp.asarray(inv_freq)
+
+        # Original DeepSeek-V2 YaRN: softmax scale is compensated by
+        # mscale(factor, mscale_all_dim)^2 (the model was TRAINED with this;
+        # the transformers port drops it when mscale == mscale_all_dim, which
+        # shrinks logits ~1.6x on real checkpoints).
+        self.softmax_scale = self.qk_head_dim**-0.5
+        rs = config.rope_scaling or {}
+        if rs.get("rope_type", rs.get("type")) == "yarn":
+            factor = rs.get("factor", 1.0)
+            msc_all = rs.get("mscale_all_dim", 0)
+            if msc_all and factor > 1:
+                import math
+
+                mscale = 0.1 * msc_all * math.log(factor) + 1.0
+                self.softmax_scale = self.softmax_scale * mscale * mscale
+
+    def is_moe_layer(self, abs_layer: int) -> bool:
+        return self.n_routed_experts > 0 and abs_layer >= self.first_k_dense_replace
+
+    # ---- cache: asymmetric dims --------------------------------------
+    def kv_config(self, n_layers, batch, max_seq, dtype="bfloat16", quant_bits=0) -> KVConfig:
+        return KVConfig(
+            n_layers=n_layers,
+            batch=batch,
+            max_seq=max_seq,
+            n_kv_heads=self.config.num_attention_heads,
+            head_dim=self.qk_head_dim,
+            dtype=dtype,
+            v_head_dim=self.v_head_dim,
+            quant_bits=quant_bits,
+        )
+
+    # ---- pure compute -------------------------------------------------
+    def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        return edge_params["embed"]["weight"][tokens]
+
+    def _attention(self, p, x, kvs, pos, mask):
+        cfg = self.config
+        B, T, D = x.shape
+        H = cfg.num_attention_heads
+        nope, rope_d, vd = self.qk_nope_head_dim, self.qk_rope_head_dim, self.v_head_dim
+
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        if self.q_lora_rank is None:
+            q = h @ p["wq"]
+        else:
+            qa = rms_norm(h @ p["wq_a"], p["q_a_norm"], 1e-6)
+            q = qa @ p["wq_b"]
+        q = q.reshape(B, T, H, self.qk_head_dim)
+        q_nope, q_pe = q[..., :nope], q[..., nope:]
+
+        ckv = h @ p["wkv_a"]  # [B, T, kv_lora + rope_d]
+        k_latent, k_pe = ckv[..., : self.kv_lora_rank], ckv[..., self.kv_lora_rank:]
+        k_latent = rms_norm(k_latent, p["kv_a_norm"], 1e-6)
+        kv = (k_latent @ p["wkv_b"]).reshape(B, T, H, nope + vd)
+        k_nope, v = kv[..., :nope], kv[..., nope:]
+
+        positions = pos + jnp.arange(T)
+        q_pe = apply_rope_interleaved(q_pe, positions, self.inv_freq, self.rope_scale)
+        k_pe = apply_rope_interleaved(
+            k_pe[:, :, None, :], positions, self.inv_freq, self.rope_scale
+        )  # [B, T, 1, rope_d] — shared across heads (MQA-style)
+        k_pe = jnp.broadcast_to(k_pe, (B, T, H, rope_d))
+
+        q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
+        k_full = jnp.concatenate([k_nope, k_pe], axis=-1)
+
+        kvs = write_kv(kvs, k_full, v, pos)
+        kc, vc = read_kv(kvs, q_full.dtype)
+        attn = attend(q_full, kc, vc, mask=mask, scale=self.softmax_scale)
+        out = attn.reshape(B, T, H * vd) @ p["wo"]
+        return x + out, kvs
+
+    def _dense_mlp(self, p_prefix: dict, h: jnp.ndarray) -> jnp.ndarray:
+        gate = h @ p_prefix["w_gate"]
+        up = h @ p_prefix["w_up"]
+        return (jax.nn.silu(gate) * up) @ p_prefix["w_down"]
+
+    def _moe(self, p, x):
+        B, T, D = x.shape
+        h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
+        flat = h.reshape(B * T, D)
+
+        logits = flat.astype(jnp.float32) @ p["gate_w"].astype(jnp.float32)
+        scores = jax.nn.softmax(logits, axis=-1)  # [N, E] f32 softmax over ALL
+        k = self.num_experts_per_tok
+        if self.topk_method == "group_limited_greedy":
+            N, E = scores.shape
+            g = self.n_group
+            group_scores = scores.reshape(N, g, E // g).max(axis=-1)
+            _, group_idx = lax.top_k(group_scores, self.topk_group)
+            group_mask = jnp.zeros_like(group_scores).at[
+                jnp.arange(N)[:, None], group_idx
+            ].set(1.0)
+            score_mask = jnp.repeat(group_mask, E // g, axis=1)
+            masked = jnp.where(score_mask > 0, scores, 0.0)
+            topk_w, topk_idx = lax.top_k(masked, k)
+        else:  # greedy (DeepSeek-V2-Lite)
+            topk_w, topk_idx = lax.top_k(scores, k)
+        if self.norm_topk_prob:
+            topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
+        topk_w = topk_w * self.routed_scaling_factor
+
+        weights = jnp.zeros_like(scores).at[
+            jnp.arange(flat.shape[0])[:, None], topk_idx
+        ].set(topk_w)  # [N, E]
+
+        # dense-weighted expert compute (exact: zero weight for non-top-k)
+        gate = jnp.einsum("nd,edf->nef", flat, p["e_gate"])
+        up = jnp.einsum("nd,edf->nef", flat, p["e_up"])
+        inner = jax.nn.silu(gate) * up
+        expert_out = jnp.einsum("nef,efd->ned", inner, p["e_down"])
+        routed = jnp.einsum("ned,ne->nd", expert_out, weights.astype(flat.dtype))
+
+        shared = self._dense_mlp(
+            {"w_gate": p["s_gate"], "w_up": p["s_up"], "w_down": p["s_down"]}, flat
+        )
+        return x + (routed + shared).reshape(B, T, D)
+
+    def _layer(self, p: dict, x, kvs, pos, mask):
+        x, kvs = self._attention(p, x, kvs, pos, mask)
+        if "e_gate" in p:
+            x = self._moe(p, x)
+        else:
+            h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
+            x = x + self._dense_mlp(p, h)
+        return x, kvs
+
+    def apply_window(
+        self,
+        window_params,
+        x: jnp.ndarray,
+        kv: dict,
+        pos: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        layer_kinds: Optional[jnp.ndarray] = None,
+        tp_axis: Optional[str] = None,
+        kv_commit=None,
+    ) -> Tuple[jnp.ndarray, dict]:
+        if tp_axis is not None or kv_commit is not None:
+            raise NotImplementedError(
+                "deepseek_v2 TP/ring-program support is pending; run pp-only"
+            )
+        if mask is None:
+            mask = causal_mask(x.shape[1], kv["k"].shape[2], pos)
+        layers: List[dict] = window_params["layers"]
+        for li, p in enumerate(layers):
+            kvs = jax.tree.map(lambda a: a[li], kv)
+            x, kvs = self._layer(p, x, kvs, pos, mask)
+            kv = jax.tree.map(lambda full, one: full.at[li].set(one), kv, kvs)
+        return x, kv
+
+    def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)
+
+    def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        if self.config.tie_word_embeddings:
+            return x @ edge_params["embed"]["weight"].T
+        return x @ edge_params["lm_head"]["weight"]
+
+    # ---- weight mapping ----------------------------------------------
+    def stack_layers(self, per_layer: List[Dict[str, np.ndarray]]):
+        """Heterogeneous layers (dense vs MoE): keep a list, no stacking."""
+        return {"layers": list(per_layer)}
+
+    def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
+        return {"layers": [mapped]}
+
+    def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        def t(name):
+            return np.ascontiguousarray(raw[name].T)
+
+        p: Dict[str, np.ndarray] = {
+            "attn_norm": raw["input_layernorm.weight"],
+            "mlp_norm": raw["post_attention_layernorm.weight"],
+            "wkv_a": t("self_attn.kv_a_proj_with_mqa.weight"),
+            "kv_a_norm": raw["self_attn.kv_a_layernorm.weight"],
+            "wkv_b": t("self_attn.kv_b_proj.weight"),
+            "wo": t("self_attn.o_proj.weight"),
+        }
+        if "self_attn.q_proj.weight" in raw:
+            p["wq"] = t("self_attn.q_proj.weight")
+        else:
+            p["wq_a"] = t("self_attn.q_a_proj.weight")
+            p["q_a_norm"] = raw["self_attn.q_a_layernorm.weight"]
+            p["wq_b"] = t("self_attn.q_b_proj.weight")
+
+        if "mlp.gate.weight" in raw:  # MoE layer
+            p["gate_w"] = t("mlp.gate.weight")
+            e_gate, e_up, e_down = [], [], []
+            e = 0
+            while f"mlp.experts.{e}.gate_proj.weight" in raw:
+                e_gate.append(t(f"mlp.experts.{e}.gate_proj.weight"))
+                e_up.append(t(f"mlp.experts.{e}.up_proj.weight"))
+                e_down.append(t(f"mlp.experts.{e}.down_proj.weight"))
+                e += 1
+            p["e_gate"] = np.stack(e_gate)
+            p["e_up"] = np.stack(e_up)
+            p["e_down"] = np.stack(e_down)
+            p["s_gate"] = t("mlp.shared_experts.gate_proj.weight")
+            p["s_up"] = t("mlp.shared_experts.up_proj.weight")
+            p["s_down"] = t("mlp.shared_experts.down_proj.weight")
+        else:  # dense layer
+            p["w_gate"] = t("mlp.gate_proj.weight")
+            p["w_up"] = t("mlp.up_proj.weight")
+            p["w_down"] = t("mlp.down_proj.weight")
+        return p
+
+    def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if "model.embed_tokens.weight" in raw:
+            out["embed"] = {"weight": raw["model.embed_tokens.weight"]}
+        if "model.norm.weight" in raw:
+            out["final_norm"] = {"weight": raw["model.norm.weight"]}
+        if "lm_head.weight" in raw:
+            out["lm_head"] = {"weight": np.ascontiguousarray(raw["lm_head.weight"].T)}
+        return out

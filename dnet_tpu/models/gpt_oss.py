@@ -1,0 +1,189 @@
+"""GPT-OSS-family ring model: MoE + alternating sliding/full attention + sinks.
+
+Reference analog: src/dnet/core/models/gpt_oss.py (dual full/SWA masks,
+GLOBAL-vs-LOCAL cache handling, MXFP4 desharding).  TPU-first design:
+
+- Alternating layer kinds stay inside ONE `lax.scan`: both masks are built
+  once per window and each layer selects by its kind scalar (kind rides the
+  scan xs, so one compiled program serves both kinds).  KV is full-length
+  with an SWA mask — trades the RotatingKVCache's memory saving for a single
+  fused program; grouped scans can reclaim the memory later.
+- MoE experts are computed densely and weighted by the router's scattered
+  scores (zero for non-top-k => exact numerics) — MXU-friendly einsum over
+  the expert dim; `tp_axis` shards the EXPERT dim, so tensor-parallel ranks
+  are expert-parallel here and the psum over partial outputs is the routed sum.
+- Attention sinks ride through ops.attention.attend(sinks=...).
+
+Weights follow the HF dequantized layout (experts as [E, D, 2F]/[E, F, D]
+with interleaved gate/up columns, clamped swiglu alpha=1.702, limit=7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dnet_tpu.core.kvcache import read_kv, write_kv
+from dnet_tpu.models.base import ModelConfig, RingModel
+from dnet_tpu.ops.attention import attend, causal_mask, sliding_window_mask
+from dnet_tpu.ops.norms import rms_norm
+from dnet_tpu.ops.rope import apply_rope, rope_frequencies
+
+ALPHA = 1.702
+LIMIT = 7.0
+
+
+class GptOssRingModel(RingModel):
+    model_type = "gpt_oss"
+
+    def __init__(self, config: ModelConfig, layers):
+        super().__init__(config, layers)
+        inv_freq, self.rope_scale = rope_frequencies(
+            config.head_dim,
+            config.rope_theta,
+            config.rope_scaling,
+            config.max_position_embeddings,
+        )
+        self.inv_freq = jnp.asarray(inv_freq)
+        kinds = config.layer_types or ["full_attention"] * config.num_hidden_layers
+        # kind per ASSIGNED layer (0=full, 1=sliding), aligned with the stack
+        self.layer_kinds = jnp.asarray(
+            [1 if kinds[a] == "sliding_attention" else 0 for a in self.layers],
+            dtype=jnp.int32,
+        )
+
+    # ---- pure compute -------------------------------------------------
+    def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        return edge_params["embed"]["weight"][tokens]
+
+    def _attention(self, p, x, kvs, pos, mask, tp_axis, kv_commit):
+        cfg = self.config
+        B, T, D = x.shape
+        Hd = cfg.head_dim
+        H = p["wq"].shape[-1] // Hd
+        KVH = p["wk"].shape[-1] // Hd
+
+        h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ p["wq"] + p["bq"]).reshape(B, T, H, Hd)
+        k = (h @ p["wk"] + p["bk"]).reshape(B, T, KVH, Hd)
+        v = (h @ p["wv"] + p["bv"]).reshape(B, T, KVH, Hd)
+        positions = pos + jnp.arange(T)
+        q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
+        k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
+        kvs = write_kv(kvs, k, v, pos, kv_commit)
+        kc, vc = read_kv(kvs, q.dtype)
+        attn = attend(q, kc, vc, mask=mask, sinks=p["sinks"])
+        out = attn.reshape(B, T, H * Hd) @ p["wo"]
+        if tp_axis is not None:
+            out = lax.psum(out, tp_axis)
+        out = out + p["bo"]  # bias replicated: add once, after the psum
+        return x + out, kvs
+
+    def _moe(self, p, x, tp_axis):
+        B, T, D = x.shape
+        h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
+        flat = h.reshape(B * T, D)
+
+        # router over the FULL expert set (router weights replicated)
+        logits = flat @ p["router_w"] + p["router_b"]  # [N, E_total]
+        k = self.config.num_experts_per_tok
+        top_vals, top_idx = lax.top_k(logits, k)
+        top_probs = jax.nn.softmax(top_vals.astype(jnp.float32), axis=-1).astype(flat.dtype)
+        scores = jnp.zeros_like(logits).at[
+            jnp.arange(flat.shape[0])[:, None], top_idx
+        ].set(top_probs)
+
+        # dense expert compute over the LOCAL expert slice (tp shards experts)
+        E_local = p["gate_up"].shape[0]
+        gate_up = jnp.einsum("nd,edf->nef", flat, p["gate_up"]) + p["gate_up_b"]
+        gate = jnp.clip(gate_up[..., ::2], max=LIMIT)
+        up = jnp.clip(gate_up[..., 1::2], min=-LIMIT, max=LIMIT)
+        glu = gate * jax.nn.sigmoid(gate * ALPHA)
+        inner = (up + 1.0) * glu  # [N, E_local, F]
+        expert_out = jnp.einsum("nef,efd->ned", inner, p["down"]) + p["down_b"]
+
+        if tp_axis is not None:
+            e_off = lax.axis_index(tp_axis) * E_local
+            local_scores = lax.dynamic_slice_in_dim(scores, e_off, E_local, axis=1)
+        else:
+            local_scores = scores
+        out = jnp.einsum("ned,ne->nd", expert_out, local_scores)
+        if tp_axis is not None:
+            out = lax.psum(out, tp_axis)
+        return x + out.reshape(B, T, D)
+
+    def apply_window(
+        self,
+        window_params: dict,
+        x: jnp.ndarray,
+        kv: dict,
+        pos: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        layer_kinds: Optional[jnp.ndarray] = None,
+        tp_axis: Optional[str] = None,
+        kv_commit=None,
+    ) -> Tuple[jnp.ndarray, dict]:
+        T, S = x.shape[1], kv["k"].shape[2]
+        full_mask = causal_mask(T, S, pos)
+        swa = self.config.sliding_window or S
+        swa_mask = sliding_window_mask(T, S, pos, swa)
+        kinds = layer_kinds if layer_kinds is not None else self.layer_kinds
+
+        def body(carry, per_layer):
+            xc = carry
+            p, kvs, kind = per_layer
+            m = jnp.where(kind == 1, swa_mask, full_mask)
+            xc, kvs = self._attention(p, xc, kvs, pos, m, tp_axis, kv_commit)
+            xc = self._moe(p, xc, tp_axis)
+            return xc, kvs
+
+        x, kv_out = lax.scan(body, x, (window_params, kv, kinds))
+        return x, kv_out
+
+    def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)
+
+    def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        if self.config.tie_word_embeddings:
+            return x @ edge_params["embed"]["weight"].T
+        return x @ edge_params["lm_head"]["weight"]
+
+    # ---- weight mapping ----------------------------------------------
+    def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        def t(name):
+            return np.ascontiguousarray(raw[name].T)
+
+        return {
+            "attn_norm": raw["input_layernorm.weight"],
+            "wq": t("self_attn.q_proj.weight"),
+            "bq": raw["self_attn.q_proj.bias"],
+            "wk": t("self_attn.k_proj.weight"),
+            "bk": raw["self_attn.k_proj.bias"],
+            "wv": t("self_attn.v_proj.weight"),
+            "bv": raw["self_attn.v_proj.bias"],
+            "wo": t("self_attn.o_proj.weight"),
+            "bo": raw["self_attn.o_proj.bias"],
+            "sinks": raw["self_attn.sinks"],
+            "mlp_norm": raw["post_attention_layernorm.weight"],
+            "router_w": t("mlp.router.weight"),
+            "router_b": raw["mlp.router.bias"],
+            # experts are stored [E, D, 2F]/[E, F, D]: already (in,out)-oriented
+            "gate_up": raw["mlp.experts.gate_up_proj"],
+            "gate_up_b": raw["mlp.experts.gate_up_proj_bias"],
+            "down": raw["mlp.experts.down_proj"],
+            "down_b": raw["mlp.experts.down_proj_bias"],
+        }
+
+    def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if "model.embed_tokens.weight" in raw:
+            out["embed"] = {"weight": raw["model.embed_tokens.weight"]}
+        if "model.norm.weight" in raw:
+            out["final_norm"] = {"weight": raw["model.norm.weight"]}
+        if "lm_head.weight" in raw:
+            out["lm_head"] = {"weight": np.ascontiguousarray(raw["lm_head.weight"].T)}
+        return out

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dnet_tpu.core.kvcache import read_kv, write_kv
 from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.ops.attention import attend, causal_mask
 from dnet_tpu.ops.norms import rms_norm
@@ -28,21 +29,30 @@ class LlamaRingModel(RingModel):
 
     def __init__(self, config: ModelConfig, layers):
         super().__init__(config, layers)
-        self.inv_freq = jnp.asarray(
-            rope_frequencies(config.head_dim, config.rope_theta, config.rope_scaling)
+        inv_freq, self.rope_scale = rope_frequencies(
+            config.head_dim,
+            config.rope_theta,
+            config.rope_scaling,
+            config.max_position_embeddings,
         )
+        self.inv_freq = jnp.asarray(inv_freq)
 
     # ---- pure compute -------------------------------------------------
     def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
         return edge_params["embed"]["weight"][tokens]
 
-    def _layer(self, p: dict, x: jnp.ndarray, kc, vc, pos, mask, tp_axis=None, kv_commit=None):
+    def _qk_transform(self, p: dict, q: jnp.ndarray, k: jnp.ndarray):
+        """Pre-RoPE q/k hook; identity for llama (qwen3 adds per-head norms)."""
+        return q, k
+
+    def _layer(self, p: dict, x: jnp.ndarray, kvs: dict, pos, mask, tp_axis=None, kv_commit=None):
         """One decoder layer.  Works on full params or tensor-parallel slices:
         local head counts come from the (possibly sharded) param shapes, and
         `tp_axis` inserts the two Megatron-style psums (after o-proj and
         down-proj) when running inside shard_map.  kv_commit (scalar bool)
         gates the cache write O(T)-cheaply — a pipeline rank processing a
-        not-its-turn copy must not pollute its cache."""
+        not-its-turn copy must not pollute its cache.  kvs is this layer's
+        cache-slice dict (may carry int8 quant scales)."""
         cfg = self.config
         B, T, D = x.shape
         Hd = cfg.head_dim
@@ -53,19 +63,12 @@ class LlamaRingModel(RingModel):
         q = (h @ p["wq"]).reshape(B, T, H, Hd)
         k = (h @ p["wk"]).reshape(B, T, KVH, Hd)
         v = (h @ p["wv"]).reshape(B, T, KVH, Hd)
+        q, k = self._qk_transform(p, q, k)  # subclass hook (qwen3 q/k norms)
         positions = pos + jnp.arange(T)
-        q = apply_rope(q, positions, self.inv_freq)
-        k = apply_rope(k, positions, self.inv_freq)
-        k = k.astype(kc.dtype)
-        v = v.astype(vc.dtype)
-        if kv_commit is not None:
-            # select against the old slice (O(T)), not the whole cache (O(S))
-            k_old = lax.dynamic_slice(kc, (0, pos, 0, 0), k.shape)
-            v_old = lax.dynamic_slice(vc, (0, pos, 0, 0), v.shape)
-            k = jnp.where(kv_commit, k, k_old)
-            v = jnp.where(kv_commit, v, v_old)
-        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
+        k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
+        kvs = write_kv(kvs, k, v, pos, kv_commit)
+        kc, vc = read_kv(kvs, q.dtype)
         attn = attend(q, kc, vc, mask=mask)
         attn_out = attn.reshape(B, T, H * Hd) @ p["wo"]
         if tp_axis is not None:
@@ -79,7 +82,7 @@ class LlamaRingModel(RingModel):
         if tp_axis is not None:
             mlp_out = lax.psum(mlp_out, tp_axis)
         x = x + mlp_out
-        return x, kc, vc
+        return x, kvs
 
     def apply_window(
         self,
@@ -97,14 +100,14 @@ class LlamaRingModel(RingModel):
 
         def body(carry, per_layer):
             xc = carry
-            p, kc, vc = per_layer
-            xc, kc, vc = self._layer(
-                p, xc, kc, vc, pos, mask, tp_axis=tp_axis, kv_commit=kv_commit
+            p, kvs = per_layer
+            xc, kvs = self._layer(
+                p, xc, kvs, pos, mask, tp_axis=tp_axis, kv_commit=kv_commit
             )
-            return xc, (kc, vc)
+            return xc, kvs
 
-        x, (k_out, v_out) = lax.scan(body, x, (window_params, kv["k"], kv["v"]))
-        return x, {"k": k_out, "v": v_out}
+        x, kv_out = lax.scan(body, x, (window_params, kv))
+        return x, kv_out
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)

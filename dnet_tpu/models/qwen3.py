@@ -1,0 +1,31 @@
+"""Qwen3-family ring model.
+
+Same skeleton as Llama (reference mirrors this: src/dnet/core/models/
+qwen3.py "Same pattern as Llama") with Qwen3's differences: per-head RMS
+q/k normalization before RoPE (the `_qk_transform` hook) and an explicit
+head_dim decoupled from hidden_size/num_heads.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from dnet_tpu.models.llama import LlamaRingModel
+from dnet_tpu.ops.norms import rms_norm
+
+
+class Qwen3RingModel(LlamaRingModel):
+    model_type = "qwen3"
+
+    def _qk_transform(self, p: dict, q: jnp.ndarray, k: jnp.ndarray):
+        eps = self.config.rms_norm_eps
+        return rms_norm(q, p["q_norm"], eps), rms_norm(k, p["k_norm"], eps)
+
+    def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        params = super().map_layer(raw)
+        params["q_norm"] = raw["self_attn.q_norm.weight"]
+        params["k_norm"] = raw["self_attn.k_norm.weight"]
+        return params

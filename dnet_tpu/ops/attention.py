@@ -80,5 +80,7 @@ def attend(
         probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
         probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
 
+    # v's head dim may differ from q/k's (MLA caches qk_head for K but
+    # v_head_dim for V)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
-    return out.reshape(B, T, H, Hd).astype(q.dtype)
+    return out.reshape(B, T, H, v.shape[-1]).astype(q.dtype)

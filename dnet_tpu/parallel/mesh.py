@@ -44,8 +44,11 @@ def build_mesh(
 # column-parallel weights shard their output dim over tp, row-parallel their
 # input dim.  Norm vectors replicate.
 
-_COL_PARALLEL = {"wq", "wk", "wv", "w_gate", "w_up"}  # [.., D, out] -> out/tp
-_ROW_PARALLEL = {"wo", "w_down"}  # [.., in, D] -> in/tp
+_COL_PARALLEL = {"wq", "wk", "wv", "w_gate", "w_up"}  # [L, D, out] -> out/tp
+_ROW_PARALLEL = {"wo", "w_down"}  # [L, in, D] -> in/tp
+_HEAD_VECTORS = {"bq", "bk", "bv", "sinks"}  # [L, out] -> out/tp
+_EXPERT_SHARDED = {"gate_up", "down"}  # [L, E, ..] -> E/tp (expert parallel)
+_EXPERT_VECTORS = {"gate_up_b", "down_b"}  # [L, E, ..] -> E/tp
 
 
 def layer_param_spec(name: str) -> P:
@@ -53,7 +56,13 @@ def layer_param_spec(name: str) -> P:
         return P(AXIS_PP, None, AXIS_TP)
     if name in _ROW_PARALLEL:
         return P(AXIS_PP, AXIS_TP, None)
-    return P(AXIS_PP)  # norms and other per-layer vectors: shard layer axis only
+    if name in _HEAD_VECTORS:
+        return P(AXIS_PP, AXIS_TP)
+    if name in _EXPERT_SHARDED:
+        return P(AXIS_PP, AXIS_TP, None, None)
+    if name in _EXPERT_VECTORS:
+        return P(AXIS_PP, AXIS_TP, None)
+    return P(AXIS_PP)  # norms, router, kind scalars: shard layer axis only
 
 
 def window_param_specs(window_params: Dict) -> Dict[str, P]:

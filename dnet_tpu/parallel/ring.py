@@ -41,16 +41,20 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
     """
     PP = mesh.shape[AXIS_PP]
 
+    # mixed-attention models (gpt_oss) carry a per-layer kind array that must
+    # shard over pp alongside the layer-stacked params
+    has_kinds = getattr(model, "layer_kinds", None) is not None
     in_specs = (
         {k: layer_param_spec(k) for k in param_keys},
         P(),  # edge params replicated
         P(AXIS_DP, None),  # tokens [B, 1]
-        {"k": kv_spec(), "v": kv_spec()},
+        kv_spec(),  # pytree prefix: applies to every kv leaf (incl. scales)
         P(),  # pos scalar
+        P(AXIS_PP) if has_kinds else P(),
     )
-    out_specs = (P(AXIS_DP, None), {"k": kv_spec(), "v": kv_spec()})
+    out_specs = (P(AXIS_DP, None), kv_spec())
 
-    def spmd(window_params, edge_params, tokens, kv, pos):
+    def spmd(window_params, edge_params, tokens, kv, pos, kinds):
         my_pp = lax.axis_index(AXIS_PP)
 
         # Stage 0 embeds; everyone runs the embed (cheap for T=1) but only
@@ -67,7 +71,8 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
             # on other ranks must not pollute their caches); the gate is
             # O(T) inside the layer, not an O(S) whole-cache select.
             x_new, kv = model.apply_window(
-                window_params, x, kv, pos, tp_axis=AXIS_TP, kv_commit=(i == my_pp)
+                window_params, x, kv, pos,
+                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=(i == my_pp),
             )
             # hand the hidden state to the next pipeline rank (ICI hop)
             x_next = lax.ppermute(
@@ -87,7 +92,13 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
 
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     donate = (3,) if donate_kv else ()
-    return jax.jit(fn, donate_argnums=donate)
+    jitted = jax.jit(fn, donate_argnums=donate)
+    kinds_arr = model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
+
+    def call(window_params, edge_params, tokens, kv, pos):
+        return jitted(window_params, edge_params, tokens, kv, pos, kinds_arr)
+
+    return call
 
 
 def _bcast_from_rank0(x, axis_name: str):

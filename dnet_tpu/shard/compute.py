@@ -43,16 +43,17 @@ class ShardCompute:
         kv_bits: int = 0,
     ) -> None:
         kv_dtype = None
+        kv_quant_bits = 0
         if kv_bits == 16:
             kv_dtype = "bfloat16"
-        elif kv_bits in (4, 8):
-            # int8/int4 quantized KV lands with the quantization subsystem;
-            # fail loud rather than silently blowing the memory plan
+        elif kv_bits == 8:
+            kv_quant_bits = 8  # int8 + per-(pos,head) f32 scales
+        elif kv_bits == 4:
             log.warning(
-                "kv_bits=%d not yet implemented on TPU backend; using bf16 KV "
-                "(memory use will be higher than the solver planned)", kv_bits
+                "kv_bits=4 not yet implemented on TPU backend; using int8 KV "
+                "(memory use will be ~2x the solver's plan)"
             )
-            kv_dtype = "bfloat16"
+            kv_quant_bits = 8
         self.engine = LocalEngine(
             model_dir,
             layers=layers,
@@ -64,6 +65,7 @@ class ShardCompute:
             window_size=window_size,
             residency_size=residency_size,
             repack_dir=repack_dir,
+            kv_quant_bits=kv_quant_bits,
         )
         self.layers = self.engine.model.layers
         self.wire_dtype = wire_dtype

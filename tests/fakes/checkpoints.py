@@ -36,6 +36,237 @@ TINY_LLAMA_CONFIG = {
 }
 
 
+TINY_QWEN3_CONFIG = {
+    "architectures": ["Qwen3ForCausalLM"],
+    "model_type": "qwen3",
+    "vocab_size": 261,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "rms_norm_eps": 1e-6,
+    "rope_theta": 1000000.0,
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "attention_bias": False,
+    "hidden_act": "silu",
+    "torch_dtype": "float32",
+    "bos_token_id": 256,
+    "eos_token_id": 257,
+}
+
+
+def make_tiny_qwen3(model_dir: str | Path, config: dict | None = None, seed: int = 1) -> dict:
+    """Tiny Qwen3: Llama layout + per-head q/k norms."""
+    cfg = dict(TINY_QWEN3_CONFIG)
+    if config:
+        cfg.update(config)
+    rng = np.random.default_rng(seed)
+    D, F, V = cfg["hidden_size"], cfg["intermediate_size"], cfg["vocab_size"]
+    H, KVH, Hd = cfg["num_attention_heads"], cfg["num_key_value_heads"], cfg["head_dim"]
+
+    def w(*shape, scale=0.05):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": w(V, D),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "self_attn.q_proj.weight"] = w(H * Hd, D)
+        tensors[p + "self_attn.k_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.v_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
+        tensors[p + "self_attn.q_norm.weight"] = np.ones(Hd, np.float32) + w(Hd, scale=0.02)
+        tensors[p + "self_attn.k_norm.weight"] = np.ones(Hd, np.float32) + w(Hd, scale=0.02)
+        tensors[p + "mlp.gate_proj.weight"] = w(F, D)
+        tensors[p + "mlp.up_proj.weight"] = w(F, D)
+        tensors[p + "mlp.down_proj.weight"] = w(D, F)
+    save_checkpoint(model_dir, cfg, tensors)
+    return cfg
+
+
+TINY_GPT_OSS_CONFIG = {
+    "architectures": ["GptOssForCausalLM"],
+    "model_type": "gpt_oss",
+    "vocab_size": 261,
+    "hidden_size": 64,
+    "intermediate_size": 48,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "num_local_experts": 4,
+    "num_experts_per_tok": 2,
+    "sliding_window": 8,
+    "layer_types": [
+        "sliding_attention", "full_attention", "sliding_attention", "full_attention",
+    ],
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 150000.0,
+    "rope_scaling": {
+        "rope_type": "yarn",
+        "factor": 32.0,
+        "beta_fast": 32.0,
+        "beta_slow": 1.0,
+        "truncate": False,
+        "original_max_position_embeddings": 4096,
+    },
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "attention_bias": True,
+    "attention_dropout": 0.0,
+    "hidden_act": "silu",
+    "torch_dtype": "float32",
+    "bos_token_id": 256,
+    "eos_token_id": 257,
+}
+
+
+def make_tiny_gpt_oss(model_dir: str | Path, config: dict | None = None, seed: int = 2) -> dict:
+    """Tiny GPT-OSS: MoE + sinks + alternating SWA, HF dequantized layout."""
+    cfg = dict(TINY_GPT_OSS_CONFIG)
+    if config:
+        cfg.update(config)
+    rng = np.random.default_rng(seed)
+    D, F, V = cfg["hidden_size"], cfg["intermediate_size"], cfg["vocab_size"]
+    H, KVH, Hd = cfg["num_attention_heads"], cfg["num_key_value_heads"], cfg["head_dim"]
+    E = cfg["num_local_experts"]
+
+    def w(*shape, scale=0.05):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": w(V, D),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "self_attn.q_proj.weight"] = w(H * Hd, D)
+        tensors[p + "self_attn.q_proj.bias"] = w(H * Hd, scale=0.02)
+        tensors[p + "self_attn.k_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.k_proj.bias"] = w(KVH * Hd, scale=0.02)
+        tensors[p + "self_attn.v_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.v_proj.bias"] = w(KVH * Hd, scale=0.02)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
+        tensors[p + "self_attn.o_proj.bias"] = w(D, scale=0.02)
+        tensors[p + "self_attn.sinks"] = w(H, scale=0.5)
+        tensors[p + "mlp.router.weight"] = w(E, D)
+        tensors[p + "mlp.router.bias"] = w(E, scale=0.02)
+        tensors[p + "mlp.experts.gate_up_proj"] = w(E, D, 2 * F)
+        tensors[p + "mlp.experts.gate_up_proj_bias"] = w(E, 2 * F, scale=0.02)
+        tensors[p + "mlp.experts.down_proj"] = w(E, F, D)
+        tensors[p + "mlp.experts.down_proj_bias"] = w(E, D, scale=0.02)
+    save_checkpoint(model_dir, cfg, tensors)
+    return cfg
+
+
+TINY_DEEPSEEK_V2_CONFIG = {
+    "architectures": ["DeepseekV2ForCausalLM"],
+    "model_type": "deepseek_v2",
+    "vocab_size": 261,
+    "hidden_size": 64,
+    "intermediate_size": 96,
+    "moe_intermediate_size": 32,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 4,
+    "head_dim": 8,  # == qk_rope_head_dim (drives rotary init in HF)
+    "q_lora_rank": None,
+    "qk_nope_head_dim": 16,
+    "qk_rope_head_dim": 8,
+    "kv_lora_rank": 24,
+    "v_head_dim": 12,
+    "n_routed_experts": 4,
+    "n_shared_experts": 1,
+    "num_experts_per_tok": 2,
+    "first_k_dense_replace": 1,
+    "routed_scaling_factor": 1.0,
+    "topk_method": "greedy",
+    "norm_topk_prob": False,
+    "n_group": 1,
+    "topk_group": 1,
+    "rms_norm_eps": 1e-6,
+    "rope_theta": 10000.0,
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "attention_bias": False,
+    "attention_dropout": 0.0,
+    "mlp_bias": False,
+    "hidden_act": "silu",
+    "aux_loss_alpha": 0.0,
+    "seq_aux": True,
+    "torch_dtype": "float32",
+    "bos_token_id": 256,
+    "eos_token_id": 257,
+}
+
+
+def make_tiny_deepseek_v2(model_dir: str | Path, config: dict | None = None, seed: int = 3) -> dict:
+    """Tiny DeepSeek-V2: MLA + shared/routed MoE (layer 0 dense)."""
+    cfg = dict(TINY_DEEPSEEK_V2_CONFIG)
+    if config:
+        cfg.update(config)
+    rng = np.random.default_rng(seed)
+    D, V = cfg["hidden_size"], cfg["vocab_size"]
+    H = cfg["num_attention_heads"]
+    nope, rope_d = cfg["qk_nope_head_dim"], cfg["qk_rope_head_dim"]
+    qk = nope + rope_d
+    vd = cfg["v_head_dim"]
+    kv_rank = cfg["kv_lora_rank"]
+    E = cfg["n_routed_experts"]
+    F, MF = cfg["intermediate_size"], cfg["moe_intermediate_size"]
+    SF = MF * cfg["n_shared_experts"]
+
+    def w(*shape, scale=0.05):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": w(V, D),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        if cfg["q_lora_rank"] is None:
+            tensors[p + "self_attn.q_proj.weight"] = w(H * qk, D)
+        else:
+            r = cfg["q_lora_rank"]
+            tensors[p + "self_attn.q_a_proj.weight"] = w(r, D)
+            tensors[p + "self_attn.q_a_layernorm.weight"] = np.ones(r, np.float32)
+            tensors[p + "self_attn.q_b_proj.weight"] = w(H * qk, r)
+        tensors[p + "self_attn.kv_a_proj_with_mqa.weight"] = w(kv_rank + rope_d, D)
+        tensors[p + "self_attn.kv_a_layernorm.weight"] = np.ones(kv_rank, np.float32)
+        tensors[p + "self_attn.kv_b_proj.weight"] = w(H * (nope + vd), kv_rank)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * vd)
+        if i >= cfg["first_k_dense_replace"]:
+            tensors[p + "mlp.gate.weight"] = w(E, D)
+            for e in range(E):
+                tensors[p + f"mlp.experts.{e}.gate_proj.weight"] = w(MF, D)
+                tensors[p + f"mlp.experts.{e}.up_proj.weight"] = w(MF, D)
+                tensors[p + f"mlp.experts.{e}.down_proj.weight"] = w(D, MF)
+            tensors[p + "mlp.shared_experts.gate_proj.weight"] = w(SF, D)
+            tensors[p + "mlp.shared_experts.up_proj.weight"] = w(SF, D)
+            tensors[p + "mlp.shared_experts.down_proj.weight"] = w(D, SF)
+        else:
+            tensors[p + "mlp.gate_proj.weight"] = w(F, D)
+            tensors[p + "mlp.up_proj.weight"] = w(F, D)
+            tensors[p + "mlp.down_proj.weight"] = w(D, F)
+    save_checkpoint(model_dir, cfg, tensors)
+    return cfg
+
+
 def make_tiny_llama(model_dir: str | Path, config: dict | None = None, seed: int = 0) -> dict:
     """Write a random-weight tiny Llama checkpoint; returns the config."""
     cfg = dict(TINY_LLAMA_CONFIG)

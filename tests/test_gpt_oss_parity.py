@@ -1,0 +1,88 @@
+"""GPT-OSS numerical parity vs transformers (MoE + sinks + SWA)."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.model
+
+
+@pytest.fixture(scope="module")
+def gpt_oss_dir(tmp_path_factory):
+    from tests.fakes.checkpoints import make_tiny_gpt_oss
+
+    d = tmp_path_factory.mktemp("tiny_gpt_oss")
+    make_tiny_gpt_oss(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def hf_model(gpt_oss_dir):
+    torch = pytest.importorskip("torch")
+    from transformers import GptOssForCausalLM
+
+    return GptOssForCausalLM.from_pretrained(
+        gpt_oss_dir, dtype=torch.float32, attn_implementation="eager"
+    ).eval()
+
+
+@pytest.fixture(scope="module")
+def engine(gpt_oss_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(gpt_oss_dir, max_seq=32, param_dtype="float32")
+    assert eng.model.model_type == "gpt_oss"
+    return eng
+
+
+def test_forward_parity(engine, hf_model):
+    import torch
+
+    # long enough that sliding_window=8 actually truncates attention
+    ids = [256] + list(range(60, 72))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([ids])).logits[0].numpy()
+    logits = engine.prefill("p", ids)
+    engine.end_session("p")
+    np.testing.assert_allclose(
+        np.asarray(logits[0], np.float32), ref[-1], atol=3e-3, rtol=3e-3
+    )
+
+
+def test_greedy_generation_matches(engine, hf_model):
+    import torch
+
+    ids = [256, 72, 105]
+    hf_out = hf_model.generate(
+        torch.tensor([ids]), max_new_tokens=10, do_sample=False,
+        temperature=None, top_p=None, top_k=None, pad_token_id=0,
+    )[0].tolist()
+    from dnet_tpu.core.types import DecodingParams
+
+    ours = [
+        r.token_id
+        for r in engine.generate(ids, DecodingParams(temperature=0.0), max_tokens=10)
+    ]
+    assert ours == hf_out[len(ids):]
+
+
+def test_offload_matches_fit(gpt_oss_dir, engine):
+    """Mixed-kind layers must survive the per-layer offload path."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    ids = [256, 72, 105]
+    expected = [
+        r.token_id
+        for r in engine.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+    ]
+    off = LocalEngine(
+        gpt_oss_dir, max_seq=32, param_dtype="float32", window_size=2, residency_size=2
+    )
+    try:
+        got = [
+            r.token_id
+            for r in off.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+        ]
+        assert got == expected
+    finally:
+        off.close()

@@ -1,0 +1,62 @@
+"""Qwen3 numerical parity vs transformers."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.model
+
+
+@pytest.fixture(scope="module")
+def qwen3_dir(tmp_path_factory):
+    from tests.fakes.checkpoints import make_tiny_qwen3
+
+    d = tmp_path_factory.mktemp("tiny_qwen3")
+    make_tiny_qwen3(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def hf_model(qwen3_dir):
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen3ForCausalLM
+
+    return Qwen3ForCausalLM.from_pretrained(qwen3_dir, dtype=torch.float32).eval()
+
+
+@pytest.fixture(scope="module")
+def engine(qwen3_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(qwen3_dir, max_seq=64, param_dtype="float32")
+    assert eng.model.model_type == "qwen3"
+    return eng
+
+
+def test_forward_parity(engine, hf_model):
+    import torch
+
+    ids = [256, 72, 101, 108, 108, 111]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([ids])).logits[0].numpy()
+    logits = engine.prefill("p", ids)
+    np.testing.assert_allclose(
+        np.asarray(logits[0], np.float32), ref[-1], atol=2e-3, rtol=2e-3
+    )
+    engine.end_session("p")
+
+
+def test_greedy_generation_matches(engine, hf_model):
+    import torch
+
+    ids = [256, 72, 105]
+    hf_out = hf_model.generate(
+        torch.tensor([ids]), max_new_tokens=8, do_sample=False,
+        temperature=None, top_p=None, top_k=None, pad_token_id=0,
+    )[0].tolist()
+    from dnet_tpu.core.types import DecodingParams
+
+    ours = [
+        r.token_id
+        for r in engine.generate(ids, DecodingParams(temperature=0.0), max_tokens=8)
+    ]
+    assert ours == hf_out[len(ids):]

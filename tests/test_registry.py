@@ -1,0 +1,24 @@
+import pytest
+
+from dnet_tpu.api.catalog import find_entry, get_ci_test_models, model_catalog
+from dnet_tpu.models import get_ring_model_cls
+
+pytestmark = pytest.mark.model
+
+
+def test_registry_resolves_all_catalog_archs():
+    for entry in model_catalog:
+        cls = get_ring_model_cls(entry.arch)
+        assert cls.model_type == entry.arch
+
+
+def test_registry_unknown():
+    with pytest.raises(ValueError, match="unsupported model_type"):
+        get_ring_model_cls("not-a-model")
+
+
+def test_catalog_lookup():
+    assert find_entry("Qwen/Qwen3-4B") is not None
+    assert find_entry("Qwen3-4B") is not None  # short name
+    assert find_entry("nope") is None
+    assert len(get_ci_test_models()) >= 2

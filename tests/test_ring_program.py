@@ -59,6 +59,32 @@ def test_ring_matches_single_device(engine, eight_devices, pp, tp):
     assert ring_tokens == ref_tokens, f"pp={pp} tp={tp}: {ring_tokens} != {ref_tokens}"
 
 
+@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2)])
+def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp, tp):
+    """Mixed SWA/full kinds + MoE experts through the single-program ring."""
+    from tests.fakes.checkpoints import make_tiny_gpt_oss
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path_factory.mktemp("ring_gpt_oss")
+    make_tiny_gpt_oss(d)
+    eng = LocalEngine(d, max_seq=32, param_dtype="float32")
+    ref = _reference_tokens(eng, 65, n_steps=3)
+
+    mesh = build_mesh(pp=pp, tp=tp)
+    fn = make_ring_decode_fn(eng.model, mesh, param_keys=list(eng.window_params.keys()))
+    kv_host = init_cache(eng.model.kv_config(len(eng.model.layers), 1, 32, "float32"))
+    wp, ep, kv = place_ring_state(eng.window_params, eng.edge_params, kv_host, mesh)
+
+    tok = jnp.asarray([[65]], dtype=jnp.int32)
+    got = []
+    for pos in range(3):
+        logits, kv = fn(wp, ep, tok, kv, jnp.int32(pos))
+        t = int(jnp.argmax(logits[0]))
+        got.append(t)
+        tok = jnp.asarray([[t]], dtype=jnp.int32)
+    assert got == ref, f"pp={pp} tp={tp}: {got} != {ref}"
+
+
 def test_ring_logits_close(engine, eight_devices):
     mesh = build_mesh(pp=2, tp=2)
     model = engine.model
