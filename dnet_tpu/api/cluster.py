@@ -7,7 +7,7 @@ owns the device table and the current topology.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional  # noqa: F401
 
 import httpx
 
@@ -46,6 +46,65 @@ class ClusterManager:
 
         results = await asyncio.gather(*(check(d) for d in devices))
         return [d for d in results if d is not None]
+
+    async def profile_cluster(
+        self, payload_sizes: Optional[List[int]] = None, timeout_s: float = 300.0
+    ) -> List[DeviceInfo]:
+        """Health-filter -> parallel /profile -> /measure_latency between ring
+        neighbors -> merged DeviceInfo list (reference api/cluster.py:38-244)."""
+        import asyncio
+
+        devices = await self.healthy_devices()
+        if not devices:
+            return []
+        payload_sizes = payload_sizes or [65536, 1048576]
+
+        async with httpx.AsyncClient(timeout=timeout_s) as client:
+
+            async def profile_one(d: DeviceInfo) -> None:
+                url = f"http://{d.host}:{d.http_port}/profile"
+                try:
+                    r = await client.post(url, json={})
+                    r.raise_for_status()
+                    p = r.json()["profile"]
+                    d.flops_bf16 = p.get("flops_bf16", 0.0)
+                    d.hbm_bw = p.get("hbm_bw", 0.0)
+                    d.host_to_hbm_bw = p.get("host_to_hbm_bw", 0.0)
+                    d.hbm_bytes = p.get("hbm_bytes", 0) or d.hbm_bytes
+                    d.host_ram_bytes = p.get("host_ram_bytes", 0)
+                    d.chip_kind = p.get("device_kind", d.chip_kind)
+                except (httpx.HTTPError, KeyError) as exc:
+                    log.warning("profile of %s failed: %s", d.instance, exc)
+
+            await asyncio.gather(*(profile_one(d) for d in devices))
+
+            async def latency_one(d: DeviceInfo, peer: DeviceInfo) -> None:
+                url = f"http://{d.host}:{d.http_port}/measure_latency"
+                body = {
+                    "peers": [f"{peer.host}:{peer.grpc_port}"],
+                    "payload_sizes": payload_sizes,
+                    "rounds": 3,
+                }
+                try:
+                    r = await client.post(url, json=body)
+                    r.raise_for_status()
+                    lat = r.json()["latency"]
+                    per_size = next(iter(lat.values()), {})
+                    if per_size:
+                        # median across payload sizes ~ solver's t_comm
+                        vals = sorted(per_size.values())
+                        d.t_comm = vals[len(vals) // 2]
+                except (httpx.HTTPError, KeyError) as exc:
+                    log.warning("latency probe from %s failed: %s", d.instance, exc)
+
+            await asyncio.gather(
+                *(
+                    latency_one(d, devices[(i + 1) % len(devices)])
+                    for i, d in enumerate(devices)
+                    if len(devices) > 1
+                )
+            )
+        return devices
 
     def head_device(self) -> Optional[DeviceInfo]:
         """Owner of layer 0 in the current topology."""

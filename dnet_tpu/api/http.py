@@ -61,6 +61,7 @@ class ApiHTTPServer:
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_post("/v1/load_model", self.load_model)
         self.app.router.add_post("/v1/unload_model", self.unload_model)
+        self.app.router.add_post("/v1/prepare_topology", self.prepare_topology)
         self.app.router.add_post("/v1/prepare_topology_manual", self.prepare_topology_manual)
         self.app.router.add_get("/v1/topology", self.get_topology)
         self.app.router.add_get("/v1/devices", self.get_devices)
@@ -154,6 +155,71 @@ class ApiHTTPServer:
     async def unload_model(self, request: web.Request) -> web.Response:
         await self.model_manager.unload_model()
         return web.json_response(UnloadModelResponse(message="unloaded").model_dump())
+
+    async def prepare_topology(self, request: web.Request) -> web.Response:
+        """Auto pipeline: discover -> profile -> solve (reference
+        http_api.py:254-303)."""
+        from dnet_tpu.api.schemas import PrepareTopologyRequest
+
+        if self.cluster_manager is None:
+            return _json_error(400, "not in ring mode (no discovery configured)")
+        try:
+            req = PrepareTopologyRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return _json_error(400, f"invalid request: {exc}")
+
+        from dnet_tpu.api.model_manager import resolve_model_dir
+        from dnet_tpu.parallel.solver import (
+            model_profile_from_checkpoint,
+            solve_topology,
+        )
+
+        model_dir = resolve_model_dir(
+            req.model, getattr(self.model_manager, "models_dir", None)
+        )
+        if model_dir is None:
+            return _json_error(404, f"model {req.model!r} not found locally", "model_not_found")
+
+        devices = await self.cluster_manager.profile_cluster()
+        if not devices:
+            return _json_error(503, "no healthy shards discovered", "no_devices")
+        try:
+            profile = model_profile_from_checkpoint(
+                model_dir, seq_len=req.seq_len, kv_bits=req.kv_bits
+            )
+            from dnet_tpu.config import get_settings
+
+            topo = solve_topology(
+                devices,
+                profile,
+                kv_bits=req.kv_bits,
+                solver=get_settings().topology.solver,
+                mip_gap=get_settings().topology.mip_gap,
+            )
+        except ValueError as exc:
+            return _json_error(400, str(exc))
+        topo.model = req.model
+        self.cluster_manager.current_topology = topo
+        return web.json_response(
+            {
+                "status": "ok",
+                "topology": {
+                    "model": topo.model,
+                    "num_layers": topo.num_layers,
+                    "solution": topo.solution,
+                    "assignments": [
+                        {
+                            "instance": a.instance,
+                            "layers": a.layers,
+                            "next_instance": a.next_instance,
+                            "window_size": a.window_size,
+                            "residency_size": a.residency_size,
+                        }
+                        for a in topo.assignments
+                    ],
+                },
+            }
+        )
 
     async def prepare_topology_manual(self, request: web.Request) -> web.Response:
         """Manual layer assignment -> ring topology (reference

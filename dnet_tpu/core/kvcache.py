@@ -100,11 +100,12 @@ def write_kv(kvs: dict, k_new: jnp.ndarray, v_new: jnp.ndarray, pos, kv_commit=N
     return out
 
 
-def read_kv(kvs: dict, dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def read_kv(kvs: dict) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Full-cache k/v for attention, dequantizing if needed.
 
     Quantized path stays f32 (attend computes its softmax/matmuls in f32
-    anyway — a round-trip through bf16 would only add a cast and lose bits).
+    anyway — a round-trip through bf16 would only add a cast and lose bits);
+    the plain path returns the cache's own dtype.
     """
     if "k_scale" in kvs:
         k = kvs["k"].astype(jnp.float32) * kvs["k_scale"]

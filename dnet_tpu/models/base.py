@@ -145,9 +145,19 @@ class RingModel(abc.ABC):
         """HF per-layer tensors (prefix `model.layers.{i}.` stripped) -> our
         per-layer param dict (unstacked)."""
 
-    @abc.abstractmethod
     def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        """HF non-layer tensors -> {"embed": ..., "final_norm": ..., "lm_head": ...}."""
+        """HF non-layer tensors -> {"embed", "final_norm", "lm_head"}.
+
+        Standard HF naming is shared by every supported family; override
+        only for exotic edge layouts."""
+        out: Dict[str, Any] = {}
+        if "model.embed_tokens.weight" in raw:
+            out["embed"] = {"weight": raw["model.embed_tokens.weight"]}
+        if "model.norm.weight" in raw:
+            out["final_norm"] = {"weight": raw["model.norm.weight"]}
+        if "lm_head.weight" in raw:
+            out["lm_head"] = {"weight": np.ascontiguousarray(raw["lm_head.weight"].T)}
+        return out
 
     # ---- cache construction ------------------------------------------
     def kv_config(

@@ -130,7 +130,7 @@ class DeepseekV2RingModel(RingModel):
         k_full = jnp.concatenate([k_nope, k_pe], axis=-1)
 
         kvs = write_kv(kvs, k_full, v, pos)
-        kc, vc = read_kv(kvs, q_full.dtype)
+        kc, vc = read_kv(kvs)
         attn = attend(q_full, kc, vc, mask=mask, scale=self.softmax_scale)
         out = attn.reshape(B, T, H * vd) @ p["wo"]
         return x + out, kvs
@@ -270,12 +270,3 @@ class DeepseekV2RingModel(RingModel):
             p["w_down"] = t("mlp.down_proj.weight")
         return p
 
-    def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        out: Dict[str, Any] = {}
-        if "model.embed_tokens.weight" in raw:
-            out["embed"] = {"weight": raw["model.embed_tokens.weight"]}
-        if "model.norm.weight" in raw:
-            out["final_norm"] = {"weight": raw["model.norm.weight"]}
-        if "lm_head.weight" in raw:
-            out["lm_head"] = {"weight": np.ascontiguousarray(raw["lm_head.weight"].T)}
-        return out

@@ -75,7 +75,7 @@ class GptOssRingModel(RingModel):
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
         kvs = write_kv(kvs, k, v, pos, kv_commit)
-        kc, vc = read_kv(kvs, q.dtype)
+        kc, vc = read_kv(kvs)
         attn = attend(q, kc, vc, mask=mask, sinks=p["sinks"])
         out = attn.reshape(B, T, H * Hd) @ p["wo"]
         if tp_axis is not None:
@@ -128,9 +128,11 @@ class GptOssRingModel(RingModel):
         kv_commit=None,
     ) -> Tuple[jnp.ndarray, dict]:
         T, S = x.shape[1], kv["k"].shape[2]
-        full_mask = causal_mask(T, S, pos)
+        full_mask = causal_mask(T, S, pos) if mask is None else mask
         swa = self.config.sliding_window or S
         swa_mask = sliding_window_mask(T, S, pos, swa)
+        if mask is not None:
+            swa_mask = swa_mask & mask  # caller's mask composes with SWA
         kinds = layer_kinds if layer_kinds is not None else self.layer_kinds
 
         def body(carry, per_layer):
@@ -178,12 +180,3 @@ class GptOssRingModel(RingModel):
             "down_b": raw["mlp.experts.down_proj_bias"],
         }
 
-    def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        out: Dict[str, Any] = {}
-        if "model.embed_tokens.weight" in raw:
-            out["embed"] = {"weight": raw["model.embed_tokens.weight"]}
-        if "model.norm.weight" in raw:
-            out["final_norm"] = {"weight": raw["model.norm.weight"]}
-        if "lm_head.weight" in raw:
-            out["lm_head"] = {"weight": np.ascontiguousarray(raw["lm_head.weight"].T)}
-        return out

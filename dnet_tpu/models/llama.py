@@ -68,7 +68,7 @@ class LlamaRingModel(RingModel):
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
         kvs = write_kv(kvs, k, v, pos, kv_commit)
-        kc, vc = read_kv(kvs, q.dtype)
+        kc, vc = read_kv(kvs)
         attn = attend(q, kc, vc, mask=mask)
         attn_out = attn.reshape(B, T, H * Hd) @ p["wo"]
         if tp_axis is not None:
@@ -136,12 +136,3 @@ class LlamaRingModel(RingModel):
             "w_down": t("mlp.down_proj.weight"),
         }
 
-    def map_edge(self, raw: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        out: Dict[str, Any] = {}
-        if "model.embed_tokens.weight" in raw:
-            out["embed"] = {"weight": raw["model.embed_tokens.weight"]}
-        if "model.norm.weight" in raw:
-            out["final_norm"] = {"weight": raw["model.norm.weight"]}
-        if "lm_head.weight" in raw:
-            out["lm_head"] = {"weight": np.ascontiguousarray(raw["lm_head.weight"].T)}
-        return out

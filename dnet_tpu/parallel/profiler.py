@@ -76,3 +76,59 @@ def profile_device_quick(device=None) -> dict:
         "host_ram_bytes": psutil.virtual_memory().total,
         **mem,
     }
+
+
+def _profile_child(conn) -> None:
+    try:
+        result = profile_device_quick()
+        conn.send({"ok": True, "profile": result})
+    except Exception as exc:  # pragma: no cover - child-side
+        conn.send({"ok": False, "error": str(exc)})
+    finally:
+        conn.close()
+
+
+def profile_device_subprocess(timeout_s: float = 120.0) -> dict:
+    """Run the microbench in a spawned child so device allocations die with
+    the process (the reference's Metal-isolation trick,
+    utils/profile_subproc.py:27-63).  Falls back in-process if the child
+    cannot grab the accelerator (single-chip tunnels are exclusive)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(target=_profile_child, args=(child,), daemon=True)
+    proc.start()
+    child.close()
+
+    def _reap() -> None:
+        proc.join(timeout=5)
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=5)
+
+    result = None
+    exc: Optional[Exception] = None
+    try:
+        if parent.poll(timeout_s):
+            msg = parent.recv()
+            if msg.get("ok"):
+                result = msg["profile"]
+            else:
+                exc = RuntimeError(f"profiler child failed: {msg.get('error')}")
+        else:
+            exc = TimeoutError(f"device profile timed out after {timeout_s}s")
+    except EOFError as eof:
+        exc = eof
+    finally:
+        parent.close()
+        # reap the child BEFORE any in-process fallback — on exclusive-access
+        # devices a hung child would otherwise still hold the accelerator
+        _reap()
+
+    if result is not None:
+        return result
+    from dnet_tpu.utils.logger import get_logger
+
+    get_logger().warning("subprocess profile unavailable (%s); running in-process", exc)
+    return profile_device_quick()

@@ -1,0 +1,349 @@
+"""Topology solver: assign transformer layers to devices (HALDA analog).
+
+The reference delegates to distilp's MILP ("HALDA", prima.cpp) producing
+(w, n, k): layers per device, GPU-resident layers per device, rounds
+(SURVEY.md §2.7).  TPU re-derivation with the same outputs:
+
+- cost model per device i and layer count w_i, resident n_i:
+    t_i(w) = w * t_compute_i                      (HBM-bound decode compute)
+           + max(0, w - n) * layer_bytes / h2d_i  (host->HBM streaming, overlapped
+                                                   but bounded by transfer rate)
+           + t_comm_i                             (activation hop to next device)
+  and the ring's per-token latency is sum_i t_i (sequential pipeline for one
+  token) — minimizing the sum subject to full coverage.
+- "greedy": proportional-to-speed assignment with memory-aware residency
+  (exact for homogeneous slices: equal split, k=1).
+- "milp": scipy HiGHS mixed-integer program minimizing total ring latency
+  with integer w_i, n_i (heterogeneous clusters, the reference's regime).
+
+k > 1 (multi-round rings) is modeled as in the reference
+(api/utils.py:62-131): when every device must hold fewer resident layers
+than assigned, layers are dealt in k contiguous rounds; we emit rounds in
+LayerAssignment.rounds but currently always solve k=1 (windows/residency
+carry the memory pressure instead — the TPU host-DRAM path makes streaming
+cheaper than re-circling the ring).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from dnet_tpu.core.types import DeviceInfo, LayerAssignment, TopologyInfo
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+@dataclass
+class ModelProfile:
+    """Per-model cost inputs (≙ distilp.profile_model)."""
+
+    model_id: str
+    num_layers: int
+    layer_bytes: int  # parameter bytes per layer (serving dtype)
+    layer_flops_per_token: float  # forward FLOPs per token per layer
+    kv_bytes_per_token_per_layer: int
+    edge_bytes: int = 0  # embed + head + final norm
+    seq_len: int = 4096
+
+
+@dataclass
+class SolveResult:
+    w: List[int]
+    n: List[int]
+    k: int = 1
+    obj_value: float = 0.0
+    solver: str = "greedy"
+
+
+def device_throughput(d: DeviceInfo, m: ModelProfile) -> float:
+    """Per-layer decode time (s): max of FLOP time and HBM-read time."""
+    flops_t = m.layer_flops_per_token / max(d.flops_bf16, 1e9)
+    hbm_t = m.layer_bytes / max(d.hbm_bw, 1e9)
+    return max(flops_t, hbm_t)
+
+
+def hbm_layer_capacity(d: DeviceInfo, m: ModelProfile, reserve_frac: float = 0.15) -> int:
+    """How many layers fit in HBM after KV + edge + headroom."""
+    if d.hbm_bytes <= 0:
+        return m.num_layers  # unknown: assume everything fits
+    kv = m.kv_bytes_per_token_per_layer * m.seq_len
+    usable = d.hbm_bytes * (1 - reserve_frac) - m.edge_bytes
+    per_layer = m.layer_bytes + kv
+    return max(int(usable // per_layer), 0)
+
+
+def host_layer_capacity(d: DeviceInfo, m: ModelProfile) -> int:
+    """Layers whose params fit in host DRAM (offload ceiling)."""
+    if d.host_ram_bytes <= 0:
+        return m.num_layers
+    return max(int((d.host_ram_bytes * 0.8) // m.layer_bytes), 0)
+
+
+def solve_greedy(devices: List[DeviceInfo], m: ModelProfile) -> SolveResult:
+    """Proportional-to-speed with memory-aware residency."""
+    L = m.num_layers
+    speeds = [1.0 / device_throughput(d, m) for d in devices]
+    total = sum(speeds)
+    raw = [L * s / total for s in speeds]
+    w = [int(math.floor(r)) for r in raw]
+    # deal remaining layers by largest fractional part
+    rem = L - sum(w)
+    order = sorted(range(len(devices)), key=lambda i: raw[i] - w[i], reverse=True)
+    for i in order[:rem]:
+        w[i] += 1
+    # cap by host capacity (a device cannot even stream more than this)
+    for i, d in enumerate(devices):
+        cap = host_layer_capacity(d, m)
+        if w[i] > cap:
+            w[i] = cap
+    deficit = L - sum(w)
+    if deficit > 0:
+        # push the overflow to devices with spare host capacity, fastest first
+        for i in sorted(range(len(devices)), key=lambda i: speeds[i], reverse=True):
+            spare = host_layer_capacity(devices[i], m) - w[i]
+            take = min(spare, deficit)
+            w[i] += take
+            deficit -= take
+            if deficit == 0:
+                break
+        if deficit > 0:
+            raise ValueError(
+                f"model does not fit: {deficit} layers have no host to live on"
+            )
+    n = [min(w[i], hbm_layer_capacity(d, m)) for i, d in enumerate(devices)]
+    obj = _ring_latency(devices, m, w, n)
+    return SolveResult(w=w, n=n, k=1, obj_value=obj, solver="greedy")
+
+
+def _ring_latency(devices, m, w, n) -> float:
+    t = 0.0
+    for i, d in enumerate(devices):
+        t += w[i] * device_throughput(d, m)
+        t += max(0, w[i] - n[i]) * m.layer_bytes / max(d.host_to_hbm_bw, 1e9)
+        t += d.t_comm
+    return t
+
+
+def solve_milp(devices: List[DeviceInfo], m: ModelProfile, mip_gap: float = 1e-4) -> SolveResult:
+    """Exact (w, n) via scipy HiGHS MILP.
+
+    Variables per device: w_i (int), n_i (int), s_i >= w_i - n_i (streamed
+    layers), plus T = bottleneck stage time.  Objective: minimize T (pipeline
+    throughput under multiple in-flight tokens is set by the slowest stage)
+    with a small sum-latency tiebreak so homogeneous cases balance exactly.
+    Constraints: per-stage time <= T, sum w = L, n_i <= hbm-cap_i,
+    n_i <= w_i, w_i <= host-cap_i.
+    """
+    import numpy as np
+    from scipy.optimize import Bounds, LinearConstraint, milp
+
+    D = len(devices)
+    L = m.num_layers
+    c = np.array([device_throughput(d, m) for d in devices])
+    h = np.array(
+        [m.layer_bytes / max(d.host_to_hbm_bw, 1e9) for d in devices]
+    )
+    hbm_cap = np.array([hbm_layer_capacity(d, m) for d in devices])
+    host_cap = np.array([host_layer_capacity(d, m) for d in devices])
+
+    # x = [w_0..w_D-1, n_0..n_D-1, s_0..s_D-1, T]
+    N = 3 * D + 1
+    eps = 1e-3 / max(L, 1)
+    cost = np.concatenate([eps * c, np.zeros(D), eps * h, [1.0]])
+    integrality = np.concatenate([np.ones(D), np.ones(D), np.zeros(D), [0.0]])
+    lb = np.zeros(N)
+    ub = np.concatenate([host_cap, hbm_cap, np.full(D, L), [np.inf]])
+    constraints = []
+    # sum w == L
+    a = np.zeros(N)
+    a[:D] = 1
+    constraints.append(LinearConstraint(a, L, L))
+    for i in range(D):
+        # n_i - w_i <= 0
+        a = np.zeros(N)
+        a[D + i] = 1
+        a[i] = -1
+        constraints.append(LinearConstraint(a, -np.inf, 0))
+        # w_i - n_i - s_i <= 0
+        a = np.zeros(N)
+        a[i] = 1
+        a[D + i] = -1
+        a[2 * D + i] = -1
+        constraints.append(LinearConstraint(a, -np.inf, 0))
+        # stage time: w_i*c_i + s_i*h_i - T <= -t_comm_i (comm folded in)
+        a = np.zeros(N)
+        a[i] = c[i]
+        a[2 * D + i] = h[i]
+        a[3 * D] = -1
+        constraints.append(LinearConstraint(a, -np.inf, -devices[i].t_comm))
+
+    res = milp(
+        c=cost,
+        constraints=constraints,
+        integrality=integrality,
+        bounds=Bounds(lb, ub),
+        options={"mip_rel_gap": mip_gap},
+    )
+    if not res.success:
+        log.warning("MILP infeasible/failed (%s); falling back to greedy", res.message)
+        return solve_greedy(devices, m)
+    w = [int(round(v)) for v in res.x[:D]]
+    n = [int(round(v)) for v in res.x[D : 2 * D]]
+    # MILP maximizes residency implicitly only via streaming cost; pin n to
+    # the max that fits (streaming fewer layers never hurts)
+    n = [min(w[i], int(hbm_cap[i])) for i in range(D)]
+    obj = _ring_latency(devices, m, w, n)
+    return SolveResult(w=w, n=n, k=1, obj_value=obj, solver="milp")
+
+
+def order_devices(devices: List[DeviceInfo]) -> List[DeviceInfo]:
+    """Ring ordering: group ICI-adjacent devices so in-slice hops dominate
+    (the reference's Thunderbolt-adjacency greedy, api/utils.py:134-193)."""
+    if not devices:
+        return []
+    remaining = list(devices)
+    out = [remaining.pop(0)]
+    while remaining:
+        cur = out[-1]
+        nxt_i = 0
+        for i, cand in enumerate(remaining):
+            if cand.ici_adjacent(cur):
+                nxt_i = i
+                break
+        out.append(remaining.pop(nxt_i))
+    return out
+
+
+def postprocess_merge_singletons(
+    devices: List[DeviceInfo], w: List[int], n: List[int], m: ModelProfile
+) -> tuple[List[DeviceInfo], List[int], List[int]]:
+    """Merge single-layer devices into their lighter neighbor (reference
+    postprocess_single_round, api/utils.py:12-59) — a 1-layer stage rarely
+    pays for its hop."""
+    if len(devices) <= 1:
+        return devices, w, n
+    while True:
+        try:
+            i = next(idx for idx, wi in enumerate(w) if wi == 1 and len(w) > 1)
+        except StopIteration:
+            return devices, w, n
+        left = (i - 1) % len(w)
+        right = (i + 1) % len(w)
+        j = left if w[left] <= w[right] else right
+        if j == i:
+            return devices, w, n
+        w[j] += w[i]
+        n[j] = min(w[j], hbm_layer_capacity(devices[j], m))
+        del devices[i], w[i], n[i]
+
+
+def solve_topology(
+    devices: List[DeviceInfo],
+    m: ModelProfile,
+    kv_bits: int = 0,
+    solver: str = "auto",
+    mip_gap: float = 1e-4,
+) -> TopologyInfo:
+    """Full solve: order -> (w, n) -> merge -> contiguous assignments."""
+    if not devices:
+        raise ValueError("no devices")
+    devices = order_devices(devices)
+    heterogeneous = len(
+        {(d.chip_kind, round(d.flops_bf16 / 1e12, 1)) for d in devices}
+    ) > 1
+    use_milp = solver == "milp" or (solver == "auto" and heterogeneous)
+    result = (
+        solve_milp(devices, m, mip_gap) if use_milp else solve_greedy(devices, m)
+    )
+    devs = list(devices)
+    w, n = list(result.w), list(result.n)
+    devs, w, n = postprocess_merge_singletons(devs, w, n, m)
+
+    # drop zero-layer devices
+    keep = [i for i in range(len(devs)) if w[i] > 0]
+    devs = [devs[i] for i in keep]
+    w = [w[i] for i in keep]
+    n = [n[i] for i in keep]
+
+    assignments: List[LayerAssignment] = []
+    start = 0
+    for i, d in enumerate(devs):
+        layers = list(range(start, start + w[i]))
+        start += w[i]
+        window = 0 if n[i] >= w[i] else max(n[i] // 2, 1)
+        assignments.append(
+            LayerAssignment(
+                instance=d.instance,
+                layers=layers,
+                rounds=[layers],
+                window_size=window,
+                residency_size=0 if n[i] >= w[i] else n[i],
+            )
+        )
+    for i, a in enumerate(assignments):
+        a.next_instance = assignments[(i + 1) % len(assignments)].instance
+    return TopologyInfo(
+        model=m.model_id,
+        num_layers=m.num_layers,
+        kv_bits=kv_bits,
+        devices=devs,
+        assignments=assignments,
+        solution={
+            "k": result.k,
+            "w": w,
+            "n": n,
+            "obj_value": result.obj_value,
+            "solver": result.solver,
+        },
+    )
+
+
+def model_profile_from_checkpoint(
+    model_dir, seq_len: int = 4096, kv_bits: int = 0
+) -> ModelProfile:
+    """Cost model from checkpoint headers (no weight loading)."""
+    import json
+    from pathlib import Path
+
+    from dnet_tpu.models.base import ModelConfig
+    from dnet_tpu.utils.checkpoint import Checkpoint
+
+    ckpt = Checkpoint(model_dir)
+    cfg = ModelConfig.from_hf(ckpt.config)
+    layer_bytes = ckpt.layer_nbytes(0)
+    edge_bytes = sum(
+        _tensor_bytes(ckpt, name) for name in ckpt.edge_tensors
+    )
+    D = cfg.hidden_size
+    # decode FLOPs/token/layer ~ 2 * params_per_layer (dense); MoE uses top-k
+    params_per_layer = layer_bytes / 2  # serving bf16
+    active = params_per_layer
+    if cfg.num_local_experts and cfg.num_experts_per_tok:
+        active = params_per_layer * (
+            cfg.num_experts_per_tok / cfg.num_local_experts
+        )
+    kv_elem_bytes = 1 if kv_bits == 8 else 2
+    kvh = cfg.num_key_value_heads
+    kv_bytes = 2 * kvh * cfg.head_dim * kv_elem_bytes
+    return ModelProfile(
+        model_id=str(model_dir),
+        num_layers=cfg.num_hidden_layers,
+        layer_bytes=layer_bytes,
+        layer_flops_per_token=2.0 * active,
+        kv_bytes_per_token_per_layer=kv_bytes,
+        edge_bytes=edge_bytes,
+        seq_len=seq_len,
+    )
+
+
+def _tensor_bytes(ckpt, name: str) -> int:
+    shape, dtype = ckpt.tensor_meta(name)
+    from dnet_tpu.utils.checkpoint import _dtype_size
+
+    n = 1
+    for s in shape:
+        n *= s
+    return n * _dtype_size(dtype)

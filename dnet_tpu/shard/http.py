@@ -149,9 +149,11 @@ class ShardHTTPServer:
         return web.json_response({"status": "ok", "latency": results})
 
     async def profile(self, request: web.Request) -> web.Response:
-        """Device microbenchmark (subprocess isolation lands with the solver)."""
-        from dnet_tpu.parallel.profiler import profile_device_quick
+        """Device microbenchmark: subprocess-isolated when the accelerator
+        allows a second client, in-process otherwise (reference
+        utils/profile_subproc.py pattern)."""
+        from dnet_tpu.parallel.profiler import profile_device_subprocess
 
         loop = asyncio.get_running_loop()
-        result = await loop.run_in_executor(None, profile_device_quick)
+        result = await loop.run_in_executor(None, profile_device_subprocess)
         return web.json_response({"status": "ok", "profile": result})

@@ -173,3 +173,36 @@ def test_two_shard_chat(cluster):
     assert r.status_code == 200
     h0 = httpx.get(f"http://127.0.0.1:{ports['s0_http']}/health", timeout=5).json()
     assert h0["model"] is None and h0["layers"] == []
+
+
+def test_auto_topology_pipeline(cluster):
+    """discover -> /profile microbench -> /measure_latency -> solve -> serve."""
+    ports, model_dir = cluster
+    base = f"http://127.0.0.1:{ports['api_http']}"
+
+    r = httpx.post(
+        f"{base}/v1/prepare_topology",
+        json={"model": str(model_dir), "seq_len": 64},
+        timeout=300.0,
+    )
+    assert r.status_code == 200, r.text
+    topo = r.json()["topology"]
+    assert topo["solution"]["solver"] in {"greedy", "milp"}
+    covered = sorted(l for a in topo["assignments"] for l in a["layers"])
+    assert covered == list(range(4))
+
+    r = httpx.post(f"{base}/v1/load_model", json={"model": str(model_dir)}, timeout=300.0)
+    assert r.status_code == 200, r.text
+    r = httpx.post(
+        f"{base}/v1/chat/completions",
+        json={
+            "model": str(model_dir),
+            "messages": [{"role": "user", "content": "hey"}],
+            "max_tokens": 3,
+            "temperature": 0,
+        },
+        timeout=120.0,
+    )
+    assert r.status_code == 200, r.text
+    assert r.json()["usage"]["completion_tokens"] >= 1
+    httpx.post(f"{base}/v1/unload_model", timeout=60.0)

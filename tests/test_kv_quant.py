@@ -27,7 +27,7 @@ def test_write_read_roundtrip_accuracy():
     k_new = jnp.asarray(rng.normal(0, 2.0, (1, 3, 2, 8)).astype(np.float32))
     v_new = jnp.asarray(rng.normal(0, 0.5, (1, 3, 2, 8)).astype(np.float32))
     kvs = write_kv(kvs, k_new, v_new, jnp.int32(4))
-    k, v = read_kv(kvs, jnp.float32)
+    k, v = read_kv(kvs)
     np.testing.assert_allclose(np.asarray(k[0, 4:7]), np.asarray(k_new[0]), atol=0.04, rtol=0.03)
     np.testing.assert_allclose(np.asarray(v[0, 4:7]), np.asarray(v_new[0]), atol=0.01, rtol=0.03)
     assert np.all(np.asarray(k[0, :4]) == 0)
