@@ -47,11 +47,15 @@ class LocalModelManager:
         models_dir: Optional[str] = None,
         max_seq: int = 4096,
         param_dtype: str = "bfloat16",
+        mesh: Optional[dict] = None,  # {"pp","tp","dp","sp"} -> MeshEngine
     ) -> None:
         self.inference = inference_manager
         self.models_dir = models_dir
         self.max_seq = max_seq
         self.param_dtype = param_dtype
+        # active when any axis is parallel or pp is left to infer (pp=0 with
+        # another axis set, or an explicit pp)
+        self.mesh = mesh if mesh and (any(v > 1 for v in mesh.values()) or mesh.get("pp", 0) > 1) else None
         self.engine = None
         self.model_dir: Optional[Path] = None
 
@@ -73,13 +77,26 @@ class LocalModelManager:
         loop = asyncio.get_running_loop()
 
         def _build():
-            from dnet_tpu.core.engine import LocalEngine
+            if self.mesh is not None:
+                from dnet_tpu.parallel.engine import MeshEngine
 
-            engine = LocalEngine(
-                model_dir,
-                max_seq=max_seq or self.max_seq,
-                param_dtype=self.param_dtype,
-            )
+                engine = MeshEngine(
+                    model_dir,
+                    pp=self.mesh.get("pp", 0),
+                    tp=self.mesh.get("tp", 1),
+                    dp=self.mesh.get("dp", 1),
+                    sp=self.mesh.get("sp", 1),
+                    max_seq=max_seq or self.max_seq,
+                    param_dtype=self.param_dtype,
+                )
+            else:
+                from dnet_tpu.core.engine import LocalEngine
+
+                engine = LocalEngine(
+                    model_dir,
+                    max_seq=max_seq or self.max_seq,
+                    param_dtype=self.param_dtype,
+                )
             return engine, load_tokenizer(model_dir)
 
         engine, tokenizer = await loop.run_in_executor(None, _build)

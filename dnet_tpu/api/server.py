@@ -24,11 +24,17 @@ async def serve_async(args) -> None:
         request_timeout_s=s.api.request_timeout_s,
         max_concurrent=s.api.max_concurrent_requests,
     )
+    env_mesh = {"pp": s.mesh.pp, "tp": s.mesh.tp, "dp": s.mesh.dp, "sp": s.mesh.sp}
+    env_mesh_active = s.mesh.pp > 0 or s.mesh.tp > 1 or s.mesh.dp > 1 or s.mesh.sp > 1
+    mesh = _parse_mesh(getattr(args, "mesh", "")) or (
+        env_mesh if env_mesh_active else None
+    )
     model_manager = LocalModelManager(
         inference,
         models_dir=getattr(args, "models_dir", "") or s.api.models_dir,
         max_seq=s.api.max_seq_len,
         param_dtype=s.api.param_dtype,
+        mesh=mesh,
     )
 
     cluster_manager = None
@@ -101,6 +107,28 @@ async def serve_async(args) -> None:
         await grpc_server.stop(grace=2)
     if inference.adapter is not None:
         await inference.adapter.shutdown()
+
+
+def _parse_mesh(spec: str) -> dict | None:
+    """'pp=4,tp=2' -> {"pp": 4, "tp": 2}.  pp=0 means infer from devices."""
+    if not spec:
+        return None
+    out = {}
+    for part in spec.split(","):
+        key, eq, val = part.partition("=")
+        key = key.strip()
+        if not eq or not val.strip():
+            raise ValueError(f"--mesh expects axis=value pairs; got {part!r}")
+        if key not in {"pp", "tp", "dp", "sp"}:
+            raise ValueError(f"unknown mesh axis {key!r} in --mesh (use pp/tp/dp/sp)")
+        try:
+            n = int(val)
+        except ValueError:
+            raise ValueError(f"--mesh {key}={val!r} is not an integer") from None
+        if n < 0 or (n == 0 and key != "pp"):
+            raise ValueError(f"--mesh {key}={n} must be positive (pp=0 = infer)")
+        out[key] = n
+    return out
 
 
 def serve(args) -> None:

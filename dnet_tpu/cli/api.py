@@ -22,6 +22,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hostfile", default="", help="static discovery hostfile")
     p.add_argument("--model", default="", help="model to load at startup (path or id)")
     p.add_argument("--models-dir", default="", help="override DNET_API_MODELS_DIR")
+    p.add_argument(
+        "--mesh",
+        default="",
+        help="in-slice single-program serving, e.g. 'pp=4,tp=2' (ICI fast path)",
+    )
     return p
 
 

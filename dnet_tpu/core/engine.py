@@ -305,7 +305,9 @@ class LocalEngine:
             raise ValueError(
                 f"prompt length {sess.pos + T} exceeds max_seq {self.max_seq}"
             )
-        Tpad = min(bucket_length(T), self.max_seq)
+        # the PADDED width must also fit — dynamic_update_slice would clamp
+        # the start index and silently shift the whole KV write otherwise
+        Tpad = min(bucket_length(T), self.max_seq - sess.pos)
         tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
         tokens[:, :T] = np.asarray(prompt_ids, dtype=np.int32)
         if self.plan.streams_weights:
@@ -382,19 +384,25 @@ class LocalEngine:
                 break
         self.end_session(nonce)
 
-    def prefill_and_sample(
-        self, nonce: str, prompt_ids: Sequence[int], decoding: DecodingParams
+    def _sample_with_counts(
+        self, sess: "Session", logits, decoding: DecodingParams
     ) -> SampleResult:
-        """Prefill the prompt and sample the first token (one place owns the
-        key-split/sample/counts invariants for step 0)."""
-        logits = self.prefill(nonce, prompt_ids, decoding.seed)
-        sess = self.sessions[nonce]
-        sess.key, k0 = jax.random.split(sess.key)
+        """THE place owning the key-split/sample/counts invariants (shared by
+        LocalEngine and MeshEngine)."""
+        sess.key, step_key = jax.random.split(sess.key)
         res = sample(
-            logits, SampleParams.from_decoding(decoding), k0, token_counts=sess.counts
+            logits, SampleParams.from_decoding(decoding), step_key,
+            token_counts=sess.counts,
         )
         sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
         return res
+
+    def prefill_and_sample(
+        self, nonce: str, prompt_ids: Sequence[int], decoding: DecodingParams
+    ) -> SampleResult:
+        """Prefill the prompt and sample the first token."""
+        logits = self.prefill(nonce, prompt_ids, decoding.seed)
+        return self._sample_with_counts(self.sessions[nonce], logits, decoding)
 
     @staticmethod
     def token_result(nonce: str, res: SampleResult, step: int, decoding: DecodingParams) -> TokenResult:

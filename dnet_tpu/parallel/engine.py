@@ -1,0 +1,198 @@
+"""MeshEngine: serve a whole model on a pp x tp x dp mesh as ONE XLA program.
+
+The flagship TPU-native serving path (SURVEY.md §7 stage 4): where the
+reference runs N shard processes exchanging gRPC frames, chips of one slice
+form a Mesh and every decode step — all pipeline stages, tensor-parallel
+matmuls, the activation hops (`lax.ppermute` over ICI) and the final logits —
+is a single jitted step.  Exposes the LocalEngine session surface
+(prefill_and_sample / decode_step / sessions / token_result), so the API
+node's LocalAdapter drives it unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnet_tpu.core.engine import LocalEngine, Session, bucket_length
+from dnet_tpu.core.kvcache import init_cache
+from dnet_tpu.core.sampler import SampleResult
+from dnet_tpu.core.types import DecodingParams
+from dnet_tpu.models import ModelConfig, get_ring_model_cls
+from dnet_tpu.parallel.mesh import build_mesh
+from dnet_tpu.parallel.ring import make_ring_decode_fn, place_ring_state
+from dnet_tpu.utils.checkpoint import Checkpoint
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class MeshEngine:
+    """LocalEngine-compatible engine executing the pipelined ring in-slice.
+
+    Session/sampling invariants are LocalEngine's own methods, borrowed via
+    duck typing — one implementation, two execution substrates.
+    """
+
+    token_result = staticmethod(LocalEngine.token_result)
+    prefill_and_sample = LocalEngine.prefill_and_sample
+    _sample_with_counts = LocalEngine._sample_with_counts
+    end_session = LocalEngine.end_session
+    sweep_sessions = LocalEngine.sweep_sessions
+    reset = LocalEngine.reset
+
+    def __init__(
+        self,
+        model_dir: str | Path,
+        pp: int = 0,
+        tp: int = 1,
+        dp: int = 1,
+        sp: int = 1,
+        batch: int = 1,
+        max_seq: int = 2048,
+        param_dtype: str = "bfloat16",
+        kv_dtype: Optional[str] = None,
+        kv_quant_bits: int = 0,
+        kv_ttl_s: float = 600.0,
+        devices: Optional[Sequence] = None,
+    ):
+        if sp > 1:
+            raise NotImplementedError(
+                "sequence parallelism (sp) lands with ring attention; use pp/tp/dp"
+            )
+        self.ckpt = Checkpoint(model_dir)
+        self.config = ModelConfig.from_hf(self.ckpt.config)
+        model_cls = get_ring_model_cls(self.config.model_type)
+        self.model = model_cls(self.config, range(self.config.num_hidden_layers))
+        L = self.config.num_hidden_layers
+        if pp <= 0:  # 0 = infer: use every remaining device for pipeline stages
+            n_dev = len(list(devices) if devices is not None else jax.devices())
+            pp = max(n_dev // (tp * dp), 1)
+            while pp > 1 and L % pp != 0:
+                pp -= 1
+        if L % pp != 0:
+            raise ValueError(f"pp={pp} must divide num_layers={L}")
+        self.mesh = build_mesh(pp=pp, tp=tp, dp=dp, devices=devices)
+        self.pp, self.tp, self.dp = pp, tp, dp
+        self.batch = batch * dp
+        self.max_seq = max_seq
+        self.param_dtype = jnp.dtype(param_dtype)
+        self.kv_dtype = kv_dtype or param_dtype
+        self.kv_quant_bits = kv_quant_bits
+        self.kv_ttl_s = kv_ttl_s
+        self.sessions: Dict[str, Session] = {}
+        self.plan = type("plan", (), {"streams_weights": False, "name": "fit"})()
+
+        self._load_params()
+        self._step = make_ring_decode_fn(
+            self.model, self.mesh, param_keys=list(self._host_window.keys())
+        )
+        log.info(
+            "MeshEngine: %s over mesh pp=%d tp=%d dp=%d (%d devices)",
+            self.config.model_type, pp, tp, dp, pp * tp * dp,
+        )
+
+    # ---- loading ------------------------------------------------------
+    def _load_params(self) -> None:
+        t0 = time.perf_counter()
+        m = self.model
+        per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
+        stacked = m.stack_layers(per_layer)
+
+        def cast(a):
+            arr = np.asarray(a)
+            if np.issubdtype(arr.dtype, np.floating):
+                import ml_dtypes
+
+                target = (
+                    ml_dtypes.bfloat16
+                    if self.param_dtype == jnp.bfloat16
+                    else self.param_dtype
+                )
+                arr = arr.astype(target)
+            return arr
+
+        self._host_window = jax.tree.map(cast, stacked)
+        edge = jax.tree.map(cast, m.map_edge(self.ckpt.load_edge_raw()))
+        kv0 = init_cache(
+            m.kv_config(
+                len(m.layers), self.batch, self.max_seq, self.kv_dtype,
+                quant_bits=self.kv_quant_bits,
+            )
+        )
+        self.window_params, self.edge_params, self._kv_template = place_ring_state(
+            self._host_window, edge, kv0, self.mesh
+        )
+        log.info(
+            "[PROFILE] mesh-placed %d layers in %.2fs",
+            len(m.layers), time.perf_counter() - t0,
+        )
+
+    # ---- sessions -----------------------------------------------------
+    def new_session(self, nonce: str, seed: Optional[int] = None) -> Session:
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        kv0 = init_cache(
+            self.model.kv_config(
+                len(self.model.layers), self.batch, self.max_seq, self.kv_dtype,
+                quant_bits=self.kv_quant_bits,
+            )
+        )
+        _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
+        sess = Session(
+            kv=kv,
+            pos=0,
+            key=jax.random.key(seed),
+            counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
+        )
+        self.sessions[nonce] = sess
+        return sess
+
+    def close(self) -> None:
+        self.sessions.clear()
+
+    # ---- inference ----------------------------------------------------
+    def _forward_ring(self, sess: Session, tokens_np: np.ndarray, last_idx: int):
+        logits, sess.kv = self._step(
+            self.window_params, self.edge_params, jnp.asarray(tokens_np),
+            sess.kv, jnp.int32(sess.pos), jnp.int32(last_idx),
+        )
+        return logits
+
+    def prefill(self, nonce: str, prompt_ids: Sequence[int], seed: Optional[int] = None):
+        sess = self.sessions.get(nonce) or self.new_session(nonce, seed)
+        T = len(prompt_ids)
+        if T == 0:
+            raise ValueError("empty prompt")
+        if sess.pos + T > self.max_seq:
+            raise ValueError(f"prompt length {sess.pos + T} exceeds max_seq {self.max_seq}")
+        Tpad = min(bucket_length(T), self.max_seq)
+        tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
+        tokens[:, :T] = np.asarray(prompt_ids, dtype=np.int32)
+        logits = self._forward_ring(sess, tokens, T - 1)
+        sess.pos += T
+        sess.last_used = time.time()
+        return logits
+
+    def decode_step(self, nonce: str, token_id: int, decoding: DecodingParams) -> SampleResult:
+        sess = self.sessions[nonce]
+        if sess.pos >= self.max_seq:
+            raise ValueError(f"sequence length {sess.pos} reached max_seq {self.max_seq}")
+        tokens = np.full((self.batch, 1), token_id, dtype=np.int32)
+        logits = self._forward_ring(sess, tokens, 0)
+        res = self._sample_with_counts(sess, logits, decoding)
+        sess.pos += 1
+        sess.last_used = time.time()
+        return res
+
+    def generate(self, prompt_ids, decoding=None, max_tokens=256, eos_token_ids=None, nonce="mesh"):
+        """Same loop as LocalEngine.generate (shared via duck-typed surface)."""
+        return LocalEngine.generate(
+            self, prompt_ids, decoding, max_tokens, eos_token_ids, nonce
+        )

@@ -47,18 +47,19 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
     in_specs = (
         {k: layer_param_spec(k) for k in param_keys},
         P(),  # edge params replicated
-        P(AXIS_DP, None),  # tokens [B, 1]
+        P(AXIS_DP, None),  # tokens [B, T]
         kv_spec(),  # pytree prefix: applies to every kv leaf (incl. scales)
         P(),  # pos scalar
+        P(),  # last_idx scalar
         P(AXIS_PP) if has_kinds else P(),
     )
     out_specs = (P(AXIS_DP, None), kv_spec())
 
-    def spmd(window_params, edge_params, tokens, kv, pos, kinds):
+    def spmd(window_params, edge_params, tokens, kv, pos, last_idx, kinds):
         my_pp = lax.axis_index(AXIS_PP)
 
-        # Stage 0 embeds; everyone runs the embed (cheap for T=1) but only
-        # rank 0's x is "real" at iteration 0.
+        # Stage 0 embeds; everyone runs the embed (cheap) but only rank 0's
+        # x is "real" at iteration 0.
         x = model.embed(edge_params, tokens)
         # x becomes device-varying over pp once layer-sharded params touch
         # it (over tp it stays value-invariant thanks to the psum seams);
@@ -83,8 +84,9 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
         x, kv = lax.fori_loop(0, PP, stage_iter, (x, kv))
         # after PP hops the processed x is back on rank 0; ranks agree via
         # the ppermute ring, and rank 0 holds the final hidden state.
-        x = model.normalize(edge_params, x)
-        logits = model.lm_project(edge_params, x)
+        x_last = lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
+        x_last = model.normalize(edge_params, x_last)
+        logits = model.lm_project(edge_params, x_last)
         # Replicate rank 0's logits across pp (out_specs say logits are not
         # sharded over pp; only rank 0 holds the real value after the loop).
         logits = _bcast_from_rank0(logits, AXIS_PP)
@@ -95,8 +97,10 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
     jitted = jax.jit(fn, donate_argnums=donate)
     kinds_arr = model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
 
-    def call(window_params, edge_params, tokens, kv, pos):
-        return jitted(window_params, edge_params, tokens, kv, pos, kinds_arr)
+    def call(window_params, edge_params, tokens, kv, pos, last_idx=None):
+        if last_idx is None:
+            last_idx = jnp.int32(tokens.shape[1] - 1)
+        return jitted(window_params, edge_params, tokens, kv, pos, last_idx, kinds_arr)
 
     return call
 

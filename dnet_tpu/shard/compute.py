@@ -103,9 +103,11 @@ class ShardCompute:
             T = ids.shape[-1]
             # T==1 is the steady-state decode hop: no bucket padding (a
             # dedicated (B,1) program, like the local path's _decode)
-            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq)
             if pos + T > eng.max_seq:
                 raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
+            # padded width must fit too (a clamped dynamic_update_slice would
+            # silently shift the KV write)
+            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq - pos)
             tokens = np.zeros((eng.batch, Tpad), dtype=np.int32)
             tokens[:, :T] = ids.reshape(1, -1)
             if streams:
@@ -119,9 +121,9 @@ class ShardCompute:
         else:
             hidden = bytes_to_tensor(msg.data, msg.dtype, msg.shape)
             T = hidden.shape[1]
-            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq)
             if pos + T > eng.max_seq:
                 raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
+            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq - pos)
             if Tpad != T:
                 pad = np.zeros(
                     (hidden.shape[0], Tpad - T, hidden.shape[2]), dtype=hidden.dtype
