@@ -23,7 +23,8 @@ class ClusterManager:
         self.current_topology: Optional[TopologyInfo] = None
 
     async def scan_devices(self) -> List[DeviceInfo]:
-        return list(self.discovery.peers())
+        # manager (API) nodes are not compute shards
+        return [d for d in self.discovery.peers() if not d.is_manager]
 
     async def healthy_devices(self, timeout_s: float = 5.0) -> List[DeviceInfo]:
         """Parallel health checks; unhealthy shards are filtered before any

@@ -39,7 +39,18 @@ async def serve_async(args) -> None:
 
     cluster_manager = None
     grpc_server = None
-    if getattr(args, "hostfile", ""):
+    ring_discovery = None
+    if getattr(args, "discovery", "none") == "udp" and not getattr(args, "hostfile", ""):
+        from dnet_tpu.utils.p2p import UdpDiscovery
+
+        ring_discovery = UdpDiscovery(
+            "api", args.http_port, args.grpc_port, is_manager=True,
+            udp_port=getattr(args, "udp_port", 58899),
+            target_addr=getattr(args, "udp_target", "255.255.255.255"),
+            cluster=getattr(args, "cluster", "default"),
+        )
+        log.info("UDP discovery active (manager)")
+    if getattr(args, "hostfile", "") or ring_discovery is not None:
         from dnet_tpu.api.cluster import ClusterManager
         from dnet_tpu.api.ring import ApiTokenServicer
         from dnet_tpu.api.ring_manager import RingModelManager
@@ -49,7 +60,11 @@ async def serve_async(args) -> None:
         )
         from dnet_tpu.utils.hostfile import StaticDiscovery
 
-        discovery = StaticDiscovery.from_hostfile(args.hostfile)
+        discovery = (
+            ring_discovery
+            if ring_discovery is not None
+            else StaticDiscovery.from_hostfile(args.hostfile)
+        )
         cluster_manager = ClusterManager(discovery)
         # callback address shards dial for SendToken: explicit override, else
         # the interface facing the shards (reference http_api.py:188-196)
@@ -78,7 +93,11 @@ async def serve_async(args) -> None:
                 )
             ),
         )
-        log.info("ring mode: %d shard(s) from hostfile", len(discovery.peers()))
+        log.info(
+            "ring mode: %d shard(s) via %s",
+            len(discovery.peers()),
+            "udp discovery" if ring_discovery is not None else "hostfile",
+        )
 
     http = ApiHTTPServer(inference, model_manager, cluster_manager)
     await http.start(args.host, args.http_port)
@@ -102,6 +121,8 @@ async def serve_async(args) -> None:
     log.info("dnet-api ready")
     await stop.wait()
     log.info("shutting down")
+    if ring_discovery is not None:
+        ring_discovery.stop()
     await http.stop()
     if grpc_server is not None:
         await grpc_server.stop(grace=2)

@@ -27,6 +27,15 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="in-slice single-program serving, e.g. 'pp=4,tp=2' (ICI fast path)",
     )
+    p.add_argument(
+        "--discovery", choices=["udp", "none"], default="none",
+        help="discover shards over UDP broadcast instead of a hostfile",
+    )
+    p.add_argument("--udp-port", type=int, default=58899)
+    p.add_argument("--udp-target", default="255.255.255.255",
+                   help="announce target (loopback broadcast for single-host)")
+    p.add_argument("--cluster", default="default",
+                   help="cluster token scoping UDP discovery membership")
     return p
 
 

@@ -20,6 +20,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grpc-port", type=int, default=s.shard.grpc_port)
     p.add_argument("--queue-size", type=int, default=s.shard.queue_size)
     p.add_argument("--shard-name", default=s.shard.name)
+    p.add_argument(
+        "--discovery", choices=["udp", "none"], default="udp",
+        help="announce this shard over UDP broadcast (native lib)",
+    )
+    p.add_argument("--udp-port", type=int, default=58899)
+    p.add_argument("--udp-target", default="255.255.255.255",
+                   help="announce target (loopback broadcast for single-host)")
+    p.add_argument("--cluster", default="default",
+                   help="cluster token scoping UDP discovery membership")
     return p
 
 

@@ -1,0 +1,23 @@
+"""Wire compression for DCN activation hops.
+
+Reference: src/dnet/compression/ (8 Metal kernels + sparse wire formats,
+SURVEY.md §2.4).  On TPU the in-slice hops are ICI collectives inside one
+XLA program (no wire at all); compression only matters for cross-host DCN /
+gRPC hops, where column sparsification cuts activation bytes at a small
+accuracy cost.  Kernels are Pallas (TPU) with a jnp fallback.
+"""
+
+from dnet_tpu.compression.ops import column_l2_norms, column_sparsify
+from dnet_tpu.compression.wire import (
+    compress_tensor,
+    decompress_tensor,
+    is_compressed_dtype,
+)
+
+__all__ = [
+    "column_l2_norms",
+    "column_sparsify",
+    "compress_tensor",
+    "decompress_tensor",
+    "is_compressed_dtype",
+]

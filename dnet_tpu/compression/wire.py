@@ -1,0 +1,74 @@
+"""Sparse wire format for DCN activation hops.
+
+Reference: src/dnet/compression/wire.py:80-171 — `sparse_v1` packs a column
+bitmask + the kept fp16 columns, with metadata smuggled through the frame's
+dtype string.  Same scheme here:
+
+  dtype = "<base>|fmt=sparse_v1|pct=<drop_frac>|orig=<C>"
+  payload = [bitmask bytes (ceil(C/8))] + [kept columns, column-major f16]
+
+Compression/decompression are host-side (the wire is host-bound anyway);
+the column selection runs on device via compression.ops.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from dnet_tpu.compression.ops import _topk_column_mask, column_l2_norms
+from dnet_tpu.utils.serialization import numpy_dtype
+
+FMT_TAG = "fmt=sparse_v1"
+
+
+def is_compressed_dtype(dtype: str) -> bool:
+    return "|" in dtype and FMT_TAG in dtype
+
+
+def compress_tensor(
+    x, drop_frac: float, wire_dtype: str = "bfloat16"
+) -> Tuple[bytes, str, Tuple[int, ...]]:
+    """[B, T, D] (or [R, D]) activations -> sparse payload.
+
+    Column selection runs on device (norms + top-k); only the kept columns
+    leave the host.  wire_dtype defaults to bf16 — activations can exceed
+    fp16 range, and the kept columns are exactly the large-norm ones.
+    Returns (payload, tagged dtype string, original shape).
+    """
+    import jax.numpy as jnp
+
+    orig_shape = tuple(x.shape)
+    D = orig_shape[-1]
+    x2 = jnp.reshape(x, (-1, D))
+    keep = max(int(round(D * (1.0 - drop_frac))), 1)
+    mask_np = np.asarray(_topk_column_mask(column_l2_norms(x2), keep))
+    nd = numpy_dtype(wire_dtype)
+    kept = np.asarray(x2)[:, mask_np].astype(nd)
+    bitmask = np.packbits(mask_np)
+    payload = bitmask.tobytes() + np.ascontiguousarray(kept).tobytes()
+    dtype = f"{wire_dtype}|{FMT_TAG}|pct={drop_frac:g}|orig={D}"
+    return payload, dtype, orig_shape
+
+
+def decompress_tensor(payload: bytes, dtype: str, shape: Tuple[int, ...]) -> np.ndarray:
+    """Inverse of compress_tensor: scatter kept columns back to zeros."""
+    if not is_compressed_dtype(dtype):
+        raise ValueError(f"not a compressed dtype tag: {dtype!r}")
+    base = dtype.split("|", 1)[0]
+    nd = numpy_dtype(base)
+    fields = dict(
+        part.split("=", 1) for part in dtype.split("|")[1:] if "=" in part
+    )
+    D = int(fields["orig"])
+    mask_bytes = (D + 7) // 8
+    bitmask = np.unpackbits(
+        np.frombuffer(payload[:mask_bytes], dtype=np.uint8), count=D
+    ).astype(bool)
+    kept_count = int(bitmask.sum())
+    R = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+    kept = np.frombuffer(payload[mask_bytes:], dtype=nd).reshape(R, kept_count)
+    out = np.zeros((R, D), dtype=nd)
+    out[:, bitmask] = kept
+    return out.reshape(shape)

@@ -41,6 +41,7 @@ class ShardCompute:
         residency_size: int = 0,
         repack_dir: Optional[str] = None,
         kv_bits: int = 0,
+        compress_frac: Optional[float] = None,
     ) -> None:
         kv_dtype = None
         kv_quant_bits = 0
@@ -71,6 +72,15 @@ class ShardCompute:
         self.wire_dtype = wire_dtype
         self.is_first = self.engine.model.is_first
         self.is_last = self.engine.model.is_last
+        # column-sparsify hidden hops toward the next shard (DCN only —
+        # reference gates the same way, config.py:128-135, default off);
+        # explicit arg wins, DNET_TRANSPORT_* is the deploy-wide default
+        if compress_frac is None:
+            from dnet_tpu.config import get_settings
+
+            t = get_settings().transport
+            compress_frac = t.compress_pct if t.compress else 0.0
+        self.compress_frac = compress_frac
 
     @property
     def max_layer(self) -> int:
@@ -119,7 +129,12 @@ class ShardCompute:
                     sess.kv, jnp.int32(pos),
                 )
         else:
-            hidden = bytes_to_tensor(msg.data, msg.dtype, msg.shape)
+            from dnet_tpu.compression import decompress_tensor, is_compressed_dtype
+
+            if is_compressed_dtype(msg.dtype):
+                hidden = decompress_tensor(msg.data, msg.dtype, msg.shape)
+            else:
+                hidden = bytes_to_tensor(msg.data, msg.dtype, msg.shape)
             T = hidden.shape[1]
             if pos + T > eng.max_seq:
                 raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
@@ -164,7 +179,14 @@ class ShardCompute:
 
         # hidden hop to the next shard: slice off the padding, cast to wire
         out = np.asarray(x[:, :T])
-        payload, dtype, shape = tensor_to_bytes(out, wire_dtype=self.wire_dtype)
+        if self.compress_frac > 0:
+            from dnet_tpu.compression import compress_tensor
+
+            payload, dtype, shape = compress_tensor(
+                out, self.compress_frac, wire_dtype=self.wire_dtype
+            )
+        else:
+            payload, dtype, shape = tensor_to_bytes(out, wire_dtype=self.wire_dtype)
         return ActivationMessage(
             nonce=nonce,
             layer_id=self.max_layer,

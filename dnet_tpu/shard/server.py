@@ -90,6 +90,21 @@ async def serve_async(args) -> None:
     http = ShardHTTPServer(shard)
     await http.start(args.host, args.http_port)
 
+    discovery = None
+    if getattr(args, "discovery", "none") == "udp":
+        try:
+            from dnet_tpu.utils.p2p import UdpDiscovery
+
+            discovery = UdpDiscovery(
+                shard_id, args.http_port, args.grpc_port,
+                udp_port=getattr(args, "udp_port", 58899),
+                target_addr=getattr(args, "udp_target", "255.255.255.255"),
+                cluster=getattr(args, "cluster", "default"),
+            )
+            log.info("UDP discovery announcing as %s", shard_id)
+        except Exception as exc:
+            log.warning("UDP discovery unavailable (%s); hostfile mode only", exc)
+
     sweeper = asyncio.ensure_future(runtime.sweeper())
 
     stop = asyncio.Event()
@@ -103,6 +118,8 @@ async def serve_async(args) -> None:
     await stop.wait()
 
     log.info("shard shutting down")
+    if discovery is not None:
+        discovery.stop()
     sweeper.cancel()
     await http.stop()
     await grpc_server.stop(grace=2)

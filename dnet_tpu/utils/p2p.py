@@ -1,0 +1,159 @@
+"""UDP-broadcast LAN discovery (ctypes wrapper over native/discovery.cpp).
+
+The native analog of the reference's Rust dnet-p2p (loaded the same way the
+reference loads its lib: cli/shard.py:34 `AsyncDnetP2P("lib/dnet-p2p/lib")`).
+The shared library is built on demand with g++ and cached next to the
+source; `UdpDiscovery` exposes the same peer-table surface as
+`StaticDiscovery`, so the API node's ClusterManager is agnostic.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+from dnet_tpu.core.types import DeviceInfo
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_SRC = _NATIVE_DIR / "discovery.cpp"
+_LIB = _NATIVE_DIR / "libdnetdisc.so"
+_build_lock = threading.Lock()
+
+
+def ensure_built(force: bool = False) -> Path:
+    """Compile the discovery library if missing/stale (g++ is baked in)."""
+    with _build_lock:
+        if (
+            not force
+            and _LIB.is_file()
+            and _LIB.stat().st_mtime >= _SRC.stat().st_mtime
+        ):
+            return _LIB
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            "-o", str(_LIB), str(_SRC), "-lpthread",
+        ]
+        log.info("building native discovery: %s", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native discovery build failed:\n{proc.stderr.strip()}"
+            )
+        return _LIB
+
+
+def _load():
+    lib = ctypes.CDLL(str(ensure_built()))
+    lib.dnet_disc_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+    ]
+    lib.dnet_disc_start.restype = ctypes.c_int
+    lib.dnet_disc_update.argtypes = [ctypes.c_char_p]
+    lib.dnet_disc_peers.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dnet_disc_peers.restype = ctypes.c_int
+    lib.dnet_disc_stop.argtypes = []
+    return lib
+
+
+class UdpDiscovery:
+    """Announce this node + maintain a live LAN peer table.
+
+    One instance per process (the native lib holds process-global state,
+    like the reference's in-process Rust lib).
+    """
+
+    def __init__(
+        self,
+        instance: str,
+        http_port: int,
+        grpc_port: int,
+        is_manager: bool = False,
+        slice_id: int = 0,
+        udp_port: int = 58899,
+        target_addr: str = "255.255.255.255",
+        interval_ms: int = 500,
+        ttl_s: float = 5.0,
+        cluster: str = "default",
+    ) -> None:
+        self.instance = instance
+        self.cluster = cluster
+        self._lib = _load()
+        self._self = {
+            "instance": instance,
+            "cluster": cluster,  # scopes membership: two LANs, two clusters
+            "http_port": str(http_port),
+            "grpc_port": str(grpc_port),
+            "is_manager": "1" if is_manager else "0",
+            "slice_id": str(slice_id),
+        }
+        rc = self._lib.dnet_disc_start(
+            json.dumps(self._self, separators=(",", ":")).encode(),
+            target_addr.encode(),
+            udp_port,
+            interval_ms,
+            ctypes.c_double(ttl_s),
+        )
+        if rc == 1:
+            raise RuntimeError("discovery already running in this process")
+        if rc != 0:
+            raise RuntimeError(
+                f"discovery could not bind UDP port {udp_port} "
+                "(already in use without SO_REUSEPORT?)"
+            )
+
+    def peers(self) -> List[DeviceInfo]:
+        # size + fill must agree even if the table grows in between: retry
+        # with the newly reported size until it fits
+        needed = self._lib.dnet_disc_peers(None, 0)
+        for _ in range(5):
+            buf = ctypes.create_string_buffer(needed)
+            got = self._lib.dnet_disc_peers(buf, needed)
+            if got <= needed:
+                break
+            needed = got
+        try:
+            raw = json.loads(buf.value.decode() or "[]")
+        except json.JSONDecodeError:
+            log.warning("malformed peer table from native discovery")
+            return []
+        out = []
+        for p in raw:
+            if p.get("cluster", "default") != self.cluster:
+                continue  # different deployment sharing the LAN/port
+            try:
+                out.append(
+                    DeviceInfo(
+                        instance=p["instance"],
+                        host=p.get("addr", ""),
+                        http_port=int(p["http_port"]),
+                        grpc_port=int(p["grpc_port"]),
+                        is_manager=p.get("is_manager") == "1",
+                        slice_id=int(p.get("slice_id", 0)),
+                    )
+                )
+            except (KeyError, ValueError):
+                continue
+        return out
+
+    def get(self, instance: str) -> Optional[DeviceInfo]:
+        for d in self.peers():
+            if d.instance == instance:
+                return d
+        return None
+
+    def stop(self) -> None:
+        self._lib.dnet_disc_stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,99 @@
+"""Zero-config ring: shard + API find each other over native UDP discovery."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+from tests.integration.test_two_shard_e2e import REPO, free_port, wait_health
+from tests.test_p2p_discovery import free_udp_port
+
+pytestmark = pytest.mark.integration
+
+
+def test_udp_discovered_ring(tiny_llama_dir, tmp_path):
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+        "DNET_API_PARAM_DTYPE": "float32",
+        "DNET_LOG_TO_FILE": "0",
+    }
+    udp = free_udp_port()
+    s_http, s_grpc = free_port(), free_port()
+    a_http, a_grpc = free_port(), free_port()
+    procs = []
+
+    def spawn(name, *argv):
+        lf = open(tmp_path / f"{name}.log", "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m", *argv], env=env,
+            stdout=lf, stderr=subprocess.STDOUT, cwd=str(tmp_path),
+        )
+        procs.append((name, p))
+        return p
+
+    spawn(
+        "shard", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+        "--http-port", str(s_http), "--grpc-port", str(s_grpc),
+        "--shard-name", "solo", "--discovery", "udp", "--udp-port", str(udp), "--udp-target", "127.255.255.255",
+    )
+    spawn(
+        "api", "dnet_tpu.cli.api", "--host", "127.0.0.1",
+        "--http-port", str(a_http), "--grpc-port", str(a_grpc),
+        "--discovery", "udp", "--udp-port", str(udp), "--udp-target", "127.255.255.255",
+    )
+    try:
+        wait_health(f"http://127.0.0.1:{s_http}/health")
+        wait_health(f"http://127.0.0.1:{a_http}/health")
+        base = f"http://127.0.0.1:{a_http}"
+
+        # the API must discover the shard over UDP broadcast
+        deadline = time.monotonic() + 15
+        devices = []
+        while time.monotonic() < deadline:
+            devices = httpx.get(f"{base}/v1/devices", timeout=5).json()["devices"]
+            if devices:
+                break
+            time.sleep(0.5)
+        assert any(d["instance"] == "solo" for d in devices), devices
+
+        r = httpx.post(
+            f"{base}/v1/prepare_topology_manual",
+            json={
+                "model": str(tiny_llama_dir),
+                "assignments": [{"instance": "solo", "layers": [0, 1, 2, 3]}],
+            },
+            timeout=30.0,
+        )
+        assert r.status_code == 200, r.text
+        r = httpx.post(f"{base}/v1/load_model", json={"model": str(tiny_llama_dir)}, timeout=300.0)
+        assert r.status_code == 200, r.text
+        r = httpx.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 4,
+                "temperature": 0,
+            },
+            timeout=120.0,
+        )
+        assert r.status_code == 200, r.text
+        assert r.json()["usage"]["completion_tokens"] >= 1
+    finally:
+        for name, p in procs:
+            p.send_signal(signal.SIGTERM)
+        for name, p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for name, _ in procs:
+            print(f"==== {name} ====")
+            print((tmp_path / f"{name}.log").read_text()[-1500:])

@@ -1,0 +1,87 @@
+"""Column sparsification + sparse wire format tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnet_tpu.compression import (
+    column_l2_norms,
+    column_sparsify,
+    compress_tensor,
+    decompress_tensor,
+    is_compressed_dtype,
+)
+
+pytestmark = pytest.mark.codec
+
+
+def test_column_norms():
+    x = jnp.asarray([[3.0, 0.0, 1.0], [4.0, 0.0, 1.0]])
+    norms = np.asarray(column_l2_norms(x))
+    np.testing.assert_allclose(norms, [25.0, 0.0, 2.0])
+
+
+def test_sparsify_drops_smallest():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 8)).astype(np.float32)
+    x[:, 2] *= 0.001  # make column 2 tiny
+    x[:, 5] *= 0.001
+    sp, mask = column_sparsify(jnp.asarray(x), drop_frac=0.25)
+    mask = np.asarray(mask)
+    assert mask.sum() == 6
+    assert not mask[2] and not mask[5]
+    np.testing.assert_array_equal(np.asarray(sp)[:, ~mask], 0.0)
+    np.testing.assert_array_equal(np.asarray(sp)[:, mask], x[:, mask])
+
+
+def test_wire_roundtrip():
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (1, 4, 64)).astype(np.float32)
+    x[..., 10:30] *= 1e-4  # compressible columns
+    payload, dtype, shape = compress_tensor(jnp.asarray(x), drop_frac=0.25)
+    assert is_compressed_dtype(dtype)
+    assert shape == (1, 4, 64)
+    assert len(payload) < x.astype(np.float16).nbytes  # actually smaller
+    y = decompress_tensor(payload, dtype, shape)
+    assert y.shape == x.shape
+    kept = np.abs(y) > 0
+    # kept columns match fp16-rounded originals
+    np.testing.assert_allclose(
+        y[kept], x.astype(np.float16)[kept], atol=1e-3, rtol=1e-2
+    )
+    # 25% of columns dropped
+    dropped_cols = (~kept.any(axis=(0, 1))).sum()
+    assert dropped_cols == 16
+
+
+def test_decompress_rejects_plain_dtype():
+    with pytest.raises(ValueError, match="not a compressed"):
+        decompress_tensor(b"", "float16", (1, 1))
+
+
+def test_shard_hop_with_compression(tiny_llama_dir, monkeypatch):
+    """Two-shard chain with compression on: generation still coherent."""
+    monkeypatch.setenv("DNET_TRANSPORT_COMPRESS", "1")
+    monkeypatch.setenv("DNET_TRANSPORT_COMPRESS_PCT", "0.2")
+    from dnet_tpu.config import reset_settings_cache
+
+    reset_settings_cache()
+    try:
+        from dnet_tpu.core.types import ActivationMessage, DecodingParams
+        from dnet_tpu.shard.compute import ShardCompute
+
+        lo = ShardCompute(tiny_llama_dir, [0, 1], max_seq=32, param_dtype="float32")
+        hi = ShardCompute(tiny_llama_dir, [2, 3], max_seq=32, param_dtype="float32")
+        assert lo.compress_frac == 0.2
+
+        ids = np.asarray([[256, 72, 105]], dtype=np.int32)
+        msg = ActivationMessage(
+            nonce="c", layer_id=-1, seq=0, dtype="tokens", shape=ids.shape,
+            data=ids.tobytes(), pos=0, decoding=DecodingParams(temperature=0.0),
+        )
+        mid = lo.process(msg)
+        assert is_compressed_dtype(mid.dtype)
+        out = hi.process(mid)
+        assert out.is_final and out.token_id is not None and out.token_id >= 0
+    finally:
+        reset_settings_cache()

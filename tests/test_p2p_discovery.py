@@ -1,0 +1,98 @@
+"""Native UDP discovery: build + two-process peer exchange on loopback."""
+
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.integration
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def free_udp_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_build():
+    from dnet_tpu.utils.p2p import ensure_built
+
+    lib = ensure_built()
+    assert lib.is_file()
+
+
+def test_two_process_peer_exchange():
+    from dnet_tpu.utils.p2p import UdpDiscovery
+
+    port = free_udp_port()
+    peer_script = f"""
+import sys, time
+sys.path.insert(0, {str(REPO)!r})
+from dnet_tpu.utils.p2p import UdpDiscovery
+d = UdpDiscovery("peer-b", 8181, 58181, slice_id=3,
+                 udp_port={port}, target_addr="127.255.255.255", interval_ms=100)
+time.sleep(6)
+d.stop()
+"""
+    proc = subprocess.Popen([sys.executable, "-c", peer_script])
+    try:
+        with UdpDiscovery(
+            "peer-a", 8080, 58080,
+            udp_port=port, target_addr="127.255.255.255", interval_ms=100,
+        ) as disc:
+            deadline = time.monotonic() + 10
+            found = None
+            while time.monotonic() < deadline:
+                found = disc.get("peer-b")
+                if found:
+                    break
+                time.sleep(0.2)
+            assert found is not None, "peer-b never discovered"
+            assert found.http_port == 8181
+            assert found.grpc_port == 58181
+            assert found.slice_id == 3
+            assert found.host.startswith("127.")
+            # self must not appear in own peer table
+            assert disc.get("peer-a") is None
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_ttl_eviction():
+    from dnet_tpu.utils.p2p import UdpDiscovery
+
+    port = free_udp_port()
+    peer_script = f"""
+import sys, time
+sys.path.insert(0, {str(REPO)!r})
+from dnet_tpu.utils.p2p import UdpDiscovery
+d = UdpDiscovery("ghost", 1, 2, udp_port={port}, target_addr="127.255.255.255", interval_ms=100)
+time.sleep(1.5)
+d.stop()
+"""
+    proc = subprocess.Popen([sys.executable, "-c", peer_script])
+    try:
+        with UdpDiscovery(
+            "watcher", 3, 4, udp_port=port, target_addr="127.255.255.255",
+            interval_ms=100, ttl_s=1.0,
+        ) as disc:
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline and disc.get("ghost") is None:
+                time.sleep(0.1)
+            assert disc.get("ghost") is not None
+            proc.wait(timeout=10)
+            # after the ghost stops announcing, TTL must evict it
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline and disc.get("ghost") is not None:
+                time.sleep(0.2)
+            assert disc.get("ghost") is None, "stale peer not evicted"
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait(timeout=5)
