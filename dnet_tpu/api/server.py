@@ -111,6 +111,32 @@ async def serve_async(args) -> None:
             # failed preload must not kill the server
             log.exception("preload of %s failed; continuing without a model", preload)
 
+    tui = None
+    tui_task = None
+    if getattr(args, "tui", False):
+        from dnet_tpu.tui import DnetTUI
+
+        tui = DnetTUI(role="api")
+        tui.start_background()
+
+        async def _feed_tui() -> None:
+            while True:
+                topo = getattr(cluster_manager, "current_topology", None)
+                tui.update_status(
+                    state="ready" if inference.ready else "no model",
+                    mode="ring" if cluster_manager else ("mesh" if mesh else "local"),
+                    shards=len(topo.assignments) if topo else 0,
+                )
+                if topo is not None:
+                    layers = [l for a in topo.assignments for l in a.layers]
+                else:
+                    engine = getattr(model_manager, "engine", None)
+                    layers = list(engine.model.layers) if engine is not None else []
+                tui.update_model_info(inference.model_id, sorted(layers))
+                await asyncio.sleep(1.0)
+
+        tui_task = asyncio.ensure_future(_feed_tui())
+
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -121,6 +147,10 @@ async def serve_async(args) -> None:
     log.info("dnet-api ready")
     await stop.wait()
     log.info("shutting down")
+    if tui_task is not None:
+        tui_task.cancel()
+    if tui is not None:
+        tui.stop()
     if ring_discovery is not None:
         ring_discovery.stop()
     await http.stop()

@@ -36,6 +36,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="announce target (loopback broadcast for single-host)")
     p.add_argument("--cluster", default="default",
                    help="cluster token scoping UDP discovery membership")
+    p.add_argument("--tui", action="store_true", help="live Rich terminal dashboard")
     return p
 
 

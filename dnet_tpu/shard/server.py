@@ -107,6 +107,34 @@ async def serve_async(args) -> None:
 
     sweeper = asyncio.ensure_future(runtime.sweeper())
 
+    tui = None
+    tui_task = None
+    if getattr(args, "tui", False):
+        from dnet_tpu.tui import DnetTUI
+
+        tui = DnetTUI(role="shard", title=shard_id)
+        tui.start_background()
+
+        async def _feed_tui() -> None:
+            while True:
+                compute = runtime.compute
+                tui.update_status(
+                    state="serving" if compute else "idle",
+                    queue=runtime.queue_depth,
+                )
+                if compute is not None:
+                    resident = (
+                        compute.engine.weight_cache.resident_layers()
+                        if compute.engine.weight_cache is not None
+                        else list(compute.layers)
+                    )
+                    tui.update_model_info(runtime.model_path, list(compute.layers), resident)
+                else:
+                    tui.update_model_info(None, [])
+                await asyncio.sleep(1.0)
+
+        tui_task = asyncio.ensure_future(_feed_tui())
+
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -118,6 +146,10 @@ async def serve_async(args) -> None:
     await stop.wait()
 
     log.info("shard shutting down")
+    if tui_task is not None:
+        tui_task.cancel()
+    if tui is not None:
+        tui.stop()
     if discovery is not None:
         discovery.stop()
     sweeper.cancel()

@@ -1,0 +1,167 @@
+"""In-process Rich TUI: live log stream + model/residency + system status.
+
+Reference: src/dnet/tui.py:21-236 — a 4-pane Live terminal layout fed by a
+logging handler (banner / logs / model-info layer boxes / status+RAM).
+Attach with `dnet-shard --tui` or `dnet-api --tui`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional
+
+import psutil
+from rich.console import Console, Group
+from rich.layout import Layout
+from rich.live import Live
+from rich.panel import Panel
+from rich.table import Table
+from rich.text import Text
+
+BANNER = r"""
+     _            _        _
+  __| |_ __   ___| |_     | |_ _ __  _   _
+ / _` | '_ \ / _ \ __|____| __| '_ \| | | |
+| (_| | | | |  __/ ||_____| |_| |_) | |_| |
+ \__,_|_| |_|\___|\__|     \__| .__/ \__,_|
+                              |_|
+"""
+
+
+class TuiLogHandler(logging.Handler):
+    """Appends formatted records into the TUI's bounded deque."""
+
+    def __init__(self, sink: Deque[str]) -> None:
+        super().__init__()
+        self.sink = sink
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.sink.append(self.format(record))
+        except Exception:
+            pass
+
+
+class DnetTUI:
+    """Live terminal dashboard for either role."""
+
+    def __init__(self, role: str, title: str = "dnet-tpu") -> None:
+        self.role = role
+        self.title = title
+        self.logs: Deque[str] = deque(maxlen=200)
+        self.status: dict = {"state": "starting"}
+        self.model_id: Optional[str] = None
+        self.layers: List[int] = []
+        self.resident: List[int] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # feed thread vs render thread
+
+        self._handler = TuiLogHandler(self.logs)
+        self._handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)-7s %(message)s", datefmt="%H:%M:%S")
+        )
+        logging.getLogger("dnet_tpu").addHandler(self._handler)
+
+    # ---- feed ----------------------------------------------------------
+    def update_status(self, **kw) -> None:
+        with self._lock:
+            self.status.update(kw)
+
+    def update_model_info(
+        self, model_id: Optional[str], layers: List[int], resident: Optional[List[int]] = None
+    ) -> None:
+        with self._lock:
+            self.model_id = model_id
+            self.layers = list(layers)
+            self.resident = list(resident) if resident is not None else list(layers)
+
+    # ---- render --------------------------------------------------------
+    def _layer_boxes(self) -> Text:
+        if not self.layers:
+            return Text("no model loaded", style="dim")
+        t = Text()
+        resident = set(self.resident)
+        for layer in self.layers:
+            style = "bold green" if layer in resident else "yellow"
+            t.append(f"[{layer:>3}]", style=style)
+            t.append(" ")
+        t.append("\n")
+        t.append("green = HBM-resident, yellow = host-streamed", style="dim")
+        return t
+
+    def _render(self) -> Layout:
+        layout = Layout()
+        layout.split_column(
+            Layout(name="top", size=8),
+            Layout(name="logs"),
+            Layout(name="bottom", size=6),
+        )
+        layout["top"].update(
+            Panel(Text(BANNER, style="cyan"), title=f"{self.title} [{self.role}]")
+        )
+        log_text = Text("\n".join(list(self.logs)[-30:]))
+        layout["logs"].update(Panel(log_text, title="logs"))
+
+        vm = psutil.virtual_memory()
+        table = Table.grid(expand=True)
+        table.add_column(ratio=1)
+        table.add_column(ratio=1)
+        with self._lock:
+            status = ", ".join(f"{k}={v}" for k, v in self.status.items())
+        table.add_row(
+            Group(
+                Text(f"model: {self.model_id or '-'}"),
+                self._layer_boxes(),
+            ),
+            Group(
+                Text(f"status: {status}"),
+                Text(
+                    f"RAM {vm.used / 2**30:.1f}/{vm.total / 2**30:.1f} GiB "
+                    f"({vm.percent:.0f}%)"
+                ),
+            ),
+        )
+        layout["bottom"].update(Panel(table, title="state"))
+        return layout
+
+    # ---- lifecycle -----------------------------------------------------
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """Blocking render loop (call in a thread).
+
+        While live, the logger's console StreamHandlers are detached — raw
+        stderr writes would corrupt the alternate screen; the log pane IS
+        the console for the session.
+        """
+        stop = stop_event or self._stop
+        console = Console()
+        logger = logging.getLogger("dnet_tpu")
+        detached = [
+            h
+            for h in logger.handlers
+            if isinstance(h, logging.StreamHandler)
+            and not isinstance(h, (logging.FileHandler, TuiLogHandler))
+        ]
+        for h in detached:
+            logger.removeHandler(h)
+        try:
+            with Live(self._render(), console=console, refresh_per_second=4, screen=True) as live:
+                while not stop.is_set():
+                    live.update(self._render())
+                    time.sleep(0.25)
+        finally:
+            for h in detached:
+                logger.addHandler(h)
+
+    def start_background(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True, name="tui")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        logging.getLogger("dnet_tpu").removeHandler(self._handler)

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Fetch a model into the local models dir (reference: scripts/download_model.py).
+
+Zero-egress deployments skip this entirely: point DNET_API_MODELS_DIR /
+DNET_SHARD_MODELS_DIR at a directory that already holds HF-format model
+folders (config.json + *.safetensors [+ tokenizer files]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("repo_id", help="HF repo id, e.g. meta-llama/Llama-3.2-1B-Instruct")
+    p.add_argument("--models-dir", default="~/.dnet-tpu/models")
+    args = p.parse_args()
+
+    dest = Path(args.models_dir).expanduser() / args.repo_id.replace("/", "--")
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError:
+        print(
+            "huggingface_hub not installed (zero-egress image?). Place the "
+            f"model manually at {dest}",
+            file=sys.stderr,
+        )
+        return 1
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    path = snapshot_download(
+        args.repo_id,
+        local_dir=dest,
+        allow_patterns=["*.safetensors*", "*.json", "tokenizer*", "*.model"],
+    )
+    print(f"downloaded to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

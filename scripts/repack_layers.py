@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Pre-repack a model's layers for fast weight streaming.
+
+Reference: scripts/repack_windows.py — warms the per-layer repack cache
+(mapped, transposed, dtype-cast arrays) so offload-mode shard startup skips
+the mapping work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("model_dir")
+    p.add_argument("--layers", default="", help="comma list / a:b range; default all")
+    p.add_argument("--param-dtype", default="bfloat16")
+    p.add_argument("--repack-dir", default="~/.dnet-tpu/repacked")
+    args = p.parse_args()
+
+    from dnet_tpu.core.weights import HostLayerStore
+    from dnet_tpu.models import ModelConfig, get_ring_model_cls
+    from dnet_tpu.utils.checkpoint import Checkpoint
+
+    ckpt = Checkpoint(args.model_dir)
+    cfg = ModelConfig.from_hf(ckpt.config)
+    if args.layers:
+        if ":" in args.layers:
+            a, b = args.layers.split(":")
+            layers = list(range(int(a), int(b)))
+        else:
+            layers = [int(x) for x in args.layers.split(",")]
+    else:
+        layers = list(range(cfg.num_hidden_layers))
+
+    model = get_ring_model_cls(cfg.model_type)(cfg, layers)
+    store = HostLayerStore(
+        ckpt, model, param_dtype=args.param_dtype, repack_dir=args.repack_dir
+    )
+    t0 = time.perf_counter()
+    for i, layer in enumerate(layers):
+        store.layer_host(layer)
+        store.drop_host(layer)
+        print(f"\r[{i + 1}/{len(layers)}] layer {layer}", end="", flush=True)
+    print(f"\nrepacked {len(layers)} layers into {store.repack_path} "
+          f"in {time.perf_counter() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
