@@ -2,21 +2,25 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Config: Llama-3.2-1B-class (first BASELINE.md config), bf16, synthetic
-weights (zero-egress: no checkpoint downloads), batch 1, greedy decode.
-vs_baseline is the fraction of the single-chip HBM-bandwidth roofline
-(weights_bytes / HBM_BW bounds decode tok/s for batch 1): an honest
-hardware-relative score while the reference publishes no numbers
-(BASELINE.md "none published").
+Config: Llama-3.2-1B-class (first BASELINE.md config), int8 weight-only
+quantized (the serving configuration — enable with --weight-quant-bits 8 /
+DNET_API_WEIGHT_QUANT_BITS=8; pass --bf16 here for unquantized),
+synthetic weights (zero-egress: no checkpoint downloads), batch 1, greedy
+decode fused with lax.scan.  vs_baseline is the fraction of the single-chip
+HBM-bandwidth roofline (weights_bytes / HBM_BW bounds decode tok/s for
+batch 1): an honest hardware-relative score while the reference publishes
+no numbers (BASELINE.md "none published").
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 
 def main() -> None:
+    import dnet_tpu  # noqa: F401 - package import re-asserts JAX_PLATFORMS
     import jax
     import jax.numpy as jnp
 
@@ -25,10 +29,28 @@ def main() -> None:
     from dnet_tpu.models.llama import LlamaRingModel
     from dnet_tpu.utils.random_init import LLAMA_3_2_1B_CONFIG, random_llama_params
 
-    cfg = ModelConfig.from_hf({**LLAMA_3_2_1B_CONFIG, "architectures": []})
+    quantize = "--bf16" not in sys.argv
+    cfg_dict = dict(LLAMA_3_2_1B_CONFIG)
+    if "--smoke" in sys.argv:  # tiny shapes: code-path validation on CPU
+        cfg_dict.update(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=4, head_dim=16,
+        )
+    cfg = ModelConfig.from_hf({**cfg_dict, "architectures": []})
     layers = list(range(cfg.num_hidden_layers))
     model = LlamaRingModel(cfg, layers)
     window, edge = random_llama_params(cfg, layers, dtype="bfloat16")
+    if quantize:
+        import numpy as _np
+
+        from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
+
+        window = quantize_tree(
+            {k: _np.asarray(v) for k, v in window.items()}, QUANTIZABLE
+        )
+        # device-resident: leaving numpy here would re-upload every step
+        window = jax.tree.map(jnp.asarray, window)
     max_seq = 1024
     kv = init_cache(model.kv_config(len(layers), 1, max_seq, "bfloat16"))
 
@@ -73,6 +95,7 @@ def main() -> None:
         int(a.size) * a.dtype.itemsize
         for a in jax.tree.leaves((window, edge))
     )
+    metric = "decode_tok_s_llama1b_%s_1chip" % ("int8" if quantize else "bf16")
     dev = jax.devices()[0]
     hbm_bw = {"v5e": 819e9, "v5litepod": 819e9, "v6e": 1640e9, "v4": 1228e9}.get(
         _chip_gen(dev), 819e9
@@ -81,7 +104,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "decode_tok_s_llama1b_bf16_1chip",
+                "metric": metric,
                 "value": round(tok_s, 2),
                 "unit": "tok/s",
                 "vs_baseline": round(tok_s / roofline, 4),

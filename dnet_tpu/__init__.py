@@ -10,3 +10,17 @@ between host DRAM and TPU HBM so models larger than total HBM can run.
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Re-assert an explicit JAX_PLATFORMS through jax.config: environments
+    # whose sitecustomize registers a TPU plugin before env vars are
+    # consulted would otherwise hang every CPU-only run (server, tests,
+    # smoke benches) on an unreachable TPU backend.
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover - jax absent or already initialized
+        pass

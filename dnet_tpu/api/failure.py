@@ -1,0 +1,237 @@
+"""Failure detection + recovery for the ring.
+
+The reference detects but never recovers (SURVEY.md §5: "If a shard dies
+mid-request the token future times out — no re-solve, no re-route" — an
+explicit gap).  This monitor closes it:
+
+- periodic gRPC HealthCheck against every shard in the active topology;
+- on `fail_threshold` consecutive failures a shard is marked DOWN:
+  in-flight requests FAIL FAST (their token futures resolve with an error
+  instead of burning the 300 s timeout) and new requests are rejected with
+  a clear 503;
+- with auto_recover=True the monitor re-solves the topology over the
+  remaining healthy shards (when the model still fits) and reloads the
+  ring — elastic recovery the reference never had.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from dnet_tpu.core.types import DeviceInfo
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+@dataclass
+class ShardHealth:
+    instance: str
+    consecutive_failures: int = 0
+    last_ok: float = field(default_factory=time.monotonic)
+    down: bool = False
+
+
+class RingFailureMonitor:
+    def __init__(
+        self,
+        cluster_manager,
+        inference_manager,
+        model_manager=None,
+        interval_s: float = 5.0,
+        fail_threshold: int = 3,
+        timeout_s: float = 3.0,
+        auto_recover: bool = False,
+        ring_client_factory: Optional[Callable[[str], object]] = None,
+    ) -> None:
+        from dnet_tpu.transport.grpc_transport import RingClient
+
+        self.cluster = cluster_manager
+        self.inference = inference_manager
+        self.model_manager = model_manager
+        self.interval_s = interval_s
+        self.fail_threshold = fail_threshold
+        self.timeout_s = timeout_s
+        self.auto_recover = auto_recover
+        self._make_client = ring_client_factory or (lambda addr: RingClient(addr))
+        self.health: Dict[str, ShardHealth] = {}
+        self._clients: Dict[str, object] = {}  # addr -> RingClient (persistent)
+        self._task: Optional[asyncio.Task] = None
+        self._recovering = False
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._loop())
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        clients, self._clients = self._clients, {}
+        for c in clients.values():
+            try:
+                asyncio.ensure_future(c.close())
+            except RuntimeError:
+                pass  # loop already gone
+
+    # ---- state ----------------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        return any(h.down for h in self.health.values())
+
+    def down_shards(self) -> List[str]:
+        return [h.instance for h in self.health.values() if h.down]
+
+    def snapshot(self) -> dict:
+        return {
+            h.instance: {
+                "down": h.down,
+                "consecutive_failures": h.consecutive_failures,
+                "seconds_since_ok": round(time.monotonic() - h.last_ok, 1),
+            }
+            for h in self.health.values()
+        }
+
+    # ---- monitoring ------------------------------------------------------
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self._tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("failure monitor tick crashed")
+            await asyncio.sleep(self.interval_s)
+
+    async def _tick(self) -> None:
+        topo = self.cluster.current_topology
+        if topo is None:
+            self.health.clear()
+            await self._prune_clients(keep=set())
+            return
+        by_instance = {d.instance: d for d in topo.devices}
+        # drop state (and cached channels) for shards no longer in the topology
+        for gone in set(self.health) - set(by_instance):
+            del self.health[gone]
+        keep = {f"{d.host}:{d.grpc_port}" for d in by_instance.values()}
+        await self._prune_clients(keep=keep)
+
+        async def check(dev: DeviceInfo) -> None:
+            h = self.health.setdefault(dev.instance, ShardHealth(dev.instance))
+            addr = f"{dev.host}:{dev.grpc_port}"
+            client = self._clients.get(addr)
+            if client is None:
+                client = self._clients[addr] = self._make_client(addr)
+            try:
+                await client.health_check(timeout=self.timeout_s)
+                h.consecutive_failures = 0
+                h.last_ok = time.monotonic()
+                if h.down:
+                    log.info("shard %s is back", dev.instance)
+                    h.down = False
+            except Exception as exc:
+                h.consecutive_failures += 1
+                log.warning(
+                    "health check %s failed (%d/%d): %s",
+                    dev.instance, h.consecutive_failures, self.fail_threshold, exc,
+                )
+                if not h.down and h.consecutive_failures >= self.fail_threshold:
+                    h.down = True
+                    await self._on_shard_down(dev.instance)
+
+        await asyncio.gather(*(check(by_instance[i]) for i in by_instance))
+
+    async def _prune_clients(self, keep: set) -> None:
+        for addr in set(self._clients) - keep:
+            client = self._clients.pop(addr)
+            try:
+                await client.close()
+            except Exception:
+                pass
+
+    # ---- failure handling -------------------------------------------------
+    async def _on_shard_down(self, instance: str) -> None:
+        log.error("shard %s marked DOWN", instance)
+        # fail in-flight requests fast instead of letting them burn the
+        # full await_token timeout (the reference's 300s, inference.py)
+        adapter = self.inference.adapter
+        if adapter is not None:  # topology may exist before any model load
+            adapter.fail_pending(f"shard {instance} is unreachable")
+        if self.auto_recover:
+            await self._try_recover()
+
+    async def _try_recover(self) -> None:
+        """Re-solve over the remaining healthy shards and reload the ring."""
+        if self._recovering or self.model_manager is None:
+            return
+        model_id = self.inference.model_id
+        topo = self.cluster.current_topology
+        if model_id is None or topo is None:
+            return
+        self._recovering = True
+        try:
+            # re-profile so the solver sees real capacities (healthy_devices
+            # alone returns unprofiled DeviceInfo whose zeroed hbm_bytes would
+            # disable the feasibility check), and never re-include a shard
+            # this monitor holds DOWN — its HTTP /health may still answer 200
+            # while its gRPC data plane is dead.
+            down = set(self.down_shards())
+            healthy = [
+                d
+                for d in await self.cluster.profile_cluster()
+                if d.instance not in down
+            ]
+            if not healthy:
+                log.error("no healthy shards left; cannot recover")
+                return
+            unprofiled = [d.instance for d in healthy if not d.hbm_bytes]
+            if unprofiled:
+                log.warning(
+                    "recovering with unprofiled shard(s) %s: memory-feasibility "
+                    "check degraded", unprofiled,
+                )
+            from dnet_tpu.api.model_manager import resolve_model_dir
+            from dnet_tpu.parallel.solver import (
+                model_profile_from_checkpoint,
+                solve_topology,
+            )
+
+            model_dir = resolve_model_dir(model_id, self.model_manager.models_dir)
+            if model_dir is None:
+                return
+            # size KV the way the serving path does (seq_len + kv_bits feed
+            # the solver's memory model; a bare default would mis-size KV)
+            profile = model_profile_from_checkpoint(
+                model_dir,
+                seq_len=getattr(self.model_manager, "max_seq", 4096),
+                kv_bits=topo.kv_bits,
+                weight_quant_bits=getattr(
+                    self.model_manager, "weight_quant_bits", 0
+                ),
+            )
+            try:
+                new_topo = solve_topology(healthy, profile, kv_bits=topo.kv_bits)
+            except ValueError as exc:
+                log.error("re-solve failed (%s); staying degraded", exc)
+                return
+            new_topo.model = model_id
+            # install the new topology only for the duration of the reload:
+            # if the reload fails the old (degraded) topology must come back,
+            # or the dead shard would drop out of monitoring and the API
+            # would accept requests against a ring that never loaded
+            self.cluster.current_topology = new_topo
+            try:
+                await self.model_manager.load_model(model_id)
+            except Exception:
+                self.cluster.current_topology = topo
+                raise
+            log.info(
+                "recovered: ring re-solved over %d shard(s)", len(new_topo.assignments)
+            )
+        except Exception:
+            log.exception("auto-recovery failed")
+        finally:
+            self._recovering = False

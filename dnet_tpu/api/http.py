@@ -25,6 +25,7 @@ from dnet_tpu.api.inference import (
     InferenceError,
     InferenceManager,
     PromptTooLongError,
+    ServiceDegradedError,
 )
 from dnet_tpu.api.schemas import (
     ChatCompletionRequest,
@@ -92,6 +93,14 @@ class ApiHTTPServer:
         if not self.inference.ready:
             return _json_error(400, "no model loaded; POST /v1/load_model first")
 
+        monitor = self.inference.failure_monitor
+        if monitor is not None and monitor.degraded:
+            return _json_error(
+                503,
+                f"ring degraded: shard(s) {monitor.down_shards()} down",
+                "service_unavailable",
+            )
+
         if req.stream:
             resp = web.StreamResponse(
                 status=200,
@@ -124,6 +133,8 @@ class ApiHTTPServer:
             result = await self.inference.generate(req)
         except PromptTooLongError as exc:
             return _json_error(400, str(exc))
+        except ServiceDegradedError as exc:
+            return _json_error(503, str(exc), "service_unavailable")
         except InferenceError as exc:
             return _json_error(500, str(exc), "server_error")
         return web.json_response(result.model_dump(exclude_none=True))
@@ -185,7 +196,12 @@ class ApiHTTPServer:
             return _json_error(503, "no healthy shards discovered", "no_devices")
         try:
             profile = model_profile_from_checkpoint(
-                model_dir, seq_len=req.seq_len, kv_bits=req.kv_bits
+                model_dir,
+                seq_len=req.seq_len,
+                kv_bits=req.kv_bits,
+                weight_quant_bits=getattr(
+                    self.model_manager, "weight_quant_bits", 0
+                ),
             )
             from dnet_tpu.config import get_settings
 
@@ -321,6 +337,10 @@ class ApiHTTPServer:
         )
 
     async def health(self, request: web.Request) -> web.Response:
-        return web.json_response(
-            HealthResponse(model=self.model_manager.current_model_id).model_dump()
-        )
+        body = HealthResponse(model=self.model_manager.current_model_id).model_dump()
+        monitor = self.inference.failure_monitor
+        if monitor is not None and monitor.health:
+            body["shards"] = monitor.snapshot()
+            if monitor.degraded:
+                body["status"] = "degraded"
+        return web.json_response(body)

@@ -42,6 +42,11 @@ class PromptTooLongError(InferenceError):
     """Maps to HTTP 400 (client error) rather than 500."""
 
 
+class ServiceDegradedError(InferenceError):
+    """Ring has DOWN shards: maps to HTTP 503 immediately (fast-fail
+    instead of the reference's 300s token-future timeout)."""
+
+
 def _holdback_len(text: str, stop_seqs: list[str]) -> int:
     """Length of the longest suffix of `text` that is a proper prefix of any
     stop sequence (must be held back — the next token may complete a stop)."""
@@ -66,6 +71,7 @@ class InferenceManager:
         self.model_id: Optional[str] = None
         self.request_timeout_s = request_timeout_s
         self._semaphore = asyncio.Semaphore(max_concurrent)
+        self.failure_monitor = None  # RingFailureMonitor in ring mode
 
     @property
     def ready(self) -> bool:
@@ -110,6 +116,10 @@ class InferenceManager:
                 yield chunk
 
     async def _run(self, req: ChatCompletionRequest) -> AsyncIterator[ChatCompletionChunk]:
+        if self.failure_monitor is not None and self.failure_monitor.degraded:
+            raise ServiceDegradedError(
+                f"ring degraded: shard(s) {self.failure_monitor.down_shards()} down"
+            )
         rid = new_request_id()
         nonce = rid
         tok = self.tokenizer
@@ -143,6 +153,14 @@ class InferenceManager:
         try:
             send_ids = list(prompt_ids)
             for step in range(max_new):
+                # re-check per step: the monitor's one-shot fail_pending only
+                # covers futures pending at the DOWN transition; a request at
+                # a step boundary would otherwise hang the full timeout
+                if self.failure_monitor is not None and self.failure_monitor.degraded:
+                    raise ServiceDegradedError(
+                        f"ring degraded: shard(s) "
+                        f"{self.failure_monitor.down_shards()} down"
+                    )
                 await self.adapter.send_tokens(nonce, send_ids, decoding, step)
                 result = await self.adapter.await_token(
                     nonce, step, self.request_timeout_s

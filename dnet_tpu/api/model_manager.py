@@ -48,11 +48,13 @@ class LocalModelManager:
         max_seq: int = 4096,
         param_dtype: str = "bfloat16",
         mesh: Optional[dict] = None,  # {"pp","tp","dp","sp"} -> MeshEngine
+        weight_quant_bits: int = 0,
     ) -> None:
         self.inference = inference_manager
         self.models_dir = models_dir
         self.max_seq = max_seq
         self.param_dtype = param_dtype
+        self.weight_quant_bits = weight_quant_bits
         # active when any axis is parallel or pp is left to infer (pp=0 with
         # another axis set, or an explicit pp)
         self.mesh = mesh if mesh and (any(v > 1 for v in mesh.values()) or mesh.get("pp", 0) > 1) else None
@@ -78,6 +80,10 @@ class LocalModelManager:
 
         def _build():
             if self.mesh is not None:
+                if self.weight_quant_bits:
+                    raise NotImplementedError(
+                        "weight quantization + mesh engine lands next round"
+                    )
                 from dnet_tpu.parallel.engine import MeshEngine
 
                 engine = MeshEngine(
@@ -96,6 +102,7 @@ class LocalModelManager:
                     model_dir,
                     max_seq=max_seq or self.max_seq,
                     param_dtype=self.param_dtype,
+                    weight_quant_bits=self.weight_quant_bits,
                 )
             return engine, load_tokenizer(model_dir)
 

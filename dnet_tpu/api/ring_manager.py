@@ -82,6 +82,7 @@ class RingModelManager:
         max_seq: int = 4096,
         param_dtype: str = "bfloat16",
         request_timeout_s: float = 600.0,
+        weight_quant_bits: int = 0,
     ) -> None:
         self.inference = inference
         self.cluster = cluster_manager
@@ -90,6 +91,7 @@ class RingModelManager:
         self.max_seq = max_seq
         self.param_dtype = param_dtype
         self.request_timeout_s = request_timeout_s
+        self.weight_quant_bits = weight_quant_bits
 
     @property
     def current_model_id(self) -> Optional[str]:
@@ -127,6 +129,7 @@ class RingModelManager:
                     "max_seq_len": max_seq,
                     "api_callback_address": f"grpc://{self.api_callback_addr}",
                     "param_dtype": self.param_dtype,
+                    "weight_quant_bits": self.weight_quant_bits,
                 }
                 url = f"http://{dev.host}:{dev.http_port}/load_model"
                 r = await client.post(url, json=body)

@@ -19,6 +19,8 @@ log = get_logger()
 
 async def serve_async(args) -> None:
     s = get_settings()
+    wq = getattr(args, "weight_quant_bits", None)
+    weight_quant_bits = s.api.weight_quant_bits if wq is None else wq
     inference = InferenceManager(
         adapter=None,
         request_timeout_s=s.api.request_timeout_s,
@@ -35,6 +37,7 @@ async def serve_async(args) -> None:
         max_seq=s.api.max_seq_len,
         param_dtype=s.api.param_dtype,
         mesh=mesh,
+        weight_quant_bits=weight_quant_bits,
     )
 
     cluster_manager = None
@@ -80,6 +83,7 @@ async def serve_async(args) -> None:
             api_callback_addr=callback_addr,
             max_seq=s.api.max_seq_len,
             param_dtype=s.api.param_dtype,
+            weight_quant_bits=weight_quant_bits,
         )
         # token-callback receiver: shards resolve decode futures through here
         grpc_server = await start_grpc_server(
@@ -98,6 +102,20 @@ async def serve_async(args) -> None:
             len(discovery.peers()),
             "udp discovery" if ring_discovery is not None else "hostfile",
         )
+        # failure detection + optional elastic recovery (the reference only
+        # detects — SURVEY.md §5 flags the missing recovery as a gap)
+        from dnet_tpu.api.failure import RingFailureMonitor
+
+        monitor = RingFailureMonitor(
+            cluster_manager,
+            inference,
+            model_manager=model_manager,
+            interval_s=s.api.health_interval_s,
+            fail_threshold=s.api.health_fail_threshold,
+            auto_recover=getattr(args, "auto_recover", False),
+        )
+        inference.failure_monitor = monitor
+        monitor.start()
 
     http = ApiHTTPServer(inference, model_manager, cluster_manager)
     await http.start(args.host, args.http_port)
@@ -147,6 +165,8 @@ async def serve_async(args) -> None:
     log.info("dnet-api ready")
     await stop.wait()
     log.info("shutting down")
+    if inference.failure_monitor is not None:
+        inference.failure_monitor.stop()
     if tui_task is not None:
         tui_task.cancel()
     if tui is not None:

@@ -51,6 +51,16 @@ class ApiAdapterBase(abc.ABC):
     def resolve_token(self, result: TokenResult) -> None:
         """Called by the transport when a token arrives (default: no-op)."""
 
+    def fail_pending(self, error: str) -> None:
+        """Fail every in-flight token wait with `error` (fast-fail on shard
+        death — the failure monitor calls this instead of letting requests
+        burn the full await_token timeout).  The default covers any adapter
+        built on `_TokenFutures`; adapters with different bookkeeping
+        override."""
+        futures = getattr(self, "_futures", None)
+        if isinstance(futures, _TokenFutures):
+            futures.fail_all(error)
+
     def max_seq(self) -> Optional[int]:
         """Sequence capacity of the serving path, when known."""
         return None
@@ -100,6 +110,14 @@ class _TokenFutures:
             fut = self._futures.pop(key)
             if not fut.done():
                 fut.cancel()
+
+    def fail_all(self, error: str) -> None:
+        """Resolve every pending future with an error TokenResult (the
+        awaiting side still owns the pop)."""
+        for (nonce, step) in list(self._futures):
+            self.resolve(
+                TokenResult(nonce=nonce, token_id=-1, step=step, error=error)
+            )
 
 
 class LocalAdapter(ApiAdapterBase):

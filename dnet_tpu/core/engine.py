@@ -70,6 +70,7 @@ class LocalEngine:
         residency_size: int = 0,
         repack_dir: Optional[str] = None,
         kv_quant_bits: int = 0,
+        weight_quant_bits: int = 0,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -81,6 +82,9 @@ class LocalEngine:
         self.param_dtype = jnp.dtype(param_dtype)
         self.kv_dtype = kv_dtype or param_dtype
         self.kv_quant_bits = kv_quant_bits
+        self.weight_quant_bits = weight_quant_bits
+        if weight_quant_bits not in (0, 8):
+            raise NotImplementedError("weight quantization supports 8 bits (int8)")
         self.kv_ttl_s = kv_ttl_s
         # shard_mode: load only the edge weights this layer range needs
         # (reference: edge tensors loaded iff shard holds layer 0 / the last
@@ -114,6 +118,10 @@ class LocalEngine:
         t0 = time.perf_counter()
         m = self.model
         if self.plan.streams_weights:
+            if self.weight_quant_bits:
+                raise NotImplementedError(
+                    "weight quantization + weight streaming lands next round"
+                )
             # offload / sliding_fit: layers stream host<->HBM via WeightCache
             from dnet_tpu.core.weights import HostLayerStore, WeightCache
 
@@ -133,6 +141,17 @@ class LocalEngine:
         else:
             per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
             stacked = m.stack_layers(per_layer)
+            if self.weight_quant_bits == 8:
+                from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
+
+                if not isinstance(stacked, dict) or "layers" in stacked:
+                    raise NotImplementedError(
+                        "weight quantization not yet supported for "
+                        f"{self.config.model_type} (list-layout params)"
+                    )
+                stacked = quantize_tree(
+                    stacked, QUANTIZABLE, scale_dtype=self.param_dtype
+                )
             self.window_params = self._cast(stacked)
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())
         if self.shard_mode:

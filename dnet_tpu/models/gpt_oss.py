@@ -31,6 +31,7 @@ from dnet_tpu.core.kvcache import read_kv, write_kv
 from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.ops.attention import attend, causal_mask, sliding_window_mask
 from dnet_tpu.ops.norms import rms_norm
+from dnet_tpu.ops.quant import dq, lead_dim, out_dim
 from dnet_tpu.ops.rope import apply_rope, rope_frequencies
 
 ALPHA = 1.702
@@ -64,20 +65,20 @@ class GptOssRingModel(RingModel):
         cfg = self.config
         B, T, D = x.shape
         Hd = cfg.head_dim
-        H = p["wq"].shape[-1] // Hd
-        KVH = p["wk"].shape[-1] // Hd
+        H = out_dim(p["wq"]) // Hd
+        KVH = out_dim(p["wk"]) // Hd
 
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ p["wq"] + p["bq"]).reshape(B, T, H, Hd)
-        k = (h @ p["wk"] + p["bk"]).reshape(B, T, KVH, Hd)
-        v = (h @ p["wv"] + p["bv"]).reshape(B, T, KVH, Hd)
+        q = (h @ dq(p["wq"]) + p["bq"]).reshape(B, T, H, Hd)
+        k = (h @ dq(p["wk"]) + p["bk"]).reshape(B, T, KVH, Hd)
+        v = (h @ dq(p["wv"]) + p["bv"]).reshape(B, T, KVH, Hd)
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
         kvs = write_kv(kvs, k, v, pos, kv_commit)
         kc, vc = read_kv(kvs)
         attn = attend(q, kc, vc, mask=mask, sinks=p["sinks"])
-        out = attn.reshape(B, T, H * Hd) @ p["wo"]
+        out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
             out = lax.psum(out, tp_axis)
         out = out + p["bo"]  # bias replicated: add once, after the psum
@@ -98,13 +99,13 @@ class GptOssRingModel(RingModel):
         ].set(top_probs)
 
         # dense expert compute over the LOCAL expert slice (tp shards experts)
-        E_local = p["gate_up"].shape[0]
-        gate_up = jnp.einsum("nd,edf->nef", flat, p["gate_up"]) + p["gate_up_b"]
+        E_local = lead_dim(p["gate_up"])
+        gate_up = jnp.einsum("nd,edf->nef", flat, dq(p["gate_up"])) + p["gate_up_b"]
         gate = jnp.clip(gate_up[..., ::2], max=LIMIT)
         up = jnp.clip(gate_up[..., 1::2], min=-LIMIT, max=LIMIT)
         glu = gate * jax.nn.sigmoid(gate * ALPHA)
         inner = (up + 1.0) * glu  # [N, E_local, F]
-        expert_out = jnp.einsum("nef,efd->ned", inner, p["down"]) + p["down_b"]
+        expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["down"])) + p["down_b"]
 
         if tp_axis is not None:
             e_off = lax.axis_index(tp_axis) * E_local

@@ -21,6 +21,7 @@ from dnet_tpu.core.kvcache import read_kv, write_kv
 from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.ops.attention import attend, causal_mask
 from dnet_tpu.ops.norms import rms_norm
+from dnet_tpu.ops.quant import dq, out_dim
 from dnet_tpu.ops.rope import apply_rope, rope_frequencies
 
 
@@ -56,13 +57,13 @@ class LlamaRingModel(RingModel):
         cfg = self.config
         B, T, D = x.shape
         Hd = cfg.head_dim
-        H = p["wq"].shape[-1] // Hd  # local heads (== cfg heads / tp)
-        KVH = p["wk"].shape[-1] // Hd
+        H = out_dim(p["wq"]) // Hd  # local heads (== cfg heads / tp)
+        KVH = out_dim(p["wk"]) // Hd
 
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ p["wq"]).reshape(B, T, H, Hd)
-        k = (h @ p["wk"]).reshape(B, T, KVH, Hd)
-        v = (h @ p["wv"]).reshape(B, T, KVH, Hd)
+        q = (h @ dq(p["wq"])).reshape(B, T, H, Hd)
+        k = (h @ dq(p["wk"])).reshape(B, T, KVH, Hd)
+        v = (h @ dq(p["wv"])).reshape(B, T, KVH, Hd)
         q, k = self._qk_transform(p, q, k)  # subclass hook (qwen3 q/k norms)
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
@@ -70,15 +71,15 @@ class LlamaRingModel(RingModel):
         kvs = write_kv(kvs, k, v, pos, kv_commit)
         kc, vc = read_kv(kvs)
         attn = attend(q, kc, vc, mask=mask)
-        attn_out = attn.reshape(B, T, H * Hd) @ p["wo"]
+        attn_out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
             attn_out = lax.psum(attn_out, tp_axis)
         x = x + attn_out
 
         h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-        gate = h @ p["w_gate"]
-        up = h @ p["w_up"]
-        mlp_out = (jax.nn.silu(gate) * up) @ p["w_down"]
+        gate = h @ dq(p["w_gate"])
+        up = h @ dq(p["w_up"])
+        mlp_out = (jax.nn.silu(gate) * up) @ dq(p["w_down"])
         if tp_axis is not None:
             mlp_out = lax.psum(mlp_out, tp_axis)
         x = x + mlp_out

@@ -302,7 +302,11 @@ def solve_topology(
 
 
 def model_profile_from_checkpoint(
-    model_dir, seq_len: int = 4096, kv_bits: int = 0
+    model_dir,
+    seq_len: int = 4096,
+    kv_bits: int = 0,
+    weight_quant_bits: int = 0,
+    quant_group: int = 128,
 ) -> ModelProfile:
     """Cost model from checkpoint headers (no weight loading)."""
     import json
@@ -314,6 +318,11 @@ def model_profile_from_checkpoint(
     ckpt = Checkpoint(model_dir)
     cfg = ModelConfig.from_hf(ckpt.config)
     layer_bytes = ckpt.layer_nbytes(0)
+    if weight_quant_bits == 8:
+        # int8 weight-only serving (ops/quant.py): 1 byte/elem + per-group
+        # scales, vs the checkpoint's 2-byte elems.  Norm/bias tensors stay
+        # float but are a rounding error at layer scale.
+        layer_bytes = int(layer_bytes * (1 + 2 / quant_group) / 2)
     edge_bytes = sum(
         _tensor_bytes(ckpt, name) for name in ckpt.edge_tensors
     )

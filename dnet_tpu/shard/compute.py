@@ -42,6 +42,7 @@ class ShardCompute:
         repack_dir: Optional[str] = None,
         kv_bits: int = 0,
         compress_frac: Optional[float] = None,
+        weight_quant_bits: int = 0,
     ) -> None:
         kv_dtype = None
         kv_quant_bits = 0
@@ -67,6 +68,7 @@ class ShardCompute:
             residency_size=residency_size,
             repack_dir=repack_dir,
             kv_quant_bits=kv_quant_bits,
+            weight_quant_bits=weight_quant_bits,
         )
         self.layers = self.engine.model.layers
         self.wire_dtype = wire_dtype

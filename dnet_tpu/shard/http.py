@@ -38,6 +38,7 @@ class ShardLoadModelRequest(BaseModel):
     api_callback_address: str = ""
     param_dtype: str = "bfloat16"
     wire_dtype: str = "bfloat16"
+    weight_quant_bits: int = 0
 
 
 class MeasureLatencyRequest(BaseModel):

@@ -67,6 +67,7 @@ class ShardRuntime:
         residency_size: int = 0,
         repack_dir: str | None = None,
         kv_bits: int = 0,
+        weight_quant_bits: int = 0,
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
@@ -85,6 +86,7 @@ class ShardRuntime:
                 residency_size=residency_size,
                 repack_dir=repack_dir,
                 kv_bits=kv_bits,
+                weight_quant_bits=weight_quant_bits,
             )
             self.model_path = str(model_dir)
             log.info(

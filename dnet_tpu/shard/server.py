@@ -56,6 +56,7 @@ class Shard:
                 window_size=req.window_size,
                 residency_size=req.residency_size,
                 kv_bits=req.kv_bits,
+                weight_quant_bits=req.weight_quant_bits,
             ),
         )
         next_addr = f"{req.next_node.host}:{req.next_node.grpc_port}" if req.next_node else ""

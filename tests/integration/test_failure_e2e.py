@@ -1,0 +1,117 @@
+"""Kill a shard mid-service: detection, degraded /health, 503 fast-fail."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import httpx
+import pytest
+
+from tests.integration.test_two_shard_e2e import REPO, free_port, wait_health
+
+pytestmark = pytest.mark.integration
+
+
+def test_shard_death_detected_and_fast_failed(tiny_llama_dir, tmp_path):
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+        "DNET_API_PARAM_DTYPE": "float32",
+        "DNET_API_HEALTH_INTERVAL_S": "0.5",
+        "DNET_API_HEALTH_FAIL_THRESHOLD": "2",
+        "DNET_LOG_TO_FILE": "0",
+    }
+    ports = {
+        "s0_http": free_port(), "s0_grpc": free_port(),
+        "s1_http": free_port(), "s1_grpc": free_port(),
+        "api_http": free_port(), "api_grpc": free_port(),
+    }
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(
+        f"s0 127.0.0.1 {ports['s0_http']} {ports['s0_grpc']}\n"
+        f"s1 127.0.0.1 {ports['s1_http']} {ports['s1_grpc']}\n"
+    )
+    procs = {}
+
+    def spawn(name, *argv):
+        lf = open(tmp_path / f"{name}.log", "w")
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m", *argv], env=env,
+            stdout=lf, stderr=subprocess.STDOUT, cwd=str(tmp_path),
+        )
+
+    spawn("s0", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+          "--http-port", str(ports["s0_http"]), "--grpc-port", str(ports["s0_grpc"]),
+          "--shard-name", "s0", "--discovery", "none")
+    spawn("s1", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+          "--http-port", str(ports["s1_http"]), "--grpc-port", str(ports["s1_grpc"]),
+          "--shard-name", "s1", "--discovery", "none")
+    spawn("api", "dnet_tpu.cli.api", "--host", "127.0.0.1",
+          "--http-port", str(ports["api_http"]), "--grpc-port", str(ports["api_grpc"]),
+          "--hostfile", str(hostfile))
+    base = f"http://127.0.0.1:{ports['api_http']}"
+    try:
+        for p in ("s0_http", "s1_http", "api_http"):
+            wait_health(f"http://127.0.0.1:{ports[p]}/health")
+
+        r = httpx.post(
+            f"{base}/v1/prepare_topology_manual",
+            json={
+                "model": str(tiny_llama_dir),
+                "assignments": [
+                    {"instance": "s0", "layers": [0, 1]},
+                    {"instance": "s1", "layers": [2, 3]},
+                ],
+            },
+            timeout=30.0,
+        )
+        assert r.status_code == 200, r.text
+        r = httpx.post(f"{base}/v1/load_model", json={"model": str(tiny_llama_dir)}, timeout=300.0)
+        assert r.status_code == 200, r.text
+
+        body = {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3,
+            "temperature": 0,
+        }
+        assert httpx.post(f"{base}/v1/chat/completions", json=body, timeout=60.0).status_code == 200
+
+        # kill the tail shard
+        procs["s1"].kill()
+        procs["s1"].wait(timeout=10)
+
+        # monitor must flag degradation (0.5s interval x 2 failures + slack)
+        deadline = time.monotonic() + 20
+        degraded = False
+        while time.monotonic() < deadline:
+            h = httpx.get(f"{base}/health", timeout=5).json()
+            if h.get("status") == "degraded":
+                degraded = True
+                break
+            time.sleep(0.5)
+        assert degraded, h
+        assert h["shards"]["s1"]["down"] is True
+        assert h["shards"]["s0"]["down"] is False
+
+        # new requests fast-fail with 503 (not a 300s hang)
+        t0 = time.monotonic()
+        r = httpx.post(f"{base}/v1/chat/completions", json=body, timeout=30.0)
+        assert r.status_code == 503, r.text
+        assert "degraded" in r.json()["error"]["message"]
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        for name, p in procs.items():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for name, p in procs.items():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for name in procs:
+            print(f"==== {name} ====")
+            print((tmp_path / f"{name}.log").read_text()[-1200:])

@@ -1,0 +1,167 @@
+"""RingFailureMonitor over fakes: detection, fast-fail, recovery re-solve."""
+
+import asyncio
+
+import pytest
+
+from dnet_tpu.api.failure import RingFailureMonitor
+from dnet_tpu.api.inference import InferenceManager, ServiceDegradedError
+from dnet_tpu.api.schemas import ChatCompletionRequest
+from dnet_tpu.api.strategies import _TokenFutures, ApiAdapterBase
+from dnet_tpu.core.types import DeviceInfo, LayerAssignment, TopologyInfo
+from dnet_tpu.utils.tokenizer import ByteTokenizer
+from tests.fakes.transport import FakeRingClient
+
+pytestmark = pytest.mark.api
+
+
+class FlakyClient(FakeRingClient):
+    """Health check fails when its instance is in the dead set."""
+
+    dead: set = set()
+
+    async def health_check(self, timeout=5.0):
+        if self.addr in self.dead:
+            raise ConnectionError(f"{self.addr} unreachable")
+        return await super().health_check(timeout)
+
+
+class StubAdapter(ApiAdapterBase):
+    def __init__(self):
+        self._futures = _TokenFutures()
+
+    async def start(self): ...
+    async def shutdown(self): ...
+    async def reset_cache(self, nonce): ...
+    async def send_tokens(self, nonce, ids, dec, step): ...
+    async def await_token(self, nonce, step, timeout):
+        return await self._futures.wait(nonce, step, timeout)
+
+    def resolve_token(self, result):
+        self._futures.resolve(result)
+
+
+def make_topo():
+    devs = [
+        DeviceInfo(instance="s0", host="h0", http_port=1, grpc_port=10),
+        DeviceInfo(instance="s1", host="h1", http_port=2, grpc_port=20),
+    ]
+    las = [
+        LayerAssignment(instance="s0", layers=[0, 1], next_instance="s1"),
+        LayerAssignment(instance="s1", layers=[2, 3], next_instance="s0"),
+    ]
+    return TopologyInfo(model="m", num_layers=4, kv_bits=0, devices=devs, assignments=las)
+
+
+class StubCluster:
+    def __init__(self):
+        self.current_topology = make_topo()
+
+
+def make_monitor(inference, threshold=2):
+    return RingFailureMonitor(
+        StubCluster(),
+        inference,
+        interval_s=0.01,
+        fail_threshold=threshold,
+        ring_client_factory=lambda addr: FlakyClient(addr),
+    )
+
+
+def test_detects_down_and_fast_fails_inflight():
+    async def go():
+        FlakyClient.dead = set()
+        adapter = StubAdapter()
+        inference = InferenceManager(adapter, request_timeout_s=30.0)
+        inference.tokenizer = ByteTokenizer()
+        inference.model_id = "m"
+        monitor = make_monitor(inference, threshold=2)
+        inference.failure_monitor = monitor
+
+        await monitor._tick()
+        assert not monitor.degraded
+        assert monitor.snapshot()["s0"]["consecutive_failures"] == 0
+
+        # register a pending token future, then kill shard s1
+        fut = adapter._futures.expect("req1", 0)
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()  # failure 1
+        assert not monitor.degraded
+        await monitor._tick()  # failure 2 -> DOWN + fast-fail
+        assert monitor.degraded
+        assert monitor.down_shards() == ["s1"]
+        result = await asyncio.wait_for(fut, timeout=1.0)
+        assert "unreachable" in result.error
+
+        # new requests are rejected immediately with 503 semantics
+        req = ChatCompletionRequest.model_validate(
+            {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+        )
+        with pytest.raises(ServiceDegradedError):
+            async for _ in inference.generate_stream(req):
+                pass
+
+        # shard comes back -> cleared
+        FlakyClient.dead = set()
+        await monitor._tick()
+        assert not monitor.degraded
+
+    asyncio.run(go())
+
+
+def test_auto_recover_resolves_over_healthy(monkeypatch, tiny_llama_dir):
+    async def go():
+        FlakyClient.dead = set()
+        adapter = StubAdapter()
+        inference = InferenceManager(adapter, request_timeout_s=5.0)
+        inference.tokenizer = ByteTokenizer()
+        inference.model_id = str(tiny_llama_dir)
+        cluster = StubCluster()
+
+        reloads = []
+
+        class StubManager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None):
+                reloads.append(model_id)
+                return 0.1
+
+        monitor = RingFailureMonitor(
+            cluster,
+            inference,
+            model_manager=StubManager(),
+            interval_s=0.01,
+            fail_threshold=1,
+            auto_recover=True,
+            ring_client_factory=lambda addr: FlakyClient(addr),
+        )
+
+        async def profiled():
+            # s1 still answers HTTP /health (and so passes profile_cluster)
+            # even though its gRPC plane is dead — recovery must exclude it
+            # via the monitor's own DOWN set, not re-include it.
+            return [
+                DeviceInfo(
+                    instance="s0", host="h0", http_port=1, grpc_port=10,
+                    flops_bf16=1e14, hbm_bw=8e11, host_to_hbm_bw=1e10,
+                    hbm_bytes=16 << 30,
+                ),
+                DeviceInfo(
+                    instance="s1", host="h1", http_port=2, grpc_port=20,
+                    flops_bf16=1e14, hbm_bw=8e11, host_to_hbm_bw=1e10,
+                    hbm_bytes=16 << 30,
+                ),
+            ]
+
+        cluster.profile_cluster = profiled
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()
+        assert monitor.down_shards() == ["s1"]
+        assert reloads == [str(tiny_llama_dir)]
+        # topology re-solved over the surviving shard only
+        topo = cluster.current_topology
+        assert [a.instance for a in topo.assignments] == ["s0"]
+        assert sorted(l for a in topo.assignments for l in a.layers) == [0, 1, 2, 3]
+
+    asyncio.run(go())

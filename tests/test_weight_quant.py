@@ -1,0 +1,90 @@
+"""int8 weight-only quantization: accuracy + engine integration."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnet_tpu.core.types import DecodingParams
+from dnet_tpu.ops.quant import dq, is_quantized, quantize_weight_q8, quantize_tree
+
+pytestmark = pytest.mark.core
+
+
+def test_roundtrip_error_small():
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 0.05, (256, 128)).astype(np.float32)
+    qw = quantize_weight_q8(w, group_size=128)
+    assert qw["q"].dtype == np.int8
+    assert qw["s"].shape == (2, 128)
+    back = np.asarray(dq(qw, jnp.float32))
+    rel = np.abs(back - w).max() / np.abs(w).max()
+    assert rel < 0.01  # int8 per-group: <1% of max magnitude
+
+
+def test_matmul_error_small():
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (4, 256)).astype(np.float32)
+    w = rng.normal(0, 0.05, (256, 64)).astype(np.float32)
+    ref = x @ w
+    got = np.asarray(jnp.asarray(x) @ dq(quantize_weight_q8(w), jnp.float32))
+    denom = np.abs(ref).max()
+    assert np.abs(got - ref).max() / denom < 0.02
+
+
+def test_passthrough_and_tree():
+    w = np.ones((8, 8), np.float32)
+    assert dq(w) is w
+    tree = quantize_tree({"wq": w, "attn_norm": np.ones(8)}, {"wq"})
+    assert is_quantized(tree["wq"])
+    assert not is_quantized(tree["attn_norm"])
+
+
+def test_dq_defaults_to_scale_dtype():
+    w = np.ones((128, 16), np.float32)
+    qw = quantize_weight_q8(w, scale_dtype=np.float32)
+    assert dq(qw).dtype == jnp.float32  # float32 serving stays float32
+    qw_bf16 = quantize_weight_q8(w)
+    assert dq(qw_bf16).dtype == jnp.bfloat16
+
+
+def test_group_fallback_when_not_tiling():
+    w = np.ones((100, 16), np.float32)  # 100 % 128 != 0 -> single group
+    qw = quantize_weight_q8(w)
+    assert qw["s"].shape == (1, 16)
+    np.testing.assert_allclose(np.asarray(dq(qw, jnp.float32)), w, rtol=0.01)
+
+
+def test_quantized_engine_generates_close_tokens(tiny_llama_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    ids = [256, 72, 101, 108, 108, 111]
+    full = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+    ref_logits = np.asarray(full.prefill("a", ids), np.float32)
+    full.end_session("a")
+
+    q = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype="float32", weight_quant_bits=8
+    )
+    q_logits = np.asarray(q.prefill("b", ids), np.float32)
+    q.end_session("b")
+    assert int(q_logits[0].argmax()) == int(ref_logits[0].argmax())
+    np.testing.assert_allclose(q_logits, ref_logits, atol=0.2, rtol=0.3)
+
+    toks = [
+        r.token_id for r in q.generate(ids, DecodingParams(temperature=0.0), max_tokens=5)
+    ]
+    assert len(toks) == 5
+
+
+def test_quantized_gpt_oss(tmp_path_factory):
+    from tests.fakes.checkpoints import make_tiny_gpt_oss
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path_factory.mktemp("q_gpt_oss")
+    make_tiny_gpt_oss(d)
+    eng = LocalEngine(d, max_seq=32, param_dtype="float32", weight_quant_bits=8)
+    toks = [
+        r.token_id
+        for r in eng.generate([256, 72], DecodingParams(temperature=0.0), max_tokens=4)
+    ]
+    assert len(toks) == 4
