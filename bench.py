@@ -29,7 +29,7 @@ def main() -> None:
     from dnet_tpu.models.llama import LlamaRingModel
     from dnet_tpu.utils.random_init import LLAMA_3_2_1B_CONFIG, random_llama_params
 
-    quantize = "--bf16" not in sys.argv
+    bits = 0 if "--bf16" in sys.argv else (4 if "--int4" in sys.argv else 8)
     cfg_dict = dict(LLAMA_3_2_1B_CONFIG)
     if "--smoke" in sys.argv:  # tiny shapes: code-path validation on CPU
         cfg_dict.update(
@@ -41,13 +41,13 @@ def main() -> None:
     layers = list(range(cfg.num_hidden_layers))
     model = LlamaRingModel(cfg, layers)
     window, edge = random_llama_params(cfg, layers, dtype="bfloat16")
-    if quantize:
+    if bits:
         import numpy as _np
 
         from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
 
         window = quantize_tree(
-            {k: _np.asarray(v) for k, v in window.items()}, QUANTIZABLE
+            {k: _np.asarray(v) for k, v in window.items()}, QUANTIZABLE, bits=bits
         )
         # device-resident: leaving numpy here would re-upload every step
         window = jax.tree.map(jnp.asarray, window)
@@ -95,7 +95,9 @@ def main() -> None:
         int(a.size) * a.dtype.itemsize
         for a in jax.tree.leaves((window, edge))
     )
-    metric = "decode_tok_s_llama1b_%s_1chip" % ("int8" if quantize else "bf16")
+    metric = "decode_tok_s_llama1b_%s_1chip" % (
+        {0: "bf16", 4: "int4", 8: "int8"}[bits]
+    )
     dev = jax.devices()[0]
     hbm_bw = {"v5e": 819e9, "v5litepod": 819e9, "v6e": 1640e9, "v4": 1228e9}.get(
         _chip_gen(dev), 819e9

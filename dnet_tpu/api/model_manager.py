@@ -49,12 +49,14 @@ class LocalModelManager:
         param_dtype: str = "bfloat16",
         mesh: Optional[dict] = None,  # {"pp","tp","dp","sp"} -> MeshEngine
         weight_quant_bits: int = 0,
+        kv_bits: int = 0,
     ) -> None:
         self.inference = inference_manager
         self.models_dir = models_dir
         self.max_seq = max_seq
         self.param_dtype = param_dtype
         self.weight_quant_bits = weight_quant_bits
+        self.kv_bits = kv_bits
         # active when any axis is parallel or pp is left to infer (pp=0 with
         # another axis set, or an explicit pp)
         self.mesh = mesh if mesh and (any(v > 1 for v in mesh.values()) or mesh.get("pp", 0) > 1) else None
@@ -79,6 +81,9 @@ class LocalModelManager:
         loop = asyncio.get_running_loop()
 
         def _build():
+            from dnet_tpu.core.kvcache import resolve_kv_bits
+
+            kv_dtype, kv_quant_bits = resolve_kv_bits(self.kv_bits)
             if self.mesh is not None:
                 if self.weight_quant_bits:
                     raise NotImplementedError(
@@ -94,6 +99,8 @@ class LocalModelManager:
                     sp=self.mesh.get("sp", 1),
                     max_seq=max_seq or self.max_seq,
                     param_dtype=self.param_dtype,
+                    kv_dtype=kv_dtype,
+                    kv_quant_bits=kv_quant_bits,
                 )
             else:
                 from dnet_tpu.core.engine import LocalEngine
@@ -102,6 +109,8 @@ class LocalModelManager:
                     model_dir,
                     max_seq=max_seq or self.max_seq,
                     param_dtype=self.param_dtype,
+                    kv_dtype=kv_dtype,
+                    kv_quant_bits=kv_quant_bits,
                     weight_quant_bits=self.weight_quant_bits,
                 )
             return engine, load_tokenizer(model_dir)

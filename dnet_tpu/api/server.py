@@ -38,6 +38,7 @@ async def serve_async(args) -> None:
         param_dtype=s.api.param_dtype,
         mesh=mesh,
         weight_quant_bits=weight_quant_bits,
+        kv_bits=s.kv.bits,
     )
 
     cluster_manager = None

@@ -38,8 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cluster token scoping UDP discovery membership")
     p.add_argument("--tui", action="store_true", help="live Rich terminal dashboard")
     p.add_argument(
-        "--weight-quant-bits", type=int, default=None, choices=[0, 8],
-        help="int8 weight-only serving (default DNET_API_WEIGHT_QUANT_BITS)",
+        "--weight-quant-bits", type=int, default=None, choices=[0, 4, 8],
+        help="int4/int8 weight-only serving (default DNET_API_WEIGHT_QUANT_BITS)",
     )
     p.add_argument(
         "--auto-recover", action="store_true",

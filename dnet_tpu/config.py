@@ -208,9 +208,9 @@ class ApiSettings(_EnvGroup):
     param_dtype: str = "bfloat16"
     health_interval_s: float = 5.0
     health_fail_threshold: int = 3
-    # 0 = serve weights in param_dtype; 8 = int8 weight-only quantization
-    # (per-group symmetric, ops/quant.py) — ~2x decode roofline on HBM-bound
-    # batch-1 serving
+    # 0 = serve weights in param_dtype; 8 = int8, 4 = packed-int4 weight-only
+    # quantization (per-group symmetric, ops/quant.py) — ~2x / ~4x decode
+    # roofline on HBM-bound batch-1 serving
     weight_quant_bits: int = 0
 
 

@@ -83,8 +83,10 @@ class LocalEngine:
         self.kv_dtype = kv_dtype or param_dtype
         self.kv_quant_bits = kv_quant_bits
         self.weight_quant_bits = weight_quant_bits
-        if weight_quant_bits not in (0, 8):
-            raise NotImplementedError("weight quantization supports 8 bits (int8)")
+        if weight_quant_bits not in (0, 4, 8):
+            raise NotImplementedError(
+                "weight quantization supports 4 (packed int4) or 8 (int8) bits"
+            )
         self.kv_ttl_s = kv_ttl_s
         # shard_mode: load only the edge weights this layer range needs
         # (reference: edge tensors loaded iff shard holds layer 0 / the last
@@ -141,7 +143,7 @@ class LocalEngine:
         else:
             per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
             stacked = m.stack_layers(per_layer)
-            if self.weight_quant_bits == 8:
+            if self.weight_quant_bits:
                 from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
 
                 if not isinstance(stacked, dict) or "layers" in stacked:
@@ -150,7 +152,10 @@ class LocalEngine:
                         f"{self.config.model_type} (list-layout params)"
                     )
                 stacked = quantize_tree(
-                    stacked, QUANTIZABLE, scale_dtype=self.param_dtype
+                    stacked,
+                    QUANTIZABLE,
+                    scale_dtype=self.param_dtype,
+                    bits=self.weight_quant_bits,
                 )
             self.window_params = self._cast(stacked)
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())

@@ -33,7 +33,23 @@ class KVConfig:
     dtype: str = "bfloat16"
     sliding_window: int = 0  # 0 = full cache; >0 = ring buffer of this size
     v_head_dim: int = 0  # 0 = same as head_dim (MLA caches differ: k=nope+rope, v=v_head)
-    quant_bits: int = 0  # 0 = dtype as-is; 8 = int8 + per-(pos,head) scales
+    # 0 = dtype as-is; 8 = int8, 4 = packed int4 (two values/byte along the
+    # head dim) — both with per-(pos,head) f32 scales
+    quant_bits: int = 0
+
+
+def resolve_kv_bits(kv_bits: int) -> Tuple[Optional[str], int]:
+    """Map the API-level kv_bits knob (reference's DNET_KV_BITS / solver
+    kv_bits) to engine args: (kv_dtype override, quant bits)."""
+    if kv_bits == 16:
+        return "bfloat16", 0
+    if kv_bits in (4, 8):
+        return None, kv_bits
+    if kv_bits != 0:
+        # a typo'd value must not silently serve an unquantized cache the
+        # solver didn't budget for
+        raise NotImplementedError(f"kv_bits={kv_bits} (supported: 0/4/8/16)")
+    return None, 0
 
 
 def init_cache(cfg: KVConfig) -> dict:
@@ -49,8 +65,23 @@ def init_cache(cfg: KVConfig) -> dict:
             "k_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
             "v_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
         }
+    if cfg.quant_bits == 4:
+        # packed nibbles along the head dim (token-granular writes stay one
+        # dynamic_update_slice); uint8 storage distinguishes q4 from the
+        # int8 scheme at trace time
+        if cfg.head_dim % 2 or vd % 2:
+            raise ValueError("int4 KV needs even head dims")
+        k4 = (*k_shape[:-1], cfg.head_dim // 2)
+        v4 = (*v_shape[:-1], vd // 2)
+        scale_shape = (cfg.n_layers, cfg.batch, seq, cfg.n_kv_heads, 1)
+        return {
+            "k": jnp.zeros(k4, dtype=jnp.uint8),
+            "v": jnp.zeros(v4, dtype=jnp.uint8),
+            "k_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
+        }
     if cfg.quant_bits not in (0, 16):
-        raise NotImplementedError(f"kv quant_bits={cfg.quant_bits} (only 0/8/16)")
+        raise NotImplementedError(f"kv quant_bits={cfg.quant_bits} (only 0/4/8/16)")
     dt = jnp.dtype(cfg.dtype)
     return {"k": jnp.zeros(k_shape, dtype=dt), "v": jnp.zeros(v_shape, dtype=dt)}
 
@@ -61,6 +92,8 @@ def cache_nbytes(cfg: KVConfig) -> int:
     vd = cfg.v_head_dim or cfg.head_dim
     if cfg.quant_bits == 8:
         return base * (cfg.head_dim + vd) + base * 2 * 4  # int8 + f32 scales
+    if cfg.quant_bits == 4:
+        return base * (cfg.head_dim + vd) // 2 + base * 2 * 4
     return base * (cfg.head_dim + vd) * jnp.dtype(cfg.dtype).itemsize
 
 
@@ -75,10 +108,29 @@ def _quantize_q8(x: jnp.ndarray):
     return q, scale
 
 
+def _quantize_q4(x: jnp.ndarray):
+    """Per-(..., head) symmetric int4, offset-binary nibbles packed in pairs
+    along the last (head) axis: [..., Hd] -> uint8 [..., Hd/2]."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 7.0, 1e-8)
+    q = (
+        jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -7, 7) + 8
+    ).astype(jnp.uint8)
+    return q[..., 0::2] | (q[..., 1::2] << 4), scale
+
+
+def _unpack_q4(p: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., Hd/2] -> f32 [..., Hd] (inverse of _quantize_q4's pack)."""
+    lo = (p & jnp.uint8(0xF)).astype(jnp.float32) - 8.0
+    hi = ((p >> 4) & jnp.uint8(0xF)).astype(jnp.float32) - 8.0
+    return jnp.stack([lo, hi], axis=-1).reshape(*p.shape[:-1], p.shape[-1] * 2)
+
+
 def write_kv(kvs: dict, k_new: jnp.ndarray, v_new: jnp.ndarray, pos, kv_commit=None) -> dict:
     """Write new k/v ([B, T, KVH, Hd]) at `pos` into one layer's cache slices,
     quantizing when the cache carries scales.  kv_commit gates O(T)."""
     quant = "k_scale" in kvs
+    quantize = _quantize_q4 if (quant and kvs["k"].dtype == jnp.uint8) else _quantize_q8
 
     def gate(new, cache_arr):
         if kv_commit is None:
@@ -88,8 +140,8 @@ def write_kv(kvs: dict, k_new: jnp.ndarray, v_new: jnp.ndarray, pos, kv_commit=N
 
     out = dict(kvs)
     if quant:
-        kq, ks = _quantize_q8(k_new)
-        vq, vs = _quantize_q8(v_new)
+        kq, ks = quantize(k_new)
+        vq, vs = quantize(v_new)
         for name, val in (("k", kq), ("k_scale", ks), ("v", vq), ("v_scale", vs)):
             val = gate(val.astype(kvs[name].dtype), kvs[name])
             out[name] = lax.dynamic_update_slice(kvs[name], val, (0, pos, 0, 0))
@@ -108,8 +160,12 @@ def read_kv(kvs: dict) -> Tuple[jnp.ndarray, jnp.ndarray]:
     the plain path returns the cache's own dtype.
     """
     if "k_scale" in kvs:
-        k = kvs["k"].astype(jnp.float32) * kvs["k_scale"]
-        v = kvs["v"].astype(jnp.float32) * kvs["v_scale"]
+        if kvs["k"].dtype == jnp.uint8:  # packed int4
+            k = _unpack_q4(kvs["k"]) * kvs["k_scale"]
+            v = _unpack_q4(kvs["v"]) * kvs["v_scale"]
+        else:
+            k = kvs["k"].astype(jnp.float32) * kvs["k_scale"]
+            v = kvs["v"].astype(jnp.float32) * kvs["v_scale"]
         return k, v
     return kvs["k"], kvs["v"]
 

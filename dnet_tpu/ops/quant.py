@@ -1,14 +1,19 @@
-"""Weight-only int8 quantization (per-group symmetric, fused dequant).
+"""Weight-only int8/int4 quantization (per-group symmetric, fused dequant).
 
 Decode is HBM-bandwidth-bound: every token reads every weight.  int8 weights
-halve the bytes per token (~2x decode roofline); the dequant (convert +
-multiply by per-group scales) fuses into the consuming matmul's operand
-load on TPU, so no full-precision copy is ever materialized.
+halve the bytes per token (~2x decode roofline), int4 quarters them (~4x);
+the dequant (convert + multiply by per-group scales) fuses into the
+consuming matmul's operand load on TPU, so no full-precision copy is ever
+materialized.  int4 matches the reference's dominant serving envelope
+(4-bit catalog entries, src/dnet/api/catalog.py).
 
-Layout: a quantized weight is {"q": int8 [..., in, out], "s": bf16
-[..., in/G, out]} with groups along the IN (contraction) dimension.
+Layouts (groups along the IN / contraction dimension):
+- int8: {"q": int8 [..., in, out], "s": [..., in/G, out]}
+- int4: {"q4": uint8 [..., in/2, out], "s": [..., in/G, out]} — two
+  offset-binary nibbles per byte, adjacent in-rows share a byte (even row
+  in the low nibble).
 `dq()` is the universal accessor — it passes plain arrays through, so model
-code is quantization-agnostic.
+code is quantization-agnostic.  Scales carry the serving precision.
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 DEFAULT_GROUP = 128
+DEFAULT_GROUP_Q4 = 64  # int4 needs finer groups for acceptable error
 
 
 def quantize_weight_q8(
@@ -47,19 +53,52 @@ def quantize_weight_q8(
     }
 
 
+def quantize_weight_q4(
+    w: np.ndarray, group_size: int = DEFAULT_GROUP_Q4, scale_dtype=None
+) -> dict:
+    """[..., in, out] float -> {"q4": packed uint8, "s": scales}.
+
+    Symmetric [-7, 7] stored offset-binary (value + 8), two nibbles per
+    byte along the in axis.  Requires an even in dim."""
+    w = np.asarray(w)
+    *lead, inn, out = w.shape
+    if inn % 2 != 0:
+        raise ValueError(f"int4 packing needs an even contraction dim, got {inn}")
+    if group_size % 2 != 0:
+        raise ValueError(f"int4 group_size must be even, got {group_size}")
+    if inn % group_size != 0:
+        group_size = inn  # one group per whole axis when it doesn't tile
+    g = inn // group_size
+    wf = w.astype(np.float32).reshape(*lead, g, group_size, out)
+    amax = np.abs(wf).max(axis=-2, keepdims=True)
+    scale = np.maximum(amax / 7.0, 1e-12)
+    q = (np.clip(np.round(wf / scale), -7, 7) + 8).astype(np.uint8)
+    q = q.reshape(*lead, inn, out)
+    packed = q[..., 0::2, :] | (q[..., 1::2, :] << 4)  # [..., in/2, out]
+    if scale_dtype is None:
+        import ml_dtypes
+
+        scale_dtype = ml_dtypes.bfloat16
+    return {"q4": packed, "s": scale.squeeze(-2).astype(scale_dtype)}
+
+
 def is_quantized(w) -> bool:
-    return isinstance(w, dict) and "q" in w and "s" in w
+    return isinstance(w, dict) and "s" in w and ("q" in w or "q4" in w)
+
+
+def _qarr(w: dict) -> "np.ndarray":
+    return w["q"] if "q" in w else w["q4"]
 
 
 def out_dim(w) -> int:
     """Output (last-axis) dimension of a maybe-quantized weight."""
-    return (w["q"] if is_quantized(w) else w).shape[-1]
+    return (_qarr(w) if is_quantized(w) else w).shape[-1]
 
 
 def lead_dim(w) -> int:
     """Leading-axis dimension of a maybe-quantized weight (e.g. local expert
     count of a stacked MoE weight)."""
-    return (w["q"] if is_quantized(w) else w).shape[0]
+    return (_qarr(w) if is_quantized(w) else w).shape[0]
 
 
 def dq(w: Union[jnp.ndarray, dict], dtype=None) -> jnp.ndarray:
@@ -69,24 +108,42 @@ def dq(w: Union[jnp.ndarray, dict], dtype=None) -> jnp.ndarray:
     engine's param_dtype), so float32 serving is not silently downgraded."""
     if not is_quantized(w):
         return w
-    q, s = w["q"], w["s"]
+    s = w["s"]
     if dtype is None:
         dtype = s.dtype
-    *lead, inn, out = q.shape
+    if "q4" in w:
+        p = w["q4"]
+        *lead, half, out = p.shape
+        inn = half * 2
+        lo = (p & jnp.uint8(0xF)).astype(dtype) - 8.0
+        hi = ((p >> 4) & jnp.uint8(0xF)).astype(dtype) - 8.0
+        # re-interleave: even in-rows came from the low nibble
+        q = jnp.stack([lo, hi], axis=-2).reshape(*lead, inn, out)
+    else:
+        q = w["q"].astype(dtype)
+        *lead, inn, out = q.shape
     g = s.shape[-2]
     group = inn // g
-    deq = q.astype(dtype).reshape(*lead, g, group, out) * s.astype(dtype)[..., :, None, :]
+    deq = q.reshape(*lead, g, group, out) * s.astype(dtype)[..., :, None, :]
     return deq.reshape(*lead, inn, out)
 
 
 def quantize_tree(
-    params: dict, keys: set, group_size: int = DEFAULT_GROUP, scale_dtype=None
+    params: dict,
+    keys: set,
+    group_size: int = 0,
+    scale_dtype=None,
+    bits: int = 8,
 ) -> dict:
     """Quantize the named 2D+ weights in a (stacked) param dict."""
+    if bits not in (4, 8):
+        raise NotImplementedError(f"weight quantization bits={bits} (4 or 8)")
+    quantize = quantize_weight_q4 if bits == 4 else quantize_weight_q8
+    group_size = group_size or (DEFAULT_GROUP_Q4 if bits == 4 else DEFAULT_GROUP)
     out = {}
     for k, v in params.items():
         if k in keys and not is_quantized(v) and np.asarray(v).ndim >= 2:
-            out[k] = quantize_weight_q8(np.asarray(v), group_size, scale_dtype)
+            out[k] = quantize(np.asarray(v), group_size, scale_dtype)
         else:
             out[k] = v
     return out

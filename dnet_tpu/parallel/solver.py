@@ -306,7 +306,7 @@ def model_profile_from_checkpoint(
     seq_len: int = 4096,
     kv_bits: int = 0,
     weight_quant_bits: int = 0,
-    quant_group: int = 128,
+    quant_group: int = 0,  # 0 = the quantizer's default group size
 ) -> ModelProfile:
     """Cost model from checkpoint headers (no weight loading)."""
     import json
@@ -318,11 +318,16 @@ def model_profile_from_checkpoint(
     ckpt = Checkpoint(model_dir)
     cfg = ModelConfig.from_hf(ckpt.config)
     layer_bytes = ckpt.layer_nbytes(0)
-    if weight_quant_bits == 8:
-        # int8 weight-only serving (ops/quant.py): 1 byte/elem + per-group
-        # scales, vs the checkpoint's 2-byte elems.  Norm/bias tensors stay
-        # float but are a rounding error at layer scale.
-        layer_bytes = int(layer_bytes * (1 + 2 / quant_group) / 2)
+    if weight_quant_bits in (4, 8):
+        # weight-only serving (ops/quant.py): bits/8 bytes per elem +
+        # per-group scales, vs the checkpoint's 2-byte elems.  Norm/bias
+        # tensors stay float but are a rounding error at layer scale.
+        from dnet_tpu.ops.quant import DEFAULT_GROUP, DEFAULT_GROUP_Q4
+
+        group = quant_group or (
+            DEFAULT_GROUP_Q4 if weight_quant_bits == 4 else DEFAULT_GROUP
+        )
+        layer_bytes = int(layer_bytes * (weight_quant_bits / 8 + 2 / group) / 2)
     edge_bytes = sum(
         _tensor_bytes(ckpt, name) for name in ckpt.edge_tensors
     )
@@ -334,9 +339,13 @@ def model_profile_from_checkpoint(
         active = params_per_layer * (
             cfg.num_experts_per_tok / cfg.num_local_experts
         )
-    kv_elem_bytes = 1 if kv_bits == 8 else 2
     kvh = cfg.num_key_value_heads
-    kv_bytes = 2 * kvh * cfg.head_dim * kv_elem_bytes
+    if kv_bits == 8:  # int8 + per-(pos,head) f32 scale (core/kvcache.py)
+        kv_bytes = 2 * kvh * (cfg.head_dim + 4)
+    elif kv_bits == 4:  # packed nibbles + f32 scale
+        kv_bytes = 2 * kvh * (cfg.head_dim // 2 + 4)
+    else:
+        kv_bytes = 2 * kvh * cfg.head_dim * 2
     return ModelProfile(
         model_id=str(model_dir),
         num_layers=cfg.num_hidden_layers,

@@ -44,18 +44,9 @@ class ShardCompute:
         compress_frac: Optional[float] = None,
         weight_quant_bits: int = 0,
     ) -> None:
-        kv_dtype = None
-        kv_quant_bits = 0
-        if kv_bits == 16:
-            kv_dtype = "bfloat16"
-        elif kv_bits == 8:
-            kv_quant_bits = 8  # int8 + per-(pos,head) f32 scales
-        elif kv_bits == 4:
-            log.warning(
-                "kv_bits=4 not yet implemented on TPU backend; using int8 KV "
-                "(memory use will be ~2x the solver's plan)"
-            )
-            kv_quant_bits = 8
+        from dnet_tpu.core.kvcache import resolve_kv_bits
+
+        kv_dtype, kv_quant_bits = resolve_kv_bits(kv_bits)
         self.engine = LocalEngine(
             model_dir,
             layers=layers,

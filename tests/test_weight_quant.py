@@ -1,11 +1,18 @@
-"""int8 weight-only quantization: accuracy + engine integration."""
+"""int8/int4 weight-only quantization: accuracy + engine integration."""
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from dnet_tpu.core.types import DecodingParams
-from dnet_tpu.ops.quant import dq, is_quantized, quantize_weight_q8, quantize_tree
+from dnet_tpu.ops.quant import (
+    dq,
+    is_quantized,
+    out_dim,
+    quantize_tree,
+    quantize_weight_q4,
+    quantize_weight_q8,
+)
 
 pytestmark = pytest.mark.core
 
@@ -37,6 +44,49 @@ def test_passthrough_and_tree():
     tree = quantize_tree({"wq": w, "attn_norm": np.ones(8)}, {"wq"})
     assert is_quantized(tree["wq"])
     assert not is_quantized(tree["attn_norm"])
+
+
+def test_q4_roundtrip_and_matmul():
+    rng = np.random.default_rng(2)
+    w = rng.normal(0, 0.05, (256, 64)).astype(np.float32)
+    qw = quantize_weight_q4(w, group_size=64)
+    assert qw["q4"].dtype == np.uint8
+    assert qw["q4"].shape == (128, 64)  # packed along the in axis
+    assert qw["s"].shape == (4, 64)
+    assert out_dim(qw) == 64
+    back = np.asarray(dq(qw, jnp.float32))
+    assert back.shape == w.shape
+    rel = np.abs(back - w).max() / np.abs(w).max()
+    assert rel < 0.08  # int4 per-group-64
+
+    x = rng.normal(0, 1, (4, 256)).astype(np.float32)
+    got = np.asarray(jnp.asarray(x) @ dq(qw, jnp.float32))
+    ref = x @ w
+    # int4 error accumulates ~sqrt(K) over the K=256 contraction; random
+    # (untrained) weights are the worst case for the relative-to-max metric
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 0.25
+
+
+def test_q4_stacked_moe_layout():
+    rng = np.random.default_rng(3)
+    w = rng.normal(0, 0.05, (2, 4, 64, 32)).astype(np.float32)  # [L,E,in,out]
+    qw = quantize_weight_q4(w, group_size=32)
+    back = np.asarray(dq(qw, jnp.float32))
+    assert back.shape == w.shape
+    assert np.abs(back - w).max() / np.abs(w).max() < 0.08
+
+
+def test_q4_engine_generates(tiny_llama_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype="float32", weight_quant_bits=4
+    )
+    toks = [
+        r.token_id
+        for r in eng.generate([256, 72, 101], DecodingParams(temperature=0.0), max_tokens=5)
+    ]
+    assert len(toks) == 5
 
 
 def test_dq_defaults_to_scale_dtype():
