@@ -83,29 +83,35 @@ class HostLayerStore:
         model,
         param_dtype: str = "bfloat16",
         repack_dir: Optional[str | Path] = None,
+        weight_quant_bits: int = 0,
     ) -> None:
         self.ckpt = ckpt
         self.model = model
         self.param_dtype = np.dtype(
             __import__("ml_dtypes").bfloat16 if param_dtype == "bfloat16" else param_dtype
         )
+        self.weight_quant_bits = weight_quant_bits
         self._cache: Dict[int, Dict[str, np.ndarray]] = {}
         self._lock = threading.Lock()
         self.repack_path: Optional[Path] = None
         if repack_dir is not None:
             tag = Path(ckpt.dir).name
             key = hashlib.sha1(
-                f"v2:{param_dtype}:{','.join(map(str, model.layers))}".encode()
+                f"v3:{param_dtype}:wq{weight_quant_bits}:"
+                f"{','.join(map(str, model.layers))}".encode()
             ).hexdigest()[:10]
             self.repack_path = Path(repack_dir).expanduser() / tag / key
             self.repack_path.mkdir(parents=True, exist_ok=True)
 
-    def _cast(self, tree: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        out = {}
+    def _cast(self, tree: Dict[str, object]) -> Dict[str, object]:
+        out: Dict[str, object] = {}
         for k, v in tree.items():
-            if np.issubdtype(v.dtype, np.floating) and v.dtype != self.param_dtype:
-                v = v.astype(self.param_dtype)
-            out[k] = v
+            if isinstance(v, dict):  # quantized leaf: q stays int, s is typed
+                out[k] = self._cast(v)
+            elif np.issubdtype(v.dtype, np.floating) and v.dtype != self.param_dtype:
+                out[k] = v.astype(self.param_dtype)
+            else:
+                out[k] = v
         return out
 
     def layer_host(self, layer: int):
@@ -124,17 +130,31 @@ class HostLayerStore:
             f = self.repack_path / f"layer_{layer}.npz"
             if f.is_file():
                 z = np.load(f)
-                return {k: _bf16_view(z[k]) for k in z.files}
+                return _unflatten({k: _bf16_view(z[k]) for k in z.files})
         t0 = time.perf_counter()
-        mapped = self._cast(self.model.map_layer(self.ckpt.load_layer_raw(layer)))
+        mapped = self.model.map_layer(self.ckpt.load_layer_raw(layer))
+        if self.weight_quant_bits:
+            # quantize the RAW checkpoint values (before any lossy cast) so
+            # fit and offload policies serve bit-identical quantized weights
+            from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
+
+            mapped = quantize_tree(
+                mapped,
+                QUANTIZABLE,
+                scale_dtype=self.param_dtype,
+                bits=self.weight_quant_bits,
+            )
+        mapped = self._cast(mapped)
         log.info(
             "[PROFILE] host-load layer %d in %.1fms", layer, (time.perf_counter() - t0) * 1e3
         )
         if self.repack_path is not None:
             f = self.repack_path / f"layer_{layer}.npz"
             tmp = f.with_suffix(".tmp.npz")
-            # bf16 is not npz-native; save raw bytes views
-            np.savez(tmp, **{k: v.view(np.uint16) if v.dtype == np.dtype("bfloat16") else v for k, v in mapped.items()})
+            # bf16 is not npz-native; save raw bytes views.  Quantized leaf
+            # dicts flatten to "name::q" / "name::s" entries.
+            flat = _flatten(mapped)
+            np.savez(tmp, **{k: v.view(np.uint16) if v.dtype == np.dtype("bfloat16") else v for k, v in flat.items()})
             tmp.rename(f)
         return mapped
 
@@ -279,3 +299,26 @@ def _bf16_view(v: np.ndarray) -> np.ndarray:
 
         return v.view(ml_dtypes.bfloat16)
     return v
+
+
+def _flatten(tree: Dict[str, object]) -> Dict[str, np.ndarray]:
+    """One-level nesting ({"wq": {"q": ..., "s": ...}}) -> "wq::q" keys."""
+    flat: Dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat[f"{k}::{k2}"] = v2
+        else:
+            flat[k] = v
+    return flat
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in flat.items():
+        if "::" in k:
+            k1, _, k2 = k.partition("::")
+            out.setdefault(k1, {})[k2] = v
+        else:
+            out[k] = v
+    return out

@@ -95,6 +95,9 @@ class RingModel(abc.ABC):
     """
 
     model_type: str = ""
+    # model code routes its big matmuls through ops.quant.dq (int8/int4
+    # weight-only serving); models whose layer layout predates dq set False
+    supports_weight_quant: bool = True
 
     def __init__(self, config: ModelConfig, layers: Sequence[int]):
         self.config = config
@@ -193,8 +196,11 @@ class RingModel(abc.ABC):
 
     def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
         """Shape ONE layer's mapped host params as a single-layer window (the
-        weight-streaming unit).  Default: add the leading stack axis."""
-        return {k: v[None] for k, v in mapped.items()}
+        weight-streaming unit).  Default: add the leading stack axis (tree-
+        mapped so quantized {"q"/"q4","s"} leaf dicts wrap too)."""
+        import jax
+
+        return jax.tree.map(lambda v: v[None], mapped)
 
     def local_window(self, start_abs: int, size: int) -> List[int]:
         """The contiguous run of assigned layers beginning at start_abs."""

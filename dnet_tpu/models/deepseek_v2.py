@@ -34,6 +34,7 @@ from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
 
 class DeepseekV2RingModel(RingModel):
     model_type = "deepseek_v2"
+    supports_weight_quant = False  # MLA matmuls don't route through dq yet
 
     def __init__(self, config: ModelConfig, layers):
         super().__init__(config, layers)

@@ -22,6 +22,11 @@ def main() -> int:
     p.add_argument("--layers", default="", help="comma list / a:b range; default all")
     p.add_argument("--param-dtype", default="bfloat16")
     p.add_argument("--repack-dir", default="~/.dnet-tpu/repacked")
+    p.add_argument(
+        "--weight-quant-bits", type=int, default=0, choices=[0, 4, 8],
+        help="pre-quantize layers (must match the serving setting: the "
+        "repack cache key embeds it)",
+    )
     args = p.parse_args()
 
     from dnet_tpu.core.weights import HostLayerStore
@@ -41,7 +46,11 @@ def main() -> int:
 
     model = get_ring_model_cls(cfg.model_type)(cfg, layers)
     store = HostLayerStore(
-        ckpt, model, param_dtype=args.param_dtype, repack_dir=args.repack_dir
+        ckpt,
+        model,
+        param_dtype=args.param_dtype,
+        repack_dir=args.repack_dir,
+        weight_quant_bits=args.weight_quant_bits,
     )
     t0 = time.perf_counter()
     for i, layer in enumerate(layers):

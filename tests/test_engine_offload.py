@@ -130,3 +130,56 @@ def test_offload_shard_compute_matches(tiny_llama_dir, fit_tokens):
         s1.stop()
 
     asyncio.run(go())
+
+
+@pytest.mark.parametrize(
+    "bits,param_dtype",
+    # bfloat16 with the f32 tiny checkpoint covers checkpoint-dtype !=
+    # param_dtype: both policies must quantize the RAW values (a pre-quant
+    # cast would change scales and break fit/offload parity)
+    [(8, "float32"), (4, "float32"), (8, "bfloat16")],
+)
+def test_quantized_streaming_decodes(tiny_llama_dir, bits, param_dtype, tmp_path):
+    """Weight streaming + int8/int4 layers: quantized host store, repack
+    round-trip, quantized-vs-quantized parity between fit and offload."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    ids = [256, 72, 105]
+    fit = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype=param_dtype, weight_quant_bits=bits
+    )
+    expected = [
+        r.token_id
+        for r in fit.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+    ]
+
+    for run in range(2):  # second run exercises the repack cache load path
+        eng = LocalEngine(
+            tiny_llama_dir,
+            max_seq=64,
+            param_dtype=param_dtype,
+            window_size=2,
+            residency_size=4,
+            weight_quant_bits=bits,
+            repack_dir=str(tmp_path / "repack"),
+        )
+        assert eng.plan.name == "offload"
+        try:
+            toks = [
+                r.token_id
+                for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+            ]
+            # same quantized params either way -> identical greedy tokens
+            assert toks == expected, f"run {run}"
+        finally:
+            eng.close()
+
+
+def test_quant_unsupported_model_raises(tmp_path_factory):
+    from tests.fakes.checkpoints import make_tiny_deepseek_v2
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path_factory.mktemp("q_dsv2")
+    make_tiny_deepseek_v2(d)
+    with pytest.raises(NotImplementedError):
+        LocalEngine(d, max_seq=32, param_dtype="float32", weight_quant_bits=8)
