@@ -25,7 +25,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mesh",
         default="",
-        help="in-slice single-program serving, e.g. 'pp=4,tp=2' (ICI fast path)",
+        help="in-slice single-program serving, e.g. 'pp=2,tp=2,sp=2' (ICI fast path; sp = sequence-parallel KV / ring attention)",
     )
     p.add_argument(
         "--discovery", choices=["udp", "none"], default="none",

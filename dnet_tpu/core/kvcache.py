@@ -152,6 +152,62 @@ def write_kv(kvs: dict, k_new: jnp.ndarray, v_new: jnp.ndarray, pos, kv_commit=N
     return out
 
 
+def write_kv_sp(
+    kvs: dict,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos,
+    axis_name: str,
+    kv_commit=None,
+) -> dict:
+    """Sequence-parallel write: this rank owns KV slots
+    [rank*S_local, (rank+1)*S_local).  Each of the T incoming tokens lands on
+    exactly one rank; out-of-range ranks re-write the old value (no-op).
+    Token-at-a-time keeps a prefill chunk that straddles a shard boundary
+    correct — a single clamped slice write could not split across ranks."""
+    from jax import lax as _lax
+
+    quant = "k_scale" in kvs
+    S_local = kvs["k"].shape[1]
+    offset = _lax.axis_index(axis_name) * S_local
+    T = k_new.shape[1]
+    if quant:
+        quantize = _quantize_q4 if kvs["k"].dtype == jnp.uint8 else _quantize_q8
+        kq, ks = quantize(k_new)
+        vq, vs = quantize(v_new)
+        items = [("k", kq), ("k_scale", ks), ("v", vq), ("v_scale", vs)]
+    else:
+        items = [("k", k_new), ("v", v_new)]
+
+    out = dict(kvs)
+    if T == 1:  # decode: one gated single-slot write per cache array
+        slot = pos
+        local = jnp.clip(slot - offset, 0, S_local - 1)
+        in_range = (slot >= offset) & (slot < offset + S_local)
+        commit = in_range if kv_commit is None else (in_range & kv_commit)
+        for name, val in items:
+            c = kvs[name]
+            v_i = val.astype(c.dtype)
+            old = _lax.dynamic_slice(c, (0, local, 0, 0), v_i.shape)
+            sel = jnp.where(commit, v_i, old)
+            out[name] = _lax.dynamic_update_slice(c, sel, (0, local, 0, 0))
+        return out
+
+    # prefill: each local slot receives at most one of the T tokens, so the
+    # whole write is one gather + where (no serialized per-token loop)
+    j = offset + jnp.arange(S_local) - pos  # incoming-token index per slot
+    valid = (j >= 0) & (j < T)
+    if kv_commit is not None:
+        valid = valid & kv_commit
+    jc = jnp.clip(j, 0, T - 1)
+    sel = valid[None, :, None, None]
+    for name, val in items:
+        c = kvs[name]
+        taken = jnp.take(val.astype(c.dtype), jc, axis=1)
+        out[name] = jnp.where(sel, taken, c)
+    return out
+
+
 def read_kv(kvs: dict) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Full-cache k/v for attention, dequantizing if needed.
 

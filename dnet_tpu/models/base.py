@@ -125,6 +125,7 @@ class RingModel(abc.ABC):
         layer_kinds: Optional[jnp.ndarray] = None,
         tp_axis: Optional[str] = None,
         kv_commit=None,
+        sp_axis: Optional[str] = None,
     ) -> Tuple[jnp.ndarray, dict]:
         """Apply a stacked window of layers. kv holds this window's slices.
 

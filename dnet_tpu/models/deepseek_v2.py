@@ -201,10 +201,11 @@ class DeepseekV2RingModel(RingModel):
         layer_kinds: Optional[jnp.ndarray] = None,
         tp_axis: Optional[str] = None,
         kv_commit=None,
+        sp_axis: Optional[str] = None,
     ) -> Tuple[jnp.ndarray, dict]:
-        if tp_axis is not None or kv_commit is not None:
+        if tp_axis is not None or kv_commit is not None or sp_axis is not None:
             raise NotImplementedError(
-                "deepseek_v2 TP/ring-program support is pending; run pp-only"
+                "deepseek_v2 TP/SP/ring-program support is pending; run pp-only"
             )
         if mask is None:
             mask = causal_mask(x.shape[1], kv["k"].shape[2], pos)

@@ -27,9 +27,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dnet_tpu.core.kvcache import read_kv, write_kv
 from dnet_tpu.models.base import ModelConfig, RingModel
-from dnet_tpu.ops.attention import attend, causal_mask, sliding_window_mask
+from dnet_tpu.ops.attention import (
+    cached_attend,
+    causal_mask,
+    sliding_window_mask,
+    sp_causal_mask,
+    sp_sliding_window_mask,
+)
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq, lead_dim, out_dim
 from dnet_tpu.ops.rope import apply_rope, rope_frequencies
@@ -61,7 +66,7 @@ class GptOssRingModel(RingModel):
     def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
         return edge_params["embed"]["weight"][tokens]
 
-    def _attention(self, p, x, kvs, pos, mask, tp_axis, kv_commit):
+    def _attention(self, p, x, kvs, pos, mask, tp_axis, kv_commit, sp_axis=None):
         cfg = self.config
         B, T, D = x.shape
         Hd = cfg.head_dim
@@ -75,9 +80,10 @@ class GptOssRingModel(RingModel):
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
-        kvs = write_kv(kvs, k, v, pos, kv_commit)
-        kc, vc = read_kv(kvs)
-        attn = attend(q, kc, vc, mask=mask, sinks=p["sinks"])
+        attn, kvs = cached_attend(
+            q, k, v, kvs, pos, mask,
+            kv_commit=kv_commit, sp_axis=sp_axis, sinks=p["sinks"],
+        )
         out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
             out = lax.psum(out, tp_axis)
@@ -127,20 +133,33 @@ class GptOssRingModel(RingModel):
         layer_kinds: Optional[jnp.ndarray] = None,
         tp_axis: Optional[str] = None,
         kv_commit=None,
+        sp_axis: Optional[str] = None,
     ) -> Tuple[jnp.ndarray, dict]:
         T, S = x.shape[1], kv["k"].shape[2]
-        full_mask = causal_mask(T, S, pos) if mask is None else mask
-        swa = self.config.sliding_window or S
-        swa_mask = sliding_window_mask(T, S, pos, swa)
-        if mask is not None:
-            swa_mask = swa_mask & mask  # caller's mask composes with SWA
+        swa = self.config.sliding_window or (
+            S * (1 if sp_axis is None else lax.psum(1, sp_axis))
+        )
+        if sp_axis is None:
+            full_mask = causal_mask(T, S, pos) if mask is None else mask
+            swa_mask = sliding_window_mask(T, S, pos, swa)
+            if mask is not None:
+                swa_mask = swa_mask & mask  # caller's mask composes with SWA
+        else:
+            # KV axis holds this rank's shard: masks from absolute positions
+            full_mask = sp_causal_mask(T, S, pos, sp_axis)
+            swa_mask = sp_sliding_window_mask(T, S, pos, swa, sp_axis)
+            if mask is not None:
+                full_mask = full_mask & mask
+                swa_mask = swa_mask & mask
         kinds = layer_kinds if layer_kinds is not None else self.layer_kinds
 
         def body(carry, per_layer):
             xc = carry
             p, kvs, kind = per_layer
             m = jnp.where(kind == 1, swa_mask, full_mask)
-            xc, kvs = self._attention(p, xc, kvs, pos, m, tp_axis, kv_commit)
+            xc, kvs = self._attention(
+                p, xc, kvs, pos, m, tp_axis, kv_commit, sp_axis=sp_axis
+            )
             xc = self._moe(p, xc, tp_axis)
             return xc, kvs
 

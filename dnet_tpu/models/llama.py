@@ -17,9 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dnet_tpu.core.kvcache import read_kv, write_kv
 from dnet_tpu.models.base import ModelConfig, RingModel
-from dnet_tpu.ops.attention import attend, causal_mask
+from dnet_tpu.ops.attention import cached_attend, causal_mask, sp_causal_mask
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq, out_dim
 from dnet_tpu.ops.rope import apply_rope, rope_frequencies
@@ -46,14 +45,17 @@ class LlamaRingModel(RingModel):
         """Pre-RoPE q/k hook; identity for llama (qwen3 adds per-head norms)."""
         return q, k
 
-    def _layer(self, p: dict, x: jnp.ndarray, kvs: dict, pos, mask, tp_axis=None, kv_commit=None):
+    def _layer(self, p: dict, x: jnp.ndarray, kvs: dict, pos, mask, tp_axis=None, kv_commit=None, sp_axis=None):
         """One decoder layer.  Works on full params or tensor-parallel slices:
         local head counts come from the (possibly sharded) param shapes, and
         `tp_axis` inserts the two Megatron-style psums (after o-proj and
         down-proj) when running inside shard_map.  kv_commit (scalar bool)
         gates the cache write O(T)-cheaply — a pipeline rank processing a
         not-its-turn copy must not pollute its cache.  kvs is this layer's
-        cache-slice dict (may carry int8 quant scales)."""
+        cache-slice dict (may carry int8/int4 quant scales).  sp_axis: the
+        KV sequence axis is sharded over this mesh axis (ring attention /
+        distributed flash-decoding); `mask` is then the [T, S_local]
+        validity mask against this rank's shard."""
         cfg = self.config
         B, T, D = x.shape
         Hd = cfg.head_dim
@@ -68,9 +70,9 @@ class LlamaRingModel(RingModel):
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
-        kvs = write_kv(kvs, k, v, pos, kv_commit)
-        kc, vc = read_kv(kvs)
-        attn = attend(q, kc, vc, mask=mask)
+        attn, kvs = cached_attend(
+            q, k, v, kvs, pos, mask, kv_commit=kv_commit, sp_axis=sp_axis
+        )
         attn_out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
             attn_out = lax.psum(attn_out, tp_axis)
@@ -95,20 +97,30 @@ class LlamaRingModel(RingModel):
         layer_kinds: Optional[jnp.ndarray] = None,
         tp_axis: Optional[str] = None,
         kv_commit=None,
+        sp_axis: Optional[str] = None,
     ) -> Tuple[jnp.ndarray, dict]:
         if mask is None:
-            mask = causal_mask(x.shape[1], kv["k"].shape[2], pos)
+            mask = self._window_mask(x.shape[1], kv["k"].shape[2], pos, sp_axis)
 
         def body(carry, per_layer):
             xc = carry
             p, kvs = per_layer
             xc, kvs = self._layer(
-                p, xc, kvs, pos, mask, tp_axis=tp_axis, kv_commit=kv_commit
+                p, xc, kvs, pos, mask, tp_axis=tp_axis, kv_commit=kv_commit,
+                sp_axis=sp_axis,
             )
             return xc, kvs
 
         x, kv_out = lax.scan(body, x, (window_params, kv))
         return x, kv_out
+
+    @staticmethod
+    def _window_mask(T, S_local, pos, sp_axis):
+        """Causal mask; under sp the KV axis holds this rank's shard, so
+        causality is computed against absolute slot positions."""
+        if sp_axis is None:
+            return causal_mask(T, S_local, pos)
+        return sp_causal_mask(T, S_local, pos, sp_axis)
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)

@@ -9,9 +9,10 @@ full/sliding masks (reference: src/dnet/core/models/gpt_oss.py:111-170).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
+from jax import lax
 
 NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free on fully-masked rows
 
@@ -33,6 +34,54 @@ def sliding_window_mask(q_len: int, kv_len: int, q_offset, window: int) -> jnp.n
     q_pos = q_offset + jnp.arange(q_len)[:, None]
     kv_pos = jnp.arange(kv_len)[None, :]
     return (kv_pos <= q_pos) & (kv_pos > q_pos - window)
+
+
+def sp_causal_mask(q_len: int, kv_local: int, q_offset, sp_axis: str) -> jnp.ndarray:
+    """Causal mask against THIS rank's KV shard (sequence axis sharded over
+    `sp_axis`): causality is computed on absolute slot positions."""
+    offset = lax.axis_index(sp_axis) * kv_local
+    kv_pos = offset + jnp.arange(kv_local)[None, :]
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    return kv_pos <= q_pos
+
+
+def sp_sliding_window_mask(
+    q_len: int, kv_local: int, q_offset, window: int, sp_axis: str
+) -> jnp.ndarray:
+    """Sliding-window causal mask against this rank's KV shard."""
+    offset = lax.axis_index(sp_axis) * kv_local
+    kv_pos = offset + jnp.arange(kv_local)[None, :]
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    return (kv_pos <= q_pos) & (kv_pos > q_pos - window)
+
+
+def cached_attend(
+    q: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    kvs: dict,
+    pos,
+    mask: Optional[jnp.ndarray],
+    kv_commit=None,
+    sp_axis: Optional[str] = None,
+    sinks: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> Tuple[jnp.ndarray, dict]:
+    """Write the new k/v into one layer's cache slices and attend over the
+    full cache — the shared body of every model's attention block.  With
+    `sp_axis` the cache holds this rank's sequence shard and attention runs
+    as distributed flash-decoding (`mask` must then be rank-local, e.g.
+    sp_causal_mask)."""
+    from dnet_tpu.core.kvcache import read_kv, write_kv, write_kv_sp
+    from dnet_tpu.ops.ring_attention import sp_decode_attend
+
+    if sp_axis is None:
+        kvs = write_kv(kvs, k_new, v_new, pos, kv_commit)
+        kc, vc = read_kv(kvs)
+        return attend(q, kc, vc, mask=mask, sinks=sinks, scale=scale), kvs
+    kvs = write_kv_sp(kvs, k_new, v_new, pos, sp_axis, kv_commit)
+    kc, vc = read_kv(kvs)
+    return sp_decode_attend(q, kc, vc, mask, sp_axis, sinks=sinks), kvs
 
 
 def attend(

@@ -105,12 +105,16 @@ def sp_decode_attend(
     v_local: jnp.ndarray,
     valid_local: jnp.ndarray,
     axis_name: str,
+    sinks: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Distributed flash-decoding: q [B,T,H,Hd] replicated over the axis,
     k/v [B,S_local,KVH,Hd] this rank's KV shard, valid_local [T, S_local]
     boolean attendability mask (causal + written-slot validity).
 
     One cross-device LSE combine (pmax + 2x psum) merges the partials.
+    sinks [H]: GPT-OSS attention-sink logits — a virtual key absorbing
+    probability mass, folded into the global softmax denominator exactly
+    once (outside the psum).
     """
     B, Tq, H, Hd = q.shape
     KVH = k_local.shape[2]
@@ -121,10 +125,15 @@ def sp_decode_attend(
     scores = _block_scores(q5, k_local, valid_local)
     m_loc = jnp.max(scores, axis=-1)  # [B,KVH,G,Tq]
     m_glob = lax.pmax(m_loc, axis_name)
+    if sinks is not None:
+        sink = sinks.astype(jnp.float32).reshape(KVH, G)[None, :, :, None]
+        m_glob = jnp.maximum(m_glob, sink)
     p = jnp.exp(scores - m_glob[..., None])
     l_loc = jnp.sum(p, axis=-1)
     o_loc = jnp.einsum("bkgts,bskd->bkgtd", p, v_local.astype(jnp.float32))
     l_glob = lax.psum(l_loc, axis_name)
     o_glob = lax.psum(o_loc, axis_name)
+    if sinks is not None:
+        l_glob = l_glob + jnp.exp(jnp.broadcast_to(sink, m_glob.shape) - m_glob)
     out = o_glob / jnp.maximum(l_glob[..., None], 1e-30)
-    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, H, Hd).astype(q.dtype)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, H, v_local.shape[-1]).astype(q.dtype)

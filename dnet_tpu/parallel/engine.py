@@ -62,10 +62,6 @@ class MeshEngine:
         kv_ttl_s: float = 600.0,
         devices: Optional[Sequence] = None,
     ):
-        if sp > 1:
-            raise NotImplementedError(
-                "sequence parallelism (sp) lands with ring attention; use pp/tp/dp"
-            )
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
         model_cls = get_ring_model_cls(self.config.model_type)
@@ -73,13 +69,15 @@ class MeshEngine:
         L = self.config.num_hidden_layers
         if pp <= 0:  # 0 = infer: use every remaining device for pipeline stages
             n_dev = len(list(devices) if devices is not None else jax.devices())
-            pp = max(n_dev // (tp * dp), 1)
+            pp = max(n_dev // (tp * dp * sp), 1)
             while pp > 1 and L % pp != 0:
                 pp -= 1
         if L % pp != 0:
             raise ValueError(f"pp={pp} must divide num_layers={L}")
-        self.mesh = build_mesh(pp=pp, tp=tp, dp=dp, devices=devices)
-        self.pp, self.tp, self.dp = pp, tp, dp
+        if sp > 1 and max_seq % sp != 0:
+            raise ValueError(f"sp={sp} must divide max_seq={max_seq}")
+        self.mesh = build_mesh(pp=pp, tp=tp, dp=dp, sp=sp, devices=devices)
+        self.pp, self.tp, self.dp, self.sp = pp, tp, dp, sp
         self.batch = batch * dp
         self.max_seq = max_seq
         self.param_dtype = jnp.dtype(param_dtype)
@@ -94,8 +92,8 @@ class MeshEngine:
             self.model, self.mesh, param_keys=list(self._host_window.keys())
         )
         log.info(
-            "MeshEngine: %s over mesh pp=%d tp=%d dp=%d (%d devices)",
-            self.config.model_type, pp, tp, dp, pp * tp * dp,
+            "MeshEngine: %s over mesh pp=%d tp=%d dp=%d sp=%d (%d devices)",
+            self.config.model_type, pp, tp, dp, sp, pp * tp * dp * sp,
         )
 
     # ---- loading ------------------------------------------------------

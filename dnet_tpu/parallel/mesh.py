@@ -81,7 +81,9 @@ def replicate(tree, mesh: Mesh):
     return jax.device_put(tree, NamedSharding(mesh, P()))
 
 
-def kv_spec() -> P:
+def kv_spec(sp: bool = False) -> P:
     """KV cache [L, B, S, KVH, Hd]: layers over pp, kv-heads over tp, batch
-    over dp, (sequence over sp when ring attention is active)."""
-    return P(AXIS_PP, AXIS_DP, None, AXIS_TP, None)
+    over dp; sequence over sp only when sequence parallelism is active (a
+    size-1 sp annotation would still mark kv device-varying over sp inside
+    shard_map and break the scan carry typing)."""
+    return P(AXIS_PP, AXIS_DP, AXIS_SP if sp else None, AXIS_TP, None)

@@ -25,7 +25,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dnet_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, kv_spec, layer_param_spec
+from dnet_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    kv_spec,
+    layer_param_spec,
+)
 
 
 def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
@@ -40,6 +47,9 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
     param_keys: keys of the stacked window-param dict (spec construction).
     """
     PP = mesh.shape[AXIS_PP]
+    # sequence parallelism: KV shards over sp; queries/hidden replicate and
+    # attention runs as ring/flash-decoding with one LSE combine per layer
+    sp_axis = AXIS_SP if mesh.shape.get(AXIS_SP, 1) > 1 else None
 
     # mixed-attention models (gpt_oss) carry a per-layer kind array that must
     # shard over pp alongside the layer-stacked params
@@ -48,12 +58,12 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
         {k: layer_param_spec(k) for k in param_keys},
         P(),  # edge params replicated
         P(AXIS_DP, None),  # tokens [B, T]
-        kv_spec(),  # pytree prefix: applies to every kv leaf (incl. scales)
+        kv_spec(sp_axis is not None),  # pytree prefix: every kv leaf (incl. scales)
         P(),  # pos scalar
         P(),  # last_idx scalar
         P(AXIS_PP) if has_kinds else P(),
     )
-    out_specs = (P(AXIS_DP, None), kv_spec())
+    out_specs = (P(AXIS_DP, None), kv_spec(sp_axis is not None))
 
     def spmd(window_params, edge_params, tokens, kv, pos, last_idx, kinds):
         my_pp = lax.axis_index(AXIS_PP)
@@ -74,6 +84,7 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
             x_new, kv = model.apply_window(
                 window_params, x, kv, pos,
                 layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=(i == my_pp),
+                sp_axis=sp_axis,
             )
             # hand the hidden state to the next pipeline rank (ICI hop)
             x_next = lax.ppermute(
@@ -116,9 +127,10 @@ def place_ring_state(window_params, edge_params, kv, mesh: Mesh):
     """Device_put params/caches with ring shardings (host -> mesh)."""
     from dnet_tpu.parallel.mesh import replicate, shard_window_params
 
+    sp = mesh.shape[AXIS_SP] > 1
     wp = shard_window_params(window_params, mesh)
     ep = replicate(edge_params, mesh)
     kvp = jax.tree.map(
-        lambda a: jax.device_put(a, NamedSharding(mesh, kv_spec())), kv
+        lambda a: jax.device_put(a, NamedSharding(mesh, kv_spec(sp))), kv
     )
     return wp, ep, kvp

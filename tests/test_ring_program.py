@@ -59,9 +59,10 @@ def test_ring_matches_single_device(engine, eight_devices, pp, tp):
     assert ring_tokens == ref_tokens, f"pp={pp} tp={tp}: {ring_tokens} != {ref_tokens}"
 
 
-@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2)])
-def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp, tp):
-    """Mixed SWA/full kinds + MoE experts through the single-program ring."""
+@pytest.mark.parametrize("pp,tp,sp", [(2, 1, 1), (2, 2, 1), (2, 1, 2), (1, 2, 2)])
+def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp, tp, sp):
+    """Mixed SWA/full kinds + MoE experts through the single-program ring;
+    sp cases cover sinks + SWA masking against a sequence-sharded KV."""
     from tests.fakes.checkpoints import make_tiny_gpt_oss
     from dnet_tpu.core.engine import LocalEngine
 
@@ -70,7 +71,7 @@ def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp,
     eng = LocalEngine(d, max_seq=32, param_dtype="float32")
     ref = _reference_tokens(eng, 65, n_steps=3)
 
-    mesh = build_mesh(pp=pp, tp=tp)
+    mesh = build_mesh(pp=pp, tp=tp, sp=sp)
     fn = make_ring_decode_fn(eng.model, mesh, param_keys=list(eng.window_params.keys()))
     kv_host = init_cache(eng.model.kv_config(len(eng.model.layers), 1, 32, "float32"))
     wp, ep, kv = place_ring_state(eng.window_params, eng.edge_params, kv_host, mesh)
@@ -82,7 +83,7 @@ def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp,
         t = int(jnp.argmax(logits[0]))
         got.append(t)
         tok = jnp.asarray([[t]], dtype=jnp.int32)
-    assert got == ref, f"pp={pp} tp={tp}: {got} != {ref}"
+    assert got == ref, f"pp={pp} tp={tp} sp={sp}: {got} != {ref}"
 
 
 def test_ring_logits_close(engine, eight_devices):
