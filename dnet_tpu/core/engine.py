@@ -147,13 +147,8 @@ class LocalEngine:
             per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
             stacked = m.stack_layers(per_layer)
             if self.weight_quant_bits:
-                from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
-
-                stacked = quantize_tree(
-                    stacked,
-                    QUANTIZABLE,
-                    scale_dtype=self.param_dtype,
-                    bits=self.weight_quant_bits,
+                stacked = m.quantize_params(
+                    stacked, self.weight_quant_bits, scale_dtype=self.param_dtype
                 )
             self.window_params = self._cast(stacked)
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())

@@ -136,11 +136,11 @@ class HostLayerStore:
         if self.weight_quant_bits:
             # quantize the RAW checkpoint values (before any lossy cast) so
             # fit and offload policies serve bit-identical quantized weights
-            from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
+            from dnet_tpu.ops.quant import quantize_tree
 
             mapped = quantize_tree(
                 mapped,
-                QUANTIZABLE,
+                self.model.quant_keys,
                 scale_dtype=self.param_dtype,
                 bits=self.weight_quant_bits,
             )

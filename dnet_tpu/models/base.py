@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dnet_tpu.core.kvcache import KVConfig
+from dnet_tpu.ops.quant import QUANTIZABLE
 
 
 @dataclass
@@ -95,9 +96,13 @@ class RingModel(abc.ABC):
     """
 
     model_type: str = ""
-    # model code routes its big matmuls through ops.quant.dq (int8/int4
-    # weight-only serving); models whose layer layout predates dq set False
+    # extension point: a future model whose matmuls can't route through
+    # ops.quant.dq sets False and the engine fails fast.  Every current
+    # family supports it.
     supports_weight_quant: bool = True
+    # per-layer param names eligible for weight-only quantization (the big
+    # matmuls; norms/biases/routers stay float).  Subclasses override.
+    quant_keys: frozenset = frozenset(QUANTIZABLE)
 
     def __init__(self, config: ModelConfig, layers: Sequence[int]):
         self.config = config
@@ -194,6 +199,16 @@ class RingModel(abc.ABC):
             return {}
         keys = per_layer[0].keys()
         return {k: np.stack([p[k] for p in per_layer], axis=0) for k in keys}
+
+    def quantize_params(self, stacked, bits: int, scale_dtype=None):
+        """Weight-only quantize a stacked param pytree (engine fit path).
+        Default covers the flat stacked-dict layout; list-layout models
+        override."""
+        from dnet_tpu.ops.quant import quantize_tree
+
+        return quantize_tree(
+            stacked, self.quant_keys, bits=bits, scale_dtype=scale_dtype
+        )
 
     def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
         """Shape ONE layer's mapped host params as a single-layer window (the

@@ -29,12 +29,17 @@ from dnet_tpu.core.kvcache import KVConfig, read_kv, write_kv
 from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.ops.attention import attend, causal_mask
 from dnet_tpu.ops.norms import rms_norm
+from dnet_tpu.ops.quant import dq
 from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
 
 
 class DeepseekV2RingModel(RingModel):
     model_type = "deepseek_v2"
-    supports_weight_quant = False  # MLA matmuls don't route through dq yet
+    quant_keys = frozenset(
+        {"wq", "wq_a", "wq_b", "wkv_a", "wkv_b", "wo",  # MLA projections
+         "w_gate", "w_up", "w_down",  # dense mlp
+         "e_gate", "e_up", "e_down", "s_gate", "s_up", "s_down"}  # MoE
+    )  # router gate_w stays f32 (routing decisions are precision-sensitive)
 
     def __init__(self, config: ModelConfig, layers):
         super().__init__(config, layers)
@@ -107,17 +112,17 @@ class DeepseekV2RingModel(RingModel):
 
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
         if self.q_lora_rank is None:
-            q = h @ p["wq"]
+            q = h @ dq(p["wq"])
         else:
-            qa = rms_norm(h @ p["wq_a"], p["q_a_norm"], 1e-6)
-            q = qa @ p["wq_b"]
+            qa = rms_norm(h @ dq(p["wq_a"]), p["q_a_norm"], 1e-6)
+            q = qa @ dq(p["wq_b"])
         q = q.reshape(B, T, H, self.qk_head_dim)
         q_nope, q_pe = q[..., :nope], q[..., nope:]
 
-        ckv = h @ p["wkv_a"]  # [B, T, kv_lora + rope_d]
+        ckv = h @ dq(p["wkv_a"])  # [B, T, kv_lora + rope_d]
         k_latent, k_pe = ckv[..., : self.kv_lora_rank], ckv[..., self.kv_lora_rank:]
         k_latent = rms_norm(k_latent, p["kv_a_norm"], 1e-6)
-        kv = (k_latent @ p["wkv_b"]).reshape(B, T, H, nope + vd)
+        kv = (k_latent @ dq(p["wkv_b"])).reshape(B, T, H, nope + vd)
         k_nope, v = kv[..., :nope], kv[..., nope:]
 
         positions = pos + jnp.arange(T)
@@ -133,13 +138,13 @@ class DeepseekV2RingModel(RingModel):
         kvs = write_kv(kvs, k_full, v, pos)
         kc, vc = read_kv(kvs)
         attn = attend(q_full, kc, vc, mask=mask, scale=self.softmax_scale)
-        out = attn.reshape(B, T, H * vd) @ p["wo"]
+        out = attn.reshape(B, T, H * vd) @ dq(p["wo"])
         return x + out, kvs
 
     def _dense_mlp(self, p_prefix: dict, h: jnp.ndarray) -> jnp.ndarray:
-        gate = h @ p_prefix["w_gate"]
-        up = h @ p_prefix["w_up"]
-        return (jax.nn.silu(gate) * up) @ p_prefix["w_down"]
+        gate = h @ dq(p_prefix["w_gate"])
+        up = h @ dq(p_prefix["w_up"])
+        return (jax.nn.silu(gate) * up) @ dq(p_prefix["w_down"])
 
     def _moe(self, p, x):
         B, T, D = x.shape
@@ -171,10 +176,10 @@ class DeepseekV2RingModel(RingModel):
         ].set(topk_w)  # [N, E]
 
         # dense-weighted expert compute (exact: zero weight for non-top-k)
-        gate = jnp.einsum("nd,edf->nef", flat, p["e_gate"])
-        up = jnp.einsum("nd,edf->nef", flat, p["e_up"])
+        gate = jnp.einsum("nd,edf->nef", flat, dq(p["e_gate"]))
+        up = jnp.einsum("nd,edf->nef", flat, dq(p["e_up"]))
         inner = jax.nn.silu(gate) * up
-        expert_out = jnp.einsum("nef,efd->ned", inner, p["e_down"])
+        expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["e_down"]))
         routed = jnp.einsum("ned,ne->nd", expert_out, weights.astype(flat.dtype))
 
         shared = self._dense_mlp(
@@ -228,6 +233,16 @@ class DeepseekV2RingModel(RingModel):
     def stack_layers(self, per_layer: List[Dict[str, np.ndarray]]):
         """Heterogeneous layers (dense vs MoE): keep a list, no stacking."""
         return {"layers": list(per_layer)}
+
+    def quantize_params(self, stacked, bits: int, scale_dtype=None):
+        from dnet_tpu.ops.quant import quantize_tree
+
+        return {
+            "layers": [
+                quantize_tree(p, self.quant_keys, bits=bits, scale_dtype=scale_dtype)
+                for p in stacked["layers"]
+            ]
+        }
 
     def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
         return {"layers": [mapped]}

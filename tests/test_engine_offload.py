@@ -175,11 +175,56 @@ def test_quantized_streaming_decodes(tiny_llama_dir, bits, param_dtype, tmp_path
             eng.close()
 
 
-def test_quant_unsupported_model_raises(tmp_path_factory):
+def test_quantized_deepseek_streaming_matches_fit(tmp_path_factory, tmp_path):
+    """List-layout quantized layers through the offload policy + npz repack:
+    3-D expert weights flatten to 'e_gate::q'/'e_gate::s' entries and must
+    round-trip to the same greedy tokens as the fit path."""
+    from tests.fakes.checkpoints import make_tiny_deepseek_v2
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path_factory.mktemp("q_dsv2_stream")
+    make_tiny_deepseek_v2(d)
+    ids = [256, 72, 101]
+    fit = LocalEngine(d, max_seq=32, param_dtype="float32", weight_quant_bits=8)
+    expected = [
+        r.token_id
+        for r in fit.generate(ids, DecodingParams(temperature=0.0), max_tokens=4)
+    ]
+    for run in range(2):  # second run loads from the repack cache
+        eng = LocalEngine(
+            d, max_seq=32, param_dtype="float32", weight_quant_bits=8,
+            window_size=1, residency_size=2, repack_dir=str(tmp_path / "rp"),
+        )
+        assert eng.plan.streams_weights
+        try:
+            toks = [
+                r.token_id
+                for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=4)
+            ]
+            assert toks == expected, f"run {run}"
+        finally:
+            eng.close()
+
+
+def test_quantized_deepseek_decodes(tmp_path_factory):
+    """List-layout (dense-vs-MoE) model quantizes per layer and still decodes
+    close to the unquantized reference."""
     from tests.fakes.checkpoints import make_tiny_deepseek_v2
     from dnet_tpu.core.engine import LocalEngine
 
     d = tmp_path_factory.mktemp("q_dsv2")
     make_tiny_deepseek_v2(d)
-    with pytest.raises(NotImplementedError):
-        LocalEngine(d, max_seq=32, param_dtype="float32", weight_quant_bits=8)
+    ids = [256, 72, 101]
+    full = LocalEngine(d, max_seq=32, param_dtype="float32")
+    ref_logits = np.asarray(full.prefill("a", ids), np.float32)
+    full.end_session("a")
+
+    q = LocalEngine(d, max_seq=32, param_dtype="float32", weight_quant_bits=8)
+    q_logits = np.asarray(q.prefill("b", ids), np.float32)
+    q.end_session("b")
+    assert int(q_logits[0].argmax()) == int(ref_logits[0].argmax())
+    toks = [
+        r.token_id
+        for r in q.generate(ids, DecodingParams(temperature=0.0), max_tokens=4)
+    ]
+    assert len(toks) == 4
