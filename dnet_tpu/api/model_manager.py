@@ -50,6 +50,7 @@ class LocalModelManager:
         mesh: Optional[dict] = None,  # {"pp","tp","dp","sp"} -> MeshEngine
         weight_quant_bits: int = 0,
         kv_bits: int = 0,
+        batch_slots: int = 1,
     ) -> None:
         self.inference = inference_manager
         self.models_dir = models_dir
@@ -57,6 +58,7 @@ class LocalModelManager:
         self.param_dtype = param_dtype
         self.weight_quant_bits = weight_quant_bits
         self.kv_bits = kv_bits
+        self.batch_slots = batch_slots
         # active when any axis is parallel or pp is left to infer (pp=0 with
         # another axis set, or an explicit pp)
         self.mesh = mesh if mesh and (any(v > 1 for v in mesh.values()) or mesh.get("pp", 0) > 1) else None
@@ -102,6 +104,18 @@ class LocalModelManager:
                     kv_dtype=kv_dtype,
                     kv_quant_bits=kv_quant_bits,
                 )
+            elif self.batch_slots > 1:
+                from dnet_tpu.core.batch import BatchedEngine
+
+                engine = BatchedEngine(
+                    model_dir,
+                    slots=self.batch_slots,
+                    max_seq=max_seq or self.max_seq,
+                    param_dtype=self.param_dtype,
+                    kv_dtype=kv_dtype,
+                    kv_quant_bits=kv_quant_bits,
+                    weight_quant_bits=self.weight_quant_bits,
+                )
             else:
                 from dnet_tpu.core.engine import LocalEngine
 
@@ -119,9 +133,14 @@ class LocalModelManager:
 
         # swap adapter engine atomically
         old_adapter = self.inference.adapter
-        from dnet_tpu.api.strategies import LocalAdapter
+        from dnet_tpu.api.strategies import BatchedLocalAdapter, LocalAdapter
+        from dnet_tpu.core.batch import BatchedEngine
 
-        adapter = LocalAdapter(engine)
+        adapter = (
+            BatchedLocalAdapter(engine)
+            if isinstance(engine, BatchedEngine)
+            else LocalAdapter(engine)
+        )
         await adapter.start()
         self.inference.adapter = adapter
         self.inference.tokenizer = tokenizer

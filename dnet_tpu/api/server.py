@@ -21,10 +21,18 @@ async def serve_async(args) -> None:
     s = get_settings()
     wq = getattr(args, "weight_quant_bits", None)
     weight_quant_bits = s.api.weight_quant_bits if wq is None else wq
+    batch_slots = getattr(args, "batch_slots", None) or s.api.batch_slots
+    # with continuous batching, admission must not exceed the slot pool —
+    # an over-admitted request would hard-fail on prefill instead of queueing
+    max_concurrent = (
+        min(s.api.max_concurrent_requests, batch_slots)
+        if batch_slots > 1
+        else s.api.max_concurrent_requests
+    )
     inference = InferenceManager(
         adapter=None,
         request_timeout_s=s.api.request_timeout_s,
-        max_concurrent=s.api.max_concurrent_requests,
+        max_concurrent=max_concurrent,
     )
     env_mesh = {"pp": s.mesh.pp, "tp": s.mesh.tp, "dp": s.mesh.dp, "sp": s.mesh.sp}
     env_mesh_active = s.mesh.pp > 0 or s.mesh.tp > 1 or s.mesh.dp > 1 or s.mesh.sp > 1
@@ -39,6 +47,7 @@ async def serve_async(args) -> None:
         mesh=mesh,
         weight_quant_bits=weight_quant_bits,
         kv_bits=s.kv.bits,
+        batch_slots=batch_slots,
     )
 
     cluster_manager = None

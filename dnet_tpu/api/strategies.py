@@ -120,6 +120,118 @@ class _TokenFutures:
             )
 
 
+class BatchedLocalAdapter(ApiAdapterBase):
+    """Continuous-batching strategy over a BatchedEngine.
+
+    Decode steps from concurrent requests coalesce: send_tokens enqueues the
+    step and a scheduler task drains everything pending into ONE batched
+    engine call (core/batch.py).  While a batched step runs on the compute
+    executor, newly arriving steps queue for the next round — classic
+    continuous batching.  Prefills run between batched steps on the same
+    executor (no KV races: one compute thread)."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine  # BatchedEngine
+        self._futures = _TokenFutures()
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._pending: Dict[str, tuple] = {}  # nonce -> (token, decoding, step)
+        self._kick: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="compute")
+        self._kick = asyncio.Event()
+        self._task = asyncio.ensure_future(self._batch_loop())
+
+    async def shutdown(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        if self._executor:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+    async def reset_cache(self, nonce: str) -> None:
+        self._pending.pop(nonce, None)
+        # slot state is owned by the compute thread: freeing it from the
+        # event loop would race an in-flight batched step
+        if self._executor is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                self._executor, self.engine.end_session, nonce
+            )
+        self._futures.cancel_nonce(nonce)
+
+    def max_seq(self) -> Optional[int]:
+        return self.engine.max_seq
+
+    async def send_tokens(
+        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+    ) -> None:
+        if self._executor is None or self._kick is None:
+            raise RuntimeError("adapter not started")
+        self._futures.expect(nonce, step)
+        if step == 0 or nonce not in self.engine.sessions:
+            loop = asyncio.get_running_loop()
+            loop.run_in_executor(
+                self._executor, self._prefill, nonce, list(token_ids), decoding, step
+            )
+        else:
+            self._pending[nonce] = (token_ids[-1], decoding, step)
+            self._kick.set()
+
+    def _prefill(self, nonce: str, ids: List[int], decoding: DecodingParams, step: int) -> None:
+        try:
+            res = self.engine.prefill_and_sample(nonce, ids, decoding)
+            self._futures.resolve(
+                self.engine.token_result(nonce, res, step=step, decoding=decoding)
+            )
+        except Exception as exc:
+            log.exception("batched prefill failed")
+            self._futures.resolve(
+                TokenResult(nonce=nonce, token_id=-1, error=str(exc), step=step)
+            )
+
+    async def _batch_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            await self._kick.wait()
+            self._kick.clear()
+            await asyncio.sleep(0)  # coalesce: let concurrent senders enqueue
+            pending, self._pending = self._pending, {}
+            if not pending:
+                continue
+            await loop.run_in_executor(self._executor, self._batched_step, pending)
+
+    async def await_token(self, nonce: str, step: int, timeout: float) -> TokenResult:
+        return await self._futures.wait(nonce, step, timeout)
+
+    def resolve_token(self, result: TokenResult) -> None:
+        self._futures.resolve(result)
+
+    def _batched_step(self, pending: Dict[str, tuple]) -> None:
+        try:
+            reqs = {n: (tok, dec) for n, (tok, dec, _step) in pending.items()}
+            results, errors = self.engine.decode_batch(reqs)
+        except Exception as exc:
+            log.exception("batched decode step failed")
+            for nonce, (_tok, _dec, step) in pending.items():
+                self._futures.resolve(
+                    TokenResult(nonce=nonce, token_id=-1, error=str(exc), step=step)
+                )
+            return
+        for nonce, res in results.items():
+            _tok, dec, step = pending[nonce]
+            self._futures.resolve(
+                self.engine.token_result(nonce, res, step=step, decoding=dec)
+            )
+        for nonce, msg in errors.items():
+            _tok, _dec, step = pending[nonce]
+            self._futures.resolve(
+                TokenResult(nonce=nonce, token_id=-1, error=msg, step=step)
+            )
+
+
 class LocalAdapter(ApiAdapterBase):
     """Single-process strategy: the engine *is* the ring.
 

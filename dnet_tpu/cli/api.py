@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--auto-recover", action="store_true",
         help="on shard failure, re-solve the ring over healthy shards and reload",
     )
+    p.add_argument(
+        "--batch-slots", type=int, default=None,
+        help="continuous batching: N KV slots share one batched decode "
+        "program (default DNET_API_BATCH_SLOTS)",
+    )
     return p
 
 

@@ -1,0 +1,294 @@
+"""Continuous batching: N session slots share one batched decode program.
+
+The reference serves one in-flight sequence per nonce and leaves batching
+absent (SURVEY.md §2.8 "Speculative / batching schedulers: absent");
+`max_concurrent_requests` merely interleaves requests through one
+single-sequence engine.  On TPU, batch-1 decode is weight-bound — the MXU
+reads every weight to produce ONE token — so lanes 2..N of a batched matmul
+are nearly free.  This engine turns concurrency into throughput:
+
+- A fixed pool of `slots` KV-cache rows ([L, slots, S, ...]) serves all
+  active requests; a request owns one slot from prefill to EOS.
+- The decode step is `jax.vmap` of the SAME single-example forward+sample
+  the LocalEngine uses (per-slot pos / sampling params / RNG key / active
+  flag), jitted once — adding or finishing requests never recompiles.
+- Inactive lanes compute garbage that is discarded: their `active=False`
+  flag gates the KV write (kv_commit) and the repetition-count update, so
+  slot state cannot be corrupted.  This trades a constant slot's worth of
+  (weight-bound, ~free) FLOPs for a completely static program shape.
+- Prefill runs per-request on the LocalEngine's B=1 bucket programs, then
+  the session's KV row is inserted into the batched cache.
+
+Per-slot sampling params are traced vectors, so mixed temperatures /
+top-p's batch together (same property as core/sampler.py's traced scalars).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnet_tpu.core.engine import LocalEngine
+from dnet_tpu.core.kvcache import init_cache
+from dnet_tpu.core.sampler import SampleParams, SampleResult, sample
+from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class BatchedEngine:
+    """LocalEngine-compatible surface plus `decode_batch` for the scheduler."""
+
+    token_result = staticmethod(LocalEngine.token_result)
+
+    def __init__(self, model_dir: str | Path, slots: int = 8, **engine_kwargs):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.eng = LocalEngine(model_dir, **engine_kwargs)
+        if self.eng.plan.streams_weights:
+            raise NotImplementedError(
+                "continuous batching needs resident weights (fit policy); "
+                "weight streaming serves single-sequence"
+            )
+        if not self.eng.model.supports_kv_commit:
+            # fail at load, not mid-stream on the first batched step
+            raise NotImplementedError(
+                f"continuous batching not supported for "
+                f"{self.eng.config.model_type} (no gated KV writes yet)"
+            )
+        self.slots = slots
+        self.max_seq = self.eng.max_seq
+        self.config = self.eng.config
+        self.model = self.eng.model
+        m = self.eng.model
+        self.kv = init_cache(
+            m.kv_config(
+                len(m.layers), slots, self.max_seq, self.eng.kv_dtype,
+                quant_bits=self.eng.kv_quant_bits,
+            )
+        )
+        V = self.config.vocab_size
+        self.counts = jnp.zeros((slots, V), dtype=jnp.int32)
+        self.keys = jax.random.split(
+            jax.random.key(int.from_bytes(__import__("os").urandom(4), "little")),
+            slots,
+        )
+        self.pos = np.zeros(slots, dtype=np.int64)  # host-side per-slot length
+        self.last_used = np.zeros(slots, dtype=np.float64)
+        self.slot_of: Dict[str, int] = {}  # nonce -> slot
+        self._free: List[int] = list(range(slots))
+        self._build()
+
+    # ---- program ------------------------------------------------------
+    def _build(self) -> None:
+        model = self.eng.model
+
+        def one(wp, ep, token, kv, pos, active, sp, key, counts):
+            """Single-example decode+sample; vmapped over the slot axis.
+            kv leaves arrive batch-axis-stripped [L, S, ...]: re-add B=1."""
+            kv = jax.tree.map(lambda a: a[:, None], kv)
+            x = model.embed(ep, token[None, :])  # [1, 1, D]
+            x, kv = model.apply_window(wp, x, kv, pos, kv_commit=active)
+            x = model.normalize(ep, x[:, -1:])
+            logits = model.lm_project(ep, x)[:, 0]  # [1, V]
+            new_key, step_key = jax.random.split(key)
+            res = sample(logits, sp, step_key, token_counts=counts[None])
+            counts = counts.at[res.token[0]].add(jnp.where(active, 1, 0))
+            kv = jax.tree.map(lambda a: a[:, 0], kv)
+            # inactive lanes must not advance their RNG stream either, or a
+            # seeded request's tokens would depend on unrelated traffic
+            key = jax.random.wrap_key_data(
+                jnp.where(
+                    active, jax.random.key_data(new_key), jax.random.key_data(key)
+                )
+            )
+            return res, kv, counts, key
+
+        kv_axes = jax.tree.map(lambda _: 1, self.kv)
+        sp_axes = SampleParams(0, 0, 0, 0, 0)
+        self._step = jax.jit(
+            jax.vmap(
+                one,
+                in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
+                out_axes=(0, kv_axes, 0, 0),
+            ),
+            donate_argnums=(3, 8),
+        )
+
+    # ---- slot lifecycle ----------------------------------------------
+    def alloc_slot(self, nonce: str) -> int:
+        if nonce in self.slot_of:
+            return self.slot_of[nonce]
+        if not self._free:
+            raise RuntimeError(f"no free batch slots (capacity {self.slots})")
+        slot = self._free.pop(0)
+        self.slot_of[nonce] = slot
+        self.pos[slot] = 0
+        self.last_used[slot] = time.time()
+        return slot
+
+    def free_slot(self, nonce: str) -> None:
+        slot = self.slot_of.pop(nonce, None)
+        if slot is not None:
+            self.counts = self.counts.at[slot].set(0)
+            self.pos[slot] = 0
+            self._free.append(slot)
+
+    def end_session(self, nonce: str) -> None:
+        self.free_slot(nonce)
+        self.eng.end_session(nonce)
+
+    def reset(self) -> None:
+        for nonce in list(self.slot_of):
+            self.free_slot(nonce)
+        self.eng.reset()
+
+    def sweep_sessions(self, ttl_s: float = 600.0) -> int:
+        now = time.time()
+        dead = [
+            n for n, s in self.slot_of.items()
+            if now - self.last_used[s] > ttl_s
+        ]
+        for n in dead:
+            self.free_slot(n)
+        return len(dead) + self.eng.sweep_sessions()
+
+    def close(self) -> None:
+        self.reset()
+        self.eng.close()
+
+    @property
+    def sessions(self):  # adapter compatibility (membership checks)
+        return self.slot_of
+
+    # ---- inference ----------------------------------------------------
+    def prefill_and_sample(
+        self, nonce: str, prompt_ids: Sequence[int], decoding: DecodingParams
+    ) -> SampleResult:
+        """Prefill on the B=1 bucket program, then move the session's KV row
+        and sampling state into this request's batch slot."""
+        slot = self.alloc_slot(nonce)
+        res = self.eng.prefill_and_sample(nonce, prompt_ids, decoding)
+        sess = self.eng.sessions[nonce]
+        self.kv = jax.tree.map(
+            lambda big, one: big.at[:, slot : slot + 1].set(one.astype(big.dtype)),
+            self.kv,
+            sess.kv,
+        )
+        self.counts = self.counts.at[slot].set(sess.counts[0])
+        self.keys = self.keys.at[slot].set(sess.key)
+        self.pos[slot] = sess.pos
+        self.last_used[slot] = time.time()
+        self.eng.end_session(nonce)  # B=1 cache row no longer needed
+        return res
+
+    def decode_batch(
+        self, requests: Dict[str, Tuple[int, DecodingParams]]
+    ) -> Tuple[Dict[str, SampleResult], Dict[str, str]]:
+        """One batched decode step for every (nonce -> last token) request.
+        Slots not in `requests` stay frozen (active=False gates their KV
+        write and counts).  Returns (results, per-nonce errors): a request
+        whose slot vanished (client disconnect race) or hit max_seq fails
+        ALONE — it must never poison the rest of the batch."""
+        errors: Dict[str, str] = {}
+        if not requests:
+            return {}, errors
+        token = np.zeros((self.slots, 1), dtype=np.int32)
+        active = np.zeros(self.slots, dtype=bool)
+        pos = np.zeros(self.slots, dtype=np.int32)
+        temp = np.zeros(self.slots, dtype=np.float32)
+        top_p = np.ones(self.slots, dtype=np.float32)
+        top_k = np.zeros(self.slots, dtype=np.int32)
+        min_p = np.zeros(self.slots, dtype=np.float32)
+        rep = np.ones(self.slots, dtype=np.float32)
+        order: Dict[str, int] = {}
+        for nonce, (tok, dec) in requests.items():
+            slot = self.slot_of.get(nonce)
+            if slot is None:
+                errors[nonce] = f"request {nonce!r} has no batch slot (cancelled?)"
+                continue
+            if self.pos[slot] >= self.max_seq:
+                errors[nonce] = (
+                    f"sequence length {self.pos[slot]} reached max_seq {self.max_seq}"
+                )
+                continue
+            token[slot, 0] = tok
+            active[slot] = True
+            pos[slot] = self.pos[slot]
+            temp[slot] = dec.temperature
+            top_p[slot] = dec.top_p
+            top_k[slot] = dec.top_k
+            min_p[slot] = dec.min_p
+            rep[slot] = dec.repetition_penalty
+            order[nonce] = slot
+        if not order:
+            return {}, errors
+
+        sp = SampleParams(
+            temperature=jnp.asarray(temp),
+            top_p=jnp.asarray(top_p),
+            top_k=jnp.asarray(top_k),
+            min_p=jnp.asarray(min_p),
+            repetition_penalty=jnp.asarray(rep),
+        )
+        res, self.kv, self.counts, self.keys = self._step(
+            self.eng.window_params,
+            self.eng.edge_params,
+            jnp.asarray(token),
+            self.kv,
+            jnp.asarray(pos),
+            jnp.asarray(active),
+            sp,
+            self.keys,
+            self.counts,
+        )
+        now = time.time()
+        out: Dict[str, SampleResult] = {}
+        for nonce, slot in order.items():
+            self.pos[slot] += 1
+            self.last_used[slot] = now
+            out[nonce] = SampleResult(
+                token=res.token[slot],
+                logprob=res.logprob[slot],
+                top_tokens=res.top_tokens[slot],
+                top_logprobs=res.top_logprobs[slot],
+            )
+        return out, errors
+
+    def generate(
+        self,
+        prompt_ids: Sequence[int],
+        decoding: Optional[DecodingParams] = None,
+        max_tokens: int = 256,
+        eos_token_ids: Optional[set] = None,
+        nonce: str = "batched",
+    ):
+        """Single-sequence convenience loop over the batched program (tests /
+        parity with LocalEngine.generate)."""
+        decoding = decoding or DecodingParams()
+        eos = eos_token_ids or set()
+        self.end_session(nonce)
+        res = self.prefill_and_sample(nonce, prompt_ids, decoding)
+        token = int(res.token[0])
+        yield self.token_result(nonce, res, step=0, decoding=decoding)
+        if token in eos:
+            self.end_session(nonce)
+            return
+        for step in range(1, max_tokens):
+            if self.pos[self.slot_of[nonce]] >= self.max_seq:
+                break
+            res_map, errs = self.decode_batch({nonce: (token, decoding)})
+            if errs:
+                raise RuntimeError(errs[nonce])
+            res_row = res_map[nonce]
+            token = int(res_row.token[0])
+            yield self.token_result(nonce, res_row, step=step, decoding=decoding)
+            if token in eos:
+                break
+        self.end_session(nonce)

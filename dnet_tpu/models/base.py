@@ -100,6 +100,9 @@ class RingModel(abc.ABC):
     # ops.quant.dq sets False and the engine fails fast.  Every current
     # family supports it.
     supports_weight_quant: bool = True
+    # apply_window honors the kv_commit gate (required by the pipelined-ring
+    # mesh program and continuous batching); deepseek_v2 doesn't yet
+    supports_kv_commit: bool = True
     # per-layer param names eligible for weight-only quantization (the big
     # matmuls; norms/biases/routers stay float).  Subclasses override.
     quant_keys: frozenset = frozenset(QUANTIZABLE)

@@ -35,6 +35,7 @@ from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
 
 class DeepseekV2RingModel(RingModel):
     model_type = "deepseek_v2"
+    supports_kv_commit = False  # apply_window rejects kv_commit (pp-only)
     quant_keys = frozenset(
         {"wq", "wq_a", "wq_b", "wkv_a", "wkv_b", "wo",  # MLA projections
          "w_gate", "w_up", "w_down",  # dense mlp
