@@ -7,9 +7,10 @@ quantized (the serving configuration — enable with --weight-quant-bits 8 /
 DNET_API_WEIGHT_QUANT_BITS=8; pass --bf16 here for unquantized),
 synthetic weights (zero-egress: no checkpoint downloads), batch 1, greedy
 decode fused with lax.scan.  vs_baseline is the fraction of the single-chip
-HBM-bandwidth roofline (weights_bytes / HBM_BW bounds decode tok/s for
-batch 1): an honest hardware-relative score while the reference publishes
-no numbers (BASELINE.md "none published").
+HBM-bandwidth roofline (weights are read once per step, so the aggregate
+bound is batch * HBM_BW / weights_bytes; --batch N measures N lanes): an
+honest hardware-relative score while the reference publishes no numbers
+(BASELINE.md "none published").
 """
 
 from __future__ import annotations
@@ -30,6 +31,16 @@ def main() -> None:
     from dnet_tpu.utils.random_init import LLAMA_3_2_1B_CONFIG, random_llama_params
 
     bits = 0 if "--bf16" in sys.argv else (4 if "--int4" in sys.argv else 8)
+    batch = 1
+    if "--batch" in sys.argv:  # aggregate throughput: N sequences per step
+        try:
+            batch = int(sys.argv[sys.argv.index("--batch") + 1])
+        except (IndexError, ValueError):
+            print(json.dumps({"error": "--batch requires an integer"}))
+            raise SystemExit(2)
+        if batch < 1:
+            print(json.dumps({"error": "--batch must be >= 1"}))
+            raise SystemExit(2)
     cfg_dict = dict(LLAMA_3_2_1B_CONFIG)
     if "--smoke" in sys.argv:  # tiny shapes: code-path validation on CPU
         cfg_dict.update(
@@ -52,7 +63,7 @@ def main() -> None:
         # device-resident: leaving numpy here would re-upload every step
         window = jax.tree.map(jnp.asarray, window)
     max_seq = 1024
-    kv = init_cache(model.kv_config(len(layers), 1, max_seq, "bfloat16"))
+    kv = init_cache(model.kv_config(len(layers), batch, max_seq, "bfloat16"))
 
     def decode_step(window_params, edge_params, token, kv, pos):
         x = model.embed(edge_params, token)
@@ -79,7 +90,7 @@ def main() -> None:
 
     step = jax.jit(decode_scan, donate_argnums=(3,))
 
-    token = jnp.ones((1, 1), dtype=jnp.int32)
+    token = jnp.ones((batch, 1), dtype=jnp.int32)
     # warmup / compile
     toks, kv = step(window, edge, token, kv, jnp.int32(0))
     toks.block_until_ready()
@@ -88,7 +99,7 @@ def main() -> None:
     toks, kv = step(window, edge, token, kv, jnp.int32(n_steps))
     toks.block_until_ready()
     dt = time.perf_counter() - t0
-    tok_s = n_steps / dt
+    tok_s = batch * n_steps / dt  # aggregate across batch lanes
 
     # single-chip HBM roofline for batch-1 decode: read all weights per token
     param_bytes = sum(
@@ -98,11 +109,15 @@ def main() -> None:
     metric = "decode_tok_s_llama1b_%s_1chip" % (
         {0: "bf16", 4: "int4", 8: "int8"}[bits]
     )
+    if batch > 1:
+        metric += f"_b{batch}"
     dev = jax.devices()[0]
     hbm_bw = {"v5e": 819e9, "v5litepod": 819e9, "v6e": 1640e9, "v4": 1228e9}.get(
         _chip_gen(dev), 819e9
     )
-    roofline = hbm_bw / param_bytes
+    # weight-bound decode bound: weights are read once per STEP, so N batch
+    # lanes share one read — the aggregate bound scales with batch
+    roofline = batch * hbm_bw / param_bytes
     print(
         json.dumps(
             {
