@@ -49,6 +49,7 @@ class LocalModelManager:
         param_dtype: str = "bfloat16",
         mesh: Optional[dict] = None,  # {"pp","tp","dp","sp"} -> MeshEngine
         weight_quant_bits: int = 0,
+        weight_quant_group: int = 0,
         kv_bits: int = 0,
         batch_slots: int = 1,
     ) -> None:
@@ -57,6 +58,7 @@ class LocalModelManager:
         self.max_seq = max_seq
         self.param_dtype = param_dtype
         self.weight_quant_bits = weight_quant_bits
+        self.weight_quant_group = weight_quant_group
         self.kv_bits = kv_bits
         self.batch_slots = batch_slots
         # active when any axis is parallel or pp is left to infer (pp=0 with
@@ -87,10 +89,6 @@ class LocalModelManager:
 
             kv_dtype, kv_quant_bits = resolve_kv_bits(self.kv_bits)
             if self.mesh is not None:
-                if self.weight_quant_bits:
-                    raise NotImplementedError(
-                        "weight quantization + mesh engine lands next round"
-                    )
                 from dnet_tpu.parallel.engine import MeshEngine
 
                 engine = MeshEngine(
@@ -103,6 +101,8 @@ class LocalModelManager:
                     param_dtype=self.param_dtype,
                     kv_dtype=kv_dtype,
                     kv_quant_bits=kv_quant_bits,
+                    weight_quant_bits=self.weight_quant_bits,
+                    quant_group=self.weight_quant_group,
                 )
             elif self.batch_slots > 1:
                 from dnet_tpu.core.batch import BatchedEngine
@@ -115,6 +115,7 @@ class LocalModelManager:
                     kv_dtype=kv_dtype,
                     kv_quant_bits=kv_quant_bits,
                     weight_quant_bits=self.weight_quant_bits,
+                    weight_quant_group=self.weight_quant_group,
                 )
             else:
                 from dnet_tpu.core.engine import LocalEngine
@@ -126,6 +127,7 @@ class LocalModelManager:
                     kv_dtype=kv_dtype,
                     kv_quant_bits=kv_quant_bits,
                     weight_quant_bits=self.weight_quant_bits,
+                    weight_quant_group=self.weight_quant_group,
                 )
             return engine, load_tokenizer(model_dir)
 

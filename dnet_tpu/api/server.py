@@ -46,6 +46,7 @@ async def serve_async(args) -> None:
         param_dtype=s.api.param_dtype,
         mesh=mesh,
         weight_quant_bits=weight_quant_bits,
+        weight_quant_group=s.api.weight_quant_group,
         kv_bits=s.kv.bits,
         batch_slots=batch_slots,
     )

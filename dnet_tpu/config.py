@@ -212,6 +212,10 @@ class ApiSettings(_EnvGroup):
     # quantization (per-group symmetric, ops/quant.py) — ~2x / ~4x decode
     # roofline on HBM-bound batch-1 serving
     weight_quant_bits: int = 0
+    # quantization group size along the contraction dim (0 = quantizer
+    # default: 128 for int8, 64 for int4).  Tensor-parallel serving needs a
+    # value dividing in/tp for every quantized weight.
+    weight_quant_group: int = 0
     # >1 = continuous batching: that many KV slots share one vmapped decode
     # program (core/batch.py); concurrent requests coalesce per step
     batch_slots: int = 1

@@ -71,6 +71,7 @@ class LocalEngine:
         repack_dir: Optional[str] = None,
         kv_quant_bits: int = 0,
         weight_quant_bits: int = 0,
+        weight_quant_group: int = 0,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -83,6 +84,7 @@ class LocalEngine:
         self.kv_dtype = kv_dtype or param_dtype
         self.kv_quant_bits = kv_quant_bits
         self.weight_quant_bits = weight_quant_bits
+        self.weight_quant_group = weight_quant_group
         if weight_quant_bits not in (0, 4, 8):
             raise NotImplementedError(
                 "weight quantization supports 4 (packed int4) or 8 (int8) bits"
@@ -135,6 +137,7 @@ class LocalEngine:
                 param_dtype=str(self.param_dtype),
                 repack_dir=self._repack_dir,
                 weight_quant_bits=self.weight_quant_bits,
+                weight_quant_group=self.weight_quant_group,
             )
             self.weight_cache = WeightCache(store, max_resident=self.plan.residency)
             w = self.plan.window_size
@@ -148,7 +151,8 @@ class LocalEngine:
             stacked = m.stack_layers(per_layer)
             if self.weight_quant_bits:
                 stacked = m.quantize_params(
-                    stacked, self.weight_quant_bits, scale_dtype=self.param_dtype
+                    stacked, self.weight_quant_bits, scale_dtype=self.param_dtype,
+                    group_size=self.weight_quant_group,
                 )
             self.window_params = self._cast(stacked)
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())

@@ -84,6 +84,7 @@ class HostLayerStore:
         param_dtype: str = "bfloat16",
         repack_dir: Optional[str | Path] = None,
         weight_quant_bits: int = 0,
+        weight_quant_group: int = 0,
     ) -> None:
         self.ckpt = ckpt
         self.model = model
@@ -91,13 +92,14 @@ class HostLayerStore:
             __import__("ml_dtypes").bfloat16 if param_dtype == "bfloat16" else param_dtype
         )
         self.weight_quant_bits = weight_quant_bits
+        self.weight_quant_group = weight_quant_group
         self._cache: Dict[int, Dict[str, np.ndarray]] = {}
         self._lock = threading.Lock()
         self.repack_path: Optional[Path] = None
         if repack_dir is not None:
             tag = Path(ckpt.dir).name
             key = hashlib.sha1(
-                f"v3:{param_dtype}:wq{weight_quant_bits}:"
+                f"v3:{param_dtype}:wq{weight_quant_bits}g{weight_quant_group}:"
                 f"{','.join(map(str, model.layers))}".encode()
             ).hexdigest()[:10]
             self.repack_path = Path(repack_dir).expanduser() / tag / key
@@ -143,6 +145,7 @@ class HostLayerStore:
                 self.model.quant_keys,
                 scale_dtype=self.param_dtype,
                 bits=self.weight_quant_bits,
+                group_size=self.weight_quant_group,
             )
         mapped = self._cast(mapped)
         log.info(

@@ -203,14 +203,16 @@ class RingModel(abc.ABC):
         keys = per_layer[0].keys()
         return {k: np.stack([p[k] for p in per_layer], axis=0) for k in keys}
 
-    def quantize_params(self, stacked, bits: int, scale_dtype=None):
+    def quantize_params(self, stacked, bits: int, scale_dtype=None, group_size: int = 0):
         """Weight-only quantize a stacked param pytree (engine fit path).
         Default covers the flat stacked-dict layout; list-layout models
-        override."""
+        override.  group_size=0 uses the quantizer default; tensor-parallel
+        serving passes a size that divides the per-rank contraction dim."""
         from dnet_tpu.ops.quant import quantize_tree
 
         return quantize_tree(
-            stacked, self.quant_keys, bits=bits, scale_dtype=scale_dtype
+            stacked, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
+            group_size=group_size,
         )
 
     def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):

@@ -235,12 +235,15 @@ class DeepseekV2RingModel(RingModel):
         """Heterogeneous layers (dense vs MoE): keep a list, no stacking."""
         return {"layers": list(per_layer)}
 
-    def quantize_params(self, stacked, bits: int, scale_dtype=None):
+    def quantize_params(self, stacked, bits: int, scale_dtype=None, group_size: int = 0):
         from dnet_tpu.ops.quant import quantize_tree
 
         return {
             "layers": [
-                quantize_tree(p, self.quant_keys, bits=bits, scale_dtype=scale_dtype)
+                quantize_tree(
+                    p, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
+                    group_size=group_size,
+                )
                 for p in stacked["layers"]
             ]
         }

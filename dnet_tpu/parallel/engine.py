@@ -61,6 +61,8 @@ class MeshEngine:
         kv_quant_bits: int = 0,
         kv_ttl_s: float = 600.0,
         devices: Optional[Sequence] = None,
+        weight_quant_bits: int = 0,
+        quant_group: int = 0,  # 0 = quantizer default; must divide in/tp
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -83,6 +85,12 @@ class MeshEngine:
         self.param_dtype = jnp.dtype(param_dtype)
         self.kv_dtype = kv_dtype or param_dtype
         self.kv_quant_bits = kv_quant_bits
+        self.weight_quant_bits = weight_quant_bits
+        self.quant_group = quant_group
+        if weight_quant_bits and not self.model.supports_weight_quant:
+            raise NotImplementedError(
+                f"weight quantization not supported for {self.config.model_type}"
+            )
         self.kv_ttl_s = kv_ttl_s
         self.sessions: Dict[str, Session] = {}
         self.plan = type("plan", (), {"streams_weights": False, "name": "fit"})()
@@ -96,12 +104,43 @@ class MeshEngine:
             self.config.model_type, pp, tp, dp, sp, pp * tp * dp * sp,
         )
 
+    def _check_quant_sharding(self, stacked: dict) -> None:
+        """Fail fast with an actionable message when the scale-group axis of
+        an in-sharded (row-parallel) weight cannot split over tp — otherwise
+        the error surfaces as an opaque NamedSharding divisibility failure
+        deep in place_ring_state."""
+        from dnet_tpu.ops.quant import is_quantized
+        from dnet_tpu.parallel.mesh import _ROW_PARALLEL
+
+        if self.tp <= 1:
+            return
+        for name, w in stacked.items():
+            if name in _ROW_PARALLEL and is_quantized(w):
+                g = w["s"].shape[-2]
+                if g % self.tp != 0:
+                    raise ValueError(
+                        f"weight {name!r} has {g} dequant scale groups, not "
+                        f"divisible by tp={self.tp}: pass quant_group=G with "
+                        f"G dividing in/tp (e.g. DNET_API_WEIGHT_QUANT_GROUP)"
+                    )
+
     # ---- loading ------------------------------------------------------
     def _load_params(self) -> None:
         t0 = time.perf_counter()
         m = self.model
         per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
         stacked = m.stack_layers(per_layer)
+        if self.weight_quant_bits:
+            # quantize raw values; the TP/PP shardings apply unchanged to the
+            # {"q"/"q4","s"} leaves (scales share the weight's axis layout),
+            # and groups stay rank-local because quant_group divides in/tp
+            stacked = m.quantize_params(
+                stacked,
+                self.weight_quant_bits,
+                scale_dtype=self.param_dtype,
+                group_size=self.quant_group,
+            )
+            self._check_quant_sharding(stacked)
 
         def cast(a):
             arr = np.asarray(a)

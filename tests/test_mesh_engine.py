@@ -116,3 +116,27 @@ def test_sp_long_prefill_crosses_shard_boundary(local, tiny_llama_dir, eight_dev
     got = np.asarray(eng.prefill("b", ids), np.float32)
     eng.end_session("b")
     np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_quantized_mesh_generates_close(local, tiny_llama_dir, eight_devices):
+    """int8 weights sharded over pp x tp: the TP/PP PartitionSpecs apply to
+    the {"q","s"} leaves and per-rank dequant groups stay whole."""
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    eng = MeshEngine(
+        tiny_llama_dir, pp=2, tp=2, max_seq=64, param_dtype="float32",
+        weight_quant_bits=8, quant_group=32,  # divides in/tp for tiny dims
+    )
+    ids = [256, 72, 101, 108]
+    got = [
+        r.token_id
+        for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+    ]
+    # int8 quantized vs the bf16 local reference: same top-1 on the tiny
+    # model (quantized-vs-quantized exactness is covered by the fit/offload
+    # parity tests; here the point is the sharded dequant path runs)
+    ref = [
+        r.token_id
+        for r in local.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+    ]
+    assert got == ref
