@@ -59,6 +59,8 @@ class ApiHTTPServer:
         self.cluster_manager = cluster_manager
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
+        self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_post("/v1/embeddings", self.embeddings)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_post("/v1/load_model", self.load_model)
         self.app.router.add_post("/v1/unload_model", self.unload_model)
@@ -82,17 +84,11 @@ class ApiHTTPServer:
             await self._runner.cleanup()
             self._runner = None
 
-    # ---- handlers -----------------------------------------------------
-    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
-        try:
-            body = await request.json()
-            req = ChatCompletionRequest.model_validate(body)
-        except (json.JSONDecodeError, ValidationError) as exc:
-            return _json_error(400, f"invalid request: {exc}")
-
+    # ---- decode-endpoint scaffolding ---------------------------------
+    def _gate(self):
+        """Shared admission checks for decode endpoints (None = admitted)."""
         if not self.inference.ready:
             return _json_error(400, "no model loaded; POST /v1/load_model first")
-
         monitor = self.inference.failure_monitor
         if monitor is not None and monitor.degraded:
             return _json_error(
@@ -100,44 +96,128 @@ class ApiHTTPServer:
                 f"ring degraded: shard(s) {monitor.down_shards()} down",
                 "service_unavailable",
             )
+        return None
+
+    async def _sse(self, request, req, reshape) -> web.StreamResponse:
+        """Stream the decode chunks as SSE; `reshape(chunk) -> [json str]`."""
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        try:
+            async for chunk in self.inference.generate_stream(req):
+                for payload in reshape(chunk):
+                    await resp.write(f"data: {payload}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except PromptTooLongError as exc:
+            err = json.dumps(
+                {"error": {"message": str(exc), "type": "invalid_request_error"}}
+            )
+            await resp.write(f"data: {err}\n\n".encode())
+        except InferenceError as exc:
+            err = json.dumps({"error": {"message": str(exc), "type": "server_error"}})
+            await resp.write(f"data: {err}\n\n".encode())
+        except ConnectionResetError:
+            log.info("client disconnected mid-stream")
+        await resp.write_eof()
+        return resp
+
+    @staticmethod
+    def _map_inference_errors(exc: Exception):
+        if isinstance(exc, PromptTooLongError):
+            return _json_error(400, str(exc))
+        if isinstance(exc, ServiceDegradedError):
+            return _json_error(503, str(exc), "service_unavailable")
+        if isinstance(exc, InferenceError):
+            return _json_error(500, str(exc), "server_error")
+        raise exc
+
+    # ---- handlers -----------------------------------------------------
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            req = ChatCompletionRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return _json_error(400, f"invalid request: {exc}")
+        gate = self._gate()
+        if gate is not None:
+            return gate
 
         if req.stream:
-            resp = web.StreamResponse(
-                status=200,
-                headers={
-                    "Content-Type": "text/event-stream",
-                    "Cache-Control": "no-cache",
-                    "Connection": "keep-alive",
-                },
+            return await self._sse(
+                request, req, lambda c: [c.model_dump_json(exclude_none=True)]
             )
-            await resp.prepare(request)
-            try:
-                async for chunk in self.inference.generate_stream(req):
-                    payload = chunk.model_dump_json(exclude_none=True)
-                    await resp.write(f"data: {payload}\n\n".encode())
-                await resp.write(b"data: [DONE]\n\n")
-            except PromptTooLongError as exc:
-                err = json.dumps(
-                    {"error": {"message": str(exc), "type": "invalid_request_error"}}
-                )
-                await resp.write(f"data: {err}\n\n".encode())
-            except InferenceError as exc:
-                err = json.dumps({"error": {"message": str(exc), "type": "server_error"}})
-                await resp.write(f"data: {err}\n\n".encode())
-            except ConnectionResetError:
-                log.info("client disconnected mid-stream")
-            await resp.write_eof()
-            return resp
-
         try:
             result = await self.inference.generate(req)
-        except PromptTooLongError as exc:
-            return _json_error(400, str(exc))
-        except ServiceDegradedError as exc:
-            return _json_error(503, str(exc), "service_unavailable")
-        except InferenceError as exc:
-            return _json_error(500, str(exc), "server_error")
+        except Exception as exc:
+            return self._map_inference_errors(exc)
         return web.json_response(result.model_dump(exclude_none=True))
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        """Legacy /v1/completions: raw prompt, text_completion objects."""
+        from dnet_tpu.api.inference import completion_logprobs
+        from dnet_tpu.api.schemas import CompletionRequest
+
+        try:
+            req = CompletionRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return _json_error(400, f"invalid request: {exc}")
+        gate = self._gate()
+        if gate is not None:
+            return gate
+
+        if req.stream:
+            state = {"first": True, "offset": len(req.prompt_text()) if req.echo else 0}
+
+            def reshape(chunk):
+                """Chat-style deltas -> completion chunks (echo emits the
+                prompt before the first delta; logprobs use the completions
+                shape)."""
+                out = {
+                    "id": chunk.id.replace("chatcmpl", "cmpl"),
+                    "object": "text_completion",
+                    "model": req.model,
+                    "choices": [],
+                }
+                for c in chunk.choices:
+                    text = c.delta.content or ""
+                    if state["first"] and (text or c.finish_reason):
+                        state["first"] = False
+                        if req.echo:
+                            text = req.prompt_text() + text
+                    choice = {"index": 0, "text": text, "finish_reason": c.finish_reason}
+                    if c.logprobs is not None:
+                        lp = completion_logprobs(c.logprobs.content, state["offset"])
+                        state["offset"] += sum(len(t) for t in lp.tokens)
+                        choice["logprobs"] = lp.model_dump()
+                    out["choices"].append(choice)
+                if chunk.usage:
+                    out["usage"] = chunk.usage.model_dump()
+                return [json.dumps(out)]
+
+            return await self._sse(request, req, reshape)
+        try:
+            result = await self.inference.generate_completion(req)
+        except Exception as exc:
+            return self._map_inference_errors(exc)
+        return web.json_response(result.model_dump(exclude_none=True))
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """Schema-validated but unimplemented, like the reference (its
+        embeddings schema exists in api/models.py with no serving path)."""
+        from dnet_tpu.api.schemas import EmbeddingsRequest
+
+        try:
+            EmbeddingsRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return _json_error(400, f"invalid request: {exc}")
+        return _json_error(
+            501, "embeddings are not served by this deployment", "not_implemented"
+        )
 
     async def list_models(self, request: web.Request) -> web.Response:
         data = [ModelInfo(id=e.id) for e in model_catalog]

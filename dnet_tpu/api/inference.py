@@ -47,6 +47,22 @@ class ServiceDegradedError(InferenceError):
     instead of the reference's 300s token-future timeout)."""
 
 
+def completion_logprobs(entries: list, offset0: int = 0):
+    """Chat-style LogprobEntry list -> the OpenAI text_completion logprobs
+    shape ({tokens, token_logprobs, top_logprobs, text_offset})."""
+    from dnet_tpu.api.schemas import CompletionLogprobs
+
+    out = CompletionLogprobs()
+    offset = offset0
+    for e in entries:
+        out.tokens.append(e.token)
+        out.token_logprobs.append(e.logprob)
+        out.top_logprobs.append({t.token: t.logprob for t in e.top_logprobs})
+        out.text_offset.append(offset)
+        offset += len(e.token)
+    return out
+
+
 def _holdback_len(text: str, stop_seqs: list[str]) -> int:
     """Length of the longest suffix of `text` that is a proper prefix of any
     stop sequence (must be held back — the next token may complete a stop)."""
@@ -84,7 +100,7 @@ class InferenceManager:
             top_k=req.top_k,
             min_p=req.min_p,
             repetition_penalty=req.repetition_penalty,
-            logprobs=req.logprobs,
+            logprobs=req.logprobs_enabled,
             top_logprobs=req.top_logprobs,
             seed=req.seed,
         )
@@ -123,9 +139,7 @@ class InferenceManager:
         rid = new_request_id()
         nonce = rid
         tok = self.tokenizer
-        prompt = tok.apply_chat_template(
-            [m.model_dump() for m in req.messages], add_generation_prompt=True
-        )
+        prompt = req.render_prompt(tok)  # chat template or raw (completions)
         prompt_ids = tok.encode(prompt)
         decoding = self._decoding(req)
         stop_seqs = req.stop_sequences()
@@ -199,7 +213,7 @@ class InferenceManager:
 
                 if delta or stopped:
                     logprobs = None
-                    if req.logprobs:
+                    if req.logprobs_enabled:
                         logprobs = ChoiceLogprobs(
                             content=[self._logprob_entry(result, delta)]
                         )
@@ -256,8 +270,38 @@ class InferenceManager:
         finally:
             await self.adapter.reset_cache(nonce)
 
-    async def generate(self, req: ChatCompletionRequest) -> ChatCompletionResponse:
-        """Non-streaming: aggregate the stream (reference inference.py:255-311)."""
+    async def generate_completion(self, req) -> "CompletionResponse":
+        """Legacy /v1/completions (non-streaming): aggregate the same decode
+        stream into a text_completion object."""
+        from dnet_tpu.api.schemas import CompletionChoice, CompletionResponse
+
+        rid, text, logprob_entries, finish_reason, usage, metrics = (
+            await self._collect(req)
+        )
+        offset0 = 0
+        if req.echo:
+            text = req.prompt_text() + text
+            offset0 = len(req.prompt_text())
+        return CompletionResponse(
+            id=rid.replace("chatcmpl", "cmpl"),
+            model=req.model,
+            choices=[
+                CompletionChoice(
+                    text=text,
+                    logprobs=completion_logprobs(logprob_entries, offset0)
+                    if req.logprobs_enabled
+                    else None,
+                    finish_reason=finish_reason,
+                )
+            ],
+            usage=usage,
+            metrics=metrics,
+        )
+
+    async def _collect(self, req):
+        """Drain the decode stream into (rid, text, logprob entries,
+        finish_reason, usage, metrics) — shared by both non-streaming
+        endpoints."""
         parts: list[str] = []
         logprob_entries: list[LogprobEntry] = []
         usage = Usage()
@@ -277,13 +321,20 @@ class InferenceManager:
                 usage = chunk.usage
             if chunk.metrics:
                 metrics = chunk.metrics
+        return rid, "".join(parts), logprob_entries, finish_reason, usage, metrics
+
+    async def generate(self, req: ChatCompletionRequest) -> ChatCompletionResponse:
+        """Non-streaming: aggregate the stream (reference inference.py:255-311)."""
+        rid, text, logprob_entries, finish_reason, usage, metrics = (
+            await self._collect(req)
+        )
         return ChatCompletionResponse(
             id=rid,
             model=req.model,
             choices=[
                 ChatChoice(
-                    message=ChatMessage(role="assistant", content="".join(parts)),
-                    logprobs=ChoiceLogprobs(content=logprob_entries) if req.logprobs else None,
+                    message=ChatMessage(role="assistant", content=text),
+                    logprobs=ChoiceLogprobs(content=logprob_entries) if req.logprobs_enabled else None,
                     finish_reason=finish_reason,
                 )
             ],

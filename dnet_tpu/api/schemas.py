@@ -29,9 +29,14 @@ class ChatMessage(BaseModel):
         return "".join(parts)
 
 
-class ChatCompletionRequest(BaseModel):
+class SamplingRequest(BaseModel):
+    """Shared decode-request surface: sampling knobs + stop handling.
+
+    Subclasses provide the prompt (`render_prompt`) and their
+    default-token-limit; the decode driver (api/inference.py) works only
+    against this base."""
+
     model: str
-    messages: List[ChatMessage]
     temperature: float = Field(default=1.0, ge=0.0, le=2.0)
     top_p: float = Field(default=1.0, gt=0.0, le=1.0)
     top_k: int = Field(default=0, ge=0)
@@ -42,11 +47,36 @@ class ChatCompletionRequest(BaseModel):
     stream: bool = False
     stop: Optional[Union[str, List[str]]] = None
     seed: Optional[int] = None
-    logprobs: bool = False
-    top_logprobs: int = Field(default=0, ge=0, le=20)
     n: int = Field(default=1, ge=1, le=1)  # >1 unsupported (parity w/ reference)
     user: Optional[str] = None
     profile: bool = False  # dnet extension: include perf metrics in final chunk
+
+    _default_max_tokens: int = 256
+
+    @property
+    def completion_tokens_limit(self) -> int:
+        return self.max_completion_tokens or self.max_tokens or self._default_max_tokens
+
+    def stop_sequences(self) -> List[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def render_prompt(self, tokenizer) -> str:
+        raise NotImplementedError
+
+    @property
+    def logprobs_enabled(self) -> bool:
+        """Whether per-token logprobs were requested (field semantics differ:
+        chat uses a bool, legacy completions an Optional[int] where 0 still
+        means 'chosen-token logprobs, no alternatives')."""
+        return bool(getattr(self, "logprobs", False))
+
+
+class ChatCompletionRequest(SamplingRequest):
+    messages: List[ChatMessage]
+    logprobs: bool = False
+    top_logprobs: int = Field(default=0, ge=0, le=20)
 
     @field_validator("messages")
     @classmethod
@@ -55,14 +85,80 @@ class ChatCompletionRequest(BaseModel):
             raise ValueError("messages must be non-empty")
         return v
 
-    @property
-    def completion_tokens_limit(self) -> int:
-        return self.max_completion_tokens or self.max_tokens or 256
+    def render_prompt(self, tokenizer) -> str:
+        return tokenizer.apply_chat_template(
+            [m.model_dump() for m in self.messages], add_generation_prompt=True
+        )
 
-    def stop_sequences(self) -> List[str]:
-        if self.stop is None:
-            return []
-        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+class CompletionRequest(SamplingRequest):
+    """Legacy /v1/completions: a raw text prompt, no chat template
+    (reference api/models.py carries the same schema family)."""
+
+    prompt: Union[str, List[str]]
+    # OpenAI completions: null disables; 0 = chosen-token logprobs only;
+    # k > 0 adds the top-k alternatives
+    logprobs: Optional[int] = Field(default=None, ge=0, le=20)
+    echo: bool = False
+
+    _default_max_tokens: int = 16
+
+    @field_validator("prompt")
+    @classmethod
+    def _single_prompt(cls, v):
+        if isinstance(v, list):
+            if len(v) != 1:
+                raise ValueError("batch prompts unsupported; send one prompt")
+            if not isinstance(v[0], str):
+                raise ValueError("prompt must be a string")
+        return v
+
+    def prompt_text(self) -> str:
+        return self.prompt[0] if isinstance(self.prompt, list) else self.prompt
+
+    def render_prompt(self, tokenizer) -> str:
+        return self.prompt_text()
+
+    @property
+    def top_logprobs(self) -> int:
+        return self.logprobs or 0
+
+    @property
+    def logprobs_enabled(self) -> bool:
+        return self.logprobs is not None
+
+
+class EmbeddingsRequest(BaseModel):
+    model: str
+    input: Union[str, List[str], List[int], List[List[int]]]
+    encoding_format: Literal["float", "base64"] = "float"
+    user: Optional[str] = None
+
+
+class CompletionLogprobs(BaseModel):
+    """OpenAI text_completion logprobs shape (NOT the chat shape)."""
+
+    tokens: List[str] = Field(default_factory=list)
+    token_logprobs: List[Optional[float]] = Field(default_factory=list)
+    top_logprobs: List[Dict[str, float]] = Field(default_factory=list)
+    text_offset: List[int] = Field(default_factory=list)
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    logprobs: Optional[CompletionLogprobs] = None
+    finish_reason: Optional[str] = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: str = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[CompletionChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+    metrics: Optional[RequestMetrics] = None
 
 
 class Usage(BaseModel):

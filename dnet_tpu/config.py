@@ -230,6 +230,8 @@ class ShardSettings(_EnvGroup):
     queue_size: int = 256
     name: str = ""
     models_dir: str = "~/.dnet-tpu/models"
+    # per-layer repack cache for weight streaming (reference repack.py)
+    repack_dir: str = "~/.dnet-tpu/repacked"
 
 
 @dataclass

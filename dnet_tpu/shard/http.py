@@ -56,6 +56,7 @@ class ShardHTTPServer:
         self.app.router.add_post("/unload_model", self.unload_model)
         self.app.router.add_post("/measure_latency", self.measure_latency)
         self.app.router.add_post("/profile", self.profile)
+        self.app.router.add_post("/cleanup_repacked", self.cleanup_repacked)
         self._runner: Optional[web.AppRunner] = None
 
     async def start(self, host: str, port: int) -> None:
@@ -111,6 +112,53 @@ class ShardHTTPServer:
     async def unload_model(self, request: web.Request) -> web.Response:
         await self.shard.unload_model()
         return web.json_response({"status": "ok"})
+
+    async def cleanup_repacked(self, request: web.Request) -> web.Response:
+        """Delete repack caches: the current model's subtree when a model is
+        loaded, otherwise the whole cache dir (reference
+        shard/http_api.py:222-336 + utils/repack.py:220-313)."""
+        import asyncio
+        import shutil
+        from pathlib import Path
+
+        from dnet_tpu.config import get_settings
+
+        rt = self.shard.runtime
+
+        def cleanup():
+            # under the model lock: a concurrent /load_model can't be mid-
+            # construction (it holds the same lock), so the streams check and
+            # the delete are atomic w.r.t. loads
+            with rt._model_lock:
+                compute = rt.compute
+                if compute is not None and compute.engine.plan.streams_weights:
+                    return None, 0  # refuse: live engine reads this cache
+                base = Path(get_settings().shard.repack_dir).expanduser()
+                target = base
+                if rt.model_path:
+                    target = base / Path(rt.model_path).name
+                freed = 0
+                if target.is_dir():
+                    freed = sum(
+                        f.stat().st_size for f in target.rglob("*") if f.is_file()
+                    )
+                    shutil.rmtree(target, ignore_errors=True)
+                return str(target), freed
+
+        loop = asyncio.get_running_loop()
+        removed, freed = await loop.run_in_executor(None, cleanup)
+        if removed is None:
+            return web.json_response(
+                {
+                    "status": "error",
+                    "message": "model is streaming from the repack cache; "
+                    "POST /unload_model first",
+                },
+                status=409,
+            )
+        return web.json_response(
+            {"status": "ok", "removed": removed, "freed_bytes": freed}
+        )
 
     async def measure_latency(self, request: web.Request) -> web.Response:
         """Probe each peer over gRPC with increasing payloads; return

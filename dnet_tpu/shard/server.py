@@ -57,6 +57,9 @@ class Shard:
                 residency_size=req.residency_size,
                 kv_bits=req.kv_bits,
                 weight_quant_bits=req.weight_quant_bits,
+                # engine ignores it unless plan_policy chose a streaming
+                # policy — no second copy of that decision here
+                repack_dir=get_settings().shard.repack_dir,
             ),
         )
         next_addr = f"{req.next_node.host}:{req.next_node.grpc_port}" if req.next_node else ""

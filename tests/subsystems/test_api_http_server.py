@@ -203,3 +203,72 @@ def test_stop_sequence(tiny_llama_dir):
         await client.close()
 
     run(go())
+
+
+def test_legacy_completions_and_embeddings(tiny_llama_dir):
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        r = await client.post("/v1/load_model", json={"model": str(tiny_llama_dir)})
+        assert r.status == 200, await r.text()
+
+        # non-streaming text completion (raw prompt, no chat template)
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "Hello", "max_tokens": 5,
+                  "temperature": 0},
+        )
+        assert r.status == 200, await r.text()
+        out = await r.json()
+        assert out["object"] == "text_completion"
+        assert out["id"].startswith("cmpl-")
+        assert isinstance(out["choices"][0]["text"], str)
+        assert out["usage"]["completion_tokens"] <= 5
+
+        # echo returns the prompt followed by the completion
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "Hi", "max_tokens": 2,
+                  "temperature": 0, "echo": True},
+        )
+        assert (await r.json())["choices"][0]["text"].startswith("Hi")
+
+        # streaming: text chunks then [DONE]; echo puts the prompt in the
+        # first chunk; logprobs use the completions shape
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "Hey", "max_tokens": 3,
+                  "temperature": 0, "stream": True, "echo": True,
+                  "logprobs": 2},
+        )
+        assert r.status == 200
+        raw = (await r.read()).decode()
+        assert "data: [DONE]" in raw
+        assert '"object": "text_completion"' in raw
+        first = json.loads(raw.split("data: ")[1].split("\n")[0])
+        assert first["choices"][0]["text"].startswith("Hey")
+        assert "token_logprobs" in raw and "text_offset" in raw
+
+        # non-streaming logprobs: OpenAI completions shape
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "Yo", "max_tokens": 2,
+                  "temperature": 0, "logprobs": 1},
+        )
+        lp = (await r.json())["choices"][0]["logprobs"]
+        assert set(lp) == {"tokens", "token_logprobs", "top_logprobs", "text_offset"}
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == len(lp["text_offset"])
+
+        # batch prompts rejected; embeddings schema-validated but 501
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": ["a", "b"], "max_tokens": 1},
+        )
+        assert r.status == 400
+        r = await client.post("/v1/embeddings", json={"model": "tiny", "input": "x"})
+        assert r.status == 501
+        r = await client.post("/v1/embeddings", json={"model": "tiny"})
+        assert r.status == 400
+        await client.close()
+
+    run(go())
