@@ -52,6 +52,7 @@ class LocalModelManager:
         weight_quant_group: int = 0,
         kv_bits: int = 0,
         batch_slots: int = 1,
+        prefix_cache: int = 0,
     ) -> None:
         self.inference = inference_manager
         self.models_dir = models_dir
@@ -61,6 +62,7 @@ class LocalModelManager:
         self.weight_quant_group = weight_quant_group
         self.kv_bits = kv_bits
         self.batch_slots = batch_slots
+        self.prefix_cache = prefix_cache
         # active when any axis is parallel or pp is left to infer (pp=0 with
         # another axis set, or an explicit pp)
         self.mesh = mesh if mesh and (any(v > 1 for v in mesh.values()) or mesh.get("pp", 0) > 1) else None
@@ -89,6 +91,11 @@ class LocalModelManager:
 
             kv_dtype, kv_quant_bits = resolve_kv_bits(self.kv_bits)
             if self.mesh is not None:
+                if self.prefix_cache:
+                    log.warning(
+                        "DNET_API_PREFIX_CACHE is not supported by the mesh "
+                        "engine; disabled"
+                    )
                 from dnet_tpu.parallel.engine import MeshEngine
 
                 engine = MeshEngine(
@@ -116,6 +123,7 @@ class LocalModelManager:
                     kv_quant_bits=kv_quant_bits,
                     weight_quant_bits=self.weight_quant_bits,
                     weight_quant_group=self.weight_quant_group,
+                    prefix_cache_size=self.prefix_cache,
                 )
             else:
                 from dnet_tpu.core.engine import LocalEngine
@@ -128,6 +136,7 @@ class LocalModelManager:
                     kv_quant_bits=kv_quant_bits,
                     weight_quant_bits=self.weight_quant_bits,
                     weight_quant_group=self.weight_quant_group,
+                    prefix_cache_size=self.prefix_cache,
                 )
             return engine, load_tokenizer(model_dir)
 

@@ -49,6 +49,7 @@ async def serve_async(args) -> None:
         weight_quant_group=s.api.weight_quant_group,
         kv_bits=s.kv.bits,
         batch_slots=batch_slots,
+        prefix_cache=s.api.prefix_cache,
     )
 
     cluster_manager = None

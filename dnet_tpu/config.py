@@ -219,6 +219,11 @@ class ApiSettings(_EnvGroup):
     # >1 = continuous batching: that many KV slots share one vmapped decode
     # program (core/batch.py); concurrent requests coalesce per step
     batch_slots: int = 1
+    # >0 = cache that many full-prompt KV snapshots; a request whose prompt
+    # EXTENDS a cached prompt (multi-turn chat resending its history)
+    # prefills only the new suffix (core/prefix_cache.py).  Exact-prefix
+    # match; each snapshot is a full KV alloc.  Local/batched engines only.
+    prefix_cache: int = 0
 
 
 @dataclass

@@ -72,6 +72,7 @@ class LocalEngine:
         kv_quant_bits: int = 0,
         weight_quant_bits: int = 0,
         weight_quant_group: int = 0,
+        prefix_cache_size: int = 0,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -104,6 +105,18 @@ class LocalEngine:
         self._repack_dir = repack_dir
         self.weight_cache = None
         self._windows: list[list[int]] = []
+        self.prefix_cache = None
+        if prefix_cache_size > 0:
+            if self.plan.streams_weights or shard_mode:
+                log.warning(
+                    "prefix cache requested but unsupported for %s engines; "
+                    "disabled",
+                    "weight-streaming" if self.plan.streams_weights else "shard",
+                )
+            else:
+                from dnet_tpu.core.prefix_cache import PrefixCache
+
+                self.prefix_cache = PrefixCache(prefix_cache_size)
 
         self._load_params()
         self._build_fns()
@@ -262,32 +275,37 @@ class LocalEngine:
         return x
 
     # ---- sessions -----------------------------------------------------
-    def new_session(self, nonce: str, seed: Optional[int] = None) -> Session:
+    def new_session(
+        self, nonce: str, seed: Optional[int] = None, kv=None, pos: int = 0
+    ) -> Session:
+        """kv/pos: seed the session from a prefix-cache snapshot instead of
+        allocating + zero-filling a fresh cache it would immediately drop."""
         if seed is None:
             # fresh entropy per unseeded request — two users must not share a stream
             seed = int.from_bytes(__import__("os").urandom(4), "little")
-        if self.plan.streams_weights:
-            kv, kv_list = None, [
-                init_cache(
+        kv_list = None
+        if kv is None:
+            if self.plan.streams_weights:
+                kv_list = [
+                    init_cache(
+                        self.model.kv_config(
+                            1, self.batch, self.max_seq, self.kv_dtype,
+                            quant_bits=self.kv_quant_bits,
+                        )
+                    )
+                    for _ in self.model.layers
+                ]
+            else:
+                kv = init_cache(
                     self.model.kv_config(
-                        1, self.batch, self.max_seq, self.kv_dtype,
+                        len(self.model.layers), self.batch, self.max_seq, self.kv_dtype,
                         quant_bits=self.kv_quant_bits,
                     )
                 )
-                for _ in self.model.layers
-            ]
-        else:
-            kv = init_cache(
-                self.model.kv_config(
-                    len(self.model.layers), self.batch, self.max_seq, self.kv_dtype,
-                    quant_bits=self.kv_quant_bits,
-                )
-            )
-            kv_list = None
         sess = Session(
             kv=kv,
             kv_list=kv_list,
-            pos=0,
+            pos=pos,
             key=jax.random.key(seed),
             counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
         )
@@ -318,14 +336,33 @@ class LocalEngine:
 
         Reusing a live session continues at sess.pos (chunked prefill).
         """
-        sess = self.sessions.get(nonce) or self.new_session(nonce, seed)
-        T = len(prompt_ids)
-        if T == 0:
+        full_ids = list(prompt_ids)
+        if not full_ids:
             raise ValueError("empty prompt")
-        if sess.pos + T > self.max_seq:
+        sess = self.sessions.get(nonce)
+        fresh = sess is None
+        # validate against the FULL prompt before any session mutation: a
+        # too-long prompt must not leave a half-restored session behind
+        start = 0 if sess is None else sess.pos
+        if start + len(full_ids) > self.max_seq:
             raise ValueError(
-                f"prompt length {sess.pos + T} exceeds max_seq {self.max_seq}"
+                f"prompt length {start + len(full_ids)} exceeds max_seq {self.max_seq}"
             )
+        if sess is None:
+            hit = (
+                self.prefix_cache.lookup(full_ids)
+                if self.prefix_cache is not None
+                else None
+            )
+            if hit is not None:
+                n, kv_copy = hit
+                sess = self.new_session(nonce, seed, kv=kv_copy, pos=n)
+                prompt_ids = full_ids[n:]  # >= 1 token left by construction
+            else:
+                sess = self.new_session(nonce, seed)
+        else:
+            fresh = sess.pos == 0  # explicit chunked continuation
+        T = len(prompt_ids)
         # the PADDED width must also fit — dynamic_update_slice would clamp
         # the start index and silently shift the whole KV write otherwise
         Tpad = min(bucket_length(T), self.max_seq - sess.pos)
@@ -347,6 +384,10 @@ class LocalEngine:
         # both serving paths must share this definition to stay equivalent.
         sess.pos += T
         sess.last_used = time.time()
+        if self.prefix_cache is not None and fresh and sess.pos == len(full_ids):
+            # snapshot the full-prompt KV (copied: step fns donate their kv;
+            # the cache itself skips prompts below its min_tokens threshold)
+            self.prefix_cache.store(full_ids, sess.kv)
         return logits
 
     def decode_step(self, nonce: str, token_id: int, decoding: DecodingParams) -> SampleResult:
@@ -386,9 +427,10 @@ class LocalEngine:
         decoding = decoding or DecodingParams()
         eos = eos_token_ids or set()
         self.end_session(nonce)
-        sess = self.new_session(nonce, decoding.seed)
-
+        # session is created by prefill (which may seed it from the prefix
+        # cache); the seed flows via prefill_and_sample
         res = self.prefill_and_sample(nonce, prompt_ids, decoding)
+        sess = self.sessions[nonce]
         token = int(res.token[0])
         yield self.token_result(nonce, res, step=0, decoding=decoding)
         if token in eos:

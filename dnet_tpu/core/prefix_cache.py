@@ -1,0 +1,84 @@
+"""Prefix caching: reuse KV for repeated prompt prefixes.
+
+The reference re-feeds every prompt from scratch ("a KV cache is not
+checkpointed; a full prompt re-feed happens per request", SURVEY.md §5
+checkpoint/resume).  Matching is EXACT-prefix over full stored prompts, so
+the win is multi-turn chat: every follow-up request resends the grown
+history verbatim, hits the previous turn's snapshot, and prefills only the
+new turn — O(new-suffix) instead of O(history), directly cutting TTFT.
+(Two different conversations sharing only a system preamble do NOT match;
+prefix checkpoints at message boundaries are a possible extension.)
+
+Design:
+- A tiny LRU of full-prompt KV snapshots, keyed by the prompt's token ids.
+- Lookup returns the LONGEST cached entry that is a strict proper prefix of
+  (or equal to, minus at least one token of) the new prompt, so the engine
+  always has >= 1 token left to prefill (the forward pass must produce the
+  last position's logits).
+- Snapshots are defensive COPIES both ways: engine step functions donate
+  their KV argument, so handing out (or keeping) a shared buffer would be
+  invalidated by the next decode step.
+- Memory: each snapshot is a full KV allocation; capacity is small and
+  opt-in (DNET_API_PREFIX_CACHE).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+
+def _copy_tree(tree):
+    return jax.tree.map(lambda a: a.copy(), tree)
+
+
+class PrefixCache:
+    def __init__(self, capacity: int, min_tokens: int = 16) -> None:
+        self.capacity = capacity
+        self.min_tokens = min_tokens  # tiny prompts aren't worth a snapshot
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple[int, ...], dict]" = OrderedDict()
+        # prompt ids -> kv snapshot (repetition counts are zero at prefill
+        # end — they track generated tokens only — so KV is the whole state)
+        self.stats = {"hits": 0, "misses": 0, "stores": 0}
+
+    def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, dict]]:
+        """Longest cached prefix covering at most len(prompt)-1 tokens.
+        Returns (n_tokens, kv copy) or None."""
+        ids = tuple(prompt_ids)
+        with self._lock:
+            best = None
+            for key in self._entries:
+                if len(key) < (best and len(best) or 1):
+                    continue
+                # proper prefix with at least one token left to prefill
+                if len(key) <= len(ids) - 1 and ids[: len(key)] == key:
+                    if best is None or len(key) > len(best):
+                        best = key
+            if best is None:
+                self.stats["misses"] += 1
+                return None
+            kv = self._entries[best]
+            self._entries.move_to_end(best)
+            self.stats["hits"] += 1
+        return len(best), _copy_tree(kv)
+
+    def store(self, prompt_ids: Sequence[int], kv: dict) -> None:
+        ids = tuple(prompt_ids)
+        if len(ids) < self.min_tokens:
+            return
+        with self._lock:
+            if ids in self._entries:
+                self._entries.move_to_end(ids)
+                return
+            self._entries[ids] = _copy_tree(kv)
+            self.stats["stores"] += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

@@ -1,0 +1,129 @@
+"""Prefix caching: hit/miss mechanics and logits parity with cold prefill."""
+
+import numpy as np
+import pytest
+
+from dnet_tpu.core.types import DecodingParams
+
+pytestmark = pytest.mark.core
+
+
+def test_lookup_semantics():
+    import jax.numpy as jnp
+
+    from dnet_tpu.core.prefix_cache import PrefixCache
+
+    pc = PrefixCache(capacity=2, min_tokens=1)
+    kv = {"k": jnp.zeros((2, 2))}
+    pc.store([1, 2, 3], kv)
+    # exact prompt: no hit (at least one token must remain to prefill)
+    assert pc.lookup([1, 2, 3]) is None
+    # longer prompt with the cached prefix: hit
+    n, got = pc.lookup([1, 2, 3, 4])
+    assert n == 3 and got["k"].shape == (2, 2)
+    # diverging prompt: miss
+    assert pc.lookup([1, 9, 3, 4]) is None
+    # LRU eviction at capacity
+    pc.store([5, 6], kv)
+    pc.store([7, 8], kv)
+    assert pc.lookup([1, 2, 3, 4]) is None  # evicted (oldest)
+    assert pc.lookup([5, 6, 0]) is not None
+
+
+def test_prefill_hit_matches_cold(tiny_llama_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    system = [256, 83, 89, 83, 84, 69, 77]  # shared "system prompt"
+    q1 = system + [72, 105]
+    q2 = system + [66, 121, 101]
+
+    cold = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+    ref1 = np.asarray(cold.prefill("a", q1), np.float32)
+    cold.end_session("a")
+    ref2 = np.asarray(cold.prefill("b", q2), np.float32)
+    cold.end_session("b")
+
+    warm = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype="float32", prefix_cache_size=2
+    )
+    warm.prefix_cache.min_tokens = 1  # tiny test prompts
+    got1 = np.asarray(warm.prefill("a", q1), np.float32)
+    warm.end_session("a")
+    assert warm.prefix_cache.stats == {"hits": 0, "misses": 1, "stores": 1}
+    # q2 shares only `system` with the cached full q1 prompt -> miss (q1 is
+    # not a prefix of q2), but after caching q2's own prompt, a q2 + suffix
+    # request hits
+    got2 = np.asarray(warm.prefill("b", q2), np.float32)
+    warm.end_session("b")
+    np.testing.assert_allclose(got1, ref1, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got2, ref2, atol=1e-5, rtol=1e-5)
+
+    q3 = q2 + [33]
+    got3 = np.asarray(warm.prefill("c", q3), np.float32)
+    assert warm.prefix_cache.stats["hits"] == 1
+    ref3 = np.asarray(cold.prefill("c", q3), np.float32)
+    np.testing.assert_allclose(got3, ref3, atol=1e-4, rtol=1e-4)
+
+    # decode continues correctly from a hit-restored session
+    toks_warm = [
+        r.token_id
+        for r in warm.generate(q3, DecodingParams(temperature=0.0), max_tokens=4, nonce="d")
+    ]
+    toks_cold = [
+        r.token_id
+        for r in cold.generate(q3, DecodingParams(temperature=0.0), max_tokens=4, nonce="d")
+    ]
+    assert toks_warm == toks_cold
+
+
+def test_snapshot_survives_donation(tiny_llama_dir):
+    """The cached KV must stay valid after the borrowing session decodes
+    (engine step fns donate their KV buffers)."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype="float32", prefix_cache_size=2
+    )
+    eng.prefix_cache.min_tokens = 1  # tiny test prompts
+    base = [256, 72, 101, 108]
+    list(eng.generate(base + [108], DecodingParams(temperature=0.0), max_tokens=3, nonce="a"))
+    # hit + decode (donates the restored copy)...
+    out1 = [
+        r.token_id
+        for r in eng.generate(base + [108, 111], DecodingParams(temperature=0.0), max_tokens=3, nonce="b")
+    ]
+    # ...then the SAME cached entry must serve an identical second request
+    out2 = [
+        r.token_id
+        for r in eng.generate(base + [108, 111], DecodingParams(temperature=0.0), max_tokens=3, nonce="c")
+    ]
+    assert out1 == out2
+    assert eng.prefix_cache.stats["hits"] >= 2
+
+
+def test_too_long_prompt_leaves_no_poisoned_session(tiny_llama_dir):
+    """A hit-eligible but over-length prompt must fail cleanly: no session
+    is left behind at a nonzero position (a retry would silently prefill at
+    the stale offset)."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(
+        tiny_llama_dir, max_seq=32, param_dtype="float32", prefix_cache_size=2
+    )
+    eng.prefix_cache.min_tokens = 1
+    base = list(range(1, 21))
+    eng.prefill("a", base)
+    eng.end_session("a")
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        eng.prefill("b", base + list(range(21, 41)))  # 40 > 32
+    assert "b" not in eng.sessions
+    assert eng.prefix_cache.stats["hits"] == 0  # rejected before lookup
+
+def test_tiny_prompts_not_stored():
+    import jax.numpy as jnp
+
+    from dnet_tpu.core.prefix_cache import PrefixCache
+
+    pc = PrefixCache(capacity=2, min_tokens=16)
+    pc.store(list(range(8)), {"k": jnp.zeros((1,))})
+    assert pc.stats["stores"] == 0
