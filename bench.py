@@ -21,8 +21,38 @@ import time
 
 
 def main() -> None:
+    import os
+    import threading
+
     import dnet_tpu  # noqa: F401 - package import re-asserts JAX_PLATFORMS
     import jax
+
+    # fail fast (one JSON error line) instead of hanging the harness when
+    # the TPU backend is unreachable; first device init can legitimately
+    # take tens of seconds, so the default budget is generous
+    ready = threading.Event()
+    init_error: list = []
+
+    def probe() -> None:
+        try:
+            jax.devices()
+        except Exception as exc:  # init failure is not a hang: report it
+            init_error.append(exc)
+        finally:
+            ready.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    try:
+        budget = float(os.environ.get("DNET_BENCH_DEVICE_TIMEOUT_S", "300"))
+    except ValueError:
+        print(json.dumps({"error": "DNET_BENCH_DEVICE_TIMEOUT_S must be a number"}))
+        raise SystemExit(2)
+    if not ready.wait(budget):
+        print(json.dumps({"error": "jax backend init timed out (accelerator unreachable)"}))
+        raise SystemExit(1)
+    if init_error:
+        print(json.dumps({"error": f"jax backend init failed: {init_error[0]}"}))
+        raise SystemExit(1)
     import jax.numpy as jnp
 
     from dnet_tpu.core.kvcache import init_cache
