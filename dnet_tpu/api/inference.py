@@ -175,7 +175,9 @@ class InferenceManager:
                         f"ring degraded: shard(s) "
                         f"{self.failure_monitor.down_shards()} down"
                     )
-                await self.adapter.send_tokens(nonce, send_ids, decoding, step)
+                await self.adapter.send_tokens(
+                    nonce, send_ids, decoding, step, budget=max_new - step
+                )
                 result = await self.adapter.await_token(
                     nonce, step, self.request_timeout_s
                 )

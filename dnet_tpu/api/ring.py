@@ -99,7 +99,12 @@ class RingApiAdapter(ApiAdapterBase):
         )
 
     async def send_tokens(
-        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+        self,
+        nonce: str,
+        token_ids: List[int],
+        decoding: DecodingParams,
+        step: int,
+        budget: Optional[int] = None,
     ) -> None:
         if self._streams is None:
             raise RuntimeError("adapter not started")

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -40,9 +41,18 @@ class ApiAdapterBase(abc.ABC):
 
     @abc.abstractmethod
     async def send_tokens(
-        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+        self,
+        nonce: str,
+        token_ids: List[int],
+        decoding: DecodingParams,
+        step: int,
+        budget: Optional[int] = None,
     ) -> None:
-        """Inject tokens for one decode step (whole prompt on step 0)."""
+        """Inject tokens for one decode step (whole prompt on step 0).
+
+        `budget` is the driver's remaining token allowance for the request —
+        a hint adapters may use to fuse multiple decode steps into one device
+        program (chunked decode) without overshooting max_tokens."""
 
     @abc.abstractmethod
     async def await_token(self, nonce: str, step: int, timeout: float) -> TokenResult:
@@ -166,15 +176,29 @@ class BatchedLocalAdapter(ApiAdapterBase):
         return self.engine.max_seq
 
     async def send_tokens(
-        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+        self,
+        nonce: str,
+        token_ids: List[int],
+        decoding: DecodingParams,
+        step: int,
+        budget: Optional[int] = None,
     ) -> None:
         if self._executor is None or self._kick is None:
             raise RuntimeError("adapter not started")
         self._futures.expect(nonce, step)
-        if step == 0 or nonce not in self.engine.sessions:
+        if step == 0:
             loop = asyncio.get_running_loop()
             loop.run_in_executor(
                 self._executor, self._prefill, nonce, list(token_ids), decoding, step
+            )
+        elif nonce not in self.engine.sessions:
+            # mid-generation session loss: fail fast instead of silently
+            # re-prefilling from the single last sampled token
+            self._futures.resolve(
+                TokenResult(
+                    nonce=nonce, token_id=-1,
+                    error=f"session expired for request {nonce}", step=step,
+                )
             )
         else:
             self._pending[nonce] = (token_ids[-1], decoding, step)
@@ -238,12 +262,28 @@ class LocalAdapter(ApiAdapterBase):
     Compute runs on a dedicated single-thread executor (the analog of the
     shard's dedicated compute thread, src/dnet/shard/runtime.py:364-372), so
     the event loop never blocks on XLA.
+
+    Decode steps are CHUNKED when the engine supports it: one engine call
+    fuses up to `chunk_size` steps on-device (LocalEngine.decode_chunk) and
+    the extra tokens are buffered here, resolving later send_tokens calls
+    instantly — the driver's per-token protocol is unchanged, but the device
+    round-trip cost is paid once per chunk.  Chunk width RAMPS 2 -> 4 -> ...
+    -> chunk_size per request, so streaming clients see early tokens at
+    per-token latency while long generations converge to fused throughput.
     """
 
-    def __init__(self, engine) -> None:
+    MAX_BUFFERED_NONCES = 64  # aborted-mid-chunk leftovers cap (leak bound)
+
+    def __init__(self, engine, chunk_size: int = 32) -> None:
         self.engine = engine
+        self.chunk_size = max(1, chunk_size)
         self._futures = _TokenFutures()
         self._executor: Optional[ThreadPoolExecutor] = None
+        # nonce -> {step: TokenResult}; guarded by _buf_lock (compute thread
+        # inserts, event loop consumes/clears)
+        self._buffered: Dict[str, Dict[int, TokenResult]] = {}
+        self._ramp: Dict[str, int] = {}  # nonce -> next chunk width
+        self._buf_lock = threading.Lock()
 
     async def start(self) -> None:
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="compute")
@@ -256,30 +296,91 @@ class LocalAdapter(ApiAdapterBase):
     async def reset_cache(self, nonce: str) -> None:
         self.engine.end_session(nonce)
         self._futures.cancel_nonce(nonce)
+        with self._buf_lock:
+            self._buffered.pop(nonce, None)
+            self._ramp.pop(nonce, None)
 
     def max_seq(self) -> Optional[int]:
         return self.engine.max_seq
 
     async def send_tokens(
-        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+        self,
+        nonce: str,
+        token_ids: List[int],
+        decoding: DecodingParams,
+        step: int,
+        budget: Optional[int] = None,
     ) -> None:
         if self._executor is None:
             raise RuntimeError("adapter not started")
         self._futures.expect(nonce, step)
+        with self._buf_lock:
+            entries = self._buffered.get(nonce)
+            buffered = entries.pop(step, None) if entries else None
+            if entries is not None and not entries:
+                del self._buffered[nonce]  # drained: don't count toward the cap
+        if buffered is not None:
+            self._futures.resolve(buffered)
+            return
         loop = asyncio.get_running_loop()
         loop.run_in_executor(
-            self._executor, self._compute_step, nonce, list(token_ids), decoding, step
+            self._executor,
+            self._compute_step, nonce, list(token_ids), decoding, step, budget,
         )
 
+    def _next_chunk_width(self, nonce: str, budget: Optional[int]) -> int:
+        with self._buf_lock:
+            width = self._ramp.get(nonce, min(2, self.chunk_size))
+            self._ramp[nonce] = min(width * 2, self.chunk_size)
+        return min(width, budget or 1)
+
+    def _buffer_results(self, nonce: str, entries: Dict[int, TokenResult]) -> None:
+        with self._buf_lock:
+            self._buffered[nonce] = entries
+            if len(self._buffered) > self.MAX_BUFFERED_NONCES:
+                # leftovers of aborted requests (session already ended) are
+                # the only entries that can accumulate — never evict a live
+                # request's pending tokens, that would corrupt its stream
+                live = self.engine.sessions
+                for n in [n for n in self._buffered if n not in live]:
+                    if len(self._buffered) <= self.MAX_BUFFERED_NONCES:
+                        break
+                    del self._buffered[n]
+
     def _compute_step(
-        self, nonce: str, token_ids: List[int], decoding: DecodingParams, step: int
+        self,
+        nonce: str,
+        token_ids: List[int],
+        decoding: DecodingParams,
+        step: int,
+        budget: Optional[int] = None,
     ) -> None:
         try:
             eng = self.engine
-            if step == 0 or nonce not in eng.sessions:
+            if step == 0:
                 res = eng.prefill_and_sample(nonce, token_ids, decoding)
+            elif nonce not in eng.sessions:
+                # mid-generation session loss (TTL sweep / reset race) is an
+                # error: re-prefilling from the single last token would
+                # silently continue with empty context
+                raise RuntimeError(f"session expired for request {nonce}")
             else:
-                res = eng.decode_step(nonce, token_ids[-1], decoding)
+                chunk = self._next_chunk_width(nonce, budget)
+                if chunk > 1 and hasattr(eng, "decode_chunk"):
+                    results = eng.decode_chunk(nonce, token_ids[-1], decoding, chunk)
+                    if len(results) > 1:
+                        self._buffer_results(
+                            nonce,
+                            {
+                                step + i: eng.token_result(
+                                    nonce, r, step=step + i, decoding=decoding
+                                )
+                                for i, r in enumerate(results[1:], start=1)
+                            },
+                        )
+                    res = results[0]
+                else:
+                    res = eng.decode_step(nonce, token_ids[-1], decoding)
             result = eng.token_result(nonce, res, step=step, decoding=decoding)
             self._futures.resolve(result)
         except Exception as exc:  # surfaced to await_token as an error result

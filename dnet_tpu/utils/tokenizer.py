@@ -102,6 +102,7 @@ class Detokenizer:
     """
 
     TAIL = 16  # ids kept in the working window (enough for any multi-byte char run)
+    HARD_CAP = 128  # force-finalize beyond this: the window must stay bounded
 
     def __init__(self, tokenizer):
         self._tok = tokenizer
@@ -116,13 +117,31 @@ class Detokenizer:
     def add(self, token_id: int) -> str:
         self._ids.append(int(token_id))
         if len(self._ids) > 2 * self.TAIL:
-            # Finalize the old half of the window — but never split inside a
-            # multi-byte char (delay if the head decodes to a partial char).
-            head = self._ids[: self.TAIL]
-            head_text = self._tok.decode(head)
-            if not head_text.endswith("�"):
-                self._ids = self._ids[self.TAIL:]
-                self._done += head_text
+            # Finalize the head of the window.  The finalized text is taken
+            # from the FULL window decode (full[:-len(rest_text)]), so
+            # context-dependent decoding (sentencepiece leading-space
+            # stripping) cannot drop characters: the suffix check proves the
+            # kept ids decode to a literal suffix of the in-context text.  A
+            # boundary that splits a multi-byte char fails the check (rest
+            # decodes to a replacement char), so several consecutive
+            # boundaries are tried — a char spans <= 4 ids, one of them is
+            # clean.  A hard cap (exhaustive boundary search, then flush)
+            # keeps the window — and per-token decode cost — bounded even
+            # for a pathological tokenizer.
+            full = self._tok.decode(self._ids)
+            limit = len(self._ids) - self.TAIL
+            over_cap = len(self._ids) > self.HARD_CAP
+            tries = range(self.TAIL, limit if over_cap else min(self.TAIL + 4, limit))
+            for j in tries:
+                rest_text = self._tok.decode(self._ids[j:])
+                if rest_text and full.endswith(rest_text):
+                    self._done += full[: len(full) - len(rest_text)]
+                    self._ids = self._ids[j:]
+                    break
+            else:
+                if over_cap:
+                    self._done += full
+                    self._ids = []
         total = self._done + self._window_text()
         delta = total[self._emitted_len:]
         if delta:

@@ -33,7 +33,7 @@ class StubAdapter(ApiAdapterBase):
     async def start(self): ...
     async def shutdown(self): ...
     async def reset_cache(self, nonce): ...
-    async def send_tokens(self, nonce, ids, dec, step): ...
+    async def send_tokens(self, nonce, ids, dec, step, budget=None): ...
     async def await_token(self, nonce, step, timeout):
         return await self._futures.wait(nonce, step, timeout)
 

@@ -34,7 +34,7 @@ class FakeAdapter(ApiAdapterBase):
     def max_seq(self):
         return self.capacity
 
-    async def send_tokens(self, nonce, token_ids, decoding, step):
+    async def send_tokens(self, nonce, token_ids, decoding, step, budget=None):
         self.sent.append((step, list(token_ids)))
         fut = self._futures.expect(nonce, step)
         tok = self.script.pop(0) if self.script else 257  # EOS when exhausted
@@ -131,7 +131,7 @@ def test_holdback_len():
 
 def test_error_result_surfaces():
     class ErrAdapter(FakeAdapter):
-        async def send_tokens(self, nonce, token_ids, decoding, step):
+        async def send_tokens(self, nonce, token_ids, decoding, step, budget=None):
             fut = self._futures.expect(nonce, step)
             fut.get_loop().call_soon(
                 lambda: self._futures.resolve(

@@ -68,6 +68,117 @@ def test_repetition_penalty_changes_output(engine):
     assert base != pen
 
 
+def _stepwise_tokens(engine, ids, dec, n):
+    engine.end_session("s")
+    res = engine.prefill_and_sample("s", ids, dec)
+    tok = int(res.token[0])
+    out = [tok]
+    for _ in range(n - 1):
+        r = engine.decode_step("s", tok, dec)
+        tok = int(r.token[0])
+        out.append(tok)
+    engine.end_session("s")
+    return out
+
+
+def _chunked_tokens(engine, ids, dec, n):
+    engine.end_session("c")
+    res = engine.prefill_and_sample("c", ids, dec)
+    tok = int(res.token[0])
+    out = [tok]
+    while len(out) < n:
+        for r in engine.decode_chunk("c", tok, dec, n - len(out)):
+            tok = int(r.token[0])
+            out.append(tok)
+    engine.end_session("c")
+    return out
+
+
+def test_decode_chunk_matches_stepwise_greedy(engine):
+    ids = [256, 72, 105]
+    dec = DecodingParams(temperature=0.0)
+    assert _chunked_tokens(engine, ids, dec, 13) == _stepwise_tokens(engine, ids, dec, 13)
+
+
+def test_decode_chunk_matches_stepwise_sampled(engine):
+    """Key evolution inside the scan matches the per-step path, so seeded
+    sampling produces the identical stream through either path."""
+    ids = [256, 72, 105]
+    dec = DecodingParams(temperature=1.0, seed=11)
+    assert _chunked_tokens(engine, ids, dec, 13) == _stepwise_tokens(engine, ids, dec, 13)
+
+
+def test_decode_chunk_respects_capacity(engine):
+    engine.end_session("cc")
+    engine.prefill_and_sample("cc", list(range(8)), DecodingParams())
+    sess = engine.sessions["cc"]
+    sess.pos = engine.max_seq - 3  # only 3 slots left; must not overflow
+    results = engine.decode_chunk("cc", 1, DecodingParams(), 32)
+    assert len(results) <= 3
+    assert sess.pos <= engine.max_seq
+    engine.end_session("cc")
+
+
+def test_local_adapter_chunks_and_buffers(tiny_llama_dir):
+    """LocalAdapter fuses decode steps via decode_chunk and serves later
+    steps from its buffer — same per-token protocol, identical stream."""
+    import asyncio
+
+    from dnet_tpu.api.strategies import LocalAdapter
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+    ids = [256, 72, 105]
+    dec = DecodingParams(temperature=0.0)
+    want = _stepwise_tokens(eng, ids, dec, 10)
+
+    async def serve():
+        adapter = LocalAdapter(eng, chunk_size=4)
+        await adapter.start()
+        got = []
+        send = list(ids)
+        for step in range(10):
+            await adapter.send_tokens("n1", send, dec, step, budget=10 - step)
+            r = await adapter.await_token("n1", step, 30.0)
+            assert not r.error
+            got.append(r.token_id)
+            send = [r.token_id]
+        # every buffered token was consumed
+        assert all(not v for v in adapter._buffered.values())
+        await adapter.reset_cache("n1")
+        assert adapter._buffered == {}
+        await adapter.shutdown()
+        return got
+
+    assert asyncio.run(serve()) == want
+
+
+def test_local_adapter_expired_session_errors(tiny_llama_dir):
+    """A mid-generation session loss must surface as an error result, not a
+    silent one-token re-prefill."""
+    import asyncio
+
+    from dnet_tpu.api.strategies import LocalAdapter
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+
+    async def go():
+        adapter = LocalAdapter(eng)
+        await adapter.start()
+        dec = DecodingParams()
+        await adapter.send_tokens("gone", [256, 72], dec, 0, budget=5)
+        r = await adapter.await_token("gone", 0, 30.0)
+        assert not r.error
+        eng.end_session("gone")  # TTL sweep / reset race
+        await adapter.send_tokens("gone", [r.token_id], dec, 1, budget=4)
+        r2 = await adapter.await_token("gone", 1, 30.0)
+        assert "expired" in r2.error
+        await adapter.shutdown()
+
+    asyncio.run(go())
+
+
 def test_session_ttl_sweep(tiny_llama_dir):
     from dnet_tpu.core.engine import LocalEngine
 

@@ -48,6 +48,26 @@ def test_detokenizer_holds_back_partial_char():
     assert detok.add(euro[2]) == "€"
 
 
+class SentencePieceLike:
+    """Decode strips the leading space of the string — NON-concatenative at
+    every word boundary (the worst case for windowed detokenization)."""
+
+    def decode(self, ids):
+        text = "".join(" w%d" % i for i in ids)
+        return text[1:] if text.startswith(" ") else text
+
+
+def test_detokenizer_nonconcatenative_stays_bounded_and_exact():
+    tok = SentencePieceLike()
+    detok = Detokenizer(tok)
+    n = 500
+    out = "".join(detok.add(i) for i in range(n)) + detok.flush()
+    assert out == tok.decode(list(range(n)))
+    # the working window must stay bounded even when no split boundary is
+    # concatenative in isolation (suffix-based finalize handles it)
+    assert len(detok._ids) <= Detokenizer.HARD_CAP
+
+
 def test_load_tokenizer_fallback(tmp_path):
     tok = load_tokenizer(tmp_path)  # no tokenizer files
     assert isinstance(tok, ByteTokenizer)
