@@ -96,6 +96,69 @@ class LocalModelManager:
                         "DNET_API_PREFIX_CACHE is not supported by the mesh "
                         "engine; disabled"
                     )
+                dp, sp = self.mesh.get("dp", 1), self.mesh.get("sp", 1)
+                use_pipelined = self.batch_slots > 1 and dp == 1 and sp == 1
+                if use_pipelined:
+                    # pre-check pipelined preconditions so an incompatible
+                    # config degrades to the sequential mesh instead of
+                    # failing load_model
+                    import jax as _jax
+
+                    from dnet_tpu.models import (
+                        ModelConfig as _MC,
+                        get_ring_model_cls as _cls,
+                    )
+                    from dnet_tpu.utils.checkpoint import Checkpoint as _Ck
+
+                    _cfg = _MC.from_hf(_Ck(model_dir).config)
+                    _tp = self.mesh.get("tp", 1)
+                    _pp = self.mesh.get("pp", 0)
+                    if _pp <= 0:
+                        _pp = max(len(_jax.devices()) // _tp, 1)
+                        _L = _cfg.num_hidden_layers
+                        while _pp > 1 and _L % _pp != 0:
+                            _pp -= 1
+                    _mcls = _cls(_cfg.model_type)
+                    if (
+                        not _mcls.supports_kv_commit
+                        or getattr(_mcls, "ring_phases", 1) > 1
+                    ):
+                        log.warning(
+                            "pipelined batching unsupported for %s; serving "
+                            "sequential mesh",
+                            _cfg.model_type,
+                        )
+                        use_pipelined = False
+                    elif self.batch_slots < _pp:
+                        log.warning(
+                            "batch_slots=%d < pp=%d cannot fill the pipeline;"
+                            " serving sequential mesh (raise batch_slots)",
+                            self.batch_slots, _pp,
+                        )
+                        use_pipelined = False
+                if use_pipelined:
+                    # staggered-microbatch pipeline: batch_slots concurrent
+                    # sequences keep every pp rank busy every stage-step
+                    from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
+
+                    engine = PipelinedMeshEngine(
+                        model_dir,
+                        pp=self.mesh.get("pp", 0),
+                        tp=self.mesh.get("tp", 1),
+                        slots=self.batch_slots,
+                        max_seq=max_seq or self.max_seq,
+                        param_dtype=self.param_dtype,
+                        kv_dtype=kv_dtype,
+                        kv_quant_bits=kv_quant_bits,
+                        weight_quant_bits=self.weight_quant_bits,
+                        quant_group=self.weight_quant_group,
+                    )
+                    return engine, load_tokenizer(model_dir)
+                if self.batch_slots > 1 and not (dp == 1 and sp == 1):
+                    log.warning(
+                        "batch_slots>1 with dp/sp mesh axes: pipelined "
+                        "batching needs dp=sp=1; serving sequential mesh"
+                    )
                 from dnet_tpu.parallel.engine import MeshEngine
 
                 engine = MeshEngine(
@@ -138,6 +201,9 @@ class LocalModelManager:
                     weight_quant_group=self.weight_quant_group,
                     prefix_cache_size=self.prefix_cache,
                 )
+                # compile the chunked decode widths now, not mid-stream on
+                # the first request's ramp
+                engine.warm_chunks()
             return engine, load_tokenizer(model_dir)
 
         engine, tokenizer = await loop.run_in_executor(None, _build)
@@ -146,10 +212,11 @@ class LocalModelManager:
         old_adapter = self.inference.adapter
         from dnet_tpu.api.strategies import BatchedLocalAdapter, LocalAdapter
         from dnet_tpu.core.batch import BatchedEngine
+        from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
 
         adapter = (
             BatchedLocalAdapter(engine)
-            if isinstance(engine, BatchedEngine)
+            if isinstance(engine, (BatchedEngine, PipelinedMeshEngine))
             else LocalAdapter(engine)
         )
         await adapter.start()

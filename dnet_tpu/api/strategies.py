@@ -332,6 +332,12 @@ class LocalAdapter(ApiAdapterBase):
         with self._buf_lock:
             width = self._ramp.get(nonce, min(2, self.chunk_size))
             self._ramp[nonce] = min(width * 2, self.chunk_size)
+            if len(self._ramp) > self.MAX_BUFFERED_NONCES:
+                # entries re-created by a compute step racing reset_cache
+                # (aborted request) have no session and can be pruned
+                live = self.engine.sessions
+                for n in [n for n in self._ramp if n not in live]:
+                    del self._ramp[n]
         return min(width, budget or 1)
 
     def _buffer_results(self, nonce: str, entries: Dict[int, TokenResult]) -> None:

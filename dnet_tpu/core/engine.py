@@ -530,6 +530,29 @@ class LocalEngine:
         )
         return [SampleResult(toks[i], lps[i], tt[i], tlp[i]) for i in range(K)]
 
+    def warm_chunks(self) -> None:
+        """Compile every decode-chunk program (and the single-step decode)
+        up front, so the first request's ramp never stalls mid-stream on a
+        synchronous XLA compile."""
+        if self.plan.streams_weights:
+            return
+        nonce = "__warm__"
+        dec = DecodingParams()
+        t0 = time.perf_counter()
+        self.end_session(nonce)
+        try:
+            self.prefill_and_sample(nonce, [0], dec)
+            for b in self.DECODE_CHUNK_BUCKETS:
+                if self.sessions[nonce].pos + b < self.max_seq:
+                    self.decode_chunk(nonce, 0, dec, b)
+            self.decode_step(nonce, 0, dec)
+        finally:
+            self.end_session(nonce)
+        log.info(
+            "[PROFILE] warmed decode-chunk programs in %.1fs",
+            time.perf_counter() - t0,
+        )
+
     def generate(
         self,
         prompt_ids: Sequence[int],

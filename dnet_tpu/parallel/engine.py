@@ -69,12 +69,15 @@ class MeshEngine:
         model_cls = get_ring_model_cls(self.config.model_type)
         self.model = model_cls(self.config, range(self.config.num_hidden_layers))
         L = self.config.num_hidden_layers
+        # segmented models (ring_phases > 1) zero-pad each segment to pp
+        # divisibility, so L need not divide evenly
+        segmented = getattr(self.model, "ring_phases", 1) > 1
         if pp <= 0:  # 0 = infer: use every remaining device for pipeline stages
             n_dev = len(list(devices) if devices is not None else jax.devices())
             pp = max(n_dev // (tp * dp * sp), 1)
-            while pp > 1 and L % pp != 0:
+            while pp > 1 and L % pp != 0 and not segmented:
                 pp -= 1
-        if L % pp != 0:
+        if L % pp != 0 and not segmented:
             raise ValueError(f"pp={pp} must divide num_layers={L}")
         if sp > 1 and max_seq % sp != 0:
             raise ValueError(f"sp={sp} must divide max_seq={max_seq}")
@@ -96,9 +99,7 @@ class MeshEngine:
         self.plan = type("plan", (), {"streams_weights": False, "name": "fit"})()
 
         self._load_params()
-        self._step = make_ring_decode_fn(
-            self.model, self.mesh, param_keys=list(self._host_window.keys())
-        )
+        self._step = make_ring_decode_fn(self.model, self.mesh, self._host_window)
         log.info(
             "MeshEngine: %s over mesh pp=%d tp=%d dp=%d sp=%d (%d devices)",
             self.config.model_type, pp, tp, dp, sp, pp * tp * dp * sp,
@@ -155,11 +156,17 @@ class MeshEngine:
                 arr = arr.astype(target)
             return arr
 
+        # segmented models: zero-pad each segment's layer axis to a pp
+        # multiple (exact residual no-ops); the KV cache then holds the
+        # padded layer count, laid out per-rank (dense rows then moe rows)
+        self._n_kv_layers = len(m.layers)
+        if getattr(m, "ring_phases", 1) > 1:
+            stacked, self._n_kv_layers = m.pad_mesh_segments(stacked, self.pp)
         self._host_window = jax.tree.map(cast, stacked)
         edge = jax.tree.map(cast, m.map_edge(self.ckpt.load_edge_raw()))
         kv0 = init_cache(
             m.kv_config(
-                len(m.layers), self.batch, self.max_seq, self.kv_dtype,
+                self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
                 quant_bits=self.kv_quant_bits,
             )
         )
@@ -177,7 +184,7 @@ class MeshEngine:
             seed = int.from_bytes(os.urandom(4), "little")
         kv0 = init_cache(
             self.model.kv_config(
-                len(self.model.layers), self.batch, self.max_seq, self.kv_dtype,
+                self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
                 quant_bits=self.kv_quant_bits,
             )
         )

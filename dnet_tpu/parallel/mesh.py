@@ -44,10 +44,17 @@ def build_mesh(
 # column-parallel weights shard their output dim over tp, row-parallel their
 # input dim.  Norm vectors replicate.
 
-_COL_PARALLEL = {"wq", "wk", "wv", "w_gate", "w_up"}  # [L, D, out] -> out/tp
-_ROW_PARALLEL = {"wo", "w_down"}  # [L, in, D] -> in/tp
+_COL_PARALLEL = {
+    "wq", "wk", "wv", "w_gate", "w_up",  # [L, D, out] -> out/tp
+    "wq_b", "wkv_b",  # deepseek MLA: head-dim outputs shard over tp
+    "s_gate", "s_up",  # deepseek shared experts (dense col split)
+}
+_ROW_PARALLEL = {"wo", "w_down", "s_down"}  # [L, in, D] -> in/tp
 _HEAD_VECTORS = {"bq", "bk", "bv", "sinks"}  # [L, out] -> out/tp
-_EXPERT_SHARDED = {"gate_up", "down"}  # [L, E, ..] -> E/tp (expert parallel)
+_EXPERT_SHARDED = {
+    "gate_up", "down",  # gpt_oss [L, E, ..] -> E/tp (expert parallel)
+    "e_gate", "e_up", "e_down",  # deepseek routed experts
+}
 _EXPERT_VECTORS = {"gate_up_b", "down_b"}  # [L, E, ..] -> E/tp
 
 
@@ -65,16 +72,32 @@ def layer_param_spec(name: str) -> P:
     return P(AXIS_PP)  # norms, router, kind scalars: shard layer axis only
 
 
-def window_param_specs(window_params: Dict) -> Dict[str, P]:
-    return {k: layer_param_spec(k) for k in window_params}
+def window_param_specs(window_params: Dict) -> Dict:
+    """Spec pytree for a stacked window; handles the two-level segment
+    layout ({"dense": {...}, "moe": {...}}, deepseek) as well as flat."""
+    out: Dict = {}
+    for k, v in window_params.items():
+        if k in ("dense", "moe") and isinstance(v, dict):
+            out[k] = {kk: layer_param_spec(kk) for kk in v}
+        else:
+            out[k] = layer_param_spec(k)
+    return out
 
 
 def shard_window_params(window_params: Dict, mesh: Mesh) -> Dict:
     """Place stacked layer params onto the mesh per the TP/PP rules."""
-    return {
-        k: jax.device_put(v, NamedSharding(mesh, layer_param_spec(k)))
-        for k, v in window_params.items()
-    }
+
+    def place(subtree, spec):
+        return jax.device_put(subtree, NamedSharding(mesh, spec))
+
+    specs = window_param_specs(window_params)
+    out: Dict = {}
+    for k, v in window_params.items():
+        if isinstance(specs[k], dict):
+            out[k] = {kk: place(v[kk], specs[k][kk]) for kk in v}
+        else:
+            out[k] = place(v, specs[k])
+    return out
 
 
 def replicate(tree, mesh: Mesh):

@@ -1,0 +1,619 @@
+"""Staggered-microbatch pipelined ring: every pp rank does real work.
+
+The sequential ring program (parallel/ring.py) runs PP stage-steps per token
+with one rank's activation real at a time — (PP-1)/PP of the slice idles.
+This module fills the pipeline the classic way, compiled into ONE XLA
+program: M >= PP sequence slots are staggered across the pp ranks so that at
+every stage-step each rank computes a *different* sequence's stage, and the
+hidden states rotate one hop over ICI (`lax.ppermute`).  One "rotation" (M
+stage-steps, a single dispatch) enters one new token per slot, exits one
+sampled token per slot, and keeps every chip busy the whole time — the
+steady state promised by the reference's k-round round-robin schedule
+(src/dnet/api/utils.py:62-131), reached here inside a single jitted program.
+
+Schedule (global step t, M slots, PP stages):
+  - the token entering at step t belongs to slot  n(t) = t mod M
+  - rank r is working on the token that entered at step t - r,
+    i.e. slot (t - r) mod M
+  - rank PP-1 finishes the token that entered at t-(PP-1): exit slot
+    e(t) = (t - PP + 1) mod M; its logits are sampled ON DEVICE and the
+    token is written to the entry buffer, so slot e's next entry (step
+    t+1 when M == PP) needs no host round-trip.
+
+Sampling inside the rotation matches LocalEngine's per-step key evolution
+(split-before-sample per generated token), so a seeded request produces the
+identical stream through either engine.
+
+KV: per-slot caches live in one array with the slot folded into the batch
+axis ([L, M*B, S, KVH, Hd]); each stage-step slices its slot out, applies
+the stage, and writes it back (the write is a dynamic_update_slice into the
+donated carry).  Garbage produced by idle slots lands only in idle slots'
+rows and is overwritten by the next prefill.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dnet_tpu.core.sampler import SampleParams, SampleResult, sample
+from dnet_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_PP,
+    AXIS_TP,
+    kv_spec,
+    window_param_specs,
+)
+
+
+def _bcast_from_rank(x, axis_name: str, rank: int):
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == rank, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def make_rotation_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int = 1):
+    """Build the jitted M-stage-step rotation program.
+
+    Returned signature:
+      (window_params, edge_params, x_state[PP,B,1,D], kv, tokens[M,B],
+       pos_vec[M], pos_state[PP], sp_stack, keys[M,2]u32, counts[M,B,V],
+       real_mask[M]bool, t0)
+      -> (results: SampleResult leaves stacked [M,B,...] in EXIT-STEP order,
+          x_state, kv, tokens, pos_vec, pos_state, keys, counts)
+
+    A token's write position AND its liveness travel WITH its hidden state
+    (pos_state / live_state are ppermuted alongside x), because the ring
+    always holds one in-flight token per slot.  The live flag is the single
+    source of truth for realness: KV only commits for live tokens (idle-slot
+    garbage touches nothing), and exit-side state writes (entry token, key
+    burn, counts) are gated on the exiting token's flag — a stale token from
+    a re-assigned or idle slot can neither corrupt the fresh prefill's KV
+    rows nor clobber the injected entry token.  The engine kills the flag of
+    a slot's in-flight token at injection time (it knows which rank holds
+    it: rank r carries slot (t0 - r) mod M between rotations).
+    """
+    PP = mesh.shape[AXIS_PP]
+    M, B = n_slots, batch
+    has_kinds = getattr(model, "layer_kinds", None) is not None
+
+    # x_state mentions AXIS_DP (size 1, enforced by the engine) purely so its
+    # vma matches the dp-varying kv inside the layer scan
+    x_spec = P(AXIS_PP, AXIS_DP)
+    in_specs = (
+        window_param_specs(window_params),
+        P(),  # edge params replicated
+        x_spec,  # x_state [PP, B, 1, D]
+        kv_spec(False),  # [L, M*B, S, KVH, Hd]
+        P(),  # tokens [M, B]
+        P(),  # pos_vec [M]
+        P(AXIS_PP),  # pos_state [PP]
+        P(AXIS_PP),  # live_state [PP] bool
+        P(),  # enter_live [M] bool (slot has a live session)
+        P(),  # sp_stack (SampleParams leaves [M])
+        P(),  # keys [M, 2] uint32
+        P(),  # counts [M, B, V]
+        P(),  # t0 scalar
+        P(AXIS_PP) if has_kinds else P(),
+    )
+    res_spec = SampleResult(P(), P(), P(), P())
+    out_specs = (
+        res_spec, x_spec, kv_spec(False), P(), P(), P(AXIS_PP), P(AXIS_PP),
+        P(), P(),
+    )
+
+    def spmd(window_params, edge_params, x_state, kv, tokens, pos_vec,
+             pos_state, live_state, enter_live, sp_stack, keys, counts,
+             t0, kinds):
+        my_pp = lax.axis_index(AXIS_PP)
+        x = x_state[0]  # local [B, 1, D], device-varying over pp
+        pos_x = pos_state[0]  # this rank's in-flight token position
+        live_x = live_state[0]  # is this rank's in-flight token real?
+
+        def step(carry, j):
+            x, pos_x, live_x, kv, tokens, pos_vec, keys, counts = carry
+            t = t0 + j
+            n = jnp.mod(t, M)  # entry slot (invariant)
+            e = jnp.mod(t - (PP - 1), M)  # exit slot (invariant)
+            my_slot = jnp.mod(t - my_pp, M)  # this rank's slot (varying)
+
+            # entry: rank 0 replaces its (just-drained) hidden with the
+            # entering token's embedding; the token's position is consumed
+            # from pos_vec NOW and rides along with the hidden thereafter
+            tok_in = lax.dynamic_index_in_dim(tokens, n, keepdims=False)  # [B]
+            x_embed = model.embed(edge_params, tok_in[:, None])
+            x_embed = lax.pcast(x_embed, AXIS_PP, to="varying")
+            x_embed = lax.pcast(x_embed, AXIS_DP, to="varying")
+            x_in = jnp.where(my_pp == 0, x_embed, x)
+            pos_entry = lax.dynamic_index_in_dim(pos_vec, n, keepdims=False)
+            pos_in = jnp.where(my_pp == 0, pos_entry, pos_x)
+            live_entry = lax.dynamic_index_in_dim(enter_live, n, keepdims=False)
+            live_entry = lax.pcast(live_entry, AXIS_PP, to="varying")
+            live_in = jnp.where(my_pp == 0, live_entry, live_x)
+            pos_vec = lax.dynamic_update_index_in_dim(
+                pos_vec, pos_entry + 1, n, axis=0
+            )
+
+            # this rank's stage over its slot's KV slice; only live tokens
+            # commit KV (stale/idle garbage writes nothing, anywhere)
+            kv_slot = jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, my_slot * B, B, axis=1), kv
+            )
+            x_out, kv_slot = model.apply_window(
+                window_params, x_in, kv_slot, pos_in,
+                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=live_in,
+            )
+            kv = jax.tree.map(
+                lambda full, sl: lax.dynamic_update_slice_in_dim(
+                    full, sl, my_slot * B, axis=1
+                ),
+                kv, kv_slot,
+            )
+
+            # exit: rank PP-1's x_out is the finished hidden of slot e
+            x_last = model.normalize(edge_params, x_out)
+            logits = model.lm_project(edge_params, x_last)[:, 0]  # [B, V]
+            logits = _bcast_from_rank(logits, AXIS_PP, PP - 1)
+            # dp is pinned to 1: this psum is an identity that casts the
+            # dp-varying logits back to invariant so the sampling state
+            # (tokens/keys/counts carries, replicated out_specs) stays clean
+            logits = lax.psum(logits, AXIS_DP)
+
+            # the exiting token's own live flag decides realness (bcast from
+            # the last rank, where it resides this step)
+            real = lax.psum(
+                jnp.where(my_pp == PP - 1, live_in.astype(jnp.int32), 0),
+                AXIS_PP,
+            ) > 0
+            old_key = lax.dynamic_index_in_dim(keys, e, keepdims=False)
+            key = jax.random.wrap_key_data(old_key)
+            key, step_key = jax.random.split(key)
+            sp_e = SampleParams(*(lax.dynamic_index_in_dim(a, e, keepdims=False)
+                                  for a in sp_stack))
+            counts_e = lax.dynamic_index_in_dim(counts, e, keepdims=False)
+            res = sample(logits, sp_e, step_key, token_counts=counts_e)
+            # stale exits (re-assigned slot, cold pipeline) must not touch
+            # slot state: no key burn, no counts, no entry-token clobber
+            counts_new = counts_e.at[jnp.arange(B), res.token].add(1)
+            counts = lax.dynamic_update_index_in_dim(
+                counts, jnp.where(real, counts_new, counts_e), e, axis=0
+            )
+            keys = lax.dynamic_update_index_in_dim(
+                keys, jnp.where(real, jax.random.key_data(key), old_key), e, axis=0
+            )
+            tok_e = lax.dynamic_index_in_dim(tokens, e, keepdims=False)
+            tokens = lax.dynamic_update_index_in_dim(
+                tokens, jnp.where(real, res.token, tok_e), e, axis=0
+            )
+
+            # hand hidden states (and their position/liveness) one hop around
+            perm = [(p, (p + 1) % PP) for p in range(PP)]
+            x_next = lax.ppermute(x_out, AXIS_PP, perm)
+            pos_next = lax.ppermute(pos_in, AXIS_PP, perm)
+            live_next = lax.ppermute(live_in, AXIS_PP, perm)
+            return (x_next, pos_next, live_next, kv, tokens, pos_vec, keys,
+                    counts), res
+
+        (x, pos_x, live_x, kv, tokens, pos_vec, keys, counts), results = lax.scan(
+            step,
+            (x, pos_x, live_x, kv, tokens, pos_vec, keys, counts),
+            jnp.arange(M, dtype=jnp.int32),
+        )
+        return (results, x[None], kv, tokens, pos_vec, pos_x[None],
+                live_x[None], keys, counts)
+
+    fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    jitted = jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6, 7, 10, 11))
+    kinds_arr = (
+        model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
+    )
+
+    def call(window_params, edge_params, x_state, kv, tokens, pos_vec,
+             pos_state, live_state, enter_live, sp_stack, keys, counts, t0):
+        return jitted(window_params, edge_params, x_state, kv, tokens, pos_vec,
+                      pos_state, live_state, enter_live, sp_stack, keys,
+                      counts, jnp.int32(t0), kinds_arr)
+
+    return call
+
+
+def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int = 1):
+    """Sequential ring pass (parallel/ring.py schedule) writing ONE slot's KV.
+
+    (window_params, edge_params, tokens[B,T], kv, pos, last_idx, slot)
+      -> (logits[B,V], kv)
+    """
+    PP = mesh.shape[AXIS_PP]
+    B = batch
+    has_kinds = getattr(model, "layer_kinds", None) is not None
+    in_specs = (
+        window_param_specs(window_params),
+        P(),
+        P(AXIS_DP),  # tokens [B, T]: dp-sharded batch matches the kv vma
+        kv_spec(False), P(), P(), P(),
+        P(AXIS_PP) if has_kinds else P(),
+    )
+    out_specs = (P(), kv_spec(False))
+
+    def spmd(window_params, edge_params, tokens, kv, pos, last_idx, slot, kinds):
+        my_pp = lax.axis_index(AXIS_PP)
+        kv_slot = jax.tree.map(
+            lambda a: lax.dynamic_slice_in_dim(a, slot * B, B, axis=1), kv
+        )
+        x = model.embed(edge_params, tokens)
+        x = lax.pcast(x, AXIS_PP, to="varying")
+
+        def stage_iter(i, carry):
+            x, kv_slot = carry
+            x_new, kv_slot = model.apply_window(
+                window_params, x, kv_slot, pos,
+                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=(i == my_pp),
+            )
+            x_next = lax.ppermute(
+                x_new, AXIS_PP, [(p, (p + 1) % PP) for p in range(PP)]
+            )
+            return (x_next, kv_slot)
+
+        x, kv_slot = lax.fori_loop(0, PP, stage_iter, (x, kv_slot))
+        kv = jax.tree.map(
+            lambda full, sl: lax.dynamic_update_slice_in_dim(
+                full, sl, slot * B, axis=1
+            ),
+            kv, kv_slot,
+        )
+        x_last = lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
+        x_last = model.normalize(edge_params, x_last)
+        logits = model.lm_project(edge_params, x_last)
+        logits = _bcast_from_rank(logits, AXIS_PP, 0)
+        logits = lax.psum(logits, AXIS_DP)  # identity at dp=1: vma cast only
+        return logits[:, 0], kv
+
+    fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    jitted = jax.jit(fn, donate_argnums=(3,))
+    kinds_arr = (
+        model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
+    )
+
+    def call(window_params, edge_params, tokens, kv, pos, last_idx, slot):
+        return jitted(window_params, edge_params, tokens, kv, jnp.int32(pos),
+                      jnp.int32(last_idx), jnp.int32(slot), kinds_arr)
+
+    return call
+
+
+class PipelinedMeshEngine:
+    """BatchedEngine-compatible surface over the rotation program.
+
+    M slots serve up to M concurrent requests; `decode_batch` runs rotations
+    until every pending request has a result (steady state: exactly one).
+    Drop-in behind BatchedLocalAdapter — continuous batching ACROSS the
+    pipeline, the scheduler the sequential mesh ring lacks
+    (VERDICT.md "MeshEngine pipeline is (PP-1)/PP idle").
+    """
+
+    def __init__(
+        self,
+        model_dir,
+        pp: int = 0,
+        tp: int = 1,
+        slots: int = 0,
+        max_seq: int = 2048,
+        param_dtype: str = "bfloat16",
+        kv_dtype: Optional[str] = None,
+        kv_quant_bits: int = 0,
+        weight_quant_bits: int = 0,
+        quant_group: int = 0,
+        devices: Optional[Sequence] = None,
+    ):
+        import numpy as np
+
+        from dnet_tpu.parallel.engine import MeshEngine
+
+        # resolve pp before sizing the slot pool (same divisibility fallback
+        # as MeshEngine's inference)
+        if pp <= 0:
+            n_dev = len(list(devices) if devices is not None else jax.devices())
+            pp = max(n_dev // tp, 1)
+            import json
+            from pathlib import Path as _Path
+
+            L = json.loads(
+                (_Path(model_dir) / "config.json").read_text()
+            )["num_hidden_layers"]
+            while pp > 1 and L % pp != 0:
+                pp -= 1
+        self.n_slots = M = slots if slots > 0 else pp
+        if M < pp:
+            raise ValueError(f"slots={M} must be >= pp={pp} to fill the pipeline")
+        self.slot_batch = B = 1
+        # the inner MeshEngine loads/shards params and builds the kv template
+        # with batch = M*B (slots folded into the batch axis)
+        self._inner = MeshEngine(
+            model_dir, pp=pp, tp=tp, dp=1, sp=1, batch=M * B, max_seq=max_seq,
+            param_dtype=param_dtype, kv_dtype=kv_dtype,
+            kv_quant_bits=kv_quant_bits, weight_quant_bits=weight_quant_bits,
+            quant_group=quant_group, devices=devices,
+        )
+        inner = self._inner
+        if not inner.model.supports_kv_commit:
+            raise NotImplementedError(
+                f"pipelined serving not supported for "
+                f"{inner.config.model_type} (no gated KV writes yet)"
+            )
+        if getattr(inner.model, "ring_phases", 1) > 1:
+            raise NotImplementedError(
+                f"pipelined serving not supported for segmented "
+                f"{inner.config.model_type} (multi-lap ring pending)"
+            )
+        self.config, self.model, self.mesh = inner.config, inner.model, inner.mesh
+        self.pp, self.tp = inner.pp, inner.tp
+        self.max_seq = max_seq
+        self.window_params, self.edge_params = inner.window_params, inner.edge_params
+
+        self._rot = make_rotation_fn(self.model, self.mesh, inner._host_window, M, B)
+        self._prefill_fn = make_slot_prefill_fn(
+            self.model, self.mesh, inner._host_window, M, B
+        )
+
+        from jax.sharding import NamedSharding
+
+        D = self.config.hidden_size
+        V = self.config.vocab_size
+        rep = NamedSharding(self.mesh, P())
+        self.x_state = jax.device_put(
+            jnp.zeros((self.pp, B, 1, D), dtype=jnp.dtype(param_dtype)),
+            NamedSharding(self.mesh, P(AXIS_PP, AXIS_DP)),
+        )
+        self.kv = inner._kv_template  # [L, M*B, S, ...] mesh-sharded, live
+        self.tokens = jax.device_put(jnp.zeros((M, B), dtype=jnp.int32), rep)
+        self.pos_vec = jax.device_put(jnp.zeros((M,), dtype=jnp.int32), rep)
+        self.pos_state = jax.device_put(
+            jnp.zeros((self.pp,), dtype=jnp.int32),
+            NamedSharding(self.mesh, P(AXIS_PP)),
+        )
+        self.live_state = jax.device_put(
+            jnp.zeros((self.pp,), dtype=bool),
+            NamedSharding(self.mesh, P(AXIS_PP)),
+        )
+        self.keys = jax.device_put(jnp.zeros((M, 2), dtype=jnp.uint32), rep)
+        self.counts = jax.device_put(jnp.zeros((M, B, V), dtype=jnp.int32), rep)
+        self.t0 = 0
+
+        self.slot_of: Dict[str, int] = {}
+        self._free = list(range(M))
+        self.slot_pos = np.zeros(M, dtype=np.int64)  # host mirror of pos_vec
+        self._dec: Dict[int, "DecodingParams"] = {}  # slot -> sampling params
+        self._entries: Dict[int, list] = {i: [] for i in range(M)}  # entry steps
+        self._buffer: Dict[str, list] = {}  # nonce -> ready SampleResults
+        self._np = np
+
+    token_result = None  # set after class body (LocalEngine staticmethod)
+
+    @property
+    def sessions(self):
+        return self.slot_of
+
+    # ---- slots --------------------------------------------------------
+    def _alloc(self, nonce: str) -> int:
+        if nonce in self.slot_of:
+            return self.slot_of[nonce]
+        if not self._free:
+            raise RuntimeError(f"no free pipeline slots (capacity {self.n_slots})")
+        slot = self._free.pop(0)
+        self.slot_of[nonce] = slot
+        self._entries[slot] = []
+        self._buffer[nonce] = []
+        return slot
+
+    def end_session(self, nonce: str) -> None:
+        slot = self.slot_of.pop(nonce, None)
+        self._buffer.pop(nonce, None)
+        if slot is not None:
+            self._dec.pop(slot, None)
+            self._entries[slot] = []
+            self._free.append(slot)
+
+    def reset(self) -> None:
+        for nonce in list(self.slot_of):
+            self.end_session(nonce)
+
+    def close(self) -> None:
+        self.reset()
+
+    def sweep_sessions(self, ttl_s: float = 600.0) -> int:
+        return 0  # slots are freed by end_session; no per-slot TTL yet
+
+    # ---- serving ------------------------------------------------------
+    def prefill_and_sample(self, nonce, prompt_ids, decoding) -> SampleResult:
+        from dnet_tpu.core.engine import bucket_length
+        from dnet_tpu.core.types import DecodingParams  # noqa: F401
+
+        np = self._np
+        T = len(prompt_ids)
+        if T == 0:
+            raise ValueError("empty prompt")
+        if T >= self.max_seq:
+            raise ValueError(f"prompt length {T} exceeds max_seq {self.max_seq}")
+        slot = self._alloc(nonce)
+        B = self.slot_batch
+        Tpad = min(bucket_length(T), self.max_seq)
+        tokens = np.zeros((B, Tpad), dtype=np.int32)
+        tokens[:, :T] = np.asarray(list(prompt_ids), dtype=np.int32)
+        logits, self.kv = self._prefill_fn(
+            self.window_params, self.edge_params, jnp.asarray(tokens),
+            self.kv, 0, T - 1, slot,
+        )
+        seed = decoding.seed
+        if seed is None:
+            seed = int.from_bytes(__import__("os").urandom(4), "little")
+        key = jax.random.key(seed)
+        key, step_key = jax.random.split(key)
+        counts0 = jnp.zeros((B, self.config.vocab_size), dtype=jnp.int32)
+        res = sample(
+            logits, SampleParams.from_decoding(decoding), step_key,
+            token_counts=counts0,
+        )
+        counts0 = counts0.at[jnp.arange(B), res.token].add(1)
+        # inject: the sampled token is this slot's first pipeline entry
+        self.tokens = self.tokens.at[slot].set(res.token)
+        self.pos_vec = self.pos_vec.at[slot].set(T)
+        self.keys = self.keys.at[slot].set(jax.random.key_data(key))
+        self.counts = self.counts.at[slot].set(counts0)
+        # kill the slot's stale in-flight token: between rotations, rank r
+        # carries slot (t0 - r) mod M — its live flag must not let old
+        # garbage commit KV into the rows this prefill just wrote
+        r_star = (self.t0 - slot) % self.n_slots
+        if r_star < self.pp:
+            self.live_state = self.live_state.at[r_star].set(False)
+        self.slot_pos[slot] = T
+        self._dec[slot] = decoding
+        return res
+
+    def _sp_stack(self) -> SampleParams:
+        np = self._np
+        M = self.n_slots
+        temp = np.zeros(M, dtype=np.float32)
+        top_p = np.ones(M, dtype=np.float32)
+        top_k = np.zeros(M, dtype=np.int32)
+        min_p = np.zeros(M, dtype=np.float32)
+        rep = np.ones(M, dtype=np.float32)
+        for slot, dec in self._dec.items():
+            temp[slot] = dec.temperature
+            top_p[slot] = dec.top_p
+            top_k[slot] = dec.top_k
+            min_p[slot] = dec.min_p
+            rep[slot] = dec.repetition_penalty
+        return SampleParams(
+            jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(top_k),
+            jnp.asarray(min_p), jnp.asarray(rep),
+        )
+
+    def _rotate(self) -> None:
+        np = self._np
+        M, PP = self.n_slots, self.pp
+        nonce_of = {s: n for n, s in self.slot_of.items()}
+        # simulate the rotation's schedule on the host: which exits carry a
+        # real token (entered exactly PP-1 steps earlier) and which entries
+        # occur — this mirrors the device-side live-flag propagation, so the
+        # delivery mapping stays exact
+        sim = {m: list(self._entries[m]) for m in range(M)}
+        deliveries = []  # (step index j, slot)
+        for j in range(M):
+            t = self.t0 + j
+            e_slot = (t - (PP - 1)) % M
+            ent = sim[e_slot]
+            if ent and ent[0] == t - (PP - 1):
+                ent.pop(0)
+                deliveries.append((j, e_slot))
+            n_slot = t % M
+            # live slots below capacity feed one real token per step (must
+            # mirror the enter_live mask computed below)
+            if n_slot in nonce_of and self.slot_pos[n_slot] < self.max_seq:
+                sim[n_slot].append(t)
+        # a slot at capacity must stop ENTERING (its next token would write
+        # past max_seq); its already-buffered tokens stay deliverable
+        enter_live = np.zeros(M, dtype=bool)
+        for m in nonce_of:
+            enter_live[m] = self.slot_pos[m] < self.max_seq
+        (results, self.x_state, self.kv, self.tokens, self.pos_vec,
+         self.pos_state, self.live_state, self.keys, self.counts) = self._rot(
+            self.window_params, self.edge_params, self.x_state, self.kv,
+            self.tokens, self.pos_vec, self.pos_state, self.live_state,
+            jnp.asarray(enter_live), self._sp_stack(), self.keys, self.counts,
+            self.t0,
+        )
+        toks = np.asarray(results.token)
+        lps = np.asarray(results.logprob)
+        tts = np.asarray(results.top_tokens)
+        tlps = np.asarray(results.top_logprobs)
+        for j, slot in deliveries:
+            nonce = nonce_of.get(slot)
+            if nonce is not None and nonce in self._buffer:
+                self._buffer[nonce].append(
+                    SampleResult(toks[j], lps[j], tts[j], tlps[j])
+                )
+        self._entries = sim
+        self.slot_pos += 1  # device pos_vec advanced once per slot (at entry)
+        self.t0 += M
+
+    def decode_batch(self, requests) -> Tuple[Dict[str, SampleResult], Dict[str, str]]:
+        errors: Dict[str, str] = {}
+        order: Dict[str, int] = {}
+        for nonce, (_tok, dec) in requests.items():
+            slot = self.slot_of.get(nonce)
+            if slot is None:
+                errors[nonce] = f"request {nonce!r} has no pipeline slot (cancelled?)"
+                continue
+            self._dec[slot] = dec
+            order[nonce] = slot
+        if not order:
+            return {}, errors
+
+        def can_progress(nonce: str) -> bool:
+            """More tokens can still arrive: capacity to enter, or in flight."""
+            slot = order[nonce]
+            return (
+                self.slot_pos[slot] < self.max_seq or bool(self._entries[slot])
+            )
+
+        # steady state: one rotation yields one token per active slot; a
+        # freshly prefilled slot needs a second (its first entry is mid-ring)
+        for _ in range(3):
+            missing = [n for n in order if not self._buffer.get(n)]
+            if not missing or not any(can_progress(n) for n in missing):
+                break
+            self._rotate()
+        out: Dict[str, SampleResult] = {}
+        for nonce, slot in order.items():
+            buf = self._buffer.get(nonce)
+            if buf:
+                # buffered tokens generated before capacity are still valid
+                out[nonce] = buf.pop(0)
+            elif self.slot_pos[slot] >= self.max_seq:
+                errors[nonce] = (
+                    f"sequence length {self.slot_pos[slot]} reached max_seq "
+                    f"{self.max_seq}"
+                )
+                self.end_session(nonce)
+            else:
+                errors[nonce] = "pipeline produced no token (stall)"
+        return out, errors
+
+    def generate(self, prompt_ids, decoding=None, max_tokens=256,
+                 eos_token_ids=None, nonce="pipelined"):
+        from dnet_tpu.core.types import DecodingParams
+
+        decoding = decoding or DecodingParams()
+        eos = eos_token_ids or set()
+        self.end_session(nonce)
+        res = self.prefill_and_sample(nonce, prompt_ids, decoding)
+        token = int(res.token[0])
+        yield self.token_result(nonce, res, step=0, decoding=decoding)
+        if token in eos:
+            self.end_session(nonce)
+            return
+        for step in range(1, max_tokens):
+            if self.slot_pos[self.slot_of[nonce]] >= self.max_seq:
+                break
+            res_map, errs = self.decode_batch({nonce: (token, decoding)})
+            if errs:
+                raise RuntimeError(errs[nonce])
+            row = res_map[nonce]
+            token = int(row.token[0])
+            yield self.token_result(nonce, row, step=step, decoding=decoding)
+            if token in eos:
+                break
+        self.end_session(nonce)
+
+
+def _bind_token_result():
+    from dnet_tpu.core.engine import LocalEngine
+
+    PipelinedMeshEngine.token_result = staticmethod(LocalEngine.token_result)
+
+
+_bind_token_result()

@@ -31,22 +31,27 @@ from dnet_tpu.parallel.mesh import (
     AXIS_SP,
     AXIS_TP,
     kv_spec,
-    layer_param_spec,
+    window_param_specs,
 )
 
 
-def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
+def make_ring_decode_fn(model, mesh: Mesh, window_params, donate_kv: bool = True):
     """Build a jitted single-program ring decode step.
 
     Signature of the returned fn:
       (window_params, edge_params, tokens[B,1] int32, kv, pos) -> (logits[B,V], kv)
 
     window_params: stacked over ALL model layers [L, ...], sharded
-      (pp shards the layer axis into contiguous stages, tp the head/ffn dims).
-    kv: {"k","v"} [L, B, S, KVH, Hd] sharded the same way.
-    param_keys: keys of the stacked window-param dict (spec construction).
+      (pp shards the layer axis into contiguous stages, tp the head/ffn dims)
+      — passed here only for spec construction (flat or segmented layout).
+
+    Models with `ring_phases > 1` (deepseek: dense/moe segments) run that
+    many laps around the ring, applying one segment per lap, so the global
+    layer order is preserved even though each rank holds a slice of every
+    segment.
     """
     PP = mesh.shape[AXIS_PP]
+    phases = getattr(model, "ring_phases", 1)
     # sequence parallelism: KV shards over sp; queries/hidden replicate and
     # attention runs as ring/flash-decoding with one LSE combine per layer
     sp_axis = AXIS_SP if mesh.shape.get(AXIS_SP, 1) > 1 else None
@@ -55,7 +60,7 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
     # shard over pp alongside the layer-stacked params
     has_kinds = getattr(model, "layer_kinds", None) is not None
     in_specs = (
-        {k: layer_param_spec(k) for k in param_keys},
+        window_param_specs(window_params),
         P(),  # edge params replicated
         P(AXIS_DP, None),  # tokens [B, T]
         kv_spec(sp_axis is not None),  # pytree prefix: every kv leaf (incl. scales)
@@ -81,10 +86,12 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
             # KV only commits on the rank whose turn it is (garbage copies
             # on other ranks must not pollute their caches); the gate is
             # O(T) inside the layer, not an O(S) whole-cache select.
+            extra = {"phase": i // PP} if phases > 1 else {}
             x_new, kv = model.apply_window(
                 window_params, x, kv, pos,
-                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=(i == my_pp),
-                sp_axis=sp_axis,
+                layer_kinds=kinds, tp_axis=AXIS_TP,
+                kv_commit=(jnp.mod(i, PP) == my_pp),
+                sp_axis=sp_axis, **extra,
             )
             # hand the hidden state to the next pipeline rank (ICI hop)
             x_next = lax.ppermute(
@@ -92,7 +99,7 @@ def make_ring_decode_fn(model, mesh: Mesh, param_keys, donate_kv: bool = True):
             )
             return (x_next, kv)
 
-        x, kv = lax.fori_loop(0, PP, stage_iter, (x, kv))
+        x, kv = lax.fori_loop(0, phases * PP, stage_iter, (x, kv))
         # after PP hops the processed x is back on rank 0; ranks agree via
         # the ppermute ring, and rank 0 holds the final hidden state.
         x_last = lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)

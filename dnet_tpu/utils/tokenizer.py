@@ -116,6 +116,7 @@ class Detokenizer:
 
     def add(self, token_id: int) -> str:
         self._ids.append(int(token_id))
+        window_text = None  # reuse decodes from the finalize pass when set
         if len(self._ids) > 2 * self.TAIL:
             # Finalize the head of the window.  The finalized text is taken
             # from the FULL window decode (full[:-len(rest_text)]), so
@@ -137,12 +138,19 @@ class Detokenizer:
                 if rest_text and full.endswith(rest_text):
                     self._done += full[: len(full) - len(rest_text)]
                     self._ids = self._ids[j:]
+                    window_text = rest_text
                     break
             else:
+                window_text = full
                 if over_cap:
                     self._done += full
                     self._ids = []
-        total = self._done + self._window_text()
+                    window_text = ""
+        if window_text is None:
+            window_text = self._tok.decode(self._ids)
+        if window_text.endswith("�"):
+            window_text = window_text[:-1]
+        total = self._done + window_text
         delta = total[self._emitted_len:]
         if delta:
             self._emitted_len = len(total)

@@ -39,7 +39,7 @@ def _reference_tokens(engine, token_id, n_steps=3):
 def test_ring_matches_single_device(engine, eight_devices, pp, tp):
     mesh = build_mesh(pp=pp, tp=tp)
     model = engine.model
-    fn = make_ring_decode_fn(model, mesh, param_keys=list(engine.window_params.keys()))
+    fn = make_ring_decode_fn(model, mesh, engine.window_params)
 
     kv_host = init_cache(model.kv_config(len(model.layers), 1, 32, "float32"))
     wp, ep, kv = place_ring_state(engine.window_params, engine.edge_params, kv_host, mesh)
@@ -72,7 +72,7 @@ def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp,
     ref = _reference_tokens(eng, 65, n_steps=3)
 
     mesh = build_mesh(pp=pp, tp=tp, sp=sp)
-    fn = make_ring_decode_fn(eng.model, mesh, param_keys=list(eng.window_params.keys()))
+    fn = make_ring_decode_fn(eng.model, mesh, eng.window_params)
     kv_host = init_cache(eng.model.kv_config(len(eng.model.layers), 1, 32, "float32"))
     wp, ep, kv = place_ring_state(eng.window_params, eng.edge_params, kv_host, mesh)
 
@@ -89,7 +89,7 @@ def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp,
 def test_ring_logits_close(engine, eight_devices):
     mesh = build_mesh(pp=2, tp=2)
     model = engine.model
-    fn = make_ring_decode_fn(model, mesh, param_keys=list(engine.window_params.keys()))
+    fn = make_ring_decode_fn(model, mesh, engine.window_params)
     kv_host = init_cache(model.kv_config(len(model.layers), 1, 32, "float32"))
     wp, ep, kv = place_ring_state(engine.window_params, engine.edge_params, kv_host, mesh)
 
