@@ -10,10 +10,19 @@ asymmetric head dims).  Architecture (matching transformers' DeepseekV2*):
 - Layers < first_k_dense_replace use a dense swiglu MLP; the rest use MoE:
   softmax-then-topk routing (greedy or group-limited), routed_scaling_factor,
   plus always-on shared experts.
-- Dense vs MoE layers have different param structures, so the stacked window
-  is a LIST of per-layer dicts (python-unrolled inside jit) instead of a
-  lax.scan — correctness first; two-segment scans are the planned
-  optimization.  MoE expert compute is dense-weighted (exact numerics).
+- Dense vs MoE layers have different param structures, so the window is TWO
+  stacked segments ({"dense": ..., "moe": ...}), each applied with one
+  lax.scan — compile time is layer-count-independent (two programs), and a
+  contiguous layer range is always a dense prefix + moe suffix.  MoE expert
+  compute is dense-weighted (exact numerics); `tp_axis` shards attention
+  heads and the EXPERT dim (expert-parallel ranks) with psum seams.
+- For the mesh ring (pp sharding), segments are zero-padded to pp
+  divisibility (zero o/down projections make a padded layer an exact
+  residual no-op) and the ring runs TWO laps (`ring_phases = 2`): every
+  rank applies its dense slice on lap 0 and its moe slice on lap 1, so the
+  global execution order stays all-dense-then-all-moe.  The KV cache is laid
+  out per-rank (dense rows then moe rows), which is exactly the local
+  slicing apply_window already uses.
 """
 
 from __future__ import annotations
@@ -35,7 +44,8 @@ from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
 
 class DeepseekV2RingModel(RingModel):
     model_type = "deepseek_v2"
-    supports_kv_commit = False  # apply_window rejects kv_commit (pp-only)
+    supports_kv_commit = True
+    ring_phases = 2  # mesh ring: lap 0 = dense slices, lap 1 = moe slices
     quant_keys = frozenset(
         {"wq", "wq_a", "wq_b", "wkv_a", "wkv_b", "wo",  # MLA projections
          "w_gate", "w_up", "w_down",  # dense mlp
@@ -105,10 +115,9 @@ class DeepseekV2RingModel(RingModel):
     def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
         return edge_params["embed"]["weight"][tokens]
 
-    def _attention(self, p, x, kvs, pos, mask):
+    def _attention(self, p, x, kvs, pos, mask, tp_axis=None, kv_commit=None):
         cfg = self.config
         B, T, D = x.shape
-        H = cfg.num_attention_heads
         nope, rope_d, vd = self.qk_nope_head_dim, self.qk_rope_head_dim, self.v_head_dim
 
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
@@ -117,10 +126,12 @@ class DeepseekV2RingModel(RingModel):
         else:
             qa = rms_norm(h @ dq(p["wq_a"]), p["q_a_norm"], 1e-6)
             q = qa @ dq(p["wq_b"])
+        # local head count from the (possibly tp-sharded) projection shape
+        H = q.shape[-1] // self.qk_head_dim
         q = q.reshape(B, T, H, self.qk_head_dim)
         q_nope, q_pe = q[..., :nope], q[..., nope:]
 
-        ckv = h @ dq(p["wkv_a"])  # [B, T, kv_lora + rope_d]
+        ckv = h @ dq(p["wkv_a"])  # [B, T, kv_lora + rope_d] (replicated)
         k_latent, k_pe = ckv[..., : self.kv_lora_rank], ckv[..., self.kv_lora_rank:]
         k_latent = rms_norm(k_latent, p["kv_a_norm"], 1e-6)
         kv = (k_latent @ dq(p["wkv_b"])).reshape(B, T, H, nope + vd)
@@ -136,10 +147,12 @@ class DeepseekV2RingModel(RingModel):
         q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
         k_full = jnp.concatenate([k_nope, k_pe], axis=-1)
 
-        kvs = write_kv(kvs, k_full, v, pos)
+        kvs = write_kv(kvs, k_full, v, pos, kv_commit=kv_commit)
         kc, vc = read_kv(kvs)
         attn = attend(q_full, kc, vc, mask=mask, scale=self.softmax_scale)
         out = attn.reshape(B, T, H * vd) @ dq(p["wo"])
+        if tp_axis is not None:
+            out = lax.psum(out, tp_axis)
         return x + out, kvs
 
     def _dense_mlp(self, p_prefix: dict, h: jnp.ndarray) -> jnp.ndarray:
@@ -147,7 +160,7 @@ class DeepseekV2RingModel(RingModel):
         up = h @ dq(p_prefix["w_up"])
         return (jax.nn.silu(gate) * up) @ dq(p_prefix["w_down"])
 
-    def _moe(self, p, x):
+    def _moe(self, p, x, tp_axis=None):
         B, T, D = x.shape
         h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
         flat = h.reshape(B * T, D)
@@ -174,28 +187,51 @@ class DeepseekV2RingModel(RingModel):
 
         weights = jnp.zeros_like(scores).at[
             jnp.arange(flat.shape[0])[:, None], topk_idx
-        ].set(topk_w)  # [N, E]
+        ].set(topk_w)  # [N, E] over the GLOBAL expert space
 
-        # dense-weighted expert compute (exact: zero weight for non-top-k)
+        # dense-weighted expert compute over THIS rank's experts (exact:
+        # zero weight for non-top-k); tp ranks are expert-parallel
+        from dnet_tpu.ops.quant import lead_dim
+
+        E_local = lead_dim(p["e_gate"])
         gate = jnp.einsum("nd,edf->nef", flat, dq(p["e_gate"]))
         up = jnp.einsum("nd,edf->nef", flat, dq(p["e_up"]))
         inner = jax.nn.silu(gate) * up
         expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["e_down"]))
-        routed = jnp.einsum("ned,ne->nd", expert_out, weights.astype(flat.dtype))
+        if tp_axis is not None:
+            e_off = lax.axis_index(tp_axis) * E_local
+            w_local = lax.dynamic_slice_in_dim(weights, e_off, E_local, axis=1)
+        else:
+            w_local = weights
+        routed = jnp.einsum("ned,ne->nd", expert_out, w_local.astype(flat.dtype))
 
         shared = self._dense_mlp(
             {"w_gate": p["s_gate"], "w_up": p["s_up"], "w_down": p["s_down"]}, flat
         )
-        return x + (routed + shared).reshape(B, T, D)
+        out = routed + shared
+        if tp_axis is not None:
+            out = lax.psum(out, tp_axis)
+        return x + out.reshape(B, T, D)
 
-    def _layer(self, p: dict, x, kvs, pos, mask):
-        x, kvs = self._attention(p, x, kvs, pos, mask)
+    def _layer(self, p: dict, x, kvs, pos, mask, tp_axis=None, kv_commit=None):
+        x, kvs = self._attention(p, x, kvs, pos, mask, tp_axis, kv_commit)
         if "e_gate" in p:
-            x = self._moe(p, x)
+            x = self._moe(p, x, tp_axis)
         else:
             h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
-            x = x + self._dense_mlp(p, h)
+            out = self._dense_mlp(p, h)
+            if tp_axis is not None:
+                out = lax.psum(out, tp_axis)
+            x = x + out
         return x, kvs
+
+    def _scan_segment(self, seg, x, kv_seg, pos, mask, tp_axis, kv_commit):
+        def body(carry, per_layer):
+            p, kvs = per_layer
+            xc, kvs = self._layer(p, carry, kvs, pos, mask, tp_axis, kv_commit)
+            return xc, kvs
+
+        return lax.scan(body, x, (seg, kv_seg))
 
     def apply_window(
         self,
@@ -208,19 +244,54 @@ class DeepseekV2RingModel(RingModel):
         tp_axis: Optional[str] = None,
         kv_commit=None,
         sp_axis: Optional[str] = None,
+        phase=None,
     ) -> Tuple[jnp.ndarray, dict]:
-        if tp_axis is not None or kv_commit is not None or sp_axis is not None:
+        """Two-segment scan: the window's dense prefix, then its moe suffix.
+
+        `phase` (traced int, mesh ring only) selects ONE segment per call:
+        the ring runs `ring_phases` laps so the global layer order stays
+        all-dense-then-all-moe even though each pp rank holds a slice of
+        both segments.
+        """
+        if sp_axis is not None:
             raise NotImplementedError(
-                "deepseek_v2 TP/SP/ring-program support is pending; run pp-only"
+                "deepseek_v2 sequence parallelism is pending; run pp/tp"
             )
         if mask is None:
             mask = causal_mask(x.shape[1], kv["k"].shape[2], pos)
-        layers: List[dict] = window_params["layers"]
-        for li, p in enumerate(layers):
-            kvs = jax.tree.map(lambda a: a[li], kv)
-            x, kvs = self._layer(p, x, kvs, pos, mask)
-            kv = jax.tree.map(lambda full, one: full.at[li].set(one), kv, kvs)
-        return x, kv
+        dense = window_params.get("dense")
+        moe = window_params.get("moe")
+        Ld = dense["attn_norm"].shape[0] if dense is not None else 0
+
+        def run_dense(x, kv):
+            if dense is None:
+                return x, kv
+            kv_seg = jax.tree.map(lambda a: a[:Ld], kv)
+            x, kv_seg = self._scan_segment(
+                dense, x, kv_seg, pos, mask, tp_axis, kv_commit
+            )
+            kv = jax.tree.map(lambda f, s: f.at[:Ld].set(s), kv, kv_seg)
+            return x, kv
+
+        def run_moe(x, kv):
+            if moe is None:
+                return x, kv
+            kv_seg = jax.tree.map(lambda a: a[Ld:], kv)
+            x, kv_seg = self._scan_segment(
+                moe, x, kv_seg, pos, mask, tp_axis, kv_commit
+            )
+            kv = jax.tree.map(lambda f, s: f.at[Ld:].set(s), kv, kv_seg)
+            return x, kv
+
+        if phase is None:
+            x, kv = run_dense(x, kv)
+            return run_moe(x, kv)
+        return lax.cond(
+            phase == 0,
+            lambda args: run_dense(*args),
+            lambda args: run_moe(*args),
+            (x, kv),
+        )
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)
@@ -232,24 +303,59 @@ class DeepseekV2RingModel(RingModel):
 
     # ---- weight mapping ----------------------------------------------
     def stack_layers(self, per_layer: List[Dict[str, np.ndarray]]):
-        """Heterogeneous layers (dense vs MoE): keep a list, no stacking."""
-        return {"layers": list(per_layer)}
+        """Two homogeneous stacked segments: the window's dense prefix and
+        its moe suffix (a contiguous layer range is always dense-then-moe
+        because dense layers come first globally)."""
+        n_dense = sum(1 for a in self.layers if not self.is_moe_layer(a))
+        out: Dict[str, Any] = {}
+        if per_layer[:n_dense]:
+            out["dense"] = RingModel.stack_layers(per_layer[:n_dense])
+        if per_layer[n_dense:]:
+            out["moe"] = RingModel.stack_layers(per_layer[n_dense:])
+        return out
 
     def quantize_params(self, stacked, bits: int, scale_dtype=None, group_size: int = 0):
         from dnet_tpu.ops.quant import quantize_tree
 
         return {
-            "layers": [
-                quantize_tree(
-                    p, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
-                    group_size=group_size,
-                )
-                for p in stacked["layers"]
-            ]
+            seg: quantize_tree(
+                tree, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
+                group_size=group_size,
+            )
+            for seg, tree in stacked.items()
         }
 
     def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
-        return {"layers": [mapped]}
+        seg = "moe" if "e_gate" in mapped else "dense"
+        return {seg: jax.tree.map(lambda v: v[None], mapped)}
+
+    def pad_mesh_segments(self, stacked: dict, pp: int):
+        """Zero-pad each segment's layer axis to a multiple of pp so its
+        stack shards evenly over the pipeline axis.  A zero layer is an
+        exact residual no-op (zero o/down/expert projections contribute
+        nothing), so padded numerics are unchanged.  Returns
+        (padded_stacked, n_kv_layers): the mesh KV cache is laid out
+        per-rank (each rank's dense rows then its moe rows)."""
+
+        def pad_seg(tree, target):
+            def pad(a):
+                n = target - a.shape[0]
+                if n == 0:
+                    return a
+                return np.concatenate(
+                    [a, np.zeros((n, *a.shape[1:]), dtype=a.dtype)], axis=0
+                )
+
+            return jax.tree.map(pad, tree)
+
+        out = {}
+        total = 0
+        for seg, tree in stacked.items():
+            L = jax.tree.leaves(tree)[0].shape[0]
+            target = -(-L // pp) * pp  # ceil to pp multiple
+            out[seg] = pad_seg(tree, target)
+            total += target
+        return out, total
 
     def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         def t(name):

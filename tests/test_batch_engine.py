@@ -175,11 +175,14 @@ def test_seeded_sampling_immune_to_other_traffic(tiny_llama_dir):
     assert run(0) == run(3)
 
 
-def test_deepseek_rejected_at_load(tmp_path_factory):
+def test_deepseek_accepted_at_load(tmp_path_factory):
+    """DeepSeek-V2 now gates its KV writes (supports_kv_commit), so the
+    batched engine must accept it (full behavior covered by
+    tests/test_deepseek_mesh_batch.py)."""
     from tests.fakes.checkpoints import make_tiny_deepseek_v2
     from dnet_tpu.core.batch import BatchedEngine
 
     d = tmp_path_factory.mktemp("batch_dsv2")
     make_tiny_deepseek_v2(d)
-    with pytest.raises(NotImplementedError, match="batching"):
-        BatchedEngine(d, slots=2, max_seq=32, param_dtype="float32")
+    eng = BatchedEngine(d, slots=2, max_seq=32, param_dtype="float32")
+    assert eng.model.supports_kv_commit
