@@ -211,19 +211,38 @@ def main() -> None:
     # weight-bound decode bound: weights are read once per STEP, so N batch
     # lanes share one read — the aggregate bound scales with batch
     roofline = batch * hbm_bw / param_bytes
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(tok_s, 2),
-                "unit": "tok/s",
-                "vs_baseline": round(tok_s / roofline, 4),
-                "fused_tok_s": round(fused_tok_s, 2),
-                "serve_vs_fused": round(tok_s / fused_tok_s, 4),
-                "ttft_p50_ms": round(served["ttft_p50_ms"], 1),
-            }
-        )
-    )
+    out = {
+        "metric": metric,
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / roofline, 4),
+        "fused_tok_s": round(fused_tok_s, 2),
+        "serve_vs_fused": round(tok_s / fused_tok_s, 4),
+        "ttft_p50_ms": round(served["ttft_p50_ms"], 1),
+    }
+    if "--smoke" in sys.argv:
+        out.update(_compress_microbench())
+    print(json.dumps(out))
+
+
+def _compress_microbench() -> dict:
+    """DCN wire-format round-trip rates (smoke mode only)."""
+    import numpy as np
+
+    from dnet_tpu.compression import compress_tensor, decompress_tensor
+
+    x = np.random.default_rng(0).normal(size=(1, 64, 2048)).astype(np.float32)
+    out = {}
+    for name, bits in (("sparse_v1", 0), ("qsparse8_v1", 8)):
+        p, d, s = compress_tensor(x, 0.5, quant_bits=bits)  # warm
+        t0 = time.perf_counter()
+        for _ in range(5):
+            p, d, s = compress_tensor(x, 0.5, quant_bits=bits)
+            decompress_tensor(p, d, s)
+        dt = (time.perf_counter() - t0) / 5
+        out[f"{name}_roundtrip_ms"] = round(dt * 1000, 2)
+        out[f"{name}_ratio"] = round(x.nbytes / len(p), 2)
+    return out
 
 
 def _chip_gen(dev) -> str:

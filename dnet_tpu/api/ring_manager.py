@@ -20,6 +20,16 @@ from dnet_tpu.utils.tokenizer import load_tokenizer
 log = get_logger()
 
 
+def _contiguous_runs(layers: List[int]) -> List[List[int]]:
+    runs: List[List[int]] = []
+    for a in layers:
+        if runs and a == runs[-1][-1] + 1:
+            runs[-1].append(a)
+        else:
+            runs.append([a])
+    return runs
+
+
 def build_manual_topology(
     model: str,
     num_layers: int,
@@ -51,13 +61,12 @@ def build_manual_topology(
             f"assignments must cover layers 0..{num_layers - 1} exactly once; "
             f"got {sorted(covered)}"
         )
+    # non-contiguous assignments are k-round schedules: each contiguous run
+    # is one ring visit (shard/compute.py:_process_round); frames for a
+    # layer a shard doesn't own relay along the ring's next pointers, so
+    # exact coverage is the only structural requirement
     for a in las:
-        # each shard applies its layers as one contiguous window; a gap would
-        # silently run layers out of order
-        if a.layers != list(range(a.layers[0], a.layers[-1] + 1)):
-            raise ValueError(
-                f"layers for {a.instance!r} must be contiguous; got {a.layers}"
-            )
+        a.rounds = _contiguous_runs(a.layers)
     for i, a in enumerate(las):
         a.next_instance = las[(i + 1) % len(las)].instance
     used = [by_instance[a.instance] for a in las]
@@ -111,11 +120,20 @@ class RingModelManager:
         by_instance = {d.instance: d for d in topo.devices}
         max_seq = max_seq or self.max_seq
 
+        # k-round schedules wrap the ring: even the tail shard forwards its
+        # mid-round hidden frames to the head (final tokens still go to the
+        # API callback), so it needs a live next hop
+        multi_round = any(
+            len(_contiguous_runs(a.layers)) > 1 for a in topo.assignments
+        )
         async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
             for a in topo.assignments:
                 dev = by_instance[a.instance]
                 nxt = by_instance.get(a.next_instance)
-                is_last_hop = a.next_instance == topo.assignments[0].instance
+                is_last_hop = (
+                    not multi_round
+                    and a.next_instance == topo.assignments[0].instance
+                )
                 body = {
                     "model_path": model_id,
                     "layers": a.layers,

@@ -7,7 +7,12 @@ gRPC hops, where column sparsification cuts activation bytes at a small
 accuracy cost.  Kernels are Pallas (TPU) with a jnp fallback.
 """
 
-from dnet_tpu.compression.ops import column_l2_norms, column_sparsify
+from dnet_tpu.compression.ops import (
+    column_l2_norms,
+    column_sparsify,
+    gather_columns,
+    scatter_columns,
+)
 from dnet_tpu.compression.wire import (
     compress_tensor,
     decompress_tensor,
@@ -17,6 +22,8 @@ from dnet_tpu.compression.wire import (
 __all__ = [
     "column_l2_norms",
     "column_sparsify",
+    "gather_columns",
+    "scatter_columns",
     "compress_tensor",
     "decompress_tensor",
     "is_compressed_dtype",

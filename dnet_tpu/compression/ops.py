@@ -74,6 +74,100 @@ def column_l2_norms(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(xf * xf, axis=0)
 
 
+def _matmul_kernel(a_ref, b_ref, o_ref):
+    """Tiled matmul with accumulation over the contraction grid axis (TPU
+    grids run sequentially, so revisiting o_ref is safe)."""
+    import jax.experimental.pallas as pl
+
+    d = pl.program_id(2)
+
+    @pl.when(d == 0)
+    def _():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += jax.lax.dot_general(
+        a_ref[:].astype(jnp.float32),
+        b_ref[:].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _tile(n: int, candidates) -> int:
+    """Largest candidate tile that divides n exactly (grids must cover n —
+    a floor-division remainder would silently skip rows)."""
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return 0
+
+
+def _pallas_matmul(a: jnp.ndarray, b: jnp.ndarray):
+    """a [R, D] @ b [D, K] on the MXU via Pallas (gather/scatter engine:
+    b is a one-hot selection matrix, reference kernels.py k_gather_cols /
+    k_scatter_from_compact)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, D = a.shape
+    _, K = b.shape
+    tr = _tile(R, (256, 128, 64, 32, 16, 8))
+    td = _tile(D, (512, 256, 128))
+    tk = _tile(K, (256, 128))
+    assert tr and td and tk, "caller guards exact tiling"
+    grid = (R // tr, K // tk, D // td)
+    out = pl.pallas_call(
+        _matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tr, td), lambda i, k, d: (i, d), memory_space=pltpu.VMEM),
+            pl.BlockSpec((td, tk), lambda i, k, d: (d, k), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tr, tk), lambda i, k, d: (i, k), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((R, K), jnp.float32),
+    )(a, b)
+    return out
+
+
+def _pallas_selectable(rows: int, contraction: int, out: int) -> bool:
+    return (
+        jax.devices()[0].platform == "tpu"
+        and _tile(rows, (256, 128, 64, 32, 16, 8)) > 0
+        and _tile(contraction, (512, 256, 128)) > 0
+        and _tile(out, (256, 128)) > 0
+    )
+
+
+def gather_columns(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """[R, D] -> [R, K]: select columns `idx` (MXU one-hot select on TPU —
+    the analog of the reference's k_gather_cols Metal kernel; a plain
+    O(R*K) take elsewhere)."""
+    R, D = x.shape
+    K = idx.shape[0]
+    if _pallas_selectable(R, D, K):
+        onehot = (jnp.arange(D)[:, None] == idx[None, :]).astype(jnp.float32)
+        try:
+            return _pallas_matmul(x, onehot).astype(x.dtype)
+        except Exception:  # pallas/mosaic unavailable: fall back
+            pass
+    return jnp.take(x, idx, axis=1)
+
+
+def scatter_columns(kept: jnp.ndarray, idx: jnp.ndarray, D: int) -> jnp.ndarray:
+    """[R, K] -> [R, D]: scatter kept columns back, zeros elsewhere
+    (reference k_scatter_from_compact analog)."""
+    R, K = kept.shape
+    if _pallas_selectable(R, K, D):
+        onehot = (idx[:, None] == jnp.arange(D)[None, :]).astype(jnp.float32)
+        try:
+            return _pallas_matmul(kept, onehot).astype(kept.dtype)
+        except Exception:
+            pass
+    return jnp.zeros((R, D), dtype=kept.dtype).at[:, idx].set(kept)
+
+
 @functools.partial(jax.jit, static_argnames=("keep",))
 def _topk_column_mask(norms: jnp.ndarray, keep: int) -> jnp.ndarray:
     C = norms.shape[0]

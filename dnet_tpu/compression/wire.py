@@ -1,14 +1,24 @@
-"""Sparse wire format for DCN activation hops.
+"""Sparse wire formats for DCN activation hops.
 
-Reference: src/dnet/compression/wire.py:80-171 — `sparse_v1` packs a column
-bitmask + the kept fp16 columns, with metadata smuggled through the frame's
-dtype string.  Same scheme here:
+Reference: src/dnet/compression/wire.py:80-171 — two true-sparse formats
+with metadata smuggled through the frame's dtype string:
 
-  dtype = "<base>|fmt=sparse_v1|pct=<drop_frac>|orig=<C>"
-  payload = [bitmask bytes (ceil(C/8))] + [kept columns, column-major f16]
+  sparse_v1   (bf16 kept columns, exact on kept data):
+    dtype   = "<base>|fmt=sparse_v1|pct=<drop_frac>|orig=<C>"
+    payload = [column bitmask ceil(C/8)] + [kept columns <base>]
 
-Compression/decompression are host-side (the wire is host-bound anyway);
-the column selection runs on device via compression.ops.
+  qsparse8_v1 (int8-affine kept columns, ~4x denser than bf16 kept):
+    dtype   = "<base>|fmt=qsparse8_v1|pct=<drop_frac>|orig=<C>|gs=<G>"
+    payload = [column bitmask] + [uint8 codes R*K] +
+              [f32 scales R*ceil(K/gs)] + [f32 biases R*ceil(K/gs)]
+    codes are per-(row, group-of-kept-columns) affine: v = code*scale + bias
+    (the analog of the reference's uint8 codes + compact scales/biases,
+    wire.py:112-171; scales stay f32 because the KEPT columns are exactly
+    the large-norm activations that can overflow fp16; <base> is the
+    dequantized output dtype).
+
+Column selection and the gather run on device (compression.ops Pallas
+kernels); the byte packing is host-side — the wire is host-bound anyway.
 """
 
 from __future__ import annotations
@@ -17,24 +27,34 @@ from typing import Tuple
 
 import numpy as np
 
-from dnet_tpu.compression.ops import _topk_column_mask, column_l2_norms
+from dnet_tpu.compression.ops import (
+    _topk_column_mask,
+    column_l2_norms,
+    gather_columns,
+)
 from dnet_tpu.utils.serialization import numpy_dtype
 
 FMT_TAG = "fmt=sparse_v1"
+QFMT_TAG = "fmt=qsparse8_v1"
 
 
 def is_compressed_dtype(dtype: str) -> bool:
-    return "|" in dtype and FMT_TAG in dtype
+    return "|" in dtype and (FMT_TAG in dtype or QFMT_TAG in dtype)
 
 
 def compress_tensor(
-    x, drop_frac: float, wire_dtype: str = "bfloat16"
+    x,
+    drop_frac: float,
+    wire_dtype: str = "bfloat16",
+    quant_bits: int = 0,
+    group_size: int = 64,
 ) -> Tuple[bytes, str, Tuple[int, ...]]:
     """[B, T, D] (or [R, D]) activations -> sparse payload.
 
-    Column selection runs on device (norms + top-k); only the kept columns
-    leave the host.  wire_dtype defaults to bf16 — activations can exceed
-    fp16 range, and the kept columns are exactly the large-norm ones.
+    Column selection runs on device (norms + top-k + Pallas gather); only
+    the kept columns leave the host.  quant_bits=8 selects qsparse8_v1
+    (int8-affine kept columns with per-(row, group) f32 scales/biases);
+    0 keeps sparse_v1 (kept columns verbatim in wire_dtype).
     Returns (payload, tagged dtype string, original shape).
     """
     import jax.numpy as jnp
@@ -44,16 +64,45 @@ def compress_tensor(
     x2 = jnp.reshape(x, (-1, D))
     keep = max(int(round(D * (1.0 - drop_frac))), 1)
     mask_np = np.asarray(_topk_column_mask(column_l2_norms(x2), keep))
-    nd = numpy_dtype(wire_dtype)
-    kept = np.asarray(x2)[:, mask_np].astype(nd)
+    idx = np.nonzero(mask_np)[0]
+    kept_dev = gather_columns(x2, jnp.asarray(idx, dtype=jnp.int32))
     bitmask = np.packbits(mask_np)
-    payload = bitmask.tobytes() + np.ascontiguousarray(kept).tobytes()
-    dtype = f"{wire_dtype}|{FMT_TAG}|pct={drop_frac:g}|orig={D}"
+
+    if quant_bits == 0:
+        nd = numpy_dtype(wire_dtype)
+        kept = np.asarray(kept_dev).astype(nd)
+        payload = bitmask.tobytes() + np.ascontiguousarray(kept).tobytes()
+        dtype = f"{wire_dtype}|{FMT_TAG}|pct={drop_frac:g}|orig={D}"
+        return payload, dtype, orig_shape
+    if quant_bits != 8:
+        raise NotImplementedError(f"compress quant_bits={quant_bits} (0 or 8)")
+
+    # qsparse8_v1: per-(row, group) affine uint8 over the KEPT columns
+    R, K = kept_dev.shape
+    gs = max(int(group_size), 1)
+    G = -(-K // gs)
+    pad = G * gs - K
+    kf = jnp.pad(kept_dev.astype(jnp.float32), ((0, 0), (0, pad))).reshape(R, G, gs)
+    mn = jnp.min(kf, axis=-1)
+    mx = jnp.max(kf, axis=-1)
+    scale = jnp.maximum((mx - mn) / 255.0, 1e-12)
+    codes = jnp.clip(
+        jnp.round((kf - mn[..., None]) / scale[..., None]), 0, 255
+    ).astype(jnp.uint8)
+    codes_np = np.asarray(codes).reshape(R, G * gs)[:, :K]
+    payload = (
+        bitmask.tobytes()
+        + np.ascontiguousarray(codes_np).tobytes()
+        + np.asarray(scale, dtype=np.float32).tobytes()
+        + np.asarray(mn, dtype=np.float32).tobytes()
+    )
+    dtype = f"{wire_dtype}|{QFMT_TAG}|pct={drop_frac:g}|orig={D}|gs={gs}"
     return payload, dtype, orig_shape
 
 
 def decompress_tensor(payload: bytes, dtype: str, shape: Tuple[int, ...]) -> np.ndarray:
-    """Inverse of compress_tensor: scatter kept columns back to zeros."""
+    """Inverse of compress_tensor: (dequantize and) scatter kept columns
+    back to zeros."""
     if not is_compressed_dtype(dtype):
         raise ValueError(f"not a compressed dtype tag: {dtype!r}")
     base = dtype.split("|", 1)[0]
@@ -66,9 +115,29 @@ def decompress_tensor(payload: bytes, dtype: str, shape: Tuple[int, ...]) -> np.
     bitmask = np.unpackbits(
         np.frombuffer(payload[:mask_bytes], dtype=np.uint8), count=D
     ).astype(bool)
-    kept_count = int(bitmask.sum())
+    K = int(bitmask.sum())
     R = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
-    kept = np.frombuffer(payload[mask_bytes:], dtype=nd).reshape(R, kept_count)
+
+    if QFMT_TAG in dtype:
+        gs = int(fields["gs"])
+        G = -(-K // gs)
+        codes_end = mask_bytes + R * K
+        scales_end = codes_end + R * G * 4
+        codes = np.frombuffer(
+            payload[mask_bytes:codes_end], dtype=np.uint8
+        ).reshape(R, K)
+        scale = np.frombuffer(
+            payload[codes_end:scales_end], dtype=np.float32
+        ).reshape(R, G)
+        bias = np.frombuffer(
+            payload[scales_end:], dtype=np.float32
+        ).reshape(R, G)
+        pad = G * gs - K
+        cf = np.pad(codes.astype(np.float32), ((0, 0), (0, pad))).reshape(R, G, gs)
+        kept = (cf * scale[..., None] + bias[..., None]).reshape(R, G * gs)[:, :K]
+        kept = kept.astype(nd)
+    else:
+        kept = np.frombuffer(payload[mask_bytes:], dtype=nd).reshape(R, K)
     out = np.zeros((R, D), dtype=nd)
     out[:, bitmask] = kept
     return out.reshape(shape)

@@ -67,11 +67,9 @@ class BatchedEngine:
         self.config = self.eng.config
         self.model = self.eng.model
         m = self.eng.model
-        self.kv = init_cache(
-            m.kv_config(
-                len(m.layers), slots, self.max_seq, self.eng.kv_dtype,
-                quant_bits=self.eng.kv_quant_bits,
-            )
+        self.kv = m.init_kv(
+            len(m.layers), slots, self.max_seq, self.eng.kv_dtype,
+            quant_bits=self.eng.kv_quant_bits,
         )
         V = self.config.vocab_size
         self.counts = jnp.zeros((slots, V), dtype=jnp.int32)

@@ -236,7 +236,7 @@ class LocalEngine:
 
         def full_logits(window_params, edge_params, tokens, kv, pos, last_idx):
             x = model.embed(edge_params, tokens)
-            x, kv = model.apply_window(window_params, x, kv, pos)
+            x, kv = model.apply_window(window_params, x, kv, pos, t_real=last_idx + 1)
             x_last = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
             x_last = model.normalize(edge_params, x_last)
             logits = model.lm_project(edge_params, x_last)
@@ -278,23 +278,41 @@ class LocalEngine:
             decode_chunk_fn, static_argnums=(8,), donate_argnums=(3, 7)
         )
 
-        def hidden_step(window_params, x, kv, pos, kinds=None):
-            return model.apply_window(window_params, x, kv, pos, layer_kinds=kinds)
+        def hidden_step(window_params, x, kv, pos, t_real, kinds=None):
+            return model.apply_window(
+                window_params, x, kv, pos, layer_kinds=kinds, t_real=t_real
+            )
 
         # mid-shard path (no embed/head): used by the ring runtime and the
         # offload per-layer loop (kinds slices the mixed-attention array)
         self._hidden = jax.jit(hidden_step, donate_argnums=(2,))
 
-        def embed_window(window_params, edge_params, tokens, kv, pos):
+        def hidden_round(window_params, x, kv, pos, t_real, lo, hi, kinds=None):
+            """One ring ROUND: apply the [lo, hi) slice of this engine's
+            stacked layers (static bounds -> one compiled program per round;
+            XLA slices in place, no host-side weight copies)."""
+            wp = jax.tree.map(lambda a: a[lo:hi], window_params)
+            kv_r = jax.tree.map(lambda a: a[lo:hi], kv)
+            x, kv_r = model.apply_window(
+                wp, x, kv_r, pos, layer_kinds=kinds, t_real=t_real
+            )
+            kv = jax.tree.map(lambda f, s: f.at[lo:hi].set(s), kv, kv_r)
+            return x, kv
+
+        self._hidden_round = jax.jit(
+            hidden_round, static_argnums=(5, 6), donate_argnums=(2,)
+        )
+
+        def embed_window(window_params, edge_params, tokens, kv, pos, t_real):
             """First-shard path: embed + this shard's window, hidden out."""
             x = model.embed(edge_params, tokens)
-            return model.apply_window(window_params, x, kv, pos)
+            return model.apply_window(window_params, x, kv, pos, t_real=t_real)
 
         self._embed_window = jax.jit(embed_window, donate_argnums=(3,))
 
         def hidden_tail(window_params, edge_params, x, kv, pos, last_idx, sp, key, counts):
             """Last-shard path: window + normalize + head + sample."""
-            x, kv = model.apply_window(window_params, x, kv, pos)
+            x, kv = model.apply_window(window_params, x, kv, pos, t_real=last_idx + 1)
             x_last = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
             x_last = model.normalize(edge_params, x_last)
             logits = model.lm_project(edge_params, x_last)[:, 0]
@@ -305,7 +323,7 @@ class LocalEngine:
         self._hidden_tail = jax.jit(hidden_tail, donate_argnums=(3, 8))
 
     # ---- offload execution --------------------------------------------
-    def run_layers(self, sess: "Session", x: jnp.ndarray, pos: int) -> jnp.ndarray:
+    def run_layers(self, sess: "Session", x: jnp.ndarray, pos: int, t_real=None) -> jnp.ndarray:
         """Apply this engine's layers to x under the active policy.
 
         Fit: one fused scan over the resident stack.  Offload/sliding_fit:
@@ -314,14 +332,27 @@ class LocalEngine:
         the next window during compute, release+evict behind us, and wrap
         the prefetch to window 0 for the next token
         (reference offload.py:183-421)."""
+        t_real = jnp.int32(x.shape[1] if t_real is None else t_real)
         if not self.plan.streams_weights:
-            x, sess.kv = self._hidden(self.window_params, x, sess.kv, jnp.int32(pos))
+            x, sess.kv = self._hidden(
+                self.window_params, x, sess.kv, jnp.int32(pos), t_real
+            )
             return x
-        windows = self._windows
+        return self._stream_windows(sess, x, pos, t_real, self._windows, None)
+
+    def _stream_windows(
+        self, sess, x, pos, t_real, windows, prefetch_after
+    ) -> jnp.ndarray:
+        """Window-at-a-time weight-streaming loop; `prefetch_after` (a layer
+        list) overrides the wrap-to-first prefetch — multi-round rings
+        prefetch the NEXT round's window while other devices compute."""
         sliding = self.plan.name == "sliding_fit"
         for wi, window in enumerate(windows):
-            nxt = windows[(wi + 1) % len(windows)]
-            if len(windows) > 1:
+            if wi + 1 < len(windows):
+                nxt = windows[wi + 1]
+            else:
+                nxt = prefetch_after if prefetch_after is not None else windows[0]
+            if len(windows) > 1 or prefetch_after is not None:
                 self.weight_cache.prefetch(nxt)
             for layer in window:
                 p = self.weight_cache.get(layer)
@@ -332,16 +363,51 @@ class LocalEngine:
                     else self.model.layer_kinds[li : li + 1]
                 )
                 x, sess.kv_list[li] = self._hidden(
-                    p, x, sess.kv_list[li], jnp.int32(pos), kinds
+                    p, x, sess.kv_list[li], jnp.int32(pos), t_real, kinds
                 )
                 # unpin immediately so the residency budget can evict behind
                 # us; sliding_fit (residency < window) delta-swaps eagerly
                 self.weight_cache.release([layer])
                 if sliding:
                     self.weight_cache.evict([layer])
-            if len(windows) > 1 and not sliding:
+            if (len(windows) > 1 or prefetch_after is not None) and not sliding:
                 self.weight_cache.evict(window)  # make room for what's coming
         return x
+
+    def apply_round(
+        self,
+        sess: "Session",
+        x: jnp.ndarray,
+        pos: int,
+        run: Sequence[int],
+        t_real=None,
+        prefetch_next: Optional[Sequence[int]] = None,
+    ) -> jnp.ndarray:
+        """Apply ONE contiguous round (`run`) of this engine's layers — the
+        k-round ring schedule (reference api/utils.py:62-131): a device's
+        layers are dealt in k contiguous chunks and the activation visits it
+        k times per token, so streamed weights prefetch while OTHER devices
+        compute.  `prefetch_next` seeds the next round's first window."""
+        m = self.model
+        t_real = jnp.int32(x.shape[1] if t_real is None else t_real)
+        if not self.plan.streams_weights:
+            if getattr(m, "pair_kinds", None) or getattr(m, "ring_phases", 1) > 1:
+                raise NotImplementedError(
+                    "multi-round rings need a flat layer stack "
+                    "(gpt_oss paired / deepseek segmented layouts pending)"
+                )
+            lo, hi = m.abs_to_local[run[0]], m.abs_to_local[run[-1]] + 1
+            kinds = None if m.layer_kinds is None else m.layer_kinds[lo:hi]
+            x, sess.kv = self._hidden_round(
+                self.window_params, x, sess.kv, jnp.int32(pos), t_real, lo, hi,
+                kinds,
+            )
+            return x
+        w = self.plan.window_size or len(run)
+        windows = [list(run[i : i + w]) for i in range(0, len(run), w)]
+        return self._stream_windows(
+            sess, x, pos, t_real, windows, list(prefetch_next or [])[:w] or None
+        )
 
     # ---- sessions -----------------------------------------------------
     def new_session(
@@ -365,11 +431,9 @@ class LocalEngine:
                     for _ in self.model.layers
                 ]
             else:
-                kv = init_cache(
-                    self.model.kv_config(
-                        len(self.model.layers), self.batch, self.max_seq, self.kv_dtype,
-                        quant_bits=self.kv_quant_bits,
-                    )
+                kv = self.model.init_kv(
+                    len(self.model.layers), self.batch, self.max_seq,
+                    self.kv_dtype, quant_bits=self.kv_quant_bits,
                 )
         sess = Session(
             kv=kv,
@@ -439,7 +503,7 @@ class LocalEngine:
         tokens[:, :T] = np.asarray(prompt_ids, dtype=np.int32)
         if self.plan.streams_weights:
             x = self.model.embed(self.edge_params, jnp.asarray(tokens))
-            x = self.run_layers(sess, x, sess.pos)
+            x = self.run_layers(sess, x, sess.pos, t_real=T)
             x_last = jax.lax.dynamic_slice_in_dim(x, T - 1, 1, axis=1)
             x_last = self.model.normalize(self.edge_params, x_last)
             logits = self.model.lm_project(self.edge_params, x_last)[:, 0]
@@ -470,7 +534,7 @@ class LocalEngine:
         token = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
         if self.plan.streams_weights:
             x = self.model.embed(self.edge_params, token)
-            x = self.run_layers(sess, x, sess.pos)
+            x = self.run_layers(sess, x, sess.pos, t_real=1)
             x = self.model.normalize(self.edge_params, x)
             logits = self.model.lm_project(self.edge_params, x)[:, 0]
             res = sample(logits, sp, step_key, token_counts=sess.counts)

@@ -152,6 +152,47 @@ def write_kv(kvs: dict, k_new: jnp.ndarray, v_new: jnp.ndarray, pos, kv_commit=N
     return out
 
 
+def write_kv_rotating(
+    kvs: dict,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos,
+    kv_commit=None,
+    t_real=None,
+) -> dict:
+    """Ring-buffer write: token at absolute position p lands in slot p % W
+    (W = the cache's row count).  Arbitrary chunk length T in ONE vectorized
+    gather+where — each slot receives its MOST RECENT in-chunk token (for
+    T > W the early tokens are dead on arrival, exactly the sliding-window
+    semantics).  kv_commit gates the whole write."""
+    quant = "k_scale" in kvs
+    W = kvs["k"].shape[1]
+    T = k_new.shape[1]
+    s = jnp.arange(W)
+    j0 = jnp.mod(s - pos, W)
+    # most recent chunk index j < T with (pos + j) % W == s, or negative
+    t_eff = T if t_real is None else t_real
+    j = j0 + W * ((t_eff - 1 - j0) // W)
+    valid = (j >= 0) & (j < t_eff)
+    if kv_commit is not None:
+        valid = valid & kv_commit
+    jc = jnp.clip(j, 0, T - 1)
+    sel = valid[None, :, None, None]
+    if quant:
+        quantize = _quantize_q4 if kvs["k"].dtype == jnp.uint8 else _quantize_q8
+        kq, ks = quantize(k_new)
+        vq, vs = quantize(v_new)
+        items = [("k", kq), ("k_scale", ks), ("v", vq), ("v_scale", vs)]
+    else:
+        items = [("k", k_new), ("v", v_new)]
+    out = dict(kvs)
+    for name, val in items:
+        c = kvs[name]
+        taken = jnp.take(val.astype(c.dtype), jc, axis=1)
+        out[name] = jnp.where(sel, taken, c)
+    return out
+
+
 def write_kv_sp(
     kvs: dict,
     k_new: jnp.ndarray,

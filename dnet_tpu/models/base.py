@@ -134,6 +134,7 @@ class RingModel(abc.ABC):
         tp_axis: Optional[str] = None,
         kv_commit=None,
         sp_axis: Optional[str] = None,
+        t_real=None,
     ) -> Tuple[jnp.ndarray, dict]:
         """Apply a stacked window of layers. kv holds this window's slices.
 
@@ -141,6 +142,10 @@ class RingModel(abc.ABC):
         shard_map (params are per-device slices; reductions psum over it).
         kv_commit: optional traced bool gating cache writes (pipeline ranks
         processing a not-their-turn copy pass False).
+        t_real: number of REAL (non-padding) tokens in this chunk (traced);
+        models with rotating ring-buffer caches must exclude bucket padding
+        from writes, because padded positions would wrap around and destroy
+        live rows.  None means every token is real.
         """
 
     @abc.abstractmethod
@@ -188,6 +193,27 @@ class RingModel(abc.ABC):
             head_dim=self.config.head_dim,
             dtype=dtype,
             quant_bits=quant_bits,
+        )
+
+    def init_kv(
+        self,
+        n_layers: int,
+        batch: int,
+        max_seq: int,
+        dtype: str = "bfloat16",
+        quant_bits: int = 0,
+        rotating: bool = True,
+    ) -> dict:
+        """Allocate the stacked KV cache matching this model's window layout.
+
+        Default: one flat [L, B, S, ...] cache.  Models with per-kind cache
+        shapes (gpt_oss paired SWA ring buffers) override; `rotating=False`
+        forces full-length caches (sequence-parallel serving shards the S
+        axis and needs uniform length)."""
+        from dnet_tpu.core.kvcache import init_cache
+
+        return init_cache(
+            self.kv_config(n_layers, batch, max_seq, dtype, quant_bits=quant_bits)
         )
 
     # ---- helpers ------------------------------------------------------

@@ -245,6 +245,7 @@ class DeepseekV2RingModel(RingModel):
         kv_commit=None,
         sp_axis: Optional[str] = None,
         phase=None,
+        t_real=None,  # full-length caches overwrite padding before reading
     ) -> Tuple[jnp.ndarray, dict]:
         """Two-segment scan: the window's dense prefix, then its moe suffix.
 

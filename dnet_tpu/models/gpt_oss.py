@@ -31,6 +31,7 @@ from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.ops.attention import (
     cached_attend,
     causal_mask,
+    rotating_cached_attend,
     sliding_window_mask,
     sp_causal_mask,
     sp_sliding_window_mask,
@@ -57,16 +58,24 @@ class GptOssRingModel(RingModel):
         self.inv_freq = jnp.asarray(inv_freq)
         kinds = config.layer_types or ["full_attention"] * config.num_hidden_layers
         # kind per ASSIGNED layer (0=full, 1=sliding), aligned with the stack
-        self.layer_kinds = jnp.asarray(
-            [1 if kinds[a] == "sliding_attention" else 0 for a in self.layers],
-            dtype=jnp.int32,
-        )
+        kind_list = [1 if kinds[a] == "sliding_attention" else 0 for a in self.layers]
+        self.layer_kinds = jnp.asarray(kind_list, dtype=jnp.int32)
+        # paired layout: gpt-oss alternates sliding/full, so stacking the
+        # even and odd halves separately makes each half kind-homogeneous —
+        # static masks, and the sliding half's cache can be an O(window)
+        # ring buffer instead of full length
+        self.pair_kinds = None
+        if len(kind_list) >= 2 and len(kind_list) % 2 == 0:
+            a, b = kind_list[0::2], kind_list[1::2]
+            if len(set(a)) == 1 and len(set(b)) == 1:
+                self.pair_kinds = (a[0], b[0])
 
     # ---- pure compute -------------------------------------------------
     def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
         return edge_params["embed"]["weight"][tokens]
 
-    def _attention(self, p, x, kvs, pos, mask, tp_axis, kv_commit, sp_axis=None):
+    def _attention(self, p, x, kvs, pos, mask, tp_axis, kv_commit, sp_axis=None,
+                   rotating_window: int = 0, t_real=None):
         cfg = self.config
         B, T, D = x.shape
         Hd = cfg.head_dim
@@ -80,10 +89,16 @@ class GptOssRingModel(RingModel):
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
-        attn, kvs = cached_attend(
-            q, k, v, kvs, pos, mask,
-            kv_commit=kv_commit, sp_axis=sp_axis, sinks=p["sinks"],
-        )
+        if rotating_window:
+            attn, kvs = rotating_cached_attend(
+                q, k, v, kvs, pos, rotating_window,
+                kv_commit=kv_commit, sinks=p["sinks"], t_real=t_real,
+            )
+        else:
+            attn, kvs = cached_attend(
+                q, k, v, kvs, pos, mask,
+                kv_commit=kv_commit, sp_axis=sp_axis, sinks=p["sinks"],
+            )
         out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
             out = lax.psum(out, tp_axis)
@@ -123,6 +138,64 @@ class GptOssRingModel(RingModel):
             out = lax.psum(out, tp_axis)
         return x + out.reshape(B, T, D)
 
+    def _kind_mask(self, kind: int, T: int, S: int, pos, sp_axis, mask):
+        """Static-kind mask for one paired half."""
+        swa = self.config.sliding_window or (
+            S * (1 if sp_axis is None else lax.psum(1, sp_axis))
+        )
+        if sp_axis is None:
+            m = sliding_window_mask(T, S, pos, swa) if kind == 1 else causal_mask(T, S, pos)
+        else:
+            m = (
+                sp_sliding_window_mask(T, S, pos, swa, sp_axis)
+                if kind == 1
+                else sp_causal_mask(T, S, pos, sp_axis)
+            )
+        if mask is not None:
+            m = m & mask
+        return m
+
+    def _apply_paired(
+        self, window_params, x, kv, pos, mask, tp_axis, kv_commit, sp_axis,
+        t_real=None,
+    ):
+        """One scan over (even, odd) layer pairs: each half is
+        kind-homogeneous, so masks are static and a sliding half whose cache
+        is shorter than the full half's runs as an O(window) ring buffer."""
+        T = x.shape[1]
+        halves = [h for h in ("a", "b") if h in window_params]
+        W_cfg = self.config.sliding_window
+        ctx = {}
+        for i, h in enumerate(halves):
+            kind = self.pair_kinds[i]
+            S_h = kv[h]["k"].shape[2]
+            # a W-row cache marks the ring-buffer layout (init_kv sizes a
+            # sliding half to W only when rotating) — compare against the
+            # configured window, NOT the other half, or a both-halves-
+            # sliding window would silently fall into the clamped-write path
+            rotating = kind == 1 and 0 < W_cfg == S_h and sp_axis is None
+            m = None if rotating else self._kind_mask(kind, T, S_h, pos, sp_axis, mask)
+            W = self.config.sliding_window if rotating else 0
+            ctx[h] = (m, W)
+
+        def body(carry, per):
+            xc = carry
+            kv_out = {}
+            for i, h in enumerate(halves):
+                p, kvs = per[h]
+                m, W = ctx[h]
+                xc, kvs = self._attention(
+                    p, xc, kvs, pos, m, tp_axis, kv_commit,
+                    sp_axis=sp_axis, rotating_window=W, t_real=t_real,
+                )
+                xc = self._moe(p, xc, tp_axis)
+                kv_out[h] = kvs
+            return xc, kv_out
+
+        xs = {h: (window_params[h], kv[h]) for h in halves}
+        x, kv_out = lax.scan(body, x, xs)
+        return x, kv_out
+
     def apply_window(
         self,
         window_params: dict,
@@ -134,7 +207,13 @@ class GptOssRingModel(RingModel):
         tp_axis: Optional[str] = None,
         kv_commit=None,
         sp_axis: Optional[str] = None,
+        t_real=None,
     ) -> Tuple[jnp.ndarray, dict]:
+        if "a" in window_params:  # paired layout (fit/mesh engines)
+            return self._apply_paired(
+                window_params, x, kv, pos, mask, tp_axis, kv_commit, sp_axis,
+                t_real=t_real,
+            )
         T, S = x.shape[1], kv["k"].shape[2]
         swa = self.config.sliding_window or (
             S * (1 if sp_axis is None else lax.psum(1, sp_axis))
@@ -173,6 +252,49 @@ class GptOssRingModel(RingModel):
         if self.config.tie_word_embeddings:
             return x @ edge_params["embed"]["weight"].T
         return x @ edge_params["lm_head"]["weight"]
+
+    # ---- layout -------------------------------------------------------
+    def stack_layers(self, per_layer):
+        if self.pair_kinds is None:
+            return super().stack_layers(per_layer)
+        return {
+            "a": RingModel.stack_layers(per_layer[0::2]),
+            "b": RingModel.stack_layers(per_layer[1::2]),
+        }
+
+    def quantize_params(self, stacked, bits: int, scale_dtype=None, group_size: int = 0):
+        from dnet_tpu.ops.quant import quantize_tree
+
+        if "a" not in stacked:
+            return super().quantize_params(stacked, bits, scale_dtype, group_size)
+        return {
+            h: quantize_tree(
+                tree, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
+                group_size=group_size,
+            )
+            for h, tree in stacked.items()
+        }
+
+    def init_kv(self, n_layers, batch, max_seq, dtype="bfloat16", quant_bits=0,
+                rotating=True):
+        from dnet_tpu.core.kvcache import init_cache
+
+        if self.pair_kinds is None or n_layers != len(self.layers):
+            return super().init_kv(
+                n_layers, batch, max_seq, dtype, quant_bits, rotating
+            )
+        W = self.config.sliding_window
+
+        def cache(kind):
+            s = max_seq
+            if rotating and kind == 1 and 0 < W < max_seq:
+                s = W
+            cfg = self.kv_config(
+                n_layers // 2, batch, s, dtype, quant_bits=quant_bits
+            )
+            return init_cache(cfg)
+
+        return {"a": cache(self.pair_kinds[0]), "b": cache(self.pair_kinds[1])}
 
     # ---- weight mapping ----------------------------------------------
     def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

@@ -98,6 +98,7 @@ class LlamaRingModel(RingModel):
         tp_axis: Optional[str] = None,
         kv_commit=None,
         sp_axis: Optional[str] = None,
+        t_real=None,  # full-length caches overwrite padding before reading
     ) -> Tuple[jnp.ndarray, dict]:
         if mask is None:
             mask = self._window_mask(x.shape[1], kv["k"].shape[2], pos, sp_axis)

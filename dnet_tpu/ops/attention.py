@@ -84,6 +84,55 @@ def cached_attend(
     return sp_decode_attend(q, kc, vc, mask, sp_axis, sinks=sinks), kvs
 
 
+def rotating_cached_attend(
+    q: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    kvs: dict,
+    pos,
+    window: int,
+    kv_commit=None,
+    sinks: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    t_real=None,
+) -> Tuple[jnp.ndarray, dict]:
+    """Sliding-window attention over an O(window) ring-buffer cache.
+
+    The cache holds only the last `window` tokens (slot = pos % window), so
+    a 128K-context SWA layer stores W rows instead of S_max — the memory
+    saving the reference gets from mlx's RotatingKVCache
+    (src/dnet/core/models/gpt_oss.py:291-303).  Queries attend the PREVIOUS
+    window from the cache plus the in-chunk keys directly (a chunk longer
+    than the window would otherwise overwrite keys its own earlier queries
+    need), with masks built from each slot's absolute position."""
+    from dnet_tpu.core.kvcache import read_kv, write_kv_rotating
+
+    T = q.shape[1]
+    W = kvs["k"].shape[1]
+    k_prev, v_prev = read_kv(kvs)  # [B, W, KVH, Hd]
+    keys = jnp.concatenate([k_prev, k_new.astype(k_prev.dtype)], axis=1)
+    vals = jnp.concatenate([v_prev, v_new.astype(v_prev.dtype)], axis=1)
+
+    i = jnp.arange(T)[:, None]
+    p_abs = pos + i  # absolute query positions [T, 1]
+    s = jnp.arange(W)[None, :]
+    # slot s holds the most recent pre-chunk position congruent to s mod W
+    a_prev = (pos - 1) - jnp.mod(pos - 1 - s, W)
+    m_prev = (a_prev >= 0) & (a_prev > p_abs - window)
+    j = jnp.arange(T)[None, :]  # in-chunk key index
+    m_new = (j <= i) & (j > i - window)
+    if t_real is not None:
+        # bucket padding: padded keys are not real context, and their
+        # positions must never wrap into the ring (they would destroy the
+        # live rows a later decode still reads)
+        m_new = m_new & (j < t_real)
+    mask = jnp.concatenate([m_prev, m_new], axis=1)  # [T, W+T]
+
+    attn = attend(q, keys, vals, mask=mask, sinks=sinks, scale=scale)
+    kvs = write_kv_rotating(kvs, k_new, v_new, pos, kv_commit, t_real=t_real)
+    return attn, kvs
+
+
 def attend(
     q: jnp.ndarray,
     k: jnp.ndarray,

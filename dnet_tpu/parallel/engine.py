@@ -164,11 +164,9 @@ class MeshEngine:
             stacked, self._n_kv_layers = m.pad_mesh_segments(stacked, self.pp)
         self._host_window = jax.tree.map(cast, stacked)
         edge = jax.tree.map(cast, m.map_edge(self.ckpt.load_edge_raw()))
-        kv0 = init_cache(
-            m.kv_config(
-                self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
-                quant_bits=self.kv_quant_bits,
-            )
+        kv0 = m.init_kv(
+            self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
+            quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),
         )
         self.window_params, self.edge_params, self._kv_template = place_ring_state(
             self._host_window, edge, kv0, self.mesh
@@ -182,11 +180,9 @@ class MeshEngine:
     def new_session(self, nonce: str, seed: Optional[int] = None) -> Session:
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
-        kv0 = init_cache(
-            self.model.kv_config(
-                self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
-                quant_bits=self.kv_quant_bits,
-            )
+        kv0 = self.model.init_kv(
+            self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
+            quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),
         )
         _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
         sess = Session(

@@ -77,7 +77,8 @@ def window_param_specs(window_params: Dict) -> Dict:
     layout ({"dense": {...}, "moe": {...}}, deepseek) as well as flat."""
     out: Dict = {}
     for k, v in window_params.items():
-        if k in ("dense", "moe") and isinstance(v, dict):
+        # "dense"/"moe": deepseek segments; "a"/"b": gpt_oss layer pairs
+        if k in ("dense", "moe", "a", "b") and isinstance(v, dict):
             out[k] = {kk: layer_param_spec(kk) for kk in v}
         else:
             out[k] = layer_param_spec(k)

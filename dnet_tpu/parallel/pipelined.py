@@ -252,6 +252,7 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
             x_new, kv_slot = model.apply_window(
                 window_params, x, kv_slot, pos,
                 layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=(i == my_pp),
+                t_real=last_idx + 1,
             )
             x_next = lax.ppermute(
                 x_new, AXIS_PP, [(p, (p + 1) % PP) for p in range(PP)]

@@ -91,7 +91,7 @@ def make_ring_decode_fn(model, mesh: Mesh, window_params, donate_kv: bool = True
                 window_params, x, kv, pos,
                 layer_kinds=kinds, tp_axis=AXIS_TP,
                 kv_commit=(jnp.mod(i, PP) == my_pp),
-                sp_axis=sp_axis, **extra,
+                sp_axis=sp_axis, t_real=last_idx + 1, **extra,
             )
             # hand the hidden state to the next pipeline rank (ICI hop)
             x_next = lax.ppermute(

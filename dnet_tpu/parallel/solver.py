@@ -16,12 +16,13 @@ The reference delegates to distilp's MILP ("HALDA", prima.cpp) producing
 - "milp": scipy HiGHS mixed-integer program minimizing total ring latency
   with integer w_i, n_i (heterogeneous clusters, the reference's regime).
 
-k > 1 (multi-round rings) is modeled as in the reference
-(api/utils.py:62-131): when every device must hold fewer resident layers
-than assigned, layers are dealt in k contiguous rounds; we emit rounds in
-LayerAssignment.rounds but currently always solve k=1 (windows/residency
-carry the memory pressure instead — the TPU host-DRAM path makes streaming
-cheaper than re-circling the ring).
+k > 1 (multi-round rings) follows the reference (api/utils.py:62-131): when
+HBM residency cannot hold a device's assignment (n_i < w_i), layers are
+dealt in k contiguous rounds — the device appears k times per token pass
+and each visit's weights prefetch while the REST of the ring computes,
+which is the reference's "no memory ceiling" regime (405B over small
+hosts).  `choose_rounds` picks k; `deal_rounds` deals the chunks; shards
+execute rounds natively (shard/compute.py:_process_round).
 """
 
 from __future__ import annotations
@@ -47,6 +48,10 @@ class ModelProfile:
     kv_bytes_per_token_per_layer: int
     edge_bytes: int = 0  # embed + head + final norm
     seq_len: int = 4096
+    # models with paired/segmented window layouts (gpt_oss, deepseek_v2)
+    # cannot execute k-round fit stacks yet: fail at SOLVE time, not on the
+    # first request (core/engine.py:apply_round raises otherwise)
+    multi_round_ok: bool = True
 
 
 @dataclass
@@ -240,14 +245,46 @@ def postprocess_merge_singletons(
         del devices[i], w[i], n[i]
 
 
+def choose_rounds(w: List[int], n: List[int], max_rounds: int = 4) -> int:
+    """k for the multi-round ring (reference HALDA's k): when HBM residency
+    cannot hold a device's whole assignment (n_i < w_i), dealing the layers
+    in k contiguous chunks lets each visit's weights prefetch while the REST
+    of the ring computes — the reference's extreme-memory-pressure regime
+    (api/utils.py:62-131).  k = 1 when everything is resident."""
+    k = 1
+    for wi, ni in zip(w, n):
+        if 0 < ni < wi:
+            k = max(k, math.ceil(wi / ni))
+        elif ni == 0 and wi > 0:
+            k = max_rounds  # fully streamed device: cap
+    return min(k, max_rounds)
+
+
+def deal_rounds(w: List[int], k: int) -> List[List[List[int]]]:
+    """Deal each device's w_i layers into k contiguous chunks, iterating
+    rounds-outer/devices-inner so global layer order follows the ring k
+    times (reference compute_layer_assignments, api/utils.py:62-131).
+    Returns per-device round lists."""
+    rounds: List[List[List[int]]] = [[] for _ in w]
+    start = 0
+    for r in range(k):
+        for i, wi in enumerate(w):
+            size = wi // k + (1 if r < wi % k else 0)
+            if size:
+                rounds[i].append(list(range(start, start + size)))
+                start += size
+    return rounds
+
+
 def solve_topology(
     devices: List[DeviceInfo],
     m: ModelProfile,
     kv_bits: int = 0,
     solver: str = "auto",
     mip_gap: float = 1e-4,
+    max_rounds: int = 4,
 ) -> TopologyInfo:
-    """Full solve: order -> (w, n) -> merge -> contiguous assignments."""
+    """Full solve: order -> (w, n) -> merge -> k rounds -> assignments."""
     if not devices:
         raise ValueError("no devices")
     devices = order_devices(devices)
@@ -268,17 +305,20 @@ def solve_topology(
     w = [w[i] for i in keep]
     n = [n[i] for i in keep]
 
+    k = 1
+    if len(devs) > 1 and m.multi_round_ok:
+        k = choose_rounds(w, n, max_rounds)
+    per_dev_rounds = deal_rounds(w, k)
+
     assignments: List[LayerAssignment] = []
-    start = 0
     for i, d in enumerate(devs):
-        layers = list(range(start, start + w[i]))
-        start += w[i]
+        layers = [a for r in per_dev_rounds[i] for a in r]
         window = 0 if n[i] >= w[i] else max(n[i] // 2, 1)
         assignments.append(
             LayerAssignment(
                 instance=d.instance,
                 layers=layers,
-                rounds=[layers],
+                rounds=per_dev_rounds[i],
                 window_size=window,
                 residency_size=0 if n[i] >= w[i] else n[i],
             )
@@ -292,7 +332,7 @@ def solve_topology(
         devices=devs,
         assignments=assignments,
         solution={
-            "k": result.k,
+            "k": k,
             "w": w,
             "n": n,
             "obj_value": result.obj_value,
@@ -348,6 +388,7 @@ def model_profile_from_checkpoint(
         kv_bytes = 2 * kvh * cfg.head_dim * 2
     return ModelProfile(
         model_id=str(model_dir),
+        multi_round_ok=cfg.model_type not in ("gpt_oss", "deepseek_v2"),
         num_layers=cfg.num_hidden_layers,
         layer_bytes=layer_bytes,
         layer_flops_per_token=2.0 * active,

@@ -65,15 +65,25 @@ class ShardCompute:
         self.wire_dtype = wire_dtype
         self.is_first = self.engine.model.is_first
         self.is_last = self.engine.model.is_last
+        # k-round ring schedule: a non-contiguous assignment IS its rounds —
+        # each contiguous run is one ring visit (reference api/utils.py:62-131)
+        self.rounds: list[list[int]] = []
+        for a in self.layers:
+            if self.rounds and a == self.rounds[-1][-1] + 1:
+                self.rounds[-1].append(a)
+            else:
+                self.rounds.append([a])
         # column-sparsify hidden hops toward the next shard (DCN only —
         # reference gates the same way, config.py:128-135, default off);
         # explicit arg wins, DNET_TRANSPORT_* is the deploy-wide default
-        if compress_frac is None:
-            from dnet_tpu.config import get_settings
+        from dnet_tpu.config import get_settings
 
-            t = get_settings().transport
+        t = get_settings().transport
+        if compress_frac is None:
             compress_frac = t.compress_pct if t.compress else 0.0
         self.compress_frac = compress_frac
+        # 8 -> qsparse8_v1 (int8-affine kept columns), 0 -> sparse_v1
+        self.compress_quant_bits = t.compress_quant_bits
 
     @property
     def max_layer(self) -> int:
@@ -89,6 +99,61 @@ class ShardCompute:
         else:
             self.engine.reset()
 
+    def _decode_payload(self, msg: ActivationMessage, pos: int):
+        """Incoming hidden frame -> padded device array + real length."""
+        from dnet_tpu.compression import decompress_tensor, is_compressed_dtype
+
+        eng = self.engine
+        if is_compressed_dtype(msg.dtype):
+            hidden = decompress_tensor(msg.data, msg.dtype, msg.shape)
+        else:
+            hidden = bytes_to_tensor(msg.data, msg.dtype, msg.shape)
+        T = hidden.shape[1]
+        if pos + T > eng.max_seq:
+            raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
+        Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq - pos)
+        if Tpad != T:
+            pad = np.zeros(
+                (hidden.shape[0], Tpad - T, hidden.shape[2]), dtype=hidden.dtype
+            )
+            hidden = np.concatenate([hidden, pad], axis=1)
+        return jnp.asarray(hidden).astype(eng.param_dtype), T
+
+    def _embed_tokens(self, msg: ActivationMessage, pos: int):
+        eng = self.engine
+        ids = msg.tokens()
+        T = ids.shape[-1]
+        if pos + T > eng.max_seq:
+            raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
+        Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq - pos)
+        tokens = np.zeros((eng.batch, Tpad), dtype=np.int32)
+        tokens[:, :T] = ids.reshape(1, -1)
+        return jnp.asarray(tokens), T
+
+    def _process_round(self, msg: ActivationMessage, sess) -> ActivationMessage:
+        """k-round path: apply only the contiguous round starting at the
+        incoming target layer, prefetching the NEXT round's window while the
+        rest of the ring computes (reference offload.py:395-421 analog)."""
+        eng = self.engine
+        pos = msg.pos
+        target = 0 if msg.is_tokens else msg.layer_id + 1
+        try:
+            ridx = next(i for i, r in enumerate(self.rounds) if r[0] == target)
+        except StopIteration:
+            raise ValueError(f"no round of {self.rounds} starts at layer {target}")
+        run = self.rounds[ridx]
+        nxt_run = self.rounds[(ridx + 1) % len(self.rounds)]
+        if msg.is_tokens:
+            tokens, T = self._embed_tokens(msg, pos)
+            x = eng.model.embed(eng.edge_params, tokens)
+        else:
+            x, T = self._decode_payload(msg, pos)
+        x = eng.apply_round(sess, x, pos, run, t_real=T, prefetch_next=nxt_run)
+        sess.pos = pos + T
+        sess.last_used = time.time()
+        is_tail = run[-1] == eng.config.num_hidden_layers - 1
+        return self._emit(msg, sess, x, T, pos, is_tail, run[-1])
+
     def process(self, msg: ActivationMessage) -> ActivationMessage:
         """Run this shard's window; returns the outgoing message
         (hidden-state hop or final sampled token)."""
@@ -97,49 +162,27 @@ class ShardCompute:
         sess = eng.sessions.get(nonce) or eng.new_session(nonce, msg.decoding.seed)
         pos = msg.pos
 
+        if len(self.rounds) > 1:
+            return self._process_round(msg, sess)
+
         streams = eng.plan.streams_weights
 
         if msg.is_tokens:
             if not self.is_first:
                 raise ValueError("token frame arrived at a non-first shard")
-            ids = msg.tokens()
-            T = ids.shape[-1]
-            # T==1 is the steady-state decode hop: no bucket padding (a
-            # dedicated (B,1) program, like the local path's _decode)
-            if pos + T > eng.max_seq:
-                raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
-            # padded width must fit too (a clamped dynamic_update_slice would
-            # silently shift the KV write)
-            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq - pos)
-            tokens = np.zeros((eng.batch, Tpad), dtype=np.int32)
-            tokens[:, :T] = ids.reshape(1, -1)
+            tokens, T = self._embed_tokens(msg, pos)
             if streams:
-                x = eng.model.embed(eng.edge_params, jnp.asarray(tokens))
-                x = eng.run_layers(sess, x, pos)
+                x = eng.model.embed(eng.edge_params, tokens)
+                x = eng.run_layers(sess, x, pos, t_real=T)
             else:
                 x, sess.kv = eng._embed_window(
-                    eng.window_params, eng.edge_params, jnp.asarray(tokens),
-                    sess.kv, jnp.int32(pos),
+                    eng.window_params, eng.edge_params, tokens,
+                    sess.kv, jnp.int32(pos), jnp.int32(T),
                 )
         else:
-            from dnet_tpu.compression import decompress_tensor, is_compressed_dtype
-
-            if is_compressed_dtype(msg.dtype):
-                hidden = decompress_tensor(msg.data, msg.dtype, msg.shape)
-            else:
-                hidden = bytes_to_tensor(msg.data, msg.dtype, msg.shape)
-            T = hidden.shape[1]
-            if pos + T > eng.max_seq:
-                raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
-            Tpad = 1 if T == 1 else min(bucket_length(T), eng.max_seq - pos)
-            if Tpad != T:
-                pad = np.zeros(
-                    (hidden.shape[0], Tpad - T, hidden.shape[2]), dtype=hidden.dtype
-                )
-                hidden = np.concatenate([hidden, pad], axis=1)
-            x = jnp.asarray(hidden).astype(eng.param_dtype)
+            x, T = self._decode_payload(msg, pos)
             if streams:
-                x = eng.run_layers(sess, x, pos)
+                x = eng.run_layers(sess, x, pos, t_real=T)
             elif self.is_last:
                 # fused window+head+sample fast path
                 sess.key, step_key = jax.random.split(sess.key)
@@ -152,12 +195,20 @@ class ShardCompute:
                 sess.last_used = time.time()
                 return self._final_message(msg, res)
             else:
-                x, sess.kv = eng._hidden(eng.window_params, x, sess.kv, jnp.int32(pos))
+                x, sess.kv = eng._hidden(
+                    eng.window_params, x, sess.kv, jnp.int32(pos), jnp.int32(T)
+                )
 
         sess.pos = pos + T
         sess.last_used = time.time()
+        return self._emit(msg, sess, x, T, pos, self.is_last, self.max_layer)
 
-        if self.is_last:
+    def _emit(
+        self, msg: ActivationMessage, sess, x, T: int, pos: int,
+        is_tail: bool, out_layer: int,
+    ) -> ActivationMessage:
+        eng = self.engine
+        if is_tail:
             # tail after a streamed window pass or a single-shard token frame
             sess.key, step_key = jax.random.split(sess.key)
             sp = SampleParams.from_decoding(msg.decoding)
@@ -176,13 +227,14 @@ class ShardCompute:
             from dnet_tpu.compression import compress_tensor
 
             payload, dtype, shape = compress_tensor(
-                out, self.compress_frac, wire_dtype=self.wire_dtype
+                out, self.compress_frac, wire_dtype=self.wire_dtype,
+                quant_bits=self.compress_quant_bits,
             )
         else:
             payload, dtype, shape = tensor_to_bytes(out, wire_dtype=self.wire_dtype)
         return ActivationMessage(
-            nonce=nonce,
-            layer_id=self.max_layer,
+            nonce=msg.nonce,
+            layer_id=out_layer,
             seq=msg.seq,
             dtype=dtype,
             shape=shape,

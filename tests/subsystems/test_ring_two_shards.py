@@ -21,12 +21,17 @@ pytestmark = [pytest.mark.ring, pytest.mark.shard]
 
 
 class Ring:
-    """Wire two shards + an api adapter together with fakes."""
+    """Wire two shards + an api adapter together with fakes.
 
-    def __init__(self, tiny_llama_dir):
+    Non-contiguous layer lists run the k-round schedule: shard1's mid-round
+    hidden frames route BACK to shard0 (the ring wraps k times per token),
+    and only the round ending at the last global layer emits the token."""
+
+    def __init__(self, tiny_llama_dir, layers0=(0, 1), layers1=(2, 3)):
         self.s0 = ShardRuntime("s0")
         self.s1 = ShardRuntime("s1")
         self.tokens = []  # TokenPayloads arriving at the "API"
+        self.layers0, self.layers1 = list(layers0), list(layers1)
 
         # shard0 egress -> shard1 ingress
         self.a0 = RingAdapter(
@@ -34,16 +39,22 @@ class Ring:
             ring_client_factory=lambda addr: FakeRingClient(addr, on_frame=self._to_s1),
             callback_client_factory=lambda addr: FakeCallbackClient(addr, self.tokens),
         )
-        # shard1 egress -> api callback
+        # shard1 egress -> shard0 (multi-round wrap) or api callback (final)
         self.a1 = RingAdapter(
             self.s1,
-            ring_client_factory=lambda addr: FakeRingClient(addr),
+            ring_client_factory=lambda addr: FakeRingClient(addr, on_frame=self._to_s0),
             callback_client_factory=lambda addr: FakeCallbackClient(addr, self.tokens),
         )
         self.model_dir = tiny_llama_dir
 
     async def _to_s1(self, frame):
         ok, msg = await self.a1.ingress_frame(frame)
+        from dnet_tpu.transport.protocol import StreamAck
+
+        return StreamAck(nonce=frame.nonce, seq=frame.seq, ok=ok, message=msg)
+
+    async def _to_s0(self, frame):
+        ok, msg = await self.a0.ingress_frame(frame)
         from dnet_tpu.transport.protocol import StreamAck
 
         return StreamAck(nonce=frame.nonce, seq=frame.seq, ok=ok, message=msg)
@@ -58,18 +69,25 @@ class Ring:
             loop.run_in_executor(
                 None,
                 lambda: self.s0.load_model_core(
-                    str(self.model_dir), [0, 1], max_seq=64, param_dtype="float32"
+                    str(self.model_dir), self.layers0, max_seq=64,
+                    param_dtype="float32",
                 ),
             ),
             loop.run_in_executor(
                 None,
                 lambda: self.s1.load_model_core(
-                    str(self.model_dir), [2, 3], max_seq=64, param_dtype="float32"
+                    str(self.model_dir), self.layers1, max_seq=64,
+                    param_dtype="float32",
                 ),
             ),
         )
         self.a0.configure_topology("s1:1")
-        self.a1.configure_topology("")  # last shard
+        # multi-round: shard1's mid frames wrap to shard0; final tokens go to
+        # the callback either way
+        multi = len(self.layers1) > 1 and self.layers1 != sorted(
+            range(min(self.layers1), max(self.layers1) + 1)
+        )
+        self.a1.configure_topology("s0:1" if multi else "")
 
     async def stop(self):
         await self.a0.shutdown()
@@ -187,5 +205,43 @@ def test_relay_path(tiny_llama_dir):
         assert len(relayed) == 1 and relayed[0].nonce == "r"
         await adapter.shutdown()
         rt.stop()
+
+    asyncio.run(go())
+
+
+def test_two_shard_k2_rounds_match_single_engine(tiny_llama_dir, reference_tokens):
+    """k=2 multi-round schedule (s0=[0,2], s1=[1,3]): the activation circles
+    the ring twice per token and the stream must be identical."""
+    prompt_ids, expected = reference_tokens
+
+    async def go():
+        ring = Ring(tiny_llama_dir, layers0=(0, 2), layers1=(1, 3))
+        await ring.start()
+        try:
+            api = RingApiAdapter(
+                head_addr="s0:1",
+                callback_url="grpc://api:1",
+                shard_grpc_addrs=["s0:1", "s1:1"],
+                ring_client_factory=lambda addr: FakeRingClient(
+                    addr, on_frame=lambda f: _ingress_ack(ring.a0, f)
+                ),
+                max_seq_len=64,
+            )
+            await api.start()
+            got = []
+            dec = DecodingParams(temperature=0.0)
+            send = list(prompt_ids)
+            for step in range(5):
+                await api.send_tokens("nonce1", send, dec, step)
+                payload = await _wait_token(ring.tokens, step)
+                api.resolve_token(payload.to_result())
+                result = await api.await_token("nonce1", step, timeout=10.0)
+                assert not result.error, result.error
+                got.append(result.token_id)
+                send = [result.token_id]
+            assert got == expected
+            await api.shutdown()
+        finally:
+            await ring.stop()
 
     asyncio.run(go())

@@ -85,3 +85,71 @@ def test_shard_hop_with_compression(tiny_llama_dir, monkeypatch):
         assert out.is_final and out.token_id is not None and out.token_id >= 0
     finally:
         reset_settings_cache()
+
+
+def test_qsparse8_roundtrip_accuracy():
+    """qsparse8_v1: kept columns survive int8-affine within group-quant
+    tolerance; dropped columns come back exactly zero."""
+    import numpy as np
+
+    from dnet_tpu.compression import compress_tensor, decompress_tensor
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 2.0, size=(4, 16, 256)).astype(np.float32)
+    payload, dtype, shape = compress_tensor(
+        x, drop_frac=0.5, wire_dtype="float32", quant_bits=8, group_size=32
+    )
+    assert "qsparse8_v1" in dtype
+    out = decompress_tensor(payload, dtype, shape)
+    assert out.shape == x.shape
+    # exactly half the columns are zeroed
+    flat = out.reshape(-1, 256)
+    zero_cols = np.all(flat == 0, axis=0)
+    assert zero_cols.sum() == 128
+    # kept columns: affine uint8 error bounded by the per-group step
+    kept = ~zero_cols
+    err = np.abs(flat[:, kept] - x.reshape(-1, 256)[:, kept])
+    x2 = x.reshape(-1, 256)
+    step = (x2.max() - x2.min()) / 255.0
+    assert err.max() <= step * 2
+
+
+def test_qsparse8_smaller_than_sparse_v1():
+    import numpy as np
+
+    from dnet_tpu.compression import compress_tensor
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(2, 8, 512)).astype(np.float32)
+    p_sparse, _, _ = compress_tensor(x, 0.5, wire_dtype="bfloat16")
+    p_q, _, _ = compress_tensor(x, 0.5, wire_dtype="bfloat16", quant_bits=8)
+    assert len(p_q) < len(p_sparse)  # int8 codes beat bf16 columns
+
+
+def test_gather_scatter_columns_roundtrip():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dnet_tpu.compression import gather_columns, scatter_columns
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(16, 256)).astype(np.float32))
+    idx = jnp.asarray(sorted(rng.choice(256, size=128, replace=False)), dtype=jnp.int32)
+    kept = gather_columns(x, idx)
+    np.testing.assert_allclose(
+        np.asarray(kept), np.asarray(x)[:, np.asarray(idx)], rtol=1e-6
+    )
+    back = scatter_columns(kept, idx, 256)
+    ref = np.zeros((16, 256), np.float32)
+    ref[:, np.asarray(idx)] = np.asarray(x)[:, np.asarray(idx)]
+    np.testing.assert_allclose(np.asarray(back), ref, rtol=1e-6)
+
+
+def test_codec_roundtrips_qsparse8_dtype():
+    """is_compressed_dtype must recognize both formats (the shard codec
+    dispatches decompression on the tag)."""
+    from dnet_tpu.compression import is_compressed_dtype
+
+    assert is_compressed_dtype("bfloat16|fmt=sparse_v1|pct=0.5|orig=64")
+    assert is_compressed_dtype("bfloat16|fmt=qsparse8_v1|pct=0.5|orig=64|gs=32")
+    assert not is_compressed_dtype("bfloat16")

@@ -86,3 +86,45 @@ def test_offload_matches_fit(gpt_oss_dir, engine):
         assert got == expected
     finally:
         off.close()
+
+
+def test_swa_cache_is_window_sized(engine):
+    """The sliding half's KV is an O(window) ring buffer: its row count must
+    equal the sliding window, independent of max_seq."""
+    W = engine.config.sliding_window
+    kv = engine.model.init_kv(
+        len(engine.model.layers), 1, engine.max_seq, "float32"
+    )
+    assert engine.model.pair_kinds is not None
+    sizes = {h: kv[h]["k"].shape[2] for h in kv}
+    assert W in sizes.values() and engine.max_seq in sizes.values()
+    swa_half = [h for h, s in sizes.items() if s == W][0]
+    # memory accounting: SWA rows stay W even when max_seq grows 4x
+    kv_big = engine.model.init_kv(
+        len(engine.model.layers), 1, engine.max_seq * 4, "float32"
+    )
+    assert kv_big[swa_half]["k"].shape[2] == W
+
+
+def test_long_generation_crosses_window_matches_hf(gpt_oss_dir, hf_model):
+    """Generation far past the sliding window must stay exact: the ring
+    buffer wraps many times (W=8, ~40 generated tokens)."""
+    import torch
+
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    eng = LocalEngine(gpt_oss_dir, max_seq=128, param_dtype="float32")
+    ids = [1, 7, 3, 11, 2]
+    n = 40
+    with torch.no_grad():
+        out = hf_model.generate(
+            torch.tensor([ids]), max_new_tokens=n, do_sample=False,
+            use_cache=True,
+        )
+    want = out[0, len(ids):].tolist()
+    got = [
+        r.token_id
+        for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=n)
+    ]
+    assert got == want

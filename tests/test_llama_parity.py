@@ -97,9 +97,9 @@ def test_sharded_layer_range_composes(tiny_llama_dir):
     tokens = jnp.asarray([ids], dtype=jnp.int32)
     x = lo.model.embed(lo.edge_params, tokens)
     kv_lo = lo.new_session("a").kv
-    x, _ = lo._hidden(lo.window_params, x, kv_lo, jnp.int32(0))
+    x, _ = lo._hidden(lo.window_params, x, kv_lo, jnp.int32(0), jnp.int32(len(ids)))
     kv_hi = hi.new_session("b").kv
-    x, _ = hi._hidden(hi.window_params, x, kv_hi, jnp.int32(0))
+    x, _ = hi._hidden(hi.window_params, x, kv_hi, jnp.int32(0), jnp.int32(len(ids)))
     x_last = hi.model.normalize(hi.edge_params, x[:, -1:])
     logits = hi.model.lm_project(hi.edge_params, x_last)[:, 0]
 

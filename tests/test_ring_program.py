@@ -41,7 +41,7 @@ def test_ring_matches_single_device(engine, eight_devices, pp, tp):
     model = engine.model
     fn = make_ring_decode_fn(model, mesh, engine.window_params)
 
-    kv_host = init_cache(model.kv_config(len(model.layers), 1, 32, "float32"))
+    kv_host = model.init_kv(len(model.layers), 1, 32, "float32")
     wp, ep, kv = place_ring_state(engine.window_params, engine.edge_params, kv_host, mesh)
 
     # run 3 greedy steps through the ring program
@@ -73,7 +73,7 @@ def test_gpt_oss_ring_matches_single_device(eight_devices, tmp_path_factory, pp,
 
     mesh = build_mesh(pp=pp, tp=tp, sp=sp)
     fn = make_ring_decode_fn(eng.model, mesh, eng.window_params)
-    kv_host = init_cache(eng.model.kv_config(len(eng.model.layers), 1, 32, "float32"))
+    kv_host = eng.model.init_kv(len(eng.model.layers), 1, 32, "float32")
     wp, ep, kv = place_ring_state(eng.window_params, eng.edge_params, kv_host, mesh)
 
     tok = jnp.asarray([[65]], dtype=jnp.int32)
@@ -90,7 +90,7 @@ def test_ring_logits_close(engine, eight_devices):
     mesh = build_mesh(pp=2, tp=2)
     model = engine.model
     fn = make_ring_decode_fn(model, mesh, engine.window_params)
-    kv_host = init_cache(model.kv_config(len(model.layers), 1, 32, "float32"))
+    kv_host = model.init_kv(len(model.layers), 1, 32, "float32")
     wp, ep, kv = place_ring_state(engine.window_params, engine.edge_params, kv_host, mesh)
 
     logits, _ = fn(wp, ep, jnp.asarray([[65]], dtype=jnp.int32), kv, jnp.int32(0))

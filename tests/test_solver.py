@@ -128,3 +128,48 @@ def test_model_profile_from_checkpoint(tiny_llama_dir):
     assert p.layer_bytes > 0
     assert p.edge_bytes > 0
     assert p.layer_flops_per_token > 0
+
+
+def test_constrained_hbm_produces_multi_round():
+    """Devices whose HBM holds only half their assignment get k=2 rounds,
+    dealt contiguous per round in ring order (reference api/utils.py:62-131)."""
+    from dnet_tpu.parallel.solver import (
+        ModelProfile,
+        choose_rounds,
+        deal_rounds,
+        solve_topology,
+    )
+
+    m = ModelProfile(
+        model_id="m", num_layers=16, layer_bytes=1 << 30,
+        layer_flops_per_token=2e9, kv_bytes_per_token_per_layer=1 << 12,
+        seq_len=1024,
+    )
+    # HBM fits ~4 layers + kv; host fits everything -> w=8 each, n~4 -> k=2
+    devs = [
+        dev("d0", hbm=5 * GB),
+        dev("d1", hbm=5 * GB),
+    ]
+    topo = solve_topology(devs, m)
+    assert topo.solution["k"] == 2
+    a0, a1 = topo.assignments
+    # each device appears twice with contiguous chunks; global order rings
+    assert len(a0.rounds) == 2 and len(a1.rounds) == 2
+    assert a0.rounds[0][0] == 0
+    assert a0.rounds[0][-1] + 1 == a1.rounds[0][0]
+    assert a1.rounds[0][-1] + 1 == a0.rounds[1][0]
+    assert a1.rounds[1][-1] == m.num_layers - 1
+    flat = [x for a in (a0, a1) for x in a.layers]
+    assert sorted(flat) == list(range(16))
+
+
+def test_deal_rounds_uneven():
+    from dnet_tpu.parallel.solver import deal_rounds
+
+    rounds = deal_rounds([5, 3], 2)
+    # 8 layers total, contiguous per chunk, ring order covers 0..7
+    order = [x for r in range(2) for dev in rounds for x in (dev[r] if r < len(dev) else [])]
+    assert sorted(x for dev in rounds for ch in dev for x in ch) == list(range(8))
+    for dev in rounds:
+        for ch in dev:
+            assert ch == list(range(ch[0], ch[0] + len(ch)))
