@@ -20,6 +20,14 @@ class CatalogEntry:
     n_layers: int
     ci_test: bool = False
     notes: str = ""
+    # weight-only serving quantizations this entry supports (reference
+    # enumerates per-model quant variants as separate aliases,
+    # src/dnet/api/catalog.py; on TPU a variant is the same bf16 checkpoint
+    # served with ops/quant int8/int4 weights)
+    quant_variants: tuple = ("int8", "int4")
+
+
+QUANT_BITS = {"bf16": 0, "int8": 8, "int4": 4}
 
 
 model_catalog: List[CatalogEntry] = [
@@ -48,6 +56,23 @@ def find_entry(model_id: str) -> Optional[CatalogEntry]:
         if e.id == model_id or e.id.split("/")[-1] == model_id:
             return e
     return None
+
+
+def resolve_variant(model_id: str) -> Optional[tuple]:
+    """Resolve `<model>[:<quant>]` aliases (reference-style quant variants):
+    "Llama-3.2-1B-Instruct:int8" -> (entry, 8).  Returns (entry,
+    weight_quant_bits) or None when unknown."""
+    base, _, variant = model_id.partition(":")
+    e = find_entry(base)
+    if e is None:
+        return None
+    if not variant:
+        return e, 0
+    if variant not in QUANT_BITS:
+        return None
+    if variant != "bf16" and variant not in e.quant_variants:
+        return None
+    return e, QUANT_BITS[variant]
 
 
 def get_ci_test_models() -> List[CatalogEntry]:

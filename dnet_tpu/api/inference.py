@@ -161,6 +161,7 @@ class InferenceManager:
         generated = 0
         finish_reason = "length"
         pending = ""  # emitted-text buffer held back for stop-seq matching
+        held_entries: list = []  # logprob entries for held-back tokens
         stopped_by_seq = False
 
         await self.adapter.reset_cache(nonce)
@@ -193,6 +194,11 @@ class InferenceManager:
 
                 delta = detok.add(result.token_id)
                 send_ids = [result.token_id]
+                # one logprob entry per generated token, carrying the
+                # token's OWN text — holdback buffering must not smear one
+                # token's logprob across text accumulated from several
+                if req.logprobs_enabled:
+                    held_entries.append(self._logprob_entry(result, delta))
 
                 # Stop sequences: never emit text at or beyond a match, and
                 # hold back any suffix that could still become one.
@@ -215,10 +221,21 @@ class InferenceManager:
 
                 if delta or stopped:
                     logprobs = None
-                    if req.logprobs_enabled:
-                        logprobs = ChoiceLogprobs(
-                            content=[self._logprob_entry(result, delta)]
-                        )
+                    if req.logprobs_enabled and held_entries:
+                        if stopped:
+                            # entries for the matched stop text are discarded
+                            # with it: keep only tokens whose text fits the
+                            # emitted delta
+                            kept, cum = [], 0
+                            for e in held_entries:
+                                if cum + len(e.token) > len(delta):
+                                    break
+                                kept.append(e)
+                                cum += len(e.token)
+                            held_entries = kept
+                        if held_entries:
+                            logprobs = ChoiceLogprobs(content=held_entries)
+                        held_entries = []
                     yield ChatCompletionChunk(
                         id=rid,
                         model=req.model,
@@ -233,14 +250,24 @@ class InferenceManager:
                     stopped_by_seq = True
                     break
 
-            # On EOS/length the held-back text is real content — flush it.
-            # Only a stop-sequence match discards its own matched text.
+            # On EOS/length the held-back text is real content — flush it
+            # (with any logprob entries still held back with it).  Only a
+            # stop-sequence match discards its own matched text.
             tail = pending + detok.flush() if not stopped_by_seq else ""
-            if tail:
+            if tail or (held_entries and not stopped_by_seq):
+                logprobs = (
+                    ChoiceLogprobs(content=held_entries)
+                    if req.logprobs_enabled and held_entries and not stopped_by_seq
+                    else None
+                )
                 yield ChatCompletionChunk(
                     id=rid,
                     model=req.model,
-                    choices=[ChatStreamChoice(delta=ChatChoiceDelta(content=tail))],
+                    choices=[
+                        ChatStreamChoice(
+                            delta=ChatChoiceDelta(content=tail), logprobs=logprobs
+                        )
+                    ],
                 )
 
             t_end = time.perf_counter()

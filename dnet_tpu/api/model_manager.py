@@ -91,11 +91,6 @@ class LocalModelManager:
 
             kv_dtype, kv_quant_bits = resolve_kv_bits(self.kv_bits)
             if self.mesh is not None:
-                if self.prefix_cache:
-                    log.warning(
-                        "DNET_API_PREFIX_CACHE is not supported by the mesh "
-                        "engine; disabled"
-                    )
                 dp, sp = self.mesh.get("dp", 1), self.mesh.get("sp", 1)
                 use_pipelined = self.batch_slots > 1 and dp == 1 and sp == 1
                 if use_pipelined:
@@ -137,6 +132,11 @@ class LocalModelManager:
                         )
                         use_pipelined = False
                 if use_pipelined:
+                    if self.prefix_cache:
+                        log.warning(
+                            "DNET_API_PREFIX_CACHE is not supported by the "
+                            "pipelined mesh engine; disabled"
+                        )
                     # staggered-microbatch pipeline: batch_slots concurrent
                     # sequences keep every pp rank busy every stage-step
                     from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
@@ -173,6 +173,7 @@ class LocalModelManager:
                     kv_quant_bits=kv_quant_bits,
                     weight_quant_bits=self.weight_quant_bits,
                     quant_group=self.weight_quant_group,
+                    prefix_cache_size=self.prefix_cache,
                 )
             elif self.batch_slots > 1:
                 from dnet_tpu.core.batch import BatchedEngine

@@ -140,6 +140,8 @@ class BatchedLocalAdapter(ApiAdapterBase):
     continuous batching.  Prefills run between batched steps on the same
     executor (no KV races: one compute thread)."""
 
+    PREFILL_CHUNK = 256  # prompt tokens per executor job (interleave grain)
+
     def __init__(self, engine) -> None:
         self.engine = engine  # BatchedEngine
         self._futures = _TokenFutures()
@@ -147,6 +149,7 @@ class BatchedLocalAdapter(ApiAdapterBase):
         self._pending: Dict[str, tuple] = {}  # nonce -> (token, decoding, step)
         self._kick: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
+        self._prefill_tasks: set = set()
 
     async def start(self) -> None:
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="compute")
@@ -157,6 +160,9 @@ class BatchedLocalAdapter(ApiAdapterBase):
         if self._task:
             self._task.cancel()
             self._task = None
+        for t in list(self._prefill_tasks):
+            t.cancel()
+        self._prefill_tasks.clear()
         if self._executor:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None
@@ -187,10 +193,23 @@ class BatchedLocalAdapter(ApiAdapterBase):
             raise RuntimeError("adapter not started")
         self._futures.expect(nonce, step)
         if step == 0:
-            loop = asyncio.get_running_loop()
-            loop.run_in_executor(
-                self._executor, self._prefill, nonce, list(token_ids), decoding, step
-            )
+            if hasattr(self.engine, "prefill_chunk"):
+                # chunked prefill: one executor job per chunk, so queued
+                # batched decode steps run BETWEEN chunks — a long prompt
+                # stalls active lanes for at most one chunk's prefill.
+                # (PipelinedMeshEngine has no chunk API yet: it takes the
+                # single-shot _prefill fallback below.)
+                task = asyncio.ensure_future(
+                    self._prefill_chunked(nonce, list(token_ids), decoding, step)
+                )
+                self._prefill_tasks.add(task)
+                task.add_done_callback(self._prefill_tasks.discard)
+            else:
+                loop = asyncio.get_running_loop()
+                loop.run_in_executor(
+                    self._executor, self._prefill, nonce, list(token_ids),
+                    decoding, step,
+                )
         elif nonce not in self.engine.sessions:
             # mid-generation session loss: fail fast instead of silently
             # re-prefilling from the single last sampled token
@@ -212,6 +231,57 @@ class BatchedLocalAdapter(ApiAdapterBase):
             )
         except Exception as exc:
             log.exception("batched prefill failed")
+            self._futures.resolve(
+                TokenResult(nonce=nonce, token_id=-1, error=str(exc), step=step)
+            )
+
+    def _cancelled(self, nonce: str, step: int) -> bool:
+        return (nonce, step) not in self._futures._futures
+
+    async def _prefill_chunked(
+        self, nonce: str, ids: List[int], decoding: DecodingParams, step: int
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        eng = self.engine
+        try:
+            # claim a batch slot BEFORE burning any prefill compute (a full
+            # pool must fail instantly, not after the whole prompt)
+            await loop.run_in_executor(self._executor, eng.reserve_slot, nonce)
+            # prefix cache first: a chunked prefill must look up the FULL
+            # prompt, then prefill only the uncached suffix
+            n = await loop.run_in_executor(
+                self._executor, eng.seed_from_prefix, nonce, ids, decoding.seed
+            )
+            rest = ids[n:]
+            logits = None
+            for i in range(0, len(rest), self.PREFILL_CHUNK):
+                if self._cancelled(nonce, step):
+                    await loop.run_in_executor(
+                        self._executor, eng.abandon_prefill, nonce
+                    )
+                    return
+                chunk = rest[i : i + self.PREFILL_CHUNK]
+                logits = await loop.run_in_executor(
+                    self._executor, eng.prefill_chunk, nonce, chunk, decoding.seed
+                )
+            await loop.run_in_executor(
+                self._executor, eng.store_prefix, nonce, ids
+            )
+            if self._cancelled(nonce, step):
+                await loop.run_in_executor(self._executor, eng.abandon_prefill, nonce)
+                return
+            res = await loop.run_in_executor(
+                self._executor, eng.adopt_prefilled, nonce, logits, decoding
+            )
+            self._futures.resolve(
+                eng.token_result(nonce, res, step=step, decoding=decoding)
+            )
+        except Exception as exc:
+            log.exception("chunked batched prefill failed")
+            try:
+                await loop.run_in_executor(self._executor, eng.abandon_prefill, nonce)
+            except Exception:  # executor already shut down
+                pass
             self._futures.resolve(
                 TokenResult(nonce=nonce, token_id=-1, error=str(exc), step=step)
             )

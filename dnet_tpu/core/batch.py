@@ -166,14 +166,41 @@ class BatchedEngine:
         return self.slot_of
 
     # ---- inference ----------------------------------------------------
-    def prefill_and_sample(
-        self, nonce: str, prompt_ids: Sequence[int], decoding: DecodingParams
-    ) -> SampleResult:
-        """Prefill on the B=1 bucket program, then move the session's KV row
-        and sampling state into this request's batch slot."""
-        slot = self.alloc_slot(nonce)
-        res = self.eng.prefill_and_sample(nonce, prompt_ids, decoding)
+    def seed_from_prefix(self, nonce, full_ids, seed=None) -> int:
+        return self.eng.seed_from_prefix(nonce, full_ids, seed)
+
+    def store_prefix(self, nonce, full_ids) -> None:
+        self.eng.store_prefix(nonce, full_ids)
+
+    def reserve_slot(self, nonce) -> None:
+        """Claim a batch slot BEFORE chunked prefill burns any compute
+        (same fail-fast invariant as prefill_and_sample)."""
+        self.alloc_slot(nonce)
+
+    def prefill_chunk(self, nonce, ids, seed=None):
+        """One prompt chunk on the B=1 bucket program (continuation when the
+        session already exists); returns last-position logits.  The adapter
+        interleaves these with batched decode steps so a long prompt never
+        stalls active lanes for its whole prefill.  allow_store=False keeps
+        partial-prompt snapshots out of the prefix cache (store_prefix
+        snapshots the full prompt at the end)."""
+        return self.eng.prefill(nonce, list(ids), seed, allow_store=False)
+
+    def abandon_prefill(self, nonce) -> None:
+        """Drop a half-prefilled request (cancelled mid-chunks)."""
+        self.free_slot(nonce)
+        self.eng.end_session(nonce)
+
+    def adopt_prefilled(self, nonce, logits, decoding: DecodingParams) -> SampleResult:
+        """Sample the first token from a fully-chunk-prefilled session and
+        move its KV/sampling state into this request's batch slot."""
         sess = self.eng.sessions[nonce]
+        res = self.eng._sample_with_counts(sess, logits, decoding)
+        self._move_to_slot(nonce, sess)
+        return res
+
+    def _move_to_slot(self, nonce: str, sess) -> None:
+        slot = self.alloc_slot(nonce)
         self.kv = jax.tree.map(
             lambda big, one: big.at[:, slot : slot + 1].set(one.astype(big.dtype)),
             self.kv,
@@ -184,6 +211,15 @@ class BatchedEngine:
         self.pos[slot] = sess.pos
         self.last_used[slot] = time.time()
         self.eng.end_session(nonce)  # B=1 cache row no longer needed
+
+    def prefill_and_sample(
+        self, nonce: str, prompt_ids: Sequence[int], decoding: DecodingParams
+    ) -> SampleResult:
+        """Prefill on the B=1 bucket program, then move the session's KV row
+        and sampling state into this request's batch slot."""
+        self.alloc_slot(nonce)  # fail on a full pool BEFORE burning prefill
+        res = self.eng.prefill_and_sample(nonce, prompt_ids, decoding)
+        self._move_to_slot(nonce, self.eng.sessions[nonce])
         return res
 
     def decode_batch(

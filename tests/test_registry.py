@@ -22,3 +22,17 @@ def test_catalog_lookup():
     assert find_entry("Qwen3-4B") is not None  # short name
     assert find_entry("nope") is None
     assert len(get_ci_test_models()) >= 2
+
+
+def test_catalog_quant_variant_aliases():
+    """Reference-style quant variants resolve as `<model>:<quant>` aliases."""
+    from dnet_tpu.api.catalog import resolve_variant
+
+    e, bits = resolve_variant("Llama-3.2-1B-Instruct:int8")
+    assert e.arch == "llama" and bits == 8
+    e, bits = resolve_variant("Qwen/Qwen3-4B:int4")
+    assert e.arch == "qwen3" and bits == 4
+    e, bits = resolve_variant("Qwen/Qwen3-4B")
+    assert bits == 0
+    assert resolve_variant("Qwen/Qwen3-4B:int2") is None
+    assert resolve_variant("not-a-model:int8") is None
