@@ -168,6 +168,16 @@ class ComputeSettings(_EnvGroup):
     window_size: int = 0  # 0 = all assigned layers in one window
     residency_windows: int = 2
     donate_activations: bool = True
+    # MoE compute path: dense | auto | dispatch | a2a (ops/moe.py).  dense
+    # is exact (reference semantics) and the default; auto picks dense for
+    # decode-size token counts, capacity dispatch for prefill, and
+    # all_to_all expert parallelism when a tp axis is present — capacity
+    # dispatch may DROP over-capacity tokens (GShard semantics), a
+    # throughput trade the operator opts into.
+    moe_impl: str = "dense"
+    # per-expert capacity = ceil(k * n_tokens * factor / n_experts);
+    # <= 0 selects the exact no-drop capacity (C = n_tokens)
+    moe_capacity_factor: float = 1.25
 
 
 @dataclass

@@ -116,6 +116,13 @@ class RingModel(abc.ABC):
         # per-assigned-layer attention-kind array (models with mixed layer
         # kinds, e.g. gpt_oss SWA/full, set this; None = homogeneous)
         self.layer_kinds = None
+        # MoE compute path knobs (ops/moe.py); engines/tests may override
+        # the instance attributes after construction
+        from dnet_tpu.config import get_settings
+
+        cs = get_settings().compute
+        self.moe_impl = cs.moe_impl
+        self.moe_capacity_factor = cs.moe_capacity_factor
 
     # ---- pure compute -------------------------------------------------
     @abc.abstractmethod

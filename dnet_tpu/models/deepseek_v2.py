@@ -185,32 +185,51 @@ class DeepseekV2RingModel(RingModel):
             topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
         topk_w = topk_w * self.routed_scaling_factor
 
-        weights = jnp.zeros_like(scores).at[
-            jnp.arange(flat.shape[0])[:, None], topk_idx
-        ].set(topk_w)  # [N, E] over the GLOBAL expert space
-
-        # dense-weighted expert compute over THIS rank's experts (exact:
-        # zero weight for non-top-k); tp ranks are expert-parallel
+        from dnet_tpu.ops.moe import moe_apply
         from dnet_tpu.ops.quant import lead_dim
 
+        N = flat.shape[0]
         E_local = lead_dim(p["e_gate"])
-        gate = jnp.einsum("nd,edf->nef", flat, dq(p["e_gate"]))
-        up = jnp.einsum("nd,edf->nef", flat, dq(p["e_up"]))
-        inner = jax.nn.silu(gate) * up
-        expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["e_down"]))
-        if tp_axis is not None:
-            e_off = lax.axis_index(tp_axis) * E_local
-            w_local = lax.dynamic_slice_in_dim(weights, e_off, E_local, axis=1)
-        else:
-            w_local = weights
-        routed = jnp.einsum("ned,ne->nd", expert_out, w_local.astype(flat.dtype))
+        topk_idx = topk_idx.astype(jnp.int32)
 
+        def effn(xe):  # per-expert buffers [E*, C*, D] -> [E*, C*, D]
+            gate = jnp.einsum("ecd,edf->ecf", xe, dq(p["e_gate"]))
+            up = jnp.einsum("ecd,edf->ecf", xe, dq(p["e_up"]))
+            return jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, dq(p["e_down"]))
+
+        def dense():  # scattered weights mask the all-local-experts einsum
+            weights = jnp.zeros_like(scores).at[
+                jnp.arange(N)[:, None], topk_idx
+            ].set(topk_w)  # [N, E] over the GLOBAL expert space
+            gate = jnp.einsum("nd,edf->nef", flat, dq(p["e_gate"]))
+            up = jnp.einsum("nd,edf->nef", flat, dq(p["e_up"]))
+            inner = jax.nn.silu(gate) * up
+            expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["e_down"]))
+            if tp_axis is not None:
+                e_off = lax.axis_index(tp_axis) * E_local
+                w_local = lax.dynamic_slice_in_dim(weights, e_off, E_local, axis=1)
+            else:
+                w_local = weights
+            return jnp.einsum("ned,ne->nd", expert_out, w_local.astype(flat.dtype))
+
+        routed, routed_partial = moe_apply(
+            self.moe_impl, flat, topk_idx, topk_w, effn, E_local,
+            self.moe_capacity_factor, k, tp_axis, dense,
+        )
+
+        # shared experts are Megatron-split over tp (col/row), so their
+        # partial output always reduces over tp; the routed partial joins
+        # that psum except on the a2a path, which returns a full output
         shared = self._dense_mlp(
             {"w_gate": p["s_gate"], "w_up": p["s_up"], "w_down": p["s_down"]}, flat
         )
-        out = routed + shared
         if tp_axis is not None:
-            out = lax.psum(out, tp_axis)
+            if routed_partial:
+                out = lax.psum(routed.astype(flat.dtype) + shared, tp_axis)
+            else:
+                out = routed.astype(flat.dtype) + lax.psum(shared, tp_axis)
+        else:
+            out = routed.astype(flat.dtype) + shared
         return x + out.reshape(B, T, D)
 
     def _layer(self, p: dict, x, kvs, pos, mask, tp_axis=None, kv_commit=None):

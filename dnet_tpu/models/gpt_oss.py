@@ -8,10 +8,11 @@ GLOBAL-vs-LOCAL cache handling, MXFP4 desharding).  TPU-first design:
   scan xs, so one compiled program serves both kinds).  KV is full-length
   with an SWA mask — trades the RotatingKVCache's memory saving for a single
   fused program; grouped scans can reclaim the memory later.
-- MoE experts are computed densely and weighted by the router's scattered
-  scores (zero for non-top-k => exact numerics) — MXU-friendly einsum over
-  the expert dim; `tp_axis` shards the EXPERT dim, so tensor-parallel ranks
-  are expert-parallel here and the psum over partial outputs is the routed sum.
+- MoE routes through ops/moe.py: dense masked einsum at decode size,
+  capacity dispatch at prefill size, and all_to_all expert parallelism when
+  a tp axis is present (`tp_axis` shards the EXPERT dim, so tensor-parallel
+  ranks are expert-parallel here; the dense/dispatch paths psum partial
+  outputs, the a2a path routes per-expert token buffers over ICI).
 - Attention sinks ride through ops.attention.attend(sinks=...).
 
 Weights follow the HF dequantized layout (experts as [E, D, 2F]/[E, F, D]
@@ -106,35 +107,52 @@ class GptOssRingModel(RingModel):
         return x + out, kvs
 
     def _moe(self, p, x, tp_axis):
+        from dnet_tpu.ops.moe import moe_apply
+
         B, T, D = x.shape
         h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
         flat = h.reshape(B * T, D)
+        N = flat.shape[0]
+        k = self.config.num_experts_per_tok
+        E_local = lead_dim(p["gate_up"])
 
         # router over the FULL expert set (router weights replicated)
         logits = flat @ p["router_w"] + p["router_b"]  # [N, E_total]
-        k = self.config.num_experts_per_tok
         top_vals, top_idx = lax.top_k(logits, k)
         top_probs = jax.nn.softmax(top_vals.astype(jnp.float32), axis=-1).astype(flat.dtype)
-        scores = jnp.zeros_like(logits).at[
-            jnp.arange(flat.shape[0])[:, None], top_idx
-        ].set(top_probs)
 
-        # dense expert compute over the LOCAL expert slice (tp shards experts)
-        E_local = lead_dim(p["gate_up"])
-        gate_up = jnp.einsum("nd,edf->nef", flat, dq(p["gate_up"])) + p["gate_up_b"]
-        gate = jnp.clip(gate_up[..., ::2], max=LIMIT)
-        up = jnp.clip(gate_up[..., 1::2], min=-LIMIT, max=LIMIT)
-        glu = gate * jax.nn.sigmoid(gate * ALPHA)
-        inner = (up + 1.0) * glu  # [N, E_local, F]
-        expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["down"])) + p["down_b"]
+        def ffn(xe):  # per-expert buffers [E*, C*, D] -> [E*, C*, D]
+            gu = jnp.einsum("ecd,edf->ecf", xe, dq(p["gate_up"])) + p["gate_up_b"][:, None, :]
+            gate = jnp.clip(gu[..., ::2], max=LIMIT)
+            up = jnp.clip(gu[..., 1::2], min=-LIMIT, max=LIMIT)
+            glu = gate * jax.nn.sigmoid(gate * ALPHA)
+            return (
+                jnp.einsum("ecf,efd->ecd", (up + 1.0) * glu, dq(p["down"]))
+                + p["down_b"][:, None, :]
+            )
 
-        if tp_axis is not None:
-            e_off = lax.axis_index(tp_axis) * E_local
-            local_scores = lax.dynamic_slice_in_dim(scores, e_off, E_local, axis=1)
-        else:
-            local_scores = scores
-        out = jnp.einsum("ned,ne->nd", expert_out, local_scores)
-        if tp_axis is not None:
+        def dense():  # every token x every local expert, scores mask the sum
+            scores = jnp.zeros_like(logits).at[
+                jnp.arange(N)[:, None], top_idx
+            ].set(top_probs)
+            gate_up = jnp.einsum("nd,edf->nef", flat, dq(p["gate_up"])) + p["gate_up_b"]
+            gate = jnp.clip(gate_up[..., ::2], max=LIMIT)
+            up = jnp.clip(gate_up[..., 1::2], min=-LIMIT, max=LIMIT)
+            glu = gate * jax.nn.sigmoid(gate * ALPHA)
+            inner = (up + 1.0) * glu  # [N, E_local, F]
+            expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["down"])) + p["down_b"]
+            if tp_axis is not None:
+                e_off = lax.axis_index(tp_axis) * E_local
+                local_scores = lax.dynamic_slice_in_dim(scores, e_off, E_local, axis=1)
+            else:
+                local_scores = scores
+            return jnp.einsum("ned,ne->nd", expert_out, local_scores)
+
+        out, partial = moe_apply(
+            self.moe_impl, flat, top_idx, top_probs, ffn, E_local,
+            self.moe_capacity_factor, k, tp_axis, dense,
+        )
+        if partial:
             out = lax.psum(out, tp_axis)
         return x + out.reshape(B, T, D)
 

@@ -1,0 +1,255 @@
+"""Mixture-of-experts token dispatch: capacity routing + expert parallelism.
+
+The reference computes GPT-OSS MoE experts densely per hosted layer — every
+token multiplies every expert's weights and the router's scattered scores
+mask the sum (src/dnet/core/models/gpt_oss.py:171-214); it has no expert
+parallelism at all (SURVEY.md §2.8: "EP ... absent").  Dense compute wastes
+an E/k factor of MXU FLOPs at prefill size.  This module is the TPU-first
+redesign: capacity-based token dispatch (GShard/Switch semantics) so each
+expert computes only the tokens routed to it, and a true expert-parallel
+path where `lax.all_to_all` routes per-expert token buffers between ranks
+over ICI.
+
+Three interchangeable compute paths over the same routed-FFN semantics:
+
+- dense       every token x every (local) expert; exact, best for decode-size
+              token counts (the models keep this path inline).
+- dispatch    scatter tokens into per-expert capacity buffers [E, C, D], run
+              the FFN once over the buffers, gather back weighted by the
+              router probs.  FLOPs drop from N*E*ffn to E*C*ffn ~= k*cf*N*ffn.
+              Tokens routed beyond an expert's capacity are dropped (standard
+              MoE capacity semantics); capacity_factor <= 0 selects the exact
+              no-drop capacity C = N (tests / small shapes).
+- a2a         expert parallelism over a mesh axis: tokens sharded over the
+              axis, experts sharded over the same axis.  Each rank scatters
+              its token slice into [E, C, D]; `all_to_all` hands each expert
+              owner its buffers ([E/R, R*C, D]); local FFN; reverse
+              `all_to_all`; local weighted gather.  The hop rides ICI inside
+              the jitted program — no wire format, no serialization.
+
+All shapes are static (capacity is a Python int), so every path jits and
+scans cleanly.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def expert_capacity(n_tokens: int, n_experts: int, k: int, factor: float) -> int:
+    """Per-expert token capacity C (static).  factor <= 0 -> exact (C = n)."""
+    if factor <= 0:
+        return int(n_tokens)
+    c = math.ceil(k * n_tokens * factor / n_experts)
+    return max(1, min(int(n_tokens), c))
+
+
+MOE_IMPLS = ("auto", "dense", "dispatch", "a2a")
+
+
+def resolve_moe_impl(impl: str, n_tokens: int, n_experts: int, ranks: int) -> str:
+    """Pick the compute path for a (token count, expert count, ranks) shape.
+
+    Shapes are static under jit, so this runs at trace time: each padding
+    bucket compiles the path that fits it.  Dense wins below ~2E tokens
+    (decode); above that dispatch cuts FLOPs by ~E/(k*cf), and with multiple
+    expert-sharded ranks the a2a path also shards the dispatch compute.
+    """
+    if impl not in MOE_IMPLS:
+        # fail fast: a typo'd DNET_COMPUTE_MOE_IMPL would otherwise fall
+        # through every model branch into silent dense compute
+        raise ValueError(f"unknown moe_impl {impl!r}; expected one of {MOE_IMPLS}")
+    if impl != "auto":
+        return impl
+    if n_tokens < max(2 * n_experts, 16):
+        return "dense"
+    return "a2a" if ranks > 1 else "dispatch"
+
+
+def route_positions(top_idx: jnp.ndarray, n_experts: int) -> jnp.ndarray:
+    """Arrival index of each (token, slot) within its expert's queue.
+
+    top_idx [N, k] int32 expert ids (entries >= n_experts are sentinels and
+    get position 0 — callers drop them via the out-of-bounds expert index).
+    Returns pos [N, k]: slot-major cumulative count, so a token's place in an
+    expert buffer is deterministic in token order.
+    """
+    flat_e = top_idx.reshape(-1)
+    onehot = flat_e[:, None] == jnp.arange(n_experts, dtype=flat_e.dtype)[None, :]
+    pos = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1
+    own = jnp.sum(pos * onehot, axis=1)
+    return own.reshape(top_idx.shape)
+
+
+def localize_topk(top_idx: jnp.ndarray, offset, n_local: int) -> jnp.ndarray:
+    """Shift global expert ids into a rank's local range; non-local entries
+    become the (out-of-bounds) sentinel n_local, so scatter/gather drop them.
+    `offset` may be traced (lax.axis_index) — jnp.where keeps it jittable."""
+    ok = (top_idx >= offset) & (top_idx < offset + n_local)
+    return jnp.where(ok, top_idx - offset, n_local).astype(jnp.int32)
+
+
+def scatter_to_experts(
+    flat: jnp.ndarray, top_idx: jnp.ndarray, pos: jnp.ndarray, n_experts: int, capacity: int
+) -> jnp.ndarray:
+    """flat [N, D] -> per-expert buffers [E, C, D].  Slots whose expert id or
+    queue position is out of bounds (non-local / over capacity) are dropped."""
+    vals = jnp.broadcast_to(flat[:, None, :], (*top_idx.shape, flat.shape[-1]))
+    buf = jnp.zeros((n_experts, capacity, flat.shape[-1]), flat.dtype)
+    return buf.at[top_idx, pos].add(vals, mode="drop")
+
+
+def gather_from_experts(
+    ye: jnp.ndarray, top_idx: jnp.ndarray, pos: jnp.ndarray, top_w: jnp.ndarray
+) -> jnp.ndarray:
+    """ye [E, C, D] + router weights [N, k] -> combined [N, D]; dropped slots
+    contribute zero (mode="fill")."""
+    g = ye.at[top_idx, pos].get(mode="fill", fill_value=0)  # [N, k, D]
+    return jnp.einsum("nkd,nk->nd", g, top_w.astype(ye.dtype))
+
+
+def moe_dispatch(
+    flat: jnp.ndarray,
+    top_idx: jnp.ndarray,
+    top_w: jnp.ndarray,
+    ffn: Callable[[jnp.ndarray], jnp.ndarray],
+    n_experts: int,
+    capacity: int,
+) -> jnp.ndarray:
+    """Single-rank capacity dispatch: [N, D] -> [N, D].
+
+    ffn maps per-expert buffers [E, C, D] -> [E, C, D] (row i uses expert
+    i's weights; per-expert biases are added inside, so a dropped token
+    simply contributes zero to the combine).
+    """
+    pos = route_positions(top_idx, n_experts)
+    xe = scatter_to_experts(flat, top_idx, pos, n_experts, capacity)
+    return gather_from_experts(ffn(xe), top_idx, pos, top_w)
+
+
+def moe_dispatch_sharded(
+    flat: jnp.ndarray,
+    top_idx: jnp.ndarray,
+    top_w: jnp.ndarray,
+    ffn_local: Callable[[jnp.ndarray], jnp.ndarray],
+    n_local: int,
+    capacity: int,
+    axis: str,
+) -> jnp.ndarray:
+    """Experts sharded over `axis`, tokens replicated: each rank dispatches
+    only the slots routed into its expert slice and returns a PARTIAL output
+    — the caller psums over `axis` (same seam as the dense path)."""
+    offset = lax.axis_index(axis) * n_local
+    local_idx = localize_topk(top_idx, offset, n_local)
+    pos = route_positions(local_idx, n_local)
+    xe = scatter_to_experts(flat, local_idx, pos, n_local, capacity)
+    return gather_from_experts(ffn_local(xe), local_idx, pos, top_w)
+
+
+def moe_apply(
+    impl: str,
+    flat: jnp.ndarray,
+    top_idx: jnp.ndarray,
+    top_w: jnp.ndarray,
+    ffn_local: Callable[[jnp.ndarray], jnp.ndarray],
+    n_local: int,
+    capacity_factor: float,
+    k: int,
+    tp_axis,
+    dense_fn: Callable[[], jnp.ndarray],
+):
+    """One MoE layer through the selected compute path (shared by every MoE
+    model; the models supply only their ffn/dense closures and routing).
+
+    Returns (out [N, D], partial): partial=True means the output is a
+    per-rank partial sum the caller must psum over tp_axis (the Megatron
+    seam both models join their other residual terms at).
+    """
+    ranks = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    n_experts = n_local * ranks  # tp ranks shard the expert dim
+    impl = resolve_moe_impl(impl, flat.shape[0], n_experts, ranks)
+    if impl == "a2a" and tp_axis is not None:
+        out = moe_a2a_replicated(
+            flat, top_idx, top_w, ffn_local, n_experts, capacity_factor, k, tp_axis
+        )
+        return out, False
+    if impl in ("dispatch", "a2a"):
+        capacity = expert_capacity(flat.shape[0], n_experts, k, capacity_factor)
+        if tp_axis is None:
+            return moe_dispatch(flat, top_idx, top_w, ffn_local, n_experts, capacity), False
+        out = moe_dispatch_sharded(
+            flat, top_idx, top_w, ffn_local, n_local, capacity, tp_axis
+        )
+        return out, True
+    return dense_fn(), tp_axis is not None
+
+
+def moe_a2a_replicated(
+    flat: jnp.ndarray,
+    top_idx: jnp.ndarray,
+    top_w: jnp.ndarray,
+    ffn_local: Callable[[jnp.ndarray], jnp.ndarray],
+    n_experts: int,
+    capacity_factor: float,
+    k: int,
+    axis: str,
+) -> jnp.ndarray:
+    """a2a expert parallelism for AXIS-REPLICATED inputs (the Megatron seam
+    both MoE models sit behind: x is replicated over the tp axis).
+
+    Splits the token set across ranks (ceil-padded; padded rows carry the
+    out-of-bounds sentinel expert id so they dispatch nowhere), runs moe_a2a
+    on each rank's slice, and restores replication with a scatter+psum —
+    psum output is axis-INVARIANT, so a lax.scan carry through this path
+    keeps its axis typing (an all_gather would mark the carry varying).
+    Returns the full [N, D] combined output, replicated over `axis`.
+    """
+    N, D = flat.shape
+    R = lax.axis_size(axis)
+    n = -(-N // R)
+    pad = n * R - N
+    if pad:
+        flat_p = jnp.pad(flat, ((0, pad), (0, 0)))
+        idx_p = jnp.pad(top_idx, ((0, pad), (0, 0)), constant_values=n_experts)
+        w_p = jnp.pad(top_w, ((0, pad), (0, 0)))
+    else:
+        flat_p, idx_p, w_p = flat, top_idx, top_w
+    i = lax.axis_index(axis)
+    fl = lax.dynamic_slice_in_dim(flat_p, i * n, n)
+    ti = lax.dynamic_slice_in_dim(idx_p, i * n, n)
+    tw = lax.dynamic_slice_in_dim(w_p, i * n, n)
+    C = expert_capacity(n, n_experts, k, capacity_factor)
+    out = moe_a2a(fl, ti, tw, ffn_local, n_experts, C, axis)
+    buf = jnp.zeros((n * R, out.shape[-1]), out.dtype)
+    buf = lax.dynamic_update_slice_in_dim(buf, out, i * n, axis=0)
+    return lax.psum(buf, axis)[:N]
+
+
+def moe_a2a(
+    flat: jnp.ndarray,
+    top_idx: jnp.ndarray,
+    top_w: jnp.ndarray,
+    ffn_local: Callable[[jnp.ndarray], jnp.ndarray],
+    n_experts: int,
+    capacity: int,
+    axis: str,
+) -> jnp.ndarray:
+    """Expert-parallel dispatch over `axis` (R ranks).
+
+    Per rank: flat [n, D] is this rank's token slice, top_idx/top_w [n, k]
+    its router output over the GLOBAL expert space, ffn_local computes the
+    rank's E/R experts on buffers [E/R, R*C, D].  Capacity is per
+    (rank, expert) pair.  Requires n_experts % R == 0.
+    """
+    pos = route_positions(top_idx, n_experts)
+    xe = scatter_to_experts(flat, top_idx, pos, n_experts, capacity)
+    # [E, C, D] -> [E/R, R*C, D]: chunk j of the expert axis goes to rank j
+    xe = lax.all_to_all(xe, axis, split_axis=0, concat_axis=1, tiled=True)
+    ye = ffn_local(xe)
+    # [E/R, R*C, D] -> [E, C, D]: return each rank's slice of every buffer
+    ye = lax.all_to_all(ye, axis, split_axis=1, concat_axis=0, tiled=True)
+    return gather_from_experts(ye, top_idx, pos, top_w)
