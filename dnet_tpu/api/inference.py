@@ -162,6 +162,7 @@ class InferenceManager:
         finish_reason = "length"
         pending = ""  # emitted-text buffer held back for stop-seq matching
         held_entries: list = []  # logprob entries for held-back tokens
+        emitted_ahead = 0  # emitted chars owned by the oldest held entry
         stopped_by_seq = False
 
         await self.adapter.reset_cache(nonce)
@@ -222,20 +223,26 @@ class InferenceManager:
                 if delta or stopped:
                     logprobs = None
                     if req.logprobs_enabled and held_entries:
+                        # flush only entries whose token text is FULLY
+                        # emitted; an entry whose text straddles the
+                        # holdback boundary stays held with its text (a
+                        # later stop match must be able to discard it —
+                        # flushing early would leave a logprob entry for
+                        # text that never reaches the client)
+                        budget = emitted_ahead + len(delta)
+                        kept = []
+                        while held_entries and len(held_entries[0].token) <= budget:
+                            budget -= len(held_entries[0].token)
+                            kept.append(held_entries.pop(0))
                         if stopped:
-                            # entries for the matched stop text are discarded
-                            # with it: keep only tokens whose text fits the
-                            # emitted delta
-                            kept, cum = [], 0
-                            for e in held_entries:
-                                if cum + len(e.token) > len(delta):
-                                    break
-                                kept.append(e)
-                                cum += len(e.token)
-                            held_entries = kept
-                        if held_entries:
-                            logprobs = ChoiceLogprobs(content=held_entries)
-                        held_entries = []
+                            # entries for the matched stop text are
+                            # discarded with it
+                            held_entries = []
+                            emitted_ahead = 0
+                        else:
+                            emitted_ahead = budget
+                        if kept:
+                            logprobs = ChoiceLogprobs(content=kept)
                     yield ChatCompletionChunk(
                         id=rid,
                         model=req.model,

@@ -408,7 +408,46 @@ class LocalAdapter(ApiAdapterBase):
                 live = self.engine.sessions
                 for n in [n for n in self._ramp if n not in live]:
                     del self._ramp[n]
-        return min(width, budget or 1)
+        # no budget => no chunking: a chunk must never overshoot max_tokens
+        # by more than the driver is prepared to discard
+        return min(width, budget) if budget is not None else 1
+
+    def _chunked_results(
+        self,
+        eng,
+        nonce: str,
+        token_ids: List[int],
+        decoding,
+        budget: Optional[int],
+    ):
+        """Pipelined chunked decode: read the current chunk AFTER dispatching
+        the next one, so the result transfer (and this thread's host work)
+        overlaps the device computing ahead.  The next chunk chains from the
+        device-resident last token — no host round trip feeds the device.
+
+        Returns the current chunk's SampleResults, or None to fall back to
+        per-token decode (engine without chunk support / width-1 budget).
+        """
+        if not hasattr(eng, "decode_chunk_dispatch"):
+            # legacy engines: one-shot chunk call, no pipelining
+            chunk = self._next_chunk_width(nonce, budget)
+            if chunk > 1 and hasattr(eng, "decode_chunk"):
+                return eng.decode_chunk(nonce, token_ids[-1], decoding, chunk)
+            return None
+        if eng.pending_chunks(nonce) == 0:
+            chunk = self._next_chunk_width(nonce, budget)
+            if chunk <= 1:
+                return None
+            if eng.decode_chunk_dispatch(nonce, token_ids[-1], decoding, chunk) == 0:
+                return None
+        # speculate one chunk beyond the unread one while we block on the
+        # read; EOS overshoot wastes at most that chunk's compute (its KV
+        # rows die with the session, same as the in-chunk overshoot)
+        if budget is not None and budget - eng.pending_width(nonce) > 1:
+            nxt = self._next_chunk_width(nonce, budget - eng.pending_width(nonce))
+            if nxt > 1:
+                eng.decode_chunk_dispatch(nonce, None, decoding, nxt)
+        return eng.decode_chunk_read(nonce)
 
     def _buffer_results(self, nonce: str, entries: Dict[int, TokenResult]) -> None:
         with self._buf_lock:
@@ -441,9 +480,10 @@ class LocalAdapter(ApiAdapterBase):
                 # silently continue with empty context
                 raise RuntimeError(f"session expired for request {nonce}")
             else:
-                chunk = self._next_chunk_width(nonce, budget)
-                if chunk > 1 and hasattr(eng, "decode_chunk"):
-                    results = eng.decode_chunk(nonce, token_ids[-1], decoding, chunk)
+                results = self._chunked_results(eng, nonce, token_ids, decoding, budget)
+                if results is None:
+                    res = eng.decode_step(nonce, token_ids[-1], decoding)
+                else:
                     if len(results) > 1:
                         self._buffer_results(
                             nonce,
@@ -455,8 +495,6 @@ class LocalAdapter(ApiAdapterBase):
                             },
                         )
                     res = results[0]
-                else:
-                    res = eng.decode_step(nonce, token_ids[-1], decoding)
             result = eng.token_result(nonce, res, step=step, decoding=decoding)
             self._futures.resolve(result)
         except Exception as exc:  # surfaced to await_token as an error result

@@ -11,17 +11,24 @@ expiry (reference: src/dnet/shard/runtime.py:374-396).
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from pathlib import Path
-from typing import Dict, Iterator, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dnet_tpu.core.kvcache import init_cache
-from dnet_tpu.core.sampler import SampleParams, SampleResult, sample
+from dnet_tpu.core.sampler import (
+    MAX_TOP_LOGPROBS,
+    SamplePlan,
+    SampleParams,
+    SampleResult,
+    sample,
+)
 from dnet_tpu.core.types import DecodingParams, TokenResult
 from dnet_tpu.models import ModelConfig, get_ring_model_cls
 from dnet_tpu.utils.checkpoint import Checkpoint
@@ -47,6 +54,10 @@ class Session:
     key: jax.Array = None
     counts: jax.Array = None  # [B, V] int32 seen-token counts (repetition penalty)
     last_used: float = field(default_factory=time.time)
+    # chunk pipelining: last sampled token ON DEVICE (chains the next chunk
+    # without a host round trip) + dispatched-but-unread chunk queue
+    last_token: jax.Array = None  # [B, 1] int32
+    pending: "deque" = field(default_factory=lambda: deque())
 
 
 class LocalEngine:
@@ -256,37 +267,58 @@ class LocalEngine:
         # donate kv (arg 3): each step reuses the cache buffers in place
         self._forward = jax.jit(full_logits, donate_argnums=(3,))
 
-        def decode_and_sample(window_params, edge_params, token, kv, pos, sp, key, counts):
+        def decode_and_sample(window_params, edge_params, token, kv, pos, sp, key, counts,
+                              plan=None):
             logits, kv = full_logits(window_params, edge_params, token, kv, pos, 0)
-            res = sample(logits, sp, key, token_counts=counts)
+            res = sample(logits, sp, key, token_counts=counts, plan=plan)
             counts = counts.at[jnp.arange(counts.shape[0]), res.token].add(1)
             return res, kv, counts
 
-        self._decode = jax.jit(decode_and_sample, donate_argnums=(3, 7))
+        self._decode = jax.jit(decode_and_sample, static_argnums=(8,), donate_argnums=(3, 7))
 
-        def decode_chunk_fn(window_params, edge_params, token, kv, pos, sp, key, counts, n_steps):
+        def decode_chunk_fn(window_params, edge_params, token, kv, pos, sp, key, counts,
+                            n_steps, plan=None):
             """n_steps decode iterations fused into ONE XLA program: the
             sampled token feeds back on-device, so the host pays one dispatch
             + one device->host read per CHUNK instead of per token.  Key
             evolution matches the per-step path exactly (split-before-sample),
             so chunked and unchunked decode produce identical streams for a
-            given seed."""
+            given seed.
+
+            Returns the per-step results PACKED into one f32 array (one
+            device->host transfer per chunk — four separate array reads cost
+            4 round trips, which dominates chunk latency on a remote-attached
+            device), plus the last sampled token ON DEVICE so the next chunk
+            can chain without a host round trip."""
 
             def body(carry, _):
                 tok, kv, pos, key, counts = carry
                 key, step_key = jax.random.split(key)
                 logits, kv = full_logits(window_params, edge_params, tok, kv, pos, 0)
-                res = sample(logits, sp, step_key, token_counts=counts)
+                res = sample(logits, sp, step_key, token_counts=counts, plan=plan)
                 counts = counts.at[jnp.arange(counts.shape[0]), res.token].add(1)
                 return (res.token[:, None], kv, pos + 1, key, counts), res
 
-            (_, kv, _, key, counts), results = jax.lax.scan(
+            (last_tok, kv, _, key, counts), results = jax.lax.scan(
                 body, (token, kv, pos, key, counts), None, length=n_steps
             )
-            return results, kv, key, counts
+            with_lp = plan is None or plan.logprobs
+            if with_lp:  # token ids are exact in f32 for V < 2**24
+                packed = jnp.concatenate(
+                    [
+                        results.token[..., None].astype(jnp.float32),
+                        results.logprob[..., None],
+                        results.top_tokens.astype(jnp.float32),
+                        results.top_logprobs,
+                    ],
+                    axis=-1,
+                )
+            else:
+                packed = results.token[..., None].astype(jnp.float32)
+            return packed, last_tok, kv, key, counts
 
         self._decode_chunk = jax.jit(
-            decode_chunk_fn, static_argnums=(8,), donate_argnums=(3, 7)
+            decode_chunk_fn, static_argnums=(8, 9), donate_argnums=(3, 7)
         )
 
         def hidden_step(window_params, x, kv, pos, t_real, kinds=None):
@@ -590,18 +622,19 @@ class LocalEngine:
             )
         sess.key, step_key = jax.random.split(sess.key)
         sp = SampleParams.from_decoding(decoding)
+        plan = SamplePlan.from_decoding(decoding)
         token = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
         if self.plan.streams_weights:
             x = self.model.embed(self.edge_params, token)
             x = self.run_layers(sess, x, sess.pos, t_real=1)
             x = self.model.normalize(self.edge_params, x)
             logits = self.model.lm_project(self.edge_params, x)[:, 0]
-            res = sample(logits, sp, step_key, token_counts=sess.counts)
+            res = sample(logits, sp, step_key, token_counts=sess.counts, plan=plan)
             sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
         else:
             res, sess.kv, sess.counts = self._decode(
                 self.window_params, self.edge_params, token, sess.kv,
-                jnp.int32(sess.pos), sp, step_key, sess.counts,
+                jnp.int32(sess.pos), sp, step_key, sess.counts, plan,
             )
         if self._sync_every_n and sess.pos % self._sync_every_n == 0:
             t0 = time.perf_counter()
@@ -618,6 +651,79 @@ class LocalEngine:
     # of compiled scan programs bounded (one per width actually used)
     DECODE_CHUNK_BUCKETS = (32, 16, 8, 4, 2)
 
+    def decode_chunk_dispatch(
+        self,
+        nonce: str,
+        token_id: Optional[int],
+        decoding: DecodingParams,
+        max_steps: int,
+    ) -> int:
+        """Dispatch (async) a fused chunk of up to `max_steps` decode steps.
+
+        token_id None chains from the DEVICE-resident last token of the
+        previously dispatched chunk — the host never has to read a token to
+        keep the device busy, so result transfers overlap the next chunk's
+        compute.  Returns the dispatched width (0 = not chunkable; caller
+        falls back to decode_step).  Results are read by decode_chunk_read
+        in dispatch order.
+        """
+        sess = self.sessions[nonce]
+        if sess.pos >= self.max_seq:
+            # full context is not an error HERE: the caller may be
+            # speculating past a chunk that exactly filled the sequence —
+            # returning 0 routes the next real step to decode_step, which
+            # raises the definitive "reached max_seq" for the request
+            return 0
+        budget = min(max_steps, self.max_seq - sess.pos)
+        K = next((b for b in self.DECODE_CHUNK_BUCKETS if b <= budget), 1)
+        if K == 1 or self.plan.streams_weights:
+            return 0
+        if token_id is None:
+            if sess.last_token is None:
+                raise RuntimeError("no device-resident token to chain from")
+            token = sess.last_token
+        else:
+            token = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
+        sp = SampleParams.from_decoding(decoding)
+        plan = SamplePlan.from_decoding(decoding)
+        packed, sess.last_token, sess.kv, sess.key, sess.counts = self._decode_chunk(
+            self.window_params, self.edge_params, token, sess.kv,
+            jnp.int32(sess.pos), sp, sess.key, sess.counts, K, plan,
+        )
+        sess.pending.append((K, packed, plan))
+        sess.pos += K
+        sess.last_used = time.time()
+        return K
+
+    def pending_chunks(self, nonce: str) -> int:
+        """Dispatched-but-unread chunk count (0 for unknown sessions)."""
+        sess = self.sessions.get(nonce)
+        return len(sess.pending) if sess is not None else 0
+
+    def pending_width(self, nonce: str) -> int:
+        """Total tokens in flight across dispatched-but-unread chunks."""
+        sess = self.sessions.get(nonce)
+        return sum(k for k, _, _ in sess.pending) if sess is not None else 0
+
+    def decode_chunk_read(self, nonce: str) -> List[SampleResult]:
+        """Read the oldest dispatched chunk: ONE device->host transfer for
+        the packed [K, B, W] result block, split host-side."""
+        sess = self.sessions[nonce]
+        K, packed, plan = sess.pending.popleft()
+        arr = np.asarray(packed)  # blocks until the chunk's program finishes
+        toks = arr[..., 0].astype(np.int32)  # [K, B]
+        if plan.logprobs:
+            M = MAX_TOP_LOGPROBS
+            lps = arr[..., 1]
+            tt = arr[..., 2 : 2 + M].astype(np.int32)
+            tlp = arr[..., 2 + M : 2 + 2 * M]
+        else:
+            B = arr.shape[1]
+            lps = np.zeros((K, B), np.float32)
+            tt = np.zeros((K, B, MAX_TOP_LOGPROBS), np.int32)
+            tlp = np.zeros((K, B, MAX_TOP_LOGPROBS), np.float32)
+        return [SampleResult(toks[i], lps[i], tt[i], tlp[i]) for i in range(K)]
+
     def decode_chunk(
         self,
         nonce: str,
@@ -625,61 +731,53 @@ class LocalEngine:
         decoding: DecodingParams,
         max_steps: int,
     ) -> list[SampleResult]:
-        """Up to `max_steps` decode steps in one on-device lax.scan.
+        """Up to `max_steps` decode steps in one on-device lax.scan
+        (dispatch + read in one call; the pipelining adapter calls the two
+        halves itself to overlap the read with the next chunk's compute).
 
-        Returns one host-side SampleResult per generated token (a single
-        device->host transfer for the whole chunk).  The caller owns EOS /
-        stop-sequence checks: tokens past a stop are simply discarded with the
-        session, exactly as the reference's driver discards its own overshoot
-        (the KV rows they wrote die with the session).  Closes the per-token
-        dispatch gap flagged in BASELINE.md (49 tok/s dispatched vs 208 fused).
+        Returns one host-side SampleResult per generated token.  The caller
+        owns EOS / stop-sequence checks: tokens past a stop are simply
+        discarded with the session, exactly as the reference's driver
+        discards its own overshoot (the KV rows they wrote die with the
+        session).  Closes the per-token dispatch gap flagged in BASELINE.md
+        (49 tok/s dispatched vs 208 fused).
         """
-        sess = self.sessions[nonce]
-        if sess.pos >= self.max_seq:
-            raise ValueError(
-                f"sequence length {sess.pos} reached max_seq {self.max_seq}"
-            )
-        budget = min(max_steps, self.max_seq - sess.pos)
-        K = next((b for b in self.DECODE_CHUNK_BUCKETS if b <= budget), 1)
-        if K == 1 or self.plan.streams_weights:
+        if self.decode_chunk_dispatch(nonce, token_id, decoding, max_steps) == 0:
             return [self.decode_step(nonce, token_id, decoding)]
-        sp = SampleParams.from_decoding(decoding)
-        token = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
-        results, sess.kv, sess.key, sess.counts = self._decode_chunk(
-            self.window_params, self.edge_params, token, sess.kv,
-            jnp.int32(sess.pos), sp, sess.key, sess.counts, K,
-        )
-        sess.pos += K
-        sess.last_used = time.time()
-        # one transfer for the stacked [K, ...] results, then split host-side
-        toks, lps, tt, tlp = (
-            np.asarray(results.token),
-            np.asarray(results.logprob),
-            np.asarray(results.top_tokens),
-            np.asarray(results.top_logprobs),
-        )
-        return [SampleResult(toks[i], lps[i], tt[i], tlp[i]) for i in range(K)]
+        return self.decode_chunk_read(nonce)
+
+    # plans warmed ahead of traffic: greedy, unfiltered-sampled (the
+    # OpenAI-default request: temperature 1, top_p 1), and filtered-sampled;
+    # logprobs/penalty variants compile on first use
+    WARM_DECODINGS = (
+        DecodingParams(),  # greedy: temperature 0, no filters
+        DecodingParams(temperature=1.0),  # API-default sampled, no filters
+        DecodingParams(temperature=0.7, top_p=0.9),  # sampled + filters
+    )
 
     def warm_chunks(self) -> None:
-        """Compile every decode-chunk program (and the single-step decode)
-        up front, so the first request's ramp never stalls mid-stream on a
-        synchronous XLA compile."""
+        """Compile the decode-chunk programs (and the single-step decode)
+        for the common sampling plans up front, so the first request's ramp
+        never stalls mid-stream on a synchronous XLA compile.  SamplePlan is
+        a static jit argument, so each warmed DecodingParams shape is its
+        own program set."""
         if self.plan.streams_weights:
             return
         nonce = "__warm__"
-        dec = DecodingParams()
         t0 = time.perf_counter()
-        self.end_session(nonce)
-        try:
-            self.prefill_and_sample(nonce, [0], dec)
-            for b in self.DECODE_CHUNK_BUCKETS:
-                if self.sessions[nonce].pos + b < self.max_seq:
-                    self.decode_chunk(nonce, 0, dec, b)
-            self.decode_step(nonce, 0, dec)
-        finally:
+        for dec in self.WARM_DECODINGS:
             self.end_session(nonce)
+            try:
+                self.prefill_and_sample(nonce, [0], dec)
+                for b in self.DECODE_CHUNK_BUCKETS:
+                    if self.sessions[nonce].pos + b < self.max_seq:
+                        self.decode_chunk(nonce, 0, dec, b)
+                self.decode_step(nonce, 0, dec)
+            finally:
+                self.end_session(nonce)
         log.info(
-            "[PROFILE] warmed decode-chunk programs in %.1fs",
+            "[PROFILE] warmed decode-chunk programs (%d plans) in %.1fs",
+            len(self.WARM_DECODINGS),
             time.perf_counter() - t0,
         )
 
@@ -723,7 +821,7 @@ class LocalEngine:
         sess.key, step_key = jax.random.split(sess.key)
         res = sample(
             logits, SampleParams.from_decoding(decoding), step_key,
-            token_counts=sess.counts,
+            token_counts=sess.counts, plan=SamplePlan.from_decoding(decoding),
         )
         sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
         return res

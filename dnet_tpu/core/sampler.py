@@ -42,6 +42,38 @@ class SampleParams(NamedTuple):
         )
 
 
+class SamplePlan(NamedTuple):
+    """STATIC sampling shape, derived host-side from DecodingParams.
+
+    The traced-knob design (SampleParams) means one program serves every
+    request — but it also means every decode step pays for machinery most
+    requests never use: three full-vocab sorts for the top-k/p filters and a
+    log_softmax + top_k(20) for logprobs cost ~4ms/step at V=128k on v5e,
+    comparable to a whole 1B-model forward.  The plan collapses the unused
+    machinery at trace time; the handful of plan combinations bound the
+    number of compiled variants, and knobs *within* a plan stay traced (a
+    temperature change still never recompiles).
+    """
+
+    greedy: bool  # temperature <= 0: token = argmax, no sampling machinery
+    filters: bool  # any of top_p < 1 / top_k > 0 / min_p > 0 active
+    logprobs: bool  # request wants logprob + top-logprob outputs
+    penalty: bool  # repetition_penalty != 1
+
+    @classmethod
+    def from_decoding(cls, d: DecodingParams) -> "SamplePlan":
+        return cls(
+            greedy=d.temperature <= 0.0,
+            filters=(d.top_p < 1.0) or (d.top_k > 0) or (d.min_p > 0.0),
+            logprobs=bool(d.logprobs),
+            penalty=d.repetition_penalty != 1.0,
+        )
+
+
+# the everything-on plan: default for callers that keep all knobs traced
+FULL_PLAN = SamplePlan(greedy=False, filters=True, logprobs=True, penalty=True)
+
+
 class SampleResult(NamedTuple):
     token: jnp.ndarray  # [B] int32
     logprob: jnp.ndarray  # [B] f32, log-softmax of raw logits at token
@@ -54,6 +86,7 @@ def sample(
     params: SampleParams,
     key: jax.Array,
     token_counts: Optional[jnp.ndarray] = None,
+    plan: Optional[SamplePlan] = None,
 ) -> SampleResult:
     """logits [B, V] -> sampled tokens with logprobs.
 
@@ -61,55 +94,72 @@ def sample(
     reference): repetition penalty over seen tokens, scale by temperature,
     keep top-k, keep smallest prefix with cumulative prob >= top_p, drop
     tokens below min_p * p_max, sample.  temperature == 0 -> greedy argmax.
+
+    `plan` statically skips machinery a request doesn't use (see SamplePlan);
+    the default FULL_PLAN preserves the everything-traced behavior.  Fields
+    a plan disables come back as zeros (shapes are stable across plans).
     """
-    if token_counts is not None:
+    if plan is None:
+        plan = FULL_PLAN
+    if plan.penalty and token_counts is not None:
         logits = apply_repetition_penalty(
             logits, token_counts, params.repetition_penalty
         )
     B, V = logits.shape
-    raw_logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
 
-    temp = jnp.maximum(params.temperature, 1e-6)
-    scaled = logits.astype(jnp.float32) / temp
+    if plan.greedy:
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        temp = jnp.maximum(params.temperature, 1e-6)
+        scaled = logits.astype(jnp.float32) / temp
+        if plan.filters:
+            # One descending sort powers top-k, top-p and min-p.
+            sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] desc
+            ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[:, ::-1], axis=-1)
 
-    # One descending sort powers top-k, top-p and min-p.
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] desc
-    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[:, ::-1], axis=-1)  # rank of each vocab id
+            # top-k: keep ranks < k (k==0 -> keep all)
+            k = jnp.where(params.top_k > 0, params.top_k, V)
+            keep_topk = ranks < k
 
-    # top-k: keep ranks < k (k==0 -> keep all)
-    k = jnp.where(params.top_k > 0, params.top_k, V)
-    keep_topk = ranks < k
+            # top-p over the sorted distribution: keep the smallest prefix
+            # with cumsum >= top_p (always keep rank 0).
+            sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+            prefix_keep_sorted = (cumprobs - sorted_probs) < params.top_p
+            keep_topp = jnp.take_along_axis(prefix_keep_sorted, ranks, axis=-1)
 
-    # top-p over the sorted distribution: keep the smallest prefix with
-    # cumsum >= top_p (always keep rank 0).
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-    prefix_keep_sorted = (cumprobs - sorted_probs) < params.top_p  # exclusive cumsum < p
-    keep_topp = jnp.take_along_axis(prefix_keep_sorted, ranks, axis=-1)
+            # min-p: probability >= min_p * max prob
+            probs = jax.nn.softmax(scaled, axis=-1)
+            pmax = jnp.max(probs, axis=-1, keepdims=True)
+            keep_minp = probs >= params.min_p * pmax
 
-    # min-p: probability >= min_p * max prob
-    probs = jax.nn.softmax(scaled, axis=-1)
-    pmax = jnp.max(probs, axis=-1, keepdims=True)
-    keep_minp = probs >= params.min_p * pmax
+            keep = keep_topk & keep_topp & keep_minp
+            # never mask everything: rank-0 always kept
+            keep = keep | (ranks == 0)
+            masked = jnp.where(keep, scaled, -jnp.inf)
+        else:
+            masked = scaled
 
-    keep = keep_topk & keep_topp & keep_minp
-    # never mask everything: rank-0 always kept
-    keep = keep | (ranks == 0)
-    masked = jnp.where(keep, scaled, -jnp.inf)
+        gumbel = jax.random.gumbel(key, masked.shape, dtype=jnp.float32)
+        stochastic = jnp.argmax(masked + gumbel, axis=-1)
+        greedy = jnp.argmax(logits, axis=-1)
+        token = jnp.where(params.temperature <= 0.0, greedy, stochastic).astype(jnp.int32)
 
-    gumbel = jax.random.gumbel(key, masked.shape, dtype=jnp.float32)
-    stochastic = jnp.argmax(masked + gumbel, axis=-1)
-    greedy = jnp.argmax(logits, axis=-1)
-    token = jnp.where(params.temperature <= 0.0, greedy, stochastic).astype(jnp.int32)
-
-    logprob = jnp.take_along_axis(raw_logprobs, token[:, None], axis=-1)[:, 0]
-    n_top = min(MAX_TOP_LOGPROBS, V)
-    top_lp, top_ids = jax.lax.top_k(raw_logprobs, n_top)
-    if n_top < MAX_TOP_LOGPROBS:  # tiny-vocab tests: pad to the static width
-        pad = MAX_TOP_LOGPROBS - n_top
-        top_lp = jnp.pad(top_lp, ((0, 0), (0, pad)), constant_values=-jnp.inf)
-        top_ids = jnp.pad(top_ids, ((0, 0), (0, pad)))
-    return SampleResult(token, logprob, top_ids.astype(jnp.int32), top_lp)
+    if plan.logprobs:
+        raw_logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logprob = jnp.take_along_axis(raw_logprobs, token[:, None], axis=-1)[:, 0]
+        n_top = min(MAX_TOP_LOGPROBS, V)
+        top_lp, top_ids = jax.lax.top_k(raw_logprobs, n_top)
+        if n_top < MAX_TOP_LOGPROBS:  # tiny-vocab tests: pad to the static width
+            pad = MAX_TOP_LOGPROBS - n_top
+            top_lp = jnp.pad(top_lp, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+            top_ids = jnp.pad(top_ids, ((0, 0), (0, pad)))
+        top_ids = top_ids.astype(jnp.int32)
+    else:
+        logprob = jnp.zeros((B,), jnp.float32)
+        top_ids = jnp.zeros((B, MAX_TOP_LOGPROBS), jnp.int32)
+        top_lp = jnp.zeros((B, MAX_TOP_LOGPROBS), jnp.float32)
+    return SampleResult(token, logprob, top_ids, top_lp)
 
 
 @partial(jax.jit, static_argnames=())

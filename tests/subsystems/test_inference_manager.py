@@ -165,3 +165,28 @@ def test_logprob_entries_stay_per_token_under_stop_holdback():
     assert out.choices[0].message.content == "abXq"
     entries = out.choices[0].logprobs.content
     assert [e.token for e in entries] == ["a", "b", "X", "q"]
+
+
+def test_logprob_entry_straddling_stop_boundary_is_not_flushed_early():
+    """A token whose text straddles the stop-holdback boundary must keep its
+    logprob entry held back with the text: if the stop later matches, both
+    the text and the entry are discarded together (flushing the entry with
+    the earlier partial delta would ship a logprob for text the client
+    never receives)."""
+
+    class MultiCharTokenizer(ByteTokenizer):
+        TEXT = {1: "aX", 2: "Yb"}
+
+        def decode(self, ids):
+            return "".join(self.TEXT.get(i, chr(i)) for i in ids)
+
+    adapter = FakeAdapter([1, 2])
+    m = make_manager(adapter)
+    m.tokenizer = MultiCharTokenizer()
+    out = collect(m, req(max_tokens=10, stop=["XY"], logprobs=True))
+    # token 1 emits "a" and holds "X"; token 2 completes the stop "XY"
+    assert out.choices[0].message.content == "a"
+    assert out.choices[0].finish_reason == "stop"
+    entries = (out.choices[0].logprobs.content if out.choices[0].logprobs else [])
+    # no entry may reference the discarded "aX"/"Yb" text
+    assert entries == []

@@ -189,3 +189,20 @@ def test_session_ttl_sweep(tiny_llama_dir):
     time.sleep(0.01)
     assert eng.sweep_sessions() == 1
     assert "old" not in eng.sessions
+
+
+def test_chunk_dispatch_full_context_returns_zero(tiny_llama_dir):
+    """A speculative dispatch after the context filled must decline (0),
+    not raise: the pipelining adapter speculates past the chunk that
+    exactly reached max_seq, and an exception there would error the
+    request before its valid pending tokens are read."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(tiny_llama_dir, max_seq=16, param_dtype="float32")
+    dec = DecodingParams(temperature=0.0)
+    eng.prefill_and_sample("n", [1, 2, 3], dec)
+    eng.sessions["n"].pos = eng.max_seq  # as if a chunk just filled it
+    assert eng.decode_chunk_dispatch("n", None, dec, 8) == 0
+    # the real next step still raises the definitive error
+    with pytest.raises(ValueError, match="max_seq"):
+        eng.decode_step("n", 1, dec)
