@@ -21,6 +21,8 @@ class ClusterManager:
     def __init__(self, discovery) -> None:
         self.discovery = discovery
         self.current_topology: Optional[TopologyInfo] = None
+        # instance -> measured/predicted stage-time ratio (calibration loop)
+        self.stage_ratios: dict = {}
 
     async def scan_devices(self) -> List[DeviceInfo]:
         # manager (API) nodes are not compute shards
@@ -106,6 +108,86 @@ class ClusterManager:
                 )
             )
         return devices
+
+    async def calibrate_topology(
+        self, steps: int = 3, timeout_s: float = 120.0
+    ) -> list:
+        """Close the solver's prediction loop: probe every loaded shard's
+        REAL per-token stage time (/probe_stage) and join it with the
+        predictions recorded at solve time.  Returns StageCalibration rows
+        (parallel/calibrate.py); the caller may feed them to recalibrate()
+        and re-solve with corrected device speeds.  The reference never
+        validates its cost model against reality (SURVEY.md §2.7)."""
+        import asyncio
+
+        from dnet_tpu.parallel.calibrate import compare, log_table
+
+        topo = self.current_topology
+        if topo is None:
+            raise ValueError("no topology loaded")
+        by_instance = {d.instance: d for d in topo.devices}
+        measured: dict = {}
+
+        async with httpx.AsyncClient(timeout=timeout_s) as client:
+
+            async def probe_one(instance: str) -> None:
+                d = by_instance.get(instance)
+                if d is None:
+                    return
+                url = f"http://{d.host}:{d.http_port}/probe_stage?steps={steps}"
+                try:
+                    r = await client.post(url)
+                    r.raise_for_status()
+                    measured[instance] = float(r.json()["stage_time_s"])
+                except (httpx.HTTPError, KeyError, ValueError) as exc:
+                    log.warning("stage probe of %s failed: %s", instance, exc)
+
+            await asyncio.gather(
+                *(probe_one(a.instance) for a in topo.assignments)
+            )
+        cals = compare(topo, measured)
+        log_table(cals)
+        return cals
+
+    # total correction is bounded even across repeated calibrations
+    _RATIO_TOTAL_CLAMP = (1 / 16, 16.0)
+
+    def store_stage_ratios(self, cals: list) -> None:
+        """Remember measured/predicted ratios so future solves use observed,
+        not estimated, per-device speed.  A new ratio COMPOSES with the one
+        already applied: after a first correction the next solve's
+        predictions are made with corrected speeds, so a follow-up
+        calibration measuring ~1.0 means "the stored correction is right",
+        not "no correction needed" — overwriting would oscillate."""
+        from dnet_tpu.parallel.calibrate import RATIO_CLAMP
+
+        lo, hi = self._RATIO_TOTAL_CLAMP
+        for c in cals:
+            if c.predicted_s > 0 and c.measured_s > 0:
+                step = min(max(c.ratio, RATIO_CLAMP[0]), RATIO_CLAMP[1])
+                total = self.stage_ratios.get(c.instance, 1.0) * step
+                self.stage_ratios[c.instance] = min(max(total, lo), hi)
+
+    def apply_stage_ratios(self, devices: List[DeviceInfo]) -> List[DeviceInfo]:
+        """Return copies of freshly profiled devices with speeds scaled by
+        the stored calibration ratios (ratio r = device ran r times slower
+        than its profile).  Copies, not in-place: discovery may hand out the
+        same DeviceInfo objects on every scan, and a failed re-profile would
+        otherwise compound the division across solves."""
+        from dataclasses import replace as dc_replace
+
+        out: List[DeviceInfo] = []
+        for d in devices:
+            r = self.stage_ratios.get(d.instance)
+            if r:
+                d = dc_replace(
+                    d,
+                    flops_bf16=d.flops_bf16 / r,
+                    hbm_bw=d.hbm_bw / r,
+                    host_to_hbm_bw=d.host_to_hbm_bw / r,
+                )
+            out.append(d)
+        return out
 
     def head_device(self) -> Optional[DeviceInfo]:
         """Owner of layer 0 in the current topology."""

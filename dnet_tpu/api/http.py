@@ -67,6 +67,7 @@ class ApiHTTPServer:
         self.app.router.add_post("/v1/prepare_topology", self.prepare_topology)
         self.app.router.add_post("/v1/prepare_topology_manual", self.prepare_topology_manual)
         self.app.router.add_get("/v1/topology", self.get_topology)
+        self.app.router.add_post("/v1/calibrate", self.calibrate)
         self.app.router.add_get("/v1/devices", self.get_devices)
         self.app.router.add_get("/health", self.health)
         self._runner: Optional[web.AppRunner] = None
@@ -274,6 +275,8 @@ class ApiHTTPServer:
         devices = await self.cluster_manager.profile_cluster()
         if not devices:
             return _json_error(503, "no healthy shards discovered", "no_devices")
+        # fold in measured stage-time ratios from earlier /v1/calibrate runs
+        devices = self.cluster_manager.apply_stage_ratios(devices)
         try:
             profile = model_profile_from_checkpoint(
                 model_dir,
@@ -367,6 +370,40 @@ class ApiHTTPServer:
                         for a in topo.assignments
                     ],
                 },
+            }
+        )
+
+    async def calibrate(self, request: web.Request) -> web.Response:
+        """Probe every loaded shard's measured stage time, compare with the
+        solver's predictions, optionally store the ratios for future solves
+        (body: {"steps": 3, "apply": false})."""
+        if self.cluster_manager is None:
+            return _json_error(400, "not in ring mode (no discovery configured)")
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            body = {}
+        if not isinstance(body, dict):
+            return _json_error(400, "body must be a JSON object")
+        try:
+            steps = int(body.get("steps", 3) or 3)
+        except (TypeError, ValueError):
+            return _json_error(400, "steps must be an integer")
+        if not 1 <= steps <= 16:
+            return _json_error(400, "steps must be between 1 and 16")
+        try:
+            cals = await self.cluster_manager.calibrate_topology(steps=steps)
+        except ValueError as exc:
+            return _json_error(409, str(exc))
+        if body.get("apply"):
+            self.cluster_manager.store_stage_ratios(cals)
+        from dnet_tpu.parallel.calibrate import max_rel_err
+
+        return web.json_response(
+            {
+                "calibrations": [c.as_dict() for c in cals],
+                "max_rel_err": max_rel_err(cals),
+                "applied": bool(body.get("apply")),
             }
         )
 

@@ -1,0 +1,129 @@
+"""Solver calibration: close the loop between predicted and measured stage
+times.
+
+The reference feeds device profiles into its MILP and never checks the
+resulting cost model against reality — a stale or wrong profile silently
+produces a bad ring (SURVEY.md §2.7; the profiler and solver never talk
+again after the solve).  Here the loop closes:
+
+  solve_topology records predicted per-stage seconds (solver.py);
+  each shard can PROBE its real stage time (ShardCompute.probe_stage_time:
+  the actual process() hot path on a synthetic decode frame);
+  compare() turns the two into per-stage ratios;
+  recalibrate() scales each device's measured-speed axes by its ratio so
+  the next solve predicts what the hardware actually did.
+
+Ratios are clamped: a probe hiccup (compile, GC pause) must nudge the
+model, not poison it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from dataclasses import replace as dc_replace
+from typing import Dict, List, Optional
+
+from dnet_tpu.core.types import DeviceInfo, TopologyInfo
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+RATIO_CLAMP = (0.25, 4.0)
+
+
+@dataclass
+class StageCalibration:
+    instance: str
+    predicted_s: float
+    measured_s: float
+
+    @property
+    def ratio(self) -> float:
+        """measured / predicted (1.0 = cost model exact; >1 = device slower
+        than the profile claims)."""
+        if self.predicted_s <= 0:
+            return 1.0
+        return self.measured_s / self.predicted_s
+
+    @property
+    def rel_err(self) -> float:
+        return abs(self.ratio - 1.0)
+
+    def as_dict(self) -> dict:
+        return {
+            "instance": self.instance,
+            "predicted_s": self.predicted_s,
+            "measured_s": self.measured_s,
+            "ratio": round(self.ratio, 4),
+            "rel_err": round(self.rel_err, 4),
+        }
+
+
+def compare(
+    topology: TopologyInfo, measured: Dict[str, float]
+) -> List[StageCalibration]:
+    """Join the solve-time predictions with measured per-stage seconds.
+
+    measured: instance -> seconds/token (from the shard stage probes).
+    Stages without a measurement are skipped (a dead shard mid-calibration
+    must not fabricate a ratio).
+    """
+    predicted = topology.solution.get("predicted_stage_s") or []
+    out: List[StageCalibration] = []
+    for i, a in enumerate(topology.assignments):
+        if a.instance not in measured:
+            continue
+        pred = predicted[i] if i < len(predicted) else 0.0
+        out.append(
+            StageCalibration(
+                instance=a.instance,
+                predicted_s=pred,
+                measured_s=measured[a.instance],
+            )
+        )
+    return out
+
+
+def recalibrate(
+    devices: List[DeviceInfo],
+    calibrations: List[StageCalibration],
+    clamp: tuple = RATIO_CLAMP,
+) -> List[DeviceInfo]:
+    """Scale each measured-speed axis by the observed ratio so the next
+    solve's cost model predicts what the hardware actually did.
+
+    A stage that ran r times slower than predicted means the device is r
+    times slower than profiled: divide flops/bandwidths by the (clamped)
+    ratio.  Devices without a calibration pass through unchanged.
+    """
+    by_instance = {c.instance: c for c in calibrations}
+    out: List[DeviceInfo] = []
+    for d in devices:
+        c = by_instance.get(d.instance)
+        if c is None or c.predicted_s <= 0 or c.measured_s <= 0:
+            out.append(d)
+            continue
+        r = min(max(c.ratio, clamp[0]), clamp[1])
+        out.append(
+            dc_replace(
+                d,
+                flops_bf16=d.flops_bf16 / r,
+                hbm_bw=d.hbm_bw / r,
+                host_to_hbm_bw=d.host_to_hbm_bw / r,
+            )
+        )
+    return out
+
+
+def log_table(calibrations: List[StageCalibration]) -> None:
+    for c in calibrations:
+        log.info(
+            "[PROFILE] calibrate %-20s predicted %.2fms measured %.2fms ratio %.2f",
+            c.instance, c.predicted_s * 1e3, c.measured_s * 1e3, c.ratio,
+        )
+
+
+def max_rel_err(calibrations: List[StageCalibration]) -> Optional[float]:
+    if not calibrations:
+        return None
+    return max(c.rel_err for c in calibrations)

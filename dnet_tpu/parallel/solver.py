@@ -123,13 +123,20 @@ def solve_greedy(devices: List[DeviceInfo], m: ModelProfile) -> SolveResult:
     return SolveResult(w=w, n=n, k=1, obj_value=obj, solver="greedy")
 
 
-def _ring_latency(devices, m, w, n) -> float:
-    t = 0.0
-    for i, d in enumerate(devices):
-        t += w[i] * device_throughput(d, m)
-        t += max(0, w[i] - n[i]) * m.layer_bytes / max(d.host_to_hbm_bw, 1e9)
-        t += d.t_comm
+def predict_stage_time(d: DeviceInfo, m: ModelProfile, w_i: int, n_i: int) -> float:
+    """Predicted per-token seconds for one device's stage: window compute +
+    host->HBM streaming of non-resident layers.  Excludes the activation
+    hop (t_comm) so it is directly comparable to an on-device stage probe
+    (parallel/calibrate.py)."""
+    t = w_i * device_throughput(d, m)
+    t += max(0, w_i - n_i) * m.layer_bytes / max(d.host_to_hbm_bw, 1e9)
     return t
+
+
+def _ring_latency(devices, m, w, n) -> float:
+    return sum(
+        predict_stage_time(d, m, w[i], n[i]) + d.t_comm for i, d in enumerate(devices)
+    )
 
 
 def solve_milp(devices: List[DeviceInfo], m: ModelProfile, mip_gap: float = 1e-4) -> SolveResult:
@@ -337,6 +344,12 @@ def solve_topology(
             "n": n,
             "obj_value": result.obj_value,
             "solver": result.solver,
+            # per-stage predictions recorded at solve time so the
+            # calibration loop (parallel/calibrate.py) can compare them
+            # against measured probes without re-deriving the model profile
+            "predicted_stage_s": [
+                predict_stage_time(d, m, w[i], n[i]) for i, d in enumerate(devs)
+            ],
         },
     )
 

@@ -271,3 +271,46 @@ class ShardCompute:
             "layers": list(self.layers),
             "sessions": len(self.engine.sessions),
         }
+
+    def probe_stage_time(self, steps: int = 3) -> float:
+        """Measured seconds/token for THIS stage: run the real process()
+        hot path on synthetic decode-shaped frames and take the median step
+        (first step discarded: it pays compile).  Feeds the solver
+        calibration loop (parallel/calibrate.py) — the counterpart of the
+        solve-time `predicted_stage_s`.  Multi-round assignments time every
+        round a token pass visits."""
+        from dnet_tpu.utils.serialization import tensor_to_bytes
+
+        nonce = "__calibrate__"
+        self.reset(nonce)
+        eng = self.engine
+        durations: list = []
+        try:
+            for i in range(steps + 1):
+                t0 = time.perf_counter()
+                for run in self.rounds:
+                    if run[0] == 0:
+                        msg = ActivationMessage(
+                            nonce=nonce, layer_id=-1, seq=i, dtype="tokens",
+                            shape=(1, 1), pos=i,
+                            data=np.ones((1, 1), np.int32).tobytes(),
+                        )
+                    else:
+                        hidden = np.zeros(
+                            (1, 1, eng.config.hidden_size), np.float32
+                        )
+                        data, dtype, shape = tensor_to_bytes(
+                            hidden, self.wire_dtype
+                        )
+                        msg = ActivationMessage(
+                            nonce=nonce, layer_id=run[0] - 1, seq=i,
+                            dtype=dtype, shape=shape, data=data, pos=i,
+                        )
+                    out = self.process(msg)
+                    if out.data is not None and hasattr(out.data, "block_until_ready"):
+                        out.data.block_until_ready()
+                durations.append(time.perf_counter() - t0)
+        finally:
+            self.reset(nonce)
+        timed = sorted(durations[1:]) or durations
+        return timed[len(timed) // 2]

@@ -56,6 +56,7 @@ class ShardHTTPServer:
         self.app.router.add_post("/unload_model", self.unload_model)
         self.app.router.add_post("/measure_latency", self.measure_latency)
         self.app.router.add_post("/profile", self.profile)
+        self.app.router.add_post("/probe_stage", self.probe_stage)
         self.app.router.add_post("/cleanup_repacked", self.cleanup_repacked)
         self._runner: Optional[web.AppRunner] = None
 
@@ -196,6 +197,33 @@ class ShardHTTPServer:
                 await client.close()
             results[peer] = peer_res
         return web.json_response({"status": "ok", "latency": results})
+
+    async def probe_stage(self, request: web.Request) -> web.Response:
+        """Measured seconds/token for this shard's loaded stage (solver
+        calibration input; parallel/calibrate.py)."""
+        rt = self.shard.runtime
+        if rt.compute is None:
+            return web.json_response(
+                {"status": "error", "message": "no model loaded"}, status=409
+            )
+        try:
+            steps = int(request.query.get("steps", "3"))
+        except ValueError:
+            return web.json_response(
+                {"status": "error", "message": "steps must be an integer"},
+                status=400,
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            stage_s = await loop.run_in_executor(
+                None, rt.compute.probe_stage_time, max(1, min(steps, 16))
+            )
+        except Exception as exc:
+            log.exception("stage probe failed")
+            return web.json_response(
+                {"status": "error", "message": str(exc)}, status=500
+            )
+        return web.json_response({"status": "ok", "stage_time_s": stage_s})
 
     async def profile(self, request: web.Request) -> web.Response:
         """Device microbenchmark: subprocess-isolated when the accelerator

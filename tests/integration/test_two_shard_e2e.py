@@ -168,6 +168,15 @@ def test_two_shard_chat(cluster):
     chunks = [json.loads(l[6:]) for l in lines[:-1]]
     assert chunks[-1]["choices"][0]["finish_reason"] in {"stop", "length"}
 
+    # calibration loop: probe both shards' real stage times over HTTP and
+    # join them with the topology (manual topologies carry no solver
+    # predictions, so ratios default sane rather than erroring)
+    r = httpx.post(f"{base}/v1/calibrate", json={"steps": 2, "apply": True}, timeout=120.0)
+    assert r.status_code == 200, r.text
+    cals = r.json()["calibrations"]
+    assert {c["instance"] for c in cals} == {"s0", "s1"}
+    assert all(c["measured_s"] > 0 for c in cals)
+
     # unload cleans both shards
     r = httpx.post(f"{base}/v1/unload_model", timeout=60.0)
     assert r.status_code == 200

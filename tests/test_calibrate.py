@@ -1,0 +1,134 @@
+"""Solver calibration loop: predicted vs measured stage times.
+
+The reference's profiler and solver never validate their cost model against
+what the loaded ring actually does (SURVEY.md §2.7); this closes the loop:
+solve_topology records per-stage predictions, shards probe their real stage
+time through the serving hot path, compare/recalibrate feed the error back
+into the next solve.
+"""
+
+import pytest
+
+from dnet_tpu.core.types import DeviceInfo
+from dnet_tpu.parallel.calibrate import (
+    StageCalibration,
+    compare,
+    max_rel_err,
+    recalibrate,
+)
+from dnet_tpu.parallel.solver import ModelProfile, solve_topology
+
+pytestmark = pytest.mark.parallel
+
+GB = 1024**3
+
+
+def dev(name, flops=200e12, hbm=16 * GB, ram=64 * GB, bw=800e9, h2d=10e9):
+    return DeviceInfo(
+        instance=name, host="h0", http_port=80, grpc_port=50,
+        chip_kind="v5e", hbm_bytes=hbm, host_ram_bytes=ram,
+        flops_bf16=flops, hbm_bw=bw, host_to_hbm_bw=h2d,
+    )
+
+
+def prof(layers=8, layer_mb=400):
+    return ModelProfile(
+        model_id="m",
+        num_layers=layers,
+        layer_bytes=layer_mb * 1024 * 1024,
+        layer_flops_per_token=layer_mb * 1024 * 1024,
+        kv_bytes_per_token_per_layer=2 * 8 * 128 * 2,
+        edge_bytes=GB,
+        seq_len=2048,
+    )
+
+
+def test_solve_records_stage_predictions():
+    topo = solve_topology([dev("a"), dev("b")], prof())
+    pred = topo.solution["predicted_stage_s"]
+    assert len(pred) == len(topo.assignments)
+    assert all(p > 0 for p in pred)
+
+
+def test_compare_joins_and_skips_missing():
+    topo = solve_topology([dev("a"), dev("b")], prof())
+    pred = topo.solution["predicted_stage_s"]
+    cals = compare(topo, {"a": pred[0] * 2.0})  # b unprobed
+    assert len(cals) == 1
+    c = cals[0]
+    assert c.instance == "a" and c.ratio == pytest.approx(2.0)
+    assert max_rel_err(cals) == pytest.approx(1.0)
+
+
+def test_recalibrate_scales_and_clamps():
+    devices = [dev("a"), dev("b")]
+    cals = [
+        StageCalibration("a", predicted_s=0.01, measured_s=0.02),  # 2x slow
+        StageCalibration("b", predicted_s=0.01, measured_s=1.0),  # clamped 4x
+    ]
+    out = recalibrate(devices, cals)
+    assert out[0].flops_bf16 == pytest.approx(devices[0].flops_bf16 / 2)
+    assert out[0].hbm_bw == pytest.approx(devices[0].hbm_bw / 2)
+    assert out[1].flops_bf16 == pytest.approx(devices[1].flops_bf16 / 4)
+
+
+def test_recalibrated_solve_shifts_layers_off_slow_device():
+    """The whole point: a device measured 3x slower than profiled gets
+    fewer layers on the next solve."""
+    devices = [dev("a"), dev("b")]
+    m = prof(layers=16)
+    topo = solve_topology(devices, m)
+    w0 = dict(zip([a.instance for a in topo.assignments], topo.solution["w"]))
+    pred = topo.solution["predicted_stage_s"]
+    cals = compare(topo, {"a": pred[0] * 3.0, "b": pred[1]})
+    topo2 = solve_topology(recalibrate(devices, cals), m)
+    w1 = dict(zip([a.instance for a in topo2.assignments], topo2.solution["w"]))
+    assert w1["a"] < w0["a"]
+    assert w1["b"] > w0["b"]
+
+
+@pytest.mark.parametrize("layers", [range(4), range(1, 3)])
+def test_shard_compute_probe_stage_time(tiny_llama_dir, layers):
+    """The measured side: the probe drives the REAL process() hot path
+    (token entry on the head shard, hidden-frame entry mid-ring) and
+    returns a sane per-token duration, leaving no session behind."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    sc = ShardCompute(tiny_llama_dir, layers=layers, max_seq=32,
+                      param_dtype="float32", wire_dtype="float32")
+    t = sc.probe_stage_time(steps=2)
+    assert 0 < t < 60
+    assert len(sc.engine.sessions) == 0
+
+
+def test_cluster_manager_ratio_store_and_apply():
+    from dnet_tpu.api.cluster import ClusterManager
+
+    cm = ClusterManager(discovery=None)
+    cals = [StageCalibration("a", predicted_s=0.01, measured_s=0.02)]
+    cm.store_stage_ratios(cals)
+    d = dev("a")
+    base = d.flops_bf16
+    out = cm.apply_stage_ratios([d])
+    assert out[0].flops_bf16 == pytest.approx(base / 2)
+    # copies, not in-place: discovery hands out the same objects every scan,
+    # so mutating them would compound the division across solves
+    assert d.flops_bf16 == base
+    out2 = cm.apply_stage_ratios([d])
+    assert out2[0].flops_bf16 == pytest.approx(base / 2)
+
+
+def test_cluster_manager_ratios_compose_not_overwrite():
+    """After an applied correction the next solve predicts with corrected
+    speeds; a follow-up calibration measuring ~1.0 must keep the stored
+    correction (overwriting would oscillate between corrected and
+    uncorrected rings)."""
+    from dnet_tpu.api.cluster import ClusterManager
+
+    cm = ClusterManager(discovery=None)
+    cm.store_stage_ratios([StageCalibration("a", 0.01, 0.02)])  # 2x slow
+    assert cm.stage_ratios["a"] == pytest.approx(2.0)
+    cm.store_stage_ratios([StageCalibration("a", 0.02, 0.02)])  # now accurate
+    assert cm.stage_ratios["a"] == pytest.approx(2.0)  # correction retained
+    cm.store_stage_ratios([StageCalibration("a", 0.02, 0.03)])  # drifted more
+    assert cm.stage_ratios["a"] == pytest.approx(3.0)
