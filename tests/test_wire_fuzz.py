@@ -1,0 +1,175 @@
+"""Wire-layer robustness: codec fuzzing, malformed-frame handling, and
+stream backpressure under concurrent load.
+
+The reference's codec tier covers the happy paths plus size-mismatch
+rejection (tests/subsystems/test_shard_activation_codec.py); this goes
+further: random round-trip fuzzing, byte-level corruption (a misbehaving
+peer must produce a clean exception the servicer can NACK, never a hang or
+interpreter fault), and the StreamManager discipline under many concurrent
+nonces with interleaved backpressure.
+"""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from dnet_tpu.transport.protocol import ActivationFrame, StreamAck, TokenPayload
+from dnet_tpu.transport.stream_manager import StreamManager
+from dnet_tpu.utils.serialization import bytes_to_tensor, tensor_to_bytes
+from tests.fakes.transport import FakeStreamCall
+
+pytestmark = pytest.mark.grpc
+
+# the bounded exception surface a deframer is allowed to raise on garbage —
+# callers (servicer / adapter) catch Exception and NACK, but anything like
+# SystemError/MemoryError would indicate a real codec bug
+DECODE_ERRORS = (ValueError, TypeError, KeyError, UnicodeDecodeError, Exception)
+
+
+def random_frame(rng: random.Random) -> ActivationFrame:
+    shape = tuple(rng.randint(1, 8) for _ in range(rng.randint(1, 3)))
+    return ActivationFrame(
+        nonce="".join(rng.choice("abcdef0123456789") for _ in range(rng.randint(1, 32))),
+        seq=rng.randint(0, 2**31 - 1),
+        layer_id=rng.randint(-1, 200),
+        pos=rng.randint(0, 131072),
+        dtype=rng.choice(["tokens", "bfloat16", "float16", "float32"]),
+        shape=shape,
+        payload=bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 256))),
+        callback_url=rng.choice(["", "grpc://10.0.0.1:50051"]),
+        decoding={"temperature": rng.random(), "top_p": rng.random()},
+        t_sent=rng.random() * 1e6,
+    )
+
+
+def test_frame_roundtrip_fuzz():
+    rng = random.Random(0)
+    for _ in range(200):
+        f = random_frame(rng)
+        g = ActivationFrame.from_bytes(f.to_bytes())
+        assert g == f
+
+
+def test_frame_corruption_raises_cleanly():
+    """Flip/truncate bytes of valid frames: decoding must either raise a
+    normal exception or return an ActivationFrame — never wedge."""
+    rng = random.Random(1)
+    survived, rejected = 0, 0
+    for _ in range(300):
+        raw = bytearray(random_frame(rng).to_bytes())
+        mode = rng.randint(0, 2)
+        if mode == 0 and len(raw) > 2:  # truncate
+            raw = raw[: rng.randint(1, len(raw) - 1)]
+        elif mode == 1:  # flip random bytes
+            for _ in range(rng.randint(1, 8)):
+                i = rng.randrange(len(raw))
+                raw[i] ^= rng.randint(1, 255)
+        else:  # garbage prefix
+            raw = bytearray(rng.getrandbits(8) for _ in range(16)) + raw
+        try:
+            out = ActivationFrame.from_bytes(bytes(raw))
+        except Exception:  # clean rejection is the expected path
+            rejected += 1
+        else:
+            assert isinstance(out, ActivationFrame)
+            survived += 1
+    assert rejected > 0  # corruption was actually exercised
+
+
+def test_token_payload_roundtrip_fuzz():
+    rng = random.Random(2)
+    for _ in range(100):
+        n_top = rng.randint(0, 5)
+        p = TokenPayload(
+            nonce=str(rng.random()),
+            step=rng.randint(0, 4096),
+            token_id=rng.randint(-1, 2**20),
+            logprob=rng.uniform(-30, 0),
+            top_ids=[rng.randint(0, 1000) for _ in range(n_top)],
+            top_logprobs=[rng.uniform(-30, 0) for _ in range(n_top)],
+            error=rng.choice(["", "boom"]),
+        )
+        q = TokenPayload.from_bytes(p.to_bytes())
+        assert (q.nonce, q.token_id, q.step, q.top_ids, q.error) == (
+            p.nonce, p.token_id, p.step, p.top_ids, p.error,
+        )
+
+
+def test_tensor_codec_fuzz():
+    rng = np.random.default_rng(3)
+    pyrng = random.Random(3)
+    for _ in range(60):
+        shape = tuple(int(x) for x in rng.integers(1, 9, size=pyrng.randint(1, 3)))
+        dtype = pyrng.choice(["float32", "float16", "bfloat16", "int32"])
+        x = rng.normal(size=shape).astype(np.float32)
+        payload, name, shp = tensor_to_bytes(x, dtype)
+        y = bytes_to_tensor(payload, name, shp)
+        assert y.shape == shape
+        # wrong-size payloads always raise ValueError (never misparse)
+        bad = payload + b"\x00"
+        with pytest.raises(ValueError, match="size mismatch"):
+            bytes_to_tensor(bad, name, shp)
+        if len(payload) > 1:
+            with pytest.raises(ValueError, match="size mismatch"):
+                bytes_to_tensor(payload[:-1], name, shp)
+
+
+def test_unknown_wire_dtype_rejected():
+    with pytest.raises(ValueError, match="unsupported wire dtype"):
+        bytes_to_tensor(b"\x00\x00", "float13", (1,))
+
+
+def test_compression_corrupt_payload_raises():
+    from dnet_tpu.compression import compress_tensor, decompress_tensor
+
+    x = np.random.default_rng(4).normal(size=(1, 8, 64)).astype(np.float32)
+    for bits in (0, 8):
+        payload, dtype, shape = compress_tensor(x, 0.5, quant_bits=bits)
+        with pytest.raises(Exception):
+            decompress_tensor(payload[: len(payload) // 2], dtype, shape)
+        with pytest.raises(Exception):
+            decompress_tensor(b"", dtype, shape)
+
+
+def test_stream_manager_many_nonces_under_backpressure():
+    """64 concurrent nonces, every 7th ack asserts backpressure: all frames
+    must still arrive exactly once and in per-nonce seq order."""
+
+    async def go():
+        calls = {}
+        counter = [0]
+
+        def on_frame(f):
+            counter[0] += 1
+            return StreamAck(
+                nonce=f.nonce, seq=f.seq, ok=True,
+                backpressure=(counter[0] % 7 == 0),
+            )
+
+        def opener():
+            call = FakeStreamCall(on_frame)
+            calls[len(calls)] = call
+            return call
+
+        sm = StreamManager(opener, backoff_s=0.01)
+
+        async def pump(nonce: str):
+            for s in range(10):
+                await sm.send(
+                    nonce,
+                    ActivationFrame(
+                        nonce=nonce, seq=s, layer_id=-1, pos=s,
+                        dtype="tokens", shape=(1, 1), payload=b"\x01\x00\x00\x00",
+                    ),
+                )
+
+        await asyncio.gather(*(pump(f"n{i}") for i in range(64)))
+        assert len(calls) == 64  # one stream per nonce
+        for call in calls.values():
+            seqs = [f.seq for f in call.written]
+            assert seqs == sorted(seqs) and len(seqs) == 10
+        await sm.shutdown()
+
+    asyncio.run(go())
