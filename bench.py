@@ -184,6 +184,7 @@ def main() -> None:
         window = quantize_tree(
             {k: _np.asarray(v) for k, v in window.items()}, QUANTIZABLE, bits=bits
         )
+        edge = model.quantize_edge(edge, bits)  # tied LM projection too
     # device-resident: leaving numpy here would re-upload every step
     window = jax.tree.map(jnp.asarray, window)
     edge = jax.tree.map(jnp.asarray, edge)

@@ -244,6 +244,11 @@ class LocalEngine:
                 edge_raw.pop("lm_head", None)
         # tied embeddings: lm_project reads edge["embed"] (reference handles
         # ties in load_weights, src/dnet/core/models/base.py:111-195)
+        if self.weight_quant_bits:
+            edge_raw = m.quantize_edge(
+                edge_raw, self.weight_quant_bits, scale_dtype=self.param_dtype,
+                group_size=self.weight_quant_group,
+            )
         self.edge_params = self._cast(edge_raw)
         log.info(
             "[PROFILE] loaded %d layers (%s) in %.2fs",

@@ -125,9 +125,11 @@ class RingModel(abc.ABC):
         self.moe_capacity_factor = cs.moe_capacity_factor
 
     # ---- pure compute -------------------------------------------------
-    @abc.abstractmethod
     def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-        """tokens [B, T] -> hidden [B, T, D]."""
+        """tokens [B, T] -> hidden [B, T, D] (maybe-quantized table)."""
+        from dnet_tpu.ops.quant import embed_lookup
+
+        return embed_lookup(edge_params["embed"]["weight"], tokens)
 
     @abc.abstractmethod
     def apply_window(
@@ -159,9 +161,21 @@ class RingModel(abc.ABC):
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         """Final norm before the LM head."""
 
-    @abc.abstractmethod
     def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
-        """hidden [B, T, D] -> logits [B, T, V]."""
+        """hidden [B, T, D] -> logits [B, T, V].
+
+        The projection matrix is the single largest per-step HBM read at
+        decode (O(hidden x vocab) — ~0.5 GB bf16 for Llama-1B); quantized
+        edges (see quantize_edge) store it in [hidden, vocab] orientation so
+        `dq` fuses the dequant into this matmul."""
+        from dnet_tpu.ops.quant import dq, is_quantized
+
+        if self.config.tie_word_embeddings:
+            w = edge_params["embed"]["weight"]
+            w = dq(w) if is_quantized(w) else w.T
+        else:
+            w = dq(edge_params["lm_head"]["weight"])
+        return x @ w
 
     # ---- weight mapping ----------------------------------------------
     @abc.abstractmethod
@@ -247,6 +261,43 @@ class RingModel(abc.ABC):
             stacked, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
             group_size=group_size,
         )
+
+    def quantize_edge(self, edge: Dict[str, Any], bits: int, scale_dtype=None,
+                      group_size: int = 0) -> Dict[str, Any]:
+        """Quantize the LM projection among the edge params.
+
+        Only the O(hidden x vocab) projection matrix is worth quantizing —
+        it is read in full every decode step, while the embedding gather
+        reads O(tokens x hidden) and the norms are vectors.  Tied embeddings
+        are re-laid out to the projection orientation [hidden, vocab]
+        (groups along hidden, the contraction dim); `embed_lookup` gathers
+        logical table rows as physical columns from that layout, so one
+        quantized array serves both ops and the bf16 table is not kept.
+        """
+        from dnet_tpu.ops.quant import (
+            DEFAULT_GROUP,
+            DEFAULT_GROUP_Q4,
+            is_quantized,
+            quantize_weight_q4,
+            quantize_weight_q8,
+        )
+
+        if bits not in (4, 8):
+            raise NotImplementedError(f"weight quantization bits={bits} (4 or 8)")
+        quant = quantize_weight_q4 if bits == 4 else quantize_weight_q8
+        group_size = group_size or (DEFAULT_GROUP_Q4 if bits == 4 else DEFAULT_GROUP)
+        out = dict(edge)
+        if self.config.tie_word_embeddings and "embed" in out:
+            # tied: lm_project always reads "embed", so quantize THAT (some
+            # tied checkpoints still serialize an lm_head — never read; drop)
+            out.pop("lm_head", None)
+            if not is_quantized(out["embed"]["weight"]):
+                w = np.ascontiguousarray(np.asarray(out["embed"]["weight"]).T)
+                out["embed"] = {"weight": quant(w, group_size, scale_dtype)}
+        elif "lm_head" in out and not is_quantized(out["lm_head"]["weight"]):
+            w = np.asarray(out["lm_head"]["weight"])  # already [hidden, vocab]
+            out["lm_head"] = {"weight": quant(w, group_size, scale_dtype)}
+        return out
 
     def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
         """Shape ONE layer's mapped host params as a single-layer window (the

@@ -112,9 +112,6 @@ class DeepseekV2RingModel(RingModel):
         )
 
     # ---- pure compute -------------------------------------------------
-    def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-        return edge_params["embed"]["weight"][tokens]
-
     def _attention(self, p, x, kvs, pos, mask, tp_axis=None, kv_commit=None):
         cfg = self.config
         B, T, D = x.shape
@@ -315,11 +312,6 @@ class DeepseekV2RingModel(RingModel):
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)
-
-    def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
-        if self.config.tie_word_embeddings:
-            return x @ edge_params["embed"]["weight"].T
-        return x @ edge_params["lm_head"]["weight"]
 
     # ---- weight mapping ----------------------------------------------
     def stack_layers(self, per_layer: List[Dict[str, np.ndarray]]):

@@ -72,9 +72,6 @@ class GptOssRingModel(RingModel):
                 self.pair_kinds = (a[0], b[0])
 
     # ---- pure compute -------------------------------------------------
-    def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-        return edge_params["embed"]["weight"][tokens]
-
     def _attention(self, p, x, kvs, pos, mask, tp_axis, kv_commit, sp_axis=None,
                    rotating_window: int = 0, t_real=None):
         cfg = self.config
@@ -265,11 +262,6 @@ class GptOssRingModel(RingModel):
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)
-
-    def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
-        if self.config.tie_word_embeddings:
-            return x @ edge_params["embed"]["weight"].T
-        return x @ edge_params["lm_head"]["weight"]
 
     # ---- layout -------------------------------------------------------
     def stack_layers(self, per_layer):

@@ -37,10 +37,7 @@ class LlamaRingModel(RingModel):
         )
         self.inv_freq = jnp.asarray(inv_freq)
 
-    # ---- pure compute -------------------------------------------------
-    def embed(self, edge_params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-        return edge_params["embed"]["weight"][tokens]
-
+    # ---- pure compute (embed/lm_project inherited quant-aware) ---------
     def _qk_transform(self, p: dict, q: jnp.ndarray, k: jnp.ndarray):
         """Pre-RoPE q/k hook; identity for llama (qwen3 adds per-head norms)."""
         return q, k
@@ -125,13 +122,6 @@ class LlamaRingModel(RingModel):
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)
-
-    def lm_project(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
-        if self.config.tie_word_embeddings:
-            w = edge_params["embed"]["weight"].T
-        else:
-            w = edge_params["lm_head"]["weight"]
-        return x @ w
 
     # ---- weight mapping ----------------------------------------------
     def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

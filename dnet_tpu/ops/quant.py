@@ -128,6 +128,36 @@ def dq(w: Union[jnp.ndarray, dict], dtype=None) -> jnp.ndarray:
     return deq.reshape(*lead, inn, out)
 
 
+def embed_lookup(w: Union[jnp.ndarray, dict], tokens: jnp.ndarray) -> jnp.ndarray:
+    """Row gather from a maybe-quantized embedding table.
+
+    Plain: w [vocab, hidden] -> w[tokens] ([..., hidden]).
+    Quantized: w holds the PROJECTION layout ({"q"/"q4": [hidden(/2), vocab],
+    "s": [g, vocab]} — see RingModel.quantize_edge), so logical table rows
+    are physical columns: gather per-token columns, then dequantize with the
+    per-group scales of those tokens.  Reads O(tokens * hidden) bytes either
+    way — quantizing the table costs the lookup nothing while halving/
+    quartering the O(hidden * vocab) projection read."""
+    if not is_quantized(w):
+        return w[tokens]
+    tok = jnp.asarray(tokens)
+    s = w["s"]
+    dtype = s.dtype
+    sg = s[:, tok].astype(dtype)  # [g, *tok]
+    if "q4" in w:
+        p = w["q4"][:, tok]  # [hidden/2, *tok]
+        lo = (p & jnp.uint8(0xF)).astype(dtype) - 8.0
+        hi = ((p >> 4) & jnp.uint8(0xF)).astype(dtype) - 8.0
+        # even hidden rows came from the low nibble (see quantize_weight_q4)
+        q = jnp.stack([lo, hi], axis=1).reshape(-1, *tok.shape)
+    else:
+        q = w["q"][:, tok].astype(dtype)  # [hidden, *tok]
+    hidden = q.shape[0]
+    g = sg.shape[0]
+    deq = q.reshape(g, hidden // g, *tok.shape) * sg[:, None]
+    return jnp.moveaxis(deq.reshape(hidden, *tok.shape), 0, -1)
+
+
 def quantize_tree(
     params: dict,
     keys: set,

@@ -171,7 +171,13 @@ class MeshEngine:
         if getattr(m, "ring_phases", 1) > 1:
             stacked, self._n_kv_layers = m.pad_mesh_segments(stacked, self.pp)
         self._host_window = jax.tree.map(cast, stacked)
-        edge = jax.tree.map(cast, m.map_edge(self.ckpt.load_edge_raw()))
+        edge_raw = m.map_edge(self.ckpt.load_edge_raw())
+        if self.weight_quant_bits:
+            edge_raw = m.quantize_edge(
+                edge_raw, self.weight_quant_bits, scale_dtype=self.param_dtype,
+                group_size=self.quant_group,
+            )
+        edge = jax.tree.map(cast, edge_raw)
         kv0 = m.init_kv(
             self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
             quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),

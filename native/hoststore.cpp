@@ -1,0 +1,212 @@
+// Native host weight store: mmap + madvise streaming for checkpoint files.
+//
+// The TPU-native equivalent of the reference's native disk->memory path
+// (src/dnet/utils/layer_manager.py:107-286 drives libc madvise through
+// ctypes; the Rust/native submodules own the performance-critical IO).
+// Here the whole subsystem is C++ with a C ABI consumed via ctypes
+// (dnet_tpu/utils/native_store.py):
+//
+//   - hs_open / hs_close        mmap a safetensors file read-only
+//   - hs_addr / hs_size         base pointer for zero-copy numpy views
+//   - hs_prefetch               madvise(MADV_WILLNEED) on page-aligned spans
+//   - hs_prefetch_async         background readahead thread: WILLNEED then
+//                               touch one byte per page, forcing the read
+//                               to overlap device compute (the reference's
+//                               prefetch thread pool, layer_manager.py:284)
+//   - hs_release                madvise(MADV_DONTNEED): drop evicted
+//                               windows' pages (layer_manager.py:217-227)
+//   - hs_read                   bounded memcpy out of the map
+//   - hs_pending                in-flight async prefetch spans (tests/obs)
+//
+// No JAX/Python types cross this boundary: offsets+lengths in, pages ready
+// or bytes out.  Thread-safe: a global handle table under one mutex, one
+// detached worker draining a condition-variable queue.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Mapping {
+  void* base = nullptr;
+  uint64_t size = 0;
+  int fd = -1;
+};
+
+struct Span {
+  int handle;
+  uint64_t off;
+  uint64_t len;
+};
+
+std::mutex g_mu;
+std::unordered_map<int, Mapping> g_maps;
+int g_next_handle = 1;
+
+std::mutex g_q_mu;
+std::condition_variable g_q_cv;
+std::deque<Span> g_queue;
+std::atomic<int> g_pending{0};
+std::atomic<bool> g_worker_up{false};
+
+long page_size() {
+  static long ps = sysconf(_SC_PAGESIZE);
+  return ps;
+}
+
+// Clamp [off, off+len) to the mapping and page-align outward.
+bool aligned_span(const Mapping& m, uint64_t off, uint64_t len, char** start,
+                  size_t* n) {
+  if (off >= m.size || len == 0) return false;
+  if (off + len > m.size) len = m.size - off;
+  const uint64_t ps = static_cast<uint64_t>(page_size());
+  uint64_t a = off / ps * ps;
+  uint64_t b = (off + len + ps - 1) / ps * ps;
+  if (b > m.size) b = m.size;
+  *start = static_cast<char*>(m.base) + a;
+  *n = static_cast<size_t>(b - a);
+  return true;
+}
+
+bool lookup(int h, Mapping* out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_maps.find(h);
+  if (it == g_maps.end()) return false;
+  *out = it->second;
+  return true;
+}
+
+void worker_main() {
+  for (;;) {
+    Span s;
+    {
+      std::unique_lock<std::mutex> lk(g_q_mu);
+      g_q_cv.wait(lk, [] { return !g_queue.empty(); });
+      s = g_queue.front();
+      g_queue.pop_front();
+    }
+    Mapping m;
+    if (lookup(s.handle, &m)) {
+      char* start;
+      size_t n;
+      if (aligned_span(m, s.off, s.len, &start, &n)) {
+        madvise(start, n, MADV_WILLNEED);
+        // Touch one byte per page: WILLNEED is only a hint, the touch
+        // guarantees the read happens HERE (overlapped with compute)
+        // instead of at first use on the hot path.
+        volatile char sink = 0;
+        const long ps = page_size();
+        for (size_t i = 0; i < n; i += static_cast<size_t>(ps)) sink ^= start[i];
+        (void)sink;
+      }
+    }
+    g_pending.fetch_sub(1, std::memory_order_relaxed);
+  }
+}
+
+void ensure_worker() {
+  bool expected = false;
+  if (g_worker_up.compare_exchange_strong(expected, true)) {
+    std::thread(worker_main).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int hs_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    close(fd);
+    return -1;
+  }
+  void* base = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return -1;
+  }
+  // Random-access pattern by default: layer reads jump between tensor
+  // spans, so kernel readahead across the whole file wastes page cache.
+  madvise(base, static_cast<size_t>(st.st_size), MADV_RANDOM);
+  std::lock_guard<std::mutex> lk(g_mu);
+  int h = g_next_handle++;
+  g_maps[h] = Mapping{base, static_cast<uint64_t>(st.st_size), fd};
+  return h;
+}
+
+void hs_close(int handle) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_maps.find(handle);
+  if (it == g_maps.end()) return;
+  munmap(it->second.base, static_cast<size_t>(it->second.size));
+  close(it->second.fd);
+  g_maps.erase(it);
+}
+
+uint64_t hs_size(int handle) {
+  Mapping m;
+  return lookup(handle, &m) ? m.size : 0;
+}
+
+void* hs_addr(int handle) {
+  Mapping m;
+  return lookup(handle, &m) ? m.base : nullptr;
+}
+
+int hs_prefetch(int handle, uint64_t off, uint64_t len) {
+  Mapping m;
+  if (!lookup(handle, &m)) return -1;
+  char* start;
+  size_t n;
+  if (!aligned_span(m, off, len, &start, &n)) return -1;
+  return madvise(start, n, MADV_WILLNEED);
+}
+
+int hs_prefetch_async(int handle, uint64_t off, uint64_t len) {
+  Mapping m;
+  if (!lookup(handle, &m)) return -1;
+  ensure_worker();
+  g_pending.fetch_add(1, std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> lk(g_q_mu);
+    g_queue.push_back(Span{handle, off, len});
+  }
+  g_q_cv.notify_one();
+  return 0;
+}
+
+int hs_release(int handle, uint64_t off, uint64_t len) {
+  Mapping m;
+  if (!lookup(handle, &m)) return -1;
+  char* start;
+  size_t n;
+  if (!aligned_span(m, off, len, &start, &n)) return -1;
+  return madvise(start, n, MADV_DONTNEED);
+}
+
+int hs_read(int handle, uint64_t off, uint64_t len, void* dst) {
+  Mapping m;
+  if (!lookup(handle, &m)) return -1;
+  if (off >= m.size || off + len > m.size) return -1;
+  memcpy(dst, static_cast<char*>(m.base) + off, static_cast<size_t>(len));
+  return 0;
+}
+
+int hs_pending() { return g_pending.load(std::memory_order_relaxed); }
+
+}  // extern "C"

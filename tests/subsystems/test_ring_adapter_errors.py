@@ -52,7 +52,6 @@ def test_full_queue_nacks_backpressure(tiny_llama_dir):
             ),
         )
         adapter = RingAdapter(rt)
-        f = hidden_frame(layer_id=-1)
         f = ActivationFrame(
             nonce="n", seq=0, layer_id=-1, pos=0, dtype="tokens",
             shape=(1, 1), payload=b"\x01\x00\x00\x00",

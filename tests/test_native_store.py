@@ -1,0 +1,102 @@
+"""Native C++ host store: mmap views, span prefetch/release, degradation."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dnet_tpu.utils.native_store import NativeSafetensors, available
+
+pytestmark = pytest.mark.core
+
+if not available():  # pragma: no cover - toolchain always present in CI image
+    pytest.skip("native host store unavailable", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def st_file(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    d = tmp_path_factory.mktemp("native_store")
+    tensors = {
+        "model.layers.0.w": np.arange(64, dtype=np.float32).reshape(8, 8),
+        "model.layers.1.w": np.full((4, 4), 2.5, np.float16),
+        "embed": np.arange(32, dtype=np.uint16),
+    }
+    path = d / "m.safetensors"
+    save_file(tensors, path)
+    return path, tensors
+
+
+def test_zero_copy_views_match(st_file):
+    path, tensors = st_file
+    st = NativeSafetensors(path)
+    try:
+        assert sorted(st.keys()) == sorted(tensors)
+        for name, want in tensors.items():
+            got = st.tensor(name)
+            np.testing.assert_array_equal(got, want)
+            assert not got.flags.writeable  # read-only mmap view
+    finally:
+        st.close()
+
+
+def test_bf16_view(tmp_path):
+    import json, struct
+
+    import ml_dtypes
+
+    # hand-write a BF16 safetensors file (the numpy writer has no bf16)
+    w = np.linspace(-2, 2, 32, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    data = w.tobytes()
+    hdr = {"w": {"dtype": "BF16", "shape": list(w.shape), "data_offsets": [0, len(data)]}}
+    enc = json.dumps(hdr, separators=(",", ":")).encode()
+    enc += b" " * (-len(enc) % 8)  # 8-byte aligned header, like real files
+    (tmp_path / "b.safetensors").write_bytes(
+        struct.pack("<Q", len(enc)) + enc + data
+    )
+    st = NativeSafetensors(tmp_path / "b.safetensors")
+    try:
+        got = st.tensor("w")
+        assert got.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(got.view(np.uint16), w.view(np.uint16))
+    finally:
+        st.close()
+
+
+def test_prefetch_and_release_roundtrip(st_file):
+    path, tensors = st_file
+    st = NativeSafetensors(path)
+    try:
+        names = list(tensors)
+        st.prefetch(names, sync=True)  # WILLNEED, synchronous madvise
+        st.prefetch(names)  # async worker: queue drains to zero
+        for _ in range(100):
+            if st.pending() == 0:
+                break
+            time.sleep(0.02)
+        assert st.pending() == 0
+        st.release(names)  # DONTNEED; pages must fault back in correctly
+        for name, want in tensors.items():
+            np.testing.assert_array_equal(st.tensor(name), want)
+    finally:
+        st.close()
+
+
+def test_coalescing_merges_adjacent_spans(st_file):
+    path, tensors = st_file
+    st = NativeSafetensors(path)
+    try:
+        spans = st._coalesced(list(tensors))
+        # the three tensors are contiguous in one small file -> one span
+        assert len(spans) == 1
+        off, nbytes = spans[0]
+        total = sum(v.nbytes for v in tensors.values())
+        assert nbytes >= total
+    finally:
+        st.close()
+
+
+def test_bad_path_raises(tmp_path):
+    with pytest.raises(OSError):
+        NativeSafetensors(tmp_path / "missing.safetensors")

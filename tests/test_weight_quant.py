@@ -138,3 +138,108 @@ def test_quantized_gpt_oss(tmp_path_factory):
         for r in eng.generate([256, 72], DecodingParams(temperature=0.0), max_tokens=4)
     ]
     assert len(toks) == 4
+
+
+def test_embed_lookup_quantized_matches_dq():
+    """Rows gathered from the projection-layout table equal full-dequant rows."""
+    from dnet_tpu.ops.quant import embed_lookup
+
+    rng = np.random.default_rng(7)
+    vocab, hidden = 512, 128
+    table = rng.normal(0, 0.05, (vocab, hidden)).astype(np.float32)
+    w = np.ascontiguousarray(table.T)  # [hidden, vocab]
+    toks = jnp.asarray(rng.integers(0, vocab, (2, 5)))
+    for quant in (quantize_weight_q8, quantize_weight_q4):
+        qw = quant(w, 32, np.float32)
+        rows = np.asarray(embed_lookup(qw, toks))
+        want = np.asarray(dq(qw, jnp.float32)).T[np.asarray(toks)]
+        np.testing.assert_allclose(rows, want, rtol=1e-6, atol=1e-6)
+        assert rows.shape == (2, 5, hidden)
+
+
+def test_embed_lookup_plain_passthrough():
+    from dnet_tpu.ops.quant import embed_lookup
+
+    table = jnp.arange(12.0).reshape(4, 3)
+    toks = jnp.asarray([[1, 3]])
+    np.testing.assert_array_equal(
+        np.asarray(embed_lookup(table, toks)), np.asarray(table)[np.asarray([[1, 3]])]
+    )
+
+
+def test_edge_quant_untied_lm_head(tiny_llama_dir):
+    """weight_quant_bits quantizes the LM head; greedy stream matches bf16."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    ids = [1, 7, 3, 11]
+    dec = DecodingParams(temperature=0.0)
+    ref = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+    q = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype="float32",
+        weight_quant_bits=8, weight_quant_group=16,
+    )
+    key = "embed" if ref.config.tie_word_embeddings else "lm_head"
+    assert is_quantized(q.edge_params[key]["weight"])
+    rl = np.asarray(ref.prefill("a", ids), np.float32)
+    ql = np.asarray(q.prefill("b", ids), np.float32)
+    # int8 on every matmul incl. the head: rankings survive
+    assert int(ql[0].argmax()) == int(rl[0].argmax())
+
+
+def test_edge_quant_tied_embedding_stream():
+    """Tied models serve lookup AND projection from one quantized table."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.models.base import ModelConfig
+    from dnet_tpu.models.llama import LlamaRingModel
+    from dnet_tpu.ops.quant import QUANTIZABLE
+    from dnet_tpu.utils.random_init import LLAMA_3_2_1B_CONFIG, random_llama_params
+
+    cfg_d = dict(LLAMA_3_2_1B_CONFIG)
+    cfg_d.update(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=4, num_attention_heads=8,
+        num_key_value_heads=4, head_dim=16,
+    )
+    cfg = ModelConfig.from_hf({**cfg_d, "architectures": []})
+    assert cfg.tie_word_embeddings
+    layers = list(range(cfg.num_hidden_layers))
+    model = LlamaRingModel(cfg, layers)
+    window, edge = random_llama_params(cfg, layers, dtype="float32")
+    ref = LocalEngine.from_params(cfg, window, edge, max_seq=64, param_dtype="float32")
+    qwin = quantize_tree(
+        {k: np.asarray(v) for k, v in window.items()}, QUANTIZABLE,
+        bits=8, group_size=16,
+    )
+    qedge = model.quantize_edge(edge, 8, group_size=16)
+    assert is_quantized(qedge["embed"]["weight"])
+    q = LocalEngine.from_params(cfg, qwin, qedge, max_seq=64, param_dtype="float32")
+    dec = DecodingParams(temperature=0.0)
+    want = [r.token_id for r in ref.generate([1, 7, 3, 11], dec, max_tokens=6)]
+    got = [r.token_id for r in q.generate([1, 7, 3, 11], dec, max_tokens=6)]
+    assert got == want
+
+
+def test_edge_quant_tied_with_serialized_lm_head():
+    """Tied checkpoints that also ship lm_head: quantize the LIVE table
+    (edge["embed"], what lm_project reads) and drop the dead lm_head."""
+    from dnet_tpu.models.base import ModelConfig
+    from dnet_tpu.models.llama import LlamaRingModel
+
+    cfg = ModelConfig.from_hf({
+        "model_type": "llama", "vocab_size": 64, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "tie_word_embeddings": True, "architectures": [],
+    })
+    model = LlamaRingModel(cfg, [0, 1])
+    rng = np.random.default_rng(0)
+    edge = {
+        "embed": {"weight": rng.normal(0, 0.05, (64, 32)).astype(np.float32)},
+        "lm_head": {"weight": rng.normal(0, 0.05, (32, 64)).astype(np.float32)},
+        "final_norm": {"weight": np.ones(32, np.float32)},
+    }
+    out = model.quantize_edge(edge, 8, group_size=16)
+    assert is_quantized(out["embed"]["weight"])
+    assert "lm_head" not in out
+    with pytest.raises(NotImplementedError):
+        model.quantize_edge(edge, 2)

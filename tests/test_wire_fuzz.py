@@ -11,6 +11,9 @@ nonces with interleaved backpressure.
 
 import asyncio
 import random
+import struct
+
+import msgpack
 
 import numpy as np
 import pytest
@@ -22,10 +25,13 @@ from tests.fakes.transport import FakeStreamCall
 
 pytestmark = pytest.mark.grpc
 
-# the bounded exception surface a deframer is allowed to raise on garbage —
-# callers (servicer / adapter) catch Exception and NACK, but anything like
-# SystemError/MemoryError would indicate a real codec bug
-DECODE_ERRORS = (ValueError, TypeError, KeyError, UnicodeDecodeError, Exception)
+# the bounded exception surface a deframer may raise on garbage — callers
+# (servicer / adapter) catch these and NACK; SystemError/MemoryError escaping
+# would indicate a real codec bug
+DECODE_ERRORS = (ValueError, TypeError, KeyError, IndexError, UnicodeDecodeError,
+                 OverflowError, struct.error, msgpack.exceptions.ExtraData,
+                 msgpack.exceptions.FormatError, msgpack.exceptions.StackError,
+                 msgpack.exceptions.OutOfData)
 
 
 def random_frame(rng: random.Random) -> ActivationFrame:
@@ -70,7 +76,7 @@ def test_frame_corruption_raises_cleanly():
             raw = bytearray(rng.getrandbits(8) for _ in range(16)) + raw
         try:
             out = ActivationFrame.from_bytes(bytes(raw))
-        except Exception:  # clean rejection is the expected path
+        except DECODE_ERRORS:  # clean rejection is the expected path
             rejected += 1
         else:
             assert isinstance(out, ActivationFrame)
