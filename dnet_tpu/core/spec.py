@@ -1,0 +1,112 @@
+"""Prompt-lookup speculative decoding (greedy-exact, device-side drafting.
+
+A serving optimization beyond the reference (its roadmap lists only
+throughput/long-context items, README.md:51-53): decode normally reads every
+weight once per token; here each verify step reads the weights once for
+L+1 positions (1 committed token + L drafts), so accepted drafts multiply
+tokens-per-weight-read — decode stays HBM-bound, the extra positions ride
+along nearly free on the MXU.
+
+Drafting is n-gram prompt-lookup (no draft model): the last `n` committed
+tokens are matched against the session's own token history (prompt +
+generated so far, device-resident); the tokens that followed the most
+recent earlier occurrence become the draft.  Verification is one forward
+over [tok, d_1..d_L]: position i's greedy argmax must equal d_{i+1} for the
+draft to extend the accepted prefix.  Greedy equivalence is exact — every
+emitted token is an argmax of the same logits plain decode would compute.
+
+KV rewind safety: accepted count is known only after the forward, so all
+L+1 positions write KV; rejected rows are simply left stale.  With a
+max_seq slot-addressed cache and causal masking against the rewound `pos`,
+stale rows are never attended and are overwritten when decode reaches their
+slot.  Rotating (ring-buffer SWA) caches break this invariant — wrap-around
+writes evict live rows — so engines only enable speculation on
+non-rotating cache layouts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ngram_draft(
+    hist: jnp.ndarray,  # [B, S] committed token ids (prompt + generated)
+    pos: jnp.ndarray,  # scalar int32: tokens committed so far (hist[:, :pos] valid)
+    lookahead: int,
+    ngram: int = 2,
+) -> jnp.ndarray:
+    """Draft `lookahead` tokens per lane by matching the trailing `ngram`.
+
+    Finds the most recent j < pos-ngram with
+    hist[:, j:j+ngram] == hist[:, pos-ngram:pos] and proposes
+    hist[:, j+ngram : j+ngram+lookahead].  No match (or a too-short history)
+    degrades to repeating the last committed token — wrong drafts cost
+    nothing beyond the verify positions that were already being computed.
+    Static shapes throughout: windows are compared over the full buffer and
+    invalidated by masks, so the op jits once per (S, lookahead, ngram).
+    """
+    B, S = hist.shape
+    key = jax.lax.dynamic_slice_in_dim(hist, pos - ngram, ngram, axis=1)  # [B, n]
+    idx = jnp.arange(S)
+    # windows[:, j] == hist[:, j:j+ngram] compared against the key
+    match = jnp.ones((B, S), dtype=bool)
+    for k in range(ngram):
+        shifted = jnp.roll(hist, -k, axis=1)  # hist[:, j+k] at column j
+        match &= shifted == key[:, k : k + 1]
+    # a candidate j must be a complete window strictly before the key itself
+    valid = (idx[None, :] + ngram) <= (pos - ngram)
+    match &= valid
+    score = jnp.where(match, idx[None, :] + 1, 0)  # latest match wins
+    j = jnp.argmax(score, axis=1)  # [B]
+    found = jnp.take_along_axis(score, j[:, None], axis=1)[:, 0] > 0
+    start = jnp.where(found, j + ngram, 0)
+
+    def take(h, s):  # [S], scalar -> [lookahead]
+        return jax.lax.dynamic_slice_in_dim(h, s, lookahead, axis=0)
+
+    cont = jax.vmap(take)(hist, start)  # [B, lookahead]
+    last = jax.lax.dynamic_slice_in_dim(hist, pos - 1, 1, axis=1)  # [B, 1]
+    fallback = jnp.broadcast_to(last, (B, lookahead))
+    # continuation windows that run past `pos` read committed-or-stale ids;
+    # they are still legal token ids and merely risk rejection
+    return jnp.where(found[:, None], cont, fallback)
+
+
+def accept_drafts(preds: jnp.ndarray, drafts: jnp.ndarray):
+    """Greedy acceptance: how far do the model's own argmaxes agree?
+
+    preds  [B, L+1]: argmax at each verified position (position 0 is the
+                     committed token's next-token prediction).
+    drafts [B, L]:   the proposed continuation.
+    Returns (n_accept [B], out_tokens [B, L+1]): n_accept = a means
+    positions 0..a of `preds` are emitted (a+1 tokens: the a accepted
+    drafts each confirmed by preds[:i]==drafts[:i], plus the first
+    disagreeing/bonus prediction).  out_tokens[:, i] is -1 beyond a.
+    """
+    B, L1 = preds.shape
+    L = L1 - 1
+    agree = preds[:, :L] == drafts  # [B, L]
+    n_accept = jnp.argmin(
+        jnp.concatenate([agree, jnp.zeros((B, 1), bool)], axis=1).astype(jnp.int32),
+        axis=1,
+    )  # first False index == count of leading Trues (works for all-True via sentinel)
+    emit = jnp.arange(L1)[None, :] <= n_accept[:, None]
+    out = jnp.where(emit, preds, -1)
+    return n_accept, out
+
+
+def commit_history(
+    hist: jnp.ndarray, pos: jnp.ndarray, tokens: jnp.ndarray, n_valid: jnp.ndarray
+) -> jnp.ndarray:
+    """Write `tokens[:, :n_valid]` at hist[:, pos:] (static-width write of
+    the full token block; columns past n_valid carry stale/-1 values that
+    the NEXT write overwrites because pos only advances by n_valid).
+    Clamps at the buffer end like the KV cache's slot writes."""
+    B, W = tokens.shape
+    safe = jnp.where(tokens < 0, 0, tokens)
+
+    def put(h, t):
+        return jax.lax.dynamic_update_slice_in_dim(h, t, pos, axis=0)
+
+    return jax.vmap(put)(hist, safe)

@@ -161,9 +161,39 @@ class HostLayerStore:
             tmp.rename(f)
         return mapped
 
+    def prefetch_disk(self, layers: Sequence[int]) -> None:
+        """Kick native page-cache readahead for layers about to materialize
+        (disk->DRAM half of the prefetch; host->HBM is WeightCache's).
+        Repacked layers read from .npz instead — skip those spans."""
+        ckpt = self.ckpt
+        if ckpt is None or not hasattr(ckpt, "prefetch_layer"):
+            return
+        for layer in layers:
+            with self._lock:
+                if layer in self._cache:
+                    continue
+            if (
+                self.repack_path is not None
+                and (self.repack_path / f"layer_{layer}.npz").is_file()
+            ):
+                continue
+            ckpt.prefetch_layer(layer)
+
     def drop_host(self, layer: int) -> None:
         with self._lock:
             self._cache.pop(layer, None)
+        # evicted spans can leave the page cache too (re-faultable); repacked
+        # layers never touched the safetensors map, nothing to release
+        ckpt = self.ckpt
+        if (
+            ckpt is not None
+            and hasattr(ckpt, "release_layer")
+            and not (
+                self.repack_path is not None
+                and (self.repack_path / f"layer_{layer}.npz").is_file()
+            )
+        ):
+            ckpt.release_layer(layer)
 
 
 # ---- HBM weight cache -------------------------------------------------------
@@ -235,6 +265,11 @@ class WeightCache:
     # -- public --------------------------------------------------------------
     def prefetch(self, layers: Sequence[int]) -> None:
         """Schedule async host->HBM loads (no waiting)."""
+        # start disk->page-cache readahead for the whole window first: the
+        # executor materializes layers one at a time, the native worker
+        # pulls the later ones off disk concurrently
+        if hasattr(self.store, "prefetch_disk"):
+            self.store.prefetch_disk(layers)
         with self._lock:
             for layer in layers:
                 if layer not in self._resident:

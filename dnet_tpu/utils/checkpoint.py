@@ -19,13 +19,17 @@ from typing import Dict, List, Optional
 import numpy as np
 from safetensors import safe_open
 
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
 _LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
 
 
 class Checkpoint:
     """An HF-format model directory: config.json + *.safetensors [+ index]."""
 
-    def __init__(self, model_dir: str | Path):
+    def __init__(self, model_dir: str | Path, use_native: bool = True):
         self.dir = Path(model_dir)
         cfg_path = self.dir / "config.json"
         if not cfg_path.is_file():
@@ -59,6 +63,23 @@ class Checkpoint:
                 self.edge_tensors[name] = name
 
         self._handles: Dict[Path, object] = {}
+        # native mmap fastpath (zero-copy views + madvise streaming); any
+        # failure degrades to the python safetensors reader per-file
+        self._native: Dict[Path, Optional[object]] = {}
+        self._use_native = use_native
+
+    def _native_handle(self, path: Path):
+        if not self._use_native:
+            return None
+        if path not in self._native:
+            try:
+                from dnet_tpu.utils.native_store import NativeSafetensors, available
+
+                self._native[path] = NativeSafetensors(path) if available() else None
+            except Exception as exc:  # corrupt file / platform quirk
+                log.warning("native mmap failed for %s (%s); python IO", path, exc)
+                self._native[path] = None
+        return self._native[path]
 
     # ---- metadata -----------------------------------------------------
     @property
@@ -90,6 +111,9 @@ class Checkpoint:
 
     # ---- loading ------------------------------------------------------
     def load_tensor(self, name: str) -> np.ndarray:
+        st = self._native_handle(self.tensor_file[name])
+        if st is not None and name in st.tensors:
+            return st.tensor(name)  # zero-copy mmap view
         return self._handle(self.tensor_file[name]).get_tensor(name)
 
     def load_layer_raw(self, layer: int) -> Dict[str, np.ndarray]:
@@ -106,8 +130,36 @@ class Checkpoint:
         keys = names if names is not None else list(self.edge_tensors)
         return {k: self.load_tensor(k) for k in keys if k in self.edge_tensors}
 
+    # ---- page-cache streaming (native layer_manager analog) -----------
+    def _layer_names_by_file(self, layer: int) -> Dict[Path, List[str]]:
+        by_file: Dict[Path, List[str]] = {}
+        for full in self.layer_tensors.get(layer, {}).values():
+            by_file.setdefault(self.tensor_file[full], []).append(full)
+        return by_file
+
+    def prefetch_layer(self, layer: int, sync: bool = False) -> None:
+        """madvise(WILLNEED) + background page-touch of one layer's spans,
+        so its disk reads overlap compute (reference layer_manager.py:107-215
+        prefetch modes).  No-op when the native store is unavailable."""
+        for path, names in self._layer_names_by_file(layer).items():
+            st = self._native_handle(path)
+            if st is not None:
+                st.prefetch(names, sync=sync)
+
+    def release_layer(self, layer: int) -> None:
+        """madvise(DONTNEED) an evicted layer's page-cache spans
+        (reference layer_manager.py:217-227)."""
+        for path, names in self._layer_names_by_file(layer).items():
+            st = self._native_handle(path)
+            if st is not None:
+                st.release(names)
+
     def close(self) -> None:
         self._handles.clear()
+        for st in self._native.values():
+            if st is not None:
+                st.close()
+        self._native.clear()
 
 
 _SAFETENSOR_SIZES = {

@@ -100,3 +100,39 @@ def test_coalescing_merges_adjacent_spans(st_file):
 def test_bad_path_raises(tmp_path):
     with pytest.raises(OSError):
         NativeSafetensors(tmp_path / "missing.safetensors")
+
+
+def test_host_store_disk_prefetch_and_release(tmp_path):
+    """HostLayerStore streams via the native page-cache protocol: prefetch
+    ahead of materialization, release after host eviction — values match."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
+    from tests.fakes.checkpoints import make_tiny_llama
+
+    from dnet_tpu.core.weights import HostLayerStore
+    from dnet_tpu.models.llama import LlamaRingModel
+    from dnet_tpu.models.base import ModelConfig
+    from dnet_tpu.utils.checkpoint import Checkpoint
+
+    make_tiny_llama(tmp_path)
+    ckpt = Checkpoint(tmp_path)
+    cfg = ModelConfig.from_hf(ckpt.config)
+    model = LlamaRingModel(cfg, list(range(cfg.num_hidden_layers)))
+    store = HostLayerStore(ckpt, model, param_dtype="float32")
+    store.prefetch_disk(model.layers)  # async readahead, then materialize
+    a = store.layer_host(0)
+    ref_ckpt = Checkpoint(tmp_path, use_native=False)
+    ref_store = HostLayerStore(ref_ckpt, model, param_dtype="float32")
+    b = ref_store.layer_host(0)
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k], np.float32),
+                                      np.asarray(b[k], np.float32))
+    store.drop_host(0)  # releases page-cache spans (re-faultable)
+    c = store.layer_host(0)
+    for k in c:
+        np.testing.assert_array_equal(np.asarray(c[k], np.float32),
+                                      np.asarray(b[k], np.float32))
+    ckpt.close()
+    ref_ckpt.close()

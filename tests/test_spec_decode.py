@@ -1,0 +1,158 @@
+"""Prompt-lookup speculative decoding: greedy-exact streams, draft accepts."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dnet_tpu.core.spec import accept_drafts, commit_history, ngram_draft
+from dnet_tpu.core.types import DecodingParams
+
+pytestmark = pytest.mark.core
+
+
+# ---- primitives ------------------------------------------------------------
+
+
+def test_ngram_draft_finds_latest_match():
+    hist = jnp.zeros((1, 32), jnp.int32)
+    for i, t in enumerate([5, 6, 7, 8, 5, 6, 9, 1, 5, 6]):
+        hist = hist.at[0, i].set(t)
+    # two earlier (5,6) occurrences; the LATEST one (followed by 9, 1, 5)
+    # must win
+    d = np.asarray(ngram_draft(hist, jnp.int32(10), lookahead=3))
+    assert list(d[0]) == [9, 1, 5]
+
+
+def test_ngram_draft_fallback_repeats_last():
+    hist = jnp.zeros((1, 16), jnp.int32)
+    for i, t in enumerate([1, 2, 3, 4]):
+        hist = hist.at[0, i].set(t)
+    d = np.asarray(ngram_draft(hist, jnp.int32(4), lookahead=4))
+    assert list(d[0]) == [4, 4, 4, 4]
+
+
+def test_accept_drafts_partial_and_full():
+    n, out = accept_drafts(jnp.asarray([[7, 8, 9, 10]]), jnp.asarray([[7, 8, 11]]))
+    assert int(n[0]) == 2
+    assert list(np.asarray(out)[0]) == [7, 8, 9, -1]
+    n, out = accept_drafts(jnp.asarray([[7, 8, 11, 3]]), jnp.asarray([[7, 8, 11]]))
+    assert int(n[0]) == 3
+    assert list(np.asarray(out)[0]) == [7, 8, 11, 3]
+    n, out = accept_drafts(jnp.asarray([[9, 8, 11, 3]]), jnp.asarray([[7, 8, 11]]))
+    assert int(n[0]) == 0
+    assert list(np.asarray(out)[0]) == [9, -1, -1, -1]
+
+
+def test_commit_history_writes_valid_prefix():
+    hist = jnp.arange(8, dtype=jnp.int32)[None, :]
+    out = np.asarray(
+        commit_history(hist, jnp.int32(3), jnp.asarray([[9, 9, -1]]), jnp.int32(2))
+    )
+    assert list(out[0][:5]) == [0, 1, 2, 9, 9]
+
+
+# ---- engine integration ----------------------------------------------------
+
+
+def _spec_engine(d, **kw):
+    from dnet_tpu.core.engine import LocalEngine
+
+    return LocalEngine(d, max_seq=128, param_dtype="float32", **kw)
+
+
+def test_spec_stream_matches_plain_greedy(tiny_llama_dir):
+    """The speculative stream must be token-identical to plain decode."""
+    ids = [1, 7, 3, 11, 1, 7]  # repeated bigram: drafts will fire
+    dec = DecodingParams(temperature=0.0)
+    plain = _spec_engine(tiny_llama_dir)
+    want = [r.token_id for r in plain.generate(ids, dec, max_tokens=24)]
+    spec = _spec_engine(tiny_llama_dir, spec_lookahead=4)
+    got = [r.token_id for r in spec.generate(ids, dec, max_tokens=24)]
+    assert got == want
+
+
+def test_spec_dispatch_emits_exact_chunks(tiny_llama_dir):
+    """decode_spec chunks advance pos by exactly the emitted token count and
+    chain across chunks."""
+    ids = [1, 7, 3, 11]
+    dec = DecodingParams(temperature=0.0)
+    plain = _spec_engine(tiny_llama_dir)
+    plain.prefill("p", ids)
+    r0 = plain.decode_step("p", ids[-1], dec)
+    want = [int(r0.token[0])]
+    for _ in range(15):
+        want.append(int(plain.decode_step("p", want[-1], dec).token[0]))
+
+    spec = _spec_engine(tiny_llama_dir, spec_lookahead=4)
+    spec.prefill("s", ids)
+    got = []
+    tok = ids[-1]
+    while len(got) < 16:
+        res = spec.decode_spec("s", tok if not got else None, dec, 16 - len(got))
+        assert res, "spec chunk emitted nothing"
+        got.extend(int(r.token[0]) for r in res)
+        tok = got[-1]
+    assert got[:16] == want
+    assert spec.sessions["s"].pos == plain.sessions["p"].pos
+
+
+def test_spec_ineligible_paths_fall_back(tiny_llama_dir):
+    """Sampled requests and logprobs requests must not take the spec path."""
+    spec = _spec_engine(tiny_llama_dir, spec_lookahead=4)
+    assert not spec.spec_eligible(DecodingParams(temperature=0.7))
+    assert not spec.spec_eligible(DecodingParams(temperature=0.0, logprobs=True))
+    assert not spec.spec_eligible(
+        DecodingParams(temperature=0.0, repetition_penalty=1.3)
+    )
+    assert spec.spec_eligible(DecodingParams(temperature=0.0))
+    plain = _spec_engine(tiny_llama_dir)
+    assert not plain.spec_eligible(DecodingParams(temperature=0.0))
+
+
+def test_spec_through_adapter_serving_stream(tiny_llama_dir):
+    """LocalAdapter + InferenceManager over a spec engine: same text as the
+    plain engine through the same stack (the driver protocol is unchanged)."""
+    import asyncio
+
+    from dnet_tpu.api.inference import InferenceManager
+    from dnet_tpu.api.schemas import ChatCompletionRequest
+    from dnet_tpu.api.strategies import LocalAdapter
+    from dnet_tpu.utils.tokenizer import ByteTokenizer
+
+    async def serve(engine):
+        adapter = LocalAdapter(engine, chunk_size=8)
+        manager = InferenceManager(adapter, request_timeout_s=120.0)
+        manager.tokenizer = ByteTokenizer()
+        manager.model_id = "t"
+        req = ChatCompletionRequest.model_validate(
+            {
+                "model": "t",
+                "messages": [{"role": "user", "content": "abcabc"}],
+                "max_tokens": 24,
+                "temperature": 0.0,
+            }
+        )
+        await adapter.start()
+        try:
+            r = await manager.generate(req)
+        finally:
+            await adapter.shutdown()
+        return r.choices[0].message.content, r.usage.completion_tokens
+
+    plain_text, plain_n = asyncio.run(serve(_spec_engine(tiny_llama_dir)))
+    spec_text, spec_n = asyncio.run(
+        serve(_spec_engine(tiny_llama_dir, spec_lookahead=4))
+    )
+    assert spec_text == plain_text
+    assert spec_n == plain_n
+
+
+def test_spec_gpt_oss_rotating_kv_ineligible(tmp_path_factory):
+    """Ring-buffer SWA caches cannot rewind: spec must refuse."""
+    from tests.fakes.checkpoints import make_tiny_gpt_oss
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path_factory.mktemp("spec_oss")
+    make_tiny_gpt_oss(d)
+    eng = LocalEngine(d, max_seq=64, param_dtype="float32", spec_lookahead=4)
+    assert not eng.spec_eligible(DecodingParams(temperature=0.0))
