@@ -428,6 +428,18 @@ class LocalAdapter(ApiAdapterBase):
         Returns the current chunk's SampleResults, or None to fall back to
         per-token decode (engine without chunk support / width-1 budget).
         """
+        if (
+            budget is not None
+            and budget > 1
+            and getattr(eng, "spec_eligible", None) is not None
+            and eng.spec_eligible(decoding)
+            and eng.spec_worthwhile(nonce)
+            and eng.pending_chunks(nonce) == 0
+        ):
+            # speculative path: one verify forward emits 1..L+1 greedy-exact
+            # tokens; the per-token driver protocol is unchanged (extras are
+            # buffered exactly like chunked results)
+            return eng.decode_spec(nonce, token_ids[-1], decoding, budget)
         if not hasattr(eng, "decode_chunk_dispatch"):
             # legacy engines: one-shot chunk call, no pipelining
             chunk = self._next_chunk_width(nonce, budget)

@@ -27,6 +27,7 @@ from dnet_tpu.core.sampler import (
     SamplePlan,
     SampleParams,
     SampleResult,
+    pack_chunk_results,
     sample,
 )
 from dnet_tpu.core.types import DecodingParams, TokenResult
@@ -58,6 +59,15 @@ class Session:
     # without a host round trip) + dispatched-but-unread chunk queue
     last_token: jax.Array = None  # [B, 1] int32
     pending: "deque" = field(default_factory=lambda: deque())
+    # speculative decoding: device-resident committed-token history
+    # (prompt + generated), indexed by position — hist[i] is the token FED
+    # at position i (whose KV landed in slot i).  None unless the engine
+    # was built with spec_lookahead > 0.
+    hist: jax.Array = None  # [B, max_seq] int32
+    # acceptance accounting: blocks run / tokens emitted, feeding the
+    # adaptive spec-vs-chunk gate (spec_worthwhile)
+    spec_blocks: int = 0
+    spec_emitted: int = 0
 
 
 class LocalEngine:
@@ -66,6 +76,10 @@ class LocalEngine:
     layers=None means the full model (single-shard serving); a sub-range
     makes this engine a shard's compute core.
     """
+
+    # class default so engine subclasses with their own __init__ (MeshEngine)
+    # are spec-ineligible unless they opt in
+    spec_lookahead = 0
 
     def __init__(
         self,
@@ -84,6 +98,7 @@ class LocalEngine:
         weight_quant_bits: int = 0,
         weight_quant_group: int = 0,
         prefix_cache_size: int = 0,
+        spec_lookahead: int = 0,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -106,6 +121,7 @@ class LocalEngine:
         # (reference: edge tensors loaded iff shard holds layer 0 / the last
         # layer, src/dnet/shard/runtime.py:262-286)
         self.shard_mode = shard_mode
+        self.spec_lookahead = int(spec_lookahead)
         self.sessions: Dict[str, Session] = {}
 
         from dnet_tpu.core.weights import plan_policy
@@ -154,6 +170,7 @@ class LocalEngine:
         kv_dtype: Optional[str] = None,
         kv_quant_bits: int = 0,
         kv_ttl_s: float = 600.0,
+        spec_lookahead: int = 0,
     ) -> "LocalEngine":
         """Build an engine around already-materialised parameters (no
         checkpoint on disk) — the zero-egress bench path: the serving hot
@@ -174,6 +191,7 @@ class LocalEngine:
         self.weight_quant_group = 0
         self.kv_ttl_s = kv_ttl_s
         self.shard_mode = False
+        self.spec_lookahead = int(spec_lookahead)
         self.sessions = {}
         self.plan = plan_policy(len(self.model.layers), 0, 0)
         self._repack_dir = None
@@ -307,19 +325,7 @@ class LocalEngine:
             (last_tok, kv, _, key, counts), results = jax.lax.scan(
                 body, (token, kv, pos, key, counts), None, length=n_steps
             )
-            with_lp = plan is None or plan.logprobs
-            if with_lp:  # token ids are exact in f32 for V < 2**24
-                packed = jnp.concatenate(
-                    [
-                        results.token[..., None].astype(jnp.float32),
-                        results.logprob[..., None],
-                        results.top_tokens.astype(jnp.float32),
-                        results.top_logprobs,
-                    ],
-                    axis=-1,
-                )
-            else:
-                packed = results.token[..., None].astype(jnp.float32)
+            packed = pack_chunk_results(results, plan is None or plan.logprobs)
             return packed, last_tok, kv, key, counts
 
         self._decode_chunk = jax.jit(
@@ -369,6 +375,35 @@ class LocalEngine:
             return res, kv, counts
 
         self._hidden_tail = jax.jit(hidden_tail, donate_argnums=(3, 8))
+
+        L = self.spec_lookahead
+        if L > 0:
+            from dnet_tpu.core.spec import accept_drafts, commit_history, ngram_draft
+
+            def spec_step_fn(window_params, edge_params, tok, hist, kv, pos):
+                """One speculative verify step: draft L tokens from history,
+                run ONE forward over [tok, d_1..d_L], greedily accept the
+                agreeing prefix.  KV for all L+1 positions is written; the
+                host-side caller rewinds pos to the accepted count (stale
+                rows are overwritten by the next block — core/spec.py)."""
+                hist = commit_history(hist, pos, tok, jnp.int32(1))
+                drafts = ngram_draft(hist, pos + 1, L)  # [B, L]
+                hist = commit_history(hist, pos + 1, drafts, jnp.int32(L))
+                block = jnp.concatenate([tok, drafts], axis=1)  # [B, L+1]
+                x = model.embed(edge_params, block)
+                x, kv = model.apply_window(
+                    window_params, x, kv, pos, t_real=L + 1
+                )
+                x = model.normalize(edge_params, x)
+                logits = model.lm_project(edge_params, x)  # [B, L+1, V]
+                preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # n_accept is recoverable host-side from out's -1 sentinel
+                # (preds are argmaxes, always >= 0), so only `out` crosses
+                # device->host — one transfer per block
+                _, out = accept_drafts(preds, drafts)
+                return out, hist, kv
+
+            self._spec_step = jax.jit(spec_step_fn, donate_argnums=(3, 4))
 
     # ---- offload execution --------------------------------------------
     def run_layers(self, sess: "Session", x: jnp.ndarray, pos: int, t_real=None) -> jnp.ndarray:
@@ -496,6 +531,11 @@ class LocalEngine:
             pos=pos,
             key=jax.random.key(seed),
             counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
+            hist=(
+                jnp.zeros((self.batch, self.max_seq), dtype=jnp.int32)
+                if self.spec_lookahead > 0
+                else None
+            ),
         )
         self.sessions[nonce] = sess
         return sess
@@ -558,6 +598,19 @@ class LocalEngine:
                 sess = self.new_session(nonce, seed)
         else:
             fresh = sess.pos == 0  # explicit chunked continuation
+        if self.spec_lookahead > 0 and sess.hist is not None:
+            # commit the prompt to the spec history buffer; on a prefix-cache
+            # hit write the FULL prompt at 0 (the cached tokens were never
+            # fed through THIS session)
+            n_cached = len(full_ids) - len(prompt_ids)
+            ids = jnp.asarray(
+                np.broadcast_to(
+                    np.asarray(full_ids, dtype=np.int32), (self.batch, len(full_ids))
+                )
+            )
+            sess.hist = jax.lax.dynamic_update_slice_in_dim(
+                sess.hist, ids, sess.pos - n_cached, axis=1
+            )
         T = len(prompt_ids)
         # the PADDED width must also fit — dynamic_update_slice would clamp
         # the start index and silently shift the whole KV write otherwise
@@ -604,7 +657,15 @@ class LocalEngine:
         if hit is None:
             return 0
         n, kv_copy = hit
-        self.new_session(nonce, seed, kv=kv_copy, pos=n)
+        sess = self.new_session(nonce, seed, kv=kv_copy, pos=n)
+        if sess.hist is not None:
+            # commit the cached prefix to the spec history (the follow-up
+            # chunked prefill only writes its own remainder) — without this
+            # prompt-lookup drafts would match against zeros
+            ids = jnp.asarray(
+                np.broadcast_to(np.asarray(full_ids[:n], dtype=np.int32), (self.batch, n))
+            )
+            sess.hist = jax.lax.dynamic_update_slice_in_dim(sess.hist, ids, 0, axis=1)
         return n
 
     def store_prefix(self, nonce: str, full_ids: Sequence[int]) -> None:
@@ -651,6 +712,104 @@ class LocalEngine:
         sess.pos += 1
         sess.last_used = time.time()
         return res
+
+    # ---- speculative decoding ----------------------------------------
+    def spec_eligible(self, decoding: DecodingParams) -> bool:
+        """Whether this engine + request pair may take the speculative path.
+
+        Greedy only (spec emits raw argmaxes; sampled streams would need
+        rejection sampling), no logprobs (the verify forward discards the
+        softmax), no repetition penalty (counts are not threaded through the
+        verify block), resident weights only (a streamed verify would re-read
+        every window per block, erasing the win), batch 1 (acceptance length
+        is per-lane), and a rewind-safe cache layout (rotating SWA ring
+        buffers cannot rewind — core/spec.py)."""
+        return (
+            self.spec_lookahead > 0
+            and self.batch == 1
+            and not self.plan.streams_weights
+            and self.model.kv_rewindable(self.max_seq)
+            and decoding.temperature == 0.0
+            and not decoding.logprobs
+            and decoding.repetition_penalty == 1.0
+        )
+
+    # adaptive gate thresholds: a spec block costs one (L+1)-wide forward +
+    # one host sync per <=L+1 tokens; a decode chunk costs one forward per
+    # token but only one sync per ~32.  Below ~1.5 tokens/block, chunks win.
+    SPEC_WARMUP_BLOCKS = 4
+    SPEC_MIN_TOKENS_PER_BLOCK = 1.5
+
+    def spec_worthwhile(self, nonce: str) -> bool:
+        """Per-session acceptance gate: after a warmup, sessions whose
+        drafts rarely accept (non-repetitive output — prompt-lookup has
+        nothing to look up) fall back to chunked decode rather than paying
+        one dispatch + host sync per ~1 token, the exact gap chunking
+        closed.  The callers re-check every block, so speculation stops the
+        moment it stops paying; it does not resume within the session."""
+        sess = self.sessions.get(nonce)
+        if sess is None or sess.spec_blocks < self.SPEC_WARMUP_BLOCKS:
+            return True
+        return (
+            sess.spec_emitted / sess.spec_blocks >= self.SPEC_MIN_TOKENS_PER_BLOCK
+        )
+
+    def decode_spec(
+        self,
+        nonce: str,
+        token_id: Optional[int],
+        decoding: DecodingParams,
+        max_new: int,
+    ) -> List[SampleResult]:
+        """One speculative verify block: feed `token_id` (None chains from
+        the device-resident last emitted token), draft spec_lookahead tokens
+        by prompt-lookup, verify in ONE forward, emit the accepted prefix
+        plus the first correction — 1..L+1 tokens per weight read.  Emission
+        is clamped to `max_new`; sess.pos advances by exactly the emitted
+        count (stale KV/history rows are overwritten by the next block)."""
+        sess = self.sessions[nonce]
+        L = self.spec_lookahead
+        if sess.pos >= self.max_seq:
+            raise ValueError(
+                f"sequence length {sess.pos} reached max_seq {self.max_seq}"
+            )
+        budget = min(max_new, self.max_seq - sess.pos)
+        if budget <= 1 or sess.pos + L + 1 > self.max_seq:
+            # no room to speculate: one plain step keeps the stream moving
+            tid = (
+                token_id
+                if token_id is not None
+                else int(np.asarray(sess.last_token)[0, 0])
+            )
+            return [self.decode_step(nonce, tid, decoding)]
+        if token_id is None:
+            if sess.last_token is None:
+                raise RuntimeError("no device-resident token to chain from")
+            tok = sess.last_token
+        else:
+            tok = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
+        out, sess.hist, sess.kv = self._spec_step(
+            self.window_params, self.edge_params, tok, sess.hist, sess.kv,
+            jnp.int32(sess.pos),
+        )
+        out_h = np.asarray(out)  # [B, L+1]; blocks until the block finishes
+        emitted = min(int((out_h[0] >= 0).sum()), budget)
+        sess.pos += emitted
+        sess.spec_blocks += 1
+        sess.spec_emitted += emitted
+        sess.last_used = time.time()
+        sess.last_token = jnp.asarray(out_h[:, emitted - 1 : emitted])
+        B = out_h.shape[0]
+        zero_lp = np.zeros((B,), np.float32)
+        zero_tt = np.zeros((B, MAX_TOP_LOGPROBS), np.int32)
+        zero_tlp = np.zeros((B, MAX_TOP_LOGPROBS), np.float32)
+        return [
+            SampleResult(
+                np.ascontiguousarray(out_h[:, i]).astype(np.int32),
+                zero_lp, zero_tt, zero_tlp,
+            )
+            for i in range(emitted)
+        ]
 
     # chunk widths tried largest-first: a fixed bucket set keeps the number
     # of compiled scan programs bounded (one per width actually used)
@@ -808,13 +967,24 @@ class LocalEngine:
             self.end_session(nonce)
             return
 
-        for step in range(1, max_tokens):
+        use_spec = self.spec_eligible(decoding)
+        step = 1
+        while step < max_tokens:
             if sess.pos >= self.max_seq:
                 break  # cache capacity reached: stop cleanly (finish_reason=length)
-            res = self.decode_step(nonce, token, decoding)
-            token = int(res.token[0])
-            yield self.token_result(nonce, res, step=step, decoding=decoding)
-            if token in eos:
+            if use_spec and self.spec_worthwhile(nonce):
+                results = self.decode_spec(nonce, token, decoding, max_tokens - step)
+            else:
+                results = [self.decode_step(nonce, token, decoding)]
+            stop = False
+            for res in results:
+                token = int(res.token[0])
+                yield self.token_result(nonce, res, step=step, decoding=decoding)
+                step += 1
+                if token in eos:
+                    stop = True
+                    break
+            if stop:
                 break
         self.end_session(nonce)
 
@@ -828,7 +998,11 @@ class LocalEngine:
             logits, SampleParams.from_decoding(decoding), step_key,
             token_counts=sess.counts, plan=SamplePlan.from_decoding(decoding),
         )
-        sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
+        # per-lane counts, matching the jitted decode/chunk programs exactly —
+        # penalty state must not depend on which dispatch path served a step
+        sess.counts = sess.counts.at[
+            jnp.arange(sess.counts.shape[0]), res.token
+        ].add(1)
         return res
 
     def prefill_and_sample(

@@ -81,6 +81,24 @@ class SampleResult(NamedTuple):
     top_logprobs: jnp.ndarray  # [B, MAX_TOP_LOGPROBS] f32
 
 
+def pack_chunk_results(results: SampleResult, with_logprobs: bool) -> jnp.ndarray:
+    """Pack a scanned SampleResult ([K, B, ...] leaves) into ONE f32 array
+    for a single device->host transfer per decode chunk (token ids are exact
+    in f32 for V < 2**24).  Shared by LocalEngine's decode_chunk and the
+    mesh ring chunk program (parallel/ring.py)."""
+    if with_logprobs:
+        return jnp.concatenate(
+            [
+                results.token[..., None].astype(jnp.float32),
+                results.logprob[..., None],
+                results.top_tokens.astype(jnp.float32),
+                results.top_logprobs,
+            ],
+            axis=-1,
+        )
+    return results.token[..., None].astype(jnp.float32)
+
+
 def sample(
     logits: jnp.ndarray,
     params: SampleParams,

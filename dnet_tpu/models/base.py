@@ -307,6 +307,15 @@ class RingModel(abc.ABC):
 
         return jax.tree.map(lambda v: v[None], mapped)
 
+    def kv_rewindable(self, max_seq: int) -> bool:
+        """Whether stale cache rows past a rewound `pos` are harmless.
+
+        Slot-addressed max_seq caches qualify (stale rows are never attended
+        and get overwritten); rotating ring-buffer SWA caches do not — a
+        wrap-around write evicts live rows, so speculative decoding must
+        refuse (see core/spec.py's KV-rewind invariant)."""
+        return True
+
     def local_window(self, start_abs: int, size: int) -> List[int]:
         """The contiguous run of assigned layers beginning at start_abs."""
         out = []

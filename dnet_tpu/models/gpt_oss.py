@@ -306,6 +306,16 @@ class GptOssRingModel(RingModel):
 
         return {"a": cache(self.pair_kinds[0]), "b": cache(self.pair_kinds[1])}
 
+    def kv_rewindable(self, max_seq: int) -> bool:
+        """False when init_kv would allocate rotating ring-buffer SWA caches
+        (paired layout + a sliding half shorter than max_seq): wrap-around
+        writes evict live rows, so a speculative rewind would corrupt the
+        attended window."""
+        W = self.config.sliding_window
+        if self.pair_kinds is None or not (0 < W < max_seq):
+            return True
+        return 1 not in tuple(int(k) for k in self.pair_kinds)
+
     # ---- weight mapping ----------------------------------------------
     def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         def t(name):

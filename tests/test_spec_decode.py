@@ -156,3 +156,17 @@ def test_spec_gpt_oss_rotating_kv_ineligible(tmp_path_factory):
     make_tiny_gpt_oss(d)
     eng = LocalEngine(d, max_seq=64, param_dtype="float32", spec_lookahead=4)
     assert not eng.spec_eligible(DecodingParams(temperature=0.0))
+
+
+def test_spec_worthwhile_gate(tiny_llama_dir):
+    """Low-acceptance sessions must fall back to chunked decode after the
+    warmup (spec is only worth the per-block host sync when drafts land)."""
+    eng = _spec_engine(tiny_llama_dir, spec_lookahead=4)
+    eng.prefill("g", [1, 2, 3])
+    sess = eng.sessions["g"]
+    assert eng.spec_worthwhile("g")  # warmup always speculates
+    sess.spec_blocks, sess.spec_emitted = 8, 8  # 1.0 tok/block < threshold
+    assert not eng.spec_worthwhile("g")
+    sess.spec_emitted = 16  # 2.0 tok/block
+    assert eng.spec_worthwhile("g")
+    assert eng.spec_worthwhile("unknown-nonce")  # unknown sessions don't gate
