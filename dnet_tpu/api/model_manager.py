@@ -175,6 +175,10 @@ class LocalModelManager:
                     quant_group=self.weight_quant_group,
                     prefix_cache_size=self.prefix_cache,
                 )
+                # the mesh chunk programs (K-step full-ring scans) are the
+                # most expensive compiles in the codebase: do them now, not
+                # mid-stream on the first request's ramp
+                engine.warm_chunks()
             elif self.batch_slots > 1:
                 from dnet_tpu.core.batch import BatchedEngine
 

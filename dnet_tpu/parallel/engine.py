@@ -26,7 +26,11 @@ from dnet_tpu.core.sampler import SampleResult
 from dnet_tpu.core.types import DecodingParams
 from dnet_tpu.models import ModelConfig, get_ring_model_cls
 from dnet_tpu.parallel.mesh import build_mesh
-from dnet_tpu.parallel.ring import make_ring_decode_fn, place_ring_state
+from dnet_tpu.parallel.ring import (
+    make_ring_chunk_fn,
+    make_ring_decode_fn,
+    place_ring_state,
+)
 from dnet_tpu.utils.checkpoint import Checkpoint
 from dnet_tpu.utils.logger import get_logger
 
@@ -46,6 +50,23 @@ class MeshEngine:
     end_session = LocalEngine.end_session
     sweep_sessions = LocalEngine.sweep_sessions
     reset = LocalEngine.reset
+    # chunked-scan decode: the ring chunk program (make_ring_chunk_fn) keeps
+    # LocalEngine's (packed, last_token, kv, key, counts) contract, so the
+    # dispatch/read/pipelining machinery is borrowed verbatim — one
+    # implementation, two execution substrates
+    DECODE_CHUNK_BUCKETS = LocalEngine.DECODE_CHUNK_BUCKETS
+    decode_chunk_dispatch = LocalEngine.decode_chunk_dispatch
+    decode_chunk_read = LocalEngine.decode_chunk_read
+    decode_chunk = LocalEngine.decode_chunk
+    pending_chunks = LocalEngine.pending_chunks
+    pending_width = LocalEngine.pending_width
+    WARM_DECODINGS = LocalEngine.WARM_DECODINGS
+    warm_chunks = LocalEngine.warm_chunks
+    # speculative decoding is LocalEngine-only for now; the borrowed
+    # generate/adapter paths consult these and short-circuit to False
+    spec_lookahead = 0
+    spec_eligible = LocalEngine.spec_eligible
+    spec_worthwhile = LocalEngine.spec_worthwhile
 
     def __init__(
         self,
@@ -108,6 +129,9 @@ class MeshEngine:
 
         self._load_params()
         self._step = make_ring_decode_fn(self.model, self.mesh, self._host_window)
+        self._decode_chunk = make_ring_chunk_fn(
+            self.model, self.mesh, self._host_window
+        )
         log.info(
             "MeshEngine: %s over mesh pp=%d tp=%d dp=%d sp=%d (%d devices)",
             self.config.model_type, pp, tp, dp, sp, pp * tp * dp * sp,

@@ -35,21 +35,10 @@ from dnet_tpu.parallel.mesh import (
 )
 
 
-def make_ring_decode_fn(model, mesh: Mesh, window_params, donate_kv: bool = True):
-    """Build a jitted single-program ring decode step.
-
-    Signature of the returned fn:
-      (window_params, edge_params, tokens[B,1] int32, kv, pos) -> (logits[B,V], kv)
-
-    window_params: stacked over ALL model layers [L, ...], sharded
-      (pp shards the layer axis into contiguous stages, tp the head/ffn dims)
-      — passed here only for spec construction (flat or segmented layout).
-
-    Models with `ring_phases > 1` (deepseek: dense/moe segments) run that
-    many laps around the ring, applying one segment per lap, so the global
-    layer order is preserved even though each rank holds a slice of every
-    segment.
-    """
+def _ring_spmd(model, mesh: Mesh, window_params):
+    """Construct the shard_map'd single-token ring step (un-jitted) and its
+    layer-kinds operand.  Shared by the per-step fn (make_ring_decode_fn)
+    and the chunked-scan fn (make_ring_chunk_fn)."""
     PP = mesh.shape[AXIS_PP]
     phases = getattr(model, "ring_phases", 1)
     # sequence parallelism: KV shards over sp; queries/hidden replicate and
@@ -111,9 +100,28 @@ def make_ring_decode_fn(model, mesh: Mesh, window_params, donate_kv: bool = True
         return logits[:, 0], kv
 
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    kinds_arr = model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
+    return fn, kinds_arr
+
+
+def make_ring_decode_fn(model, mesh: Mesh, window_params, donate_kv: bool = True):
+    """Build a jitted single-program ring decode step.
+
+    Signature of the returned fn:
+      (window_params, edge_params, tokens[B,1] int32, kv, pos) -> (logits[B,V], kv)
+
+    window_params: stacked over ALL model layers [L, ...], sharded
+      (pp shards the layer axis into contiguous stages, tp the head/ffn dims)
+      — passed here only for spec construction (flat or segmented layout).
+
+    Models with `ring_phases > 1` (deepseek: dense/moe segments) run that
+    many laps around the ring, applying one segment per lap, so the global
+    layer order is preserved even though each rank holds a slice of every
+    segment.
+    """
+    fn, kinds_arr = _ring_spmd(model, mesh, window_params)
     donate = (3,) if donate_kv else ()
     jitted = jax.jit(fn, donate_argnums=donate)
-    kinds_arr = model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
 
     def call(window_params, edge_params, tokens, kv, pos, last_idx=None):
         if last_idx is None:
@@ -121,6 +129,44 @@ def make_ring_decode_fn(model, mesh: Mesh, window_params, donate_kv: bool = True
         return jitted(window_params, edge_params, tokens, kv, pos, last_idx, kinds_arr)
 
     return call
+
+
+def make_ring_chunk_fn(model, mesh: Mesh, window_params):
+    """Chunked-scan mesh decode: K ring steps + on-device sampling fused
+    into ONE XLA program (the multi-chip analog of LocalEngine's
+    decode_chunk, core/engine.py — same packed-result, device-chained-token
+    contract, so LocalEngine's dispatch/read methods drive it unchanged).
+
+    Per-token the served mesh path previously paid one full program dispatch
+    + one host read (parallel/engine.py r2, the dispatch gap VERDICT flagged);
+    here the sampled token feeds the next ring step on-device and the host
+    pays one dispatch + one packed transfer per K tokens.  Sampling sits
+    OUTSIDE shard_map at the global-batch level, so key evolution and noise
+    shapes match the per-step path exactly (chunked and unchunked streams
+    are identical for a given seed)."""
+    from dnet_tpu.core.sampler import pack_chunk_results, sample
+
+    ring, kinds_arr = _ring_spmd(model, mesh, window_params)
+
+    def chunk(window_params, edge_params, token, kv, pos, sp, key, counts,
+              n_steps, plan=None):
+        def body(carry, _):
+            tok, kv, pos, key, counts = carry
+            key, step_key = jax.random.split(key)
+            logits, kv = ring(
+                window_params, edge_params, tok, kv, pos, jnp.int32(0), kinds_arr
+            )
+            res = sample(logits, sp, step_key, token_counts=counts, plan=plan)
+            counts = counts.at[jnp.arange(counts.shape[0]), res.token].add(1)
+            return (res.token[:, None], kv, pos + 1, key, counts), res
+
+        (last_tok, kv, _, key, counts), results = jax.lax.scan(
+            body, (token, kv, pos, key, counts), None, length=n_steps
+        )
+        packed = pack_chunk_results(results, plan is None or plan.logprobs)
+        return packed, last_tok, kv, key, counts
+
+    return jax.jit(chunk, static_argnums=(8, 9), donate_argnums=(3, 7))
 
 
 def _bcast_from_rank0(x, axis_name: str):

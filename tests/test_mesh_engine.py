@@ -140,3 +140,56 @@ def test_quantized_mesh_generates_close(local, tiny_llama_dir, eight_devices):
         for r in local.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
     ]
     assert got == ref
+
+
+def test_chunked_decode_matches_per_step(tiny_llama_dir, eight_devices):
+    """The mesh chunk program (K ring steps + sampling fused in one XLA
+    program) must produce token-identical streams to per-step decode for a
+    fixed seed — greedy AND sampled (key evolution is split-per-step in both
+    paths)."""
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    ids = [256, 72, 101, 108, 108, 111]
+    for dec in (
+        DecodingParams(temperature=0.0, seed=11),
+        DecodingParams(temperature=0.9, top_p=0.9, seed=11),
+    ):
+        eng = MeshEngine(tiny_llama_dir, pp=2, tp=1, max_seq=128, param_dtype="float32")
+        eng.prefill("a", ids, seed=dec.seed)
+        eng.prefill("b", ids, seed=dec.seed)
+        want = []
+        tok = ids[-1]
+        for _ in range(12):
+            tok = int(eng.decode_step("a", tok, dec).token[0])
+            want.append(tok)
+        got = []
+        tok = ids[-1]
+        while len(got) < 12:
+            res = eng.decode_chunk("b", tok, dec, 12 - len(got))
+            got.extend(int(r.token[0]) for r in res)
+            tok = got[-1]
+        assert got[:12] == want
+        assert eng.sessions["b"].pos == eng.sessions["a"].pos
+
+
+def test_chunked_decode_pipelined_dispatch(tiny_llama_dir, eight_devices):
+    """dispatch/read split: chain a second chunk from the device-resident
+    last token while the first is unread (the LocalAdapter overlap path)."""
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    dec = DecodingParams(temperature=0.0)
+    ids = [256, 10, 20, 30]
+    eng = MeshEngine(tiny_llama_dir, pp=2, tp=1, max_seq=128, param_dtype="float32")
+    eng.prefill("p", ids)
+    want = []
+    tok = ids[-1]
+    for _ in range(8):
+        tok = int(eng.decode_step("p", tok, dec).token[0])
+        want.append(tok)
+    eng.prefill("q", ids)
+    assert eng.decode_chunk_dispatch("q", ids[-1], dec, 4) == 4
+    assert eng.decode_chunk_dispatch("q", None, dec, 4) == 4  # device-chained
+    assert eng.pending_chunks("q") == 2 and eng.pending_width("q") == 8
+    got = [int(r.token[0]) for r in eng.decode_chunk_read("q")]
+    got += [int(r.token[0]) for r in eng.decode_chunk_read("q")]
+    assert got == want
