@@ -57,14 +57,34 @@ def _measure_fused(model, window, edge, kv, batch: int, n_steps: int = 64) -> fl
     return batch * n_steps / (time.perf_counter() - t0)
 
 
-def _measure_served(cfg, window, edge, batch: int, max_seq: int) -> dict:
+def _measure_fused_chunks(engine, batch: int, n_steps: int = 256) -> float:
+    """Pure-device ceiling for chunk-capable engines (mesh): back-to-back
+    decode_chunk dispatch/read with no serving stack in the loop.  TWO warm
+    calls (the second chunk still recompiles: the donated KV layout changes
+    after the first) and >= 8 timed chunks, so a stray compile cannot
+    dominate the window and understate the ceiling."""
+    from dnet_tpu.core.types import DecodingParams
+
+    dec = DecodingParams(temperature=0.0)
+    engine.prefill("__fused__", [1, 2, 3, 4], seed=0)
+    engine.decode_chunk("__fused__", 1, dec, 32)  # compile
+    engine.decode_chunk("__fused__", 1, dec, 32)  # steady-state layout
+    t0 = time.perf_counter()
+    done = 0
+    while done < n_steps:
+        done += len(engine.decode_chunk("__fused__", 1, dec, 32))
+    dt = time.perf_counter() - t0
+    engine.end_session("__fused__")
+    return batch * done / dt
+
+
+def _measure_served(engine, batch: int) -> dict:
     """The declared metric: decode tok/s + TTFT through the serving stack."""
     import asyncio
 
     from dnet_tpu.api.inference import InferenceManager
     from dnet_tpu.api.schemas import ChatCompletionRequest
     from dnet_tpu.api.strategies import LocalAdapter
-    from dnet_tpu.core.engine import LocalEngine
     from dnet_tpu.utils.tokenizer import ByteTokenizer
 
     class BenchTokenizer(ByteTokenizer):
@@ -74,9 +94,6 @@ def _measure_served(cfg, window, edge, batch: int, max_seq: int) -> dict:
             # early, so every request generates exactly max_tokens tokens
             return {-1}
 
-    engine = LocalEngine.from_params(
-        cfg, window, edge, batch=batch, max_seq=max_seq
-    )
     adapter = LocalAdapter(engine, chunk_size=32)
     manager = InferenceManager(adapter, request_timeout_s=600.0)
     manager.tokenizer = BenchTokenizer()
@@ -251,18 +268,46 @@ def main() -> None:
 
         from dnet_tpu.ops.quant import QUANTIZABLE, quantize_tree
 
+        # smoke shapes have tiny contraction dims: a smaller scale-group
+        # keeps groups divisible across tp ranks in --mesh mode
+        group = 32 if "--smoke" in sys.argv else 0
         window = quantize_tree(
-            {k: _np.asarray(v) for k, v in window.items()}, QUANTIZABLE, bits=bits
+            {k: _np.asarray(v) for k, v in window.items()}, QUANTIZABLE,
+            bits=bits, group_size=group,
         )
-        edge = model.quantize_edge(edge, bits)  # tied LM projection too
+        edge = model.quantize_edge(edge, bits, group_size=group)
     # device-resident: leaving numpy here would re-upload every step
     window = jax.tree.map(jnp.asarray, window)
     edge = jax.tree.map(jnp.asarray, edge)
     max_seq = 1024
 
-    kv = init_cache(model.kv_config(len(layers), batch, max_seq, "bfloat16"))
-    fused_tok_s = _measure_fused(model, window, edge, kv, batch)
-    served = _measure_served(cfg, window, edge, batch, max_seq)
+    mesh_cfg = None
+    if "--mesh" in sys.argv:  # e.g. --mesh 2x2 = pp2/tp2 over local devices
+        try:
+            pp_s, tp_s = sys.argv[sys.argv.index("--mesh") + 1].split("x")
+            mesh_cfg = (int(pp_s), int(tp_s))
+        except (IndexError, ValueError):
+            print(json.dumps({"error": "--mesh requires PPxTP, e.g. 2x2"}))
+            raise SystemExit(2)
+
+    if mesh_cfg is not None:
+        from dnet_tpu.parallel.engine import MeshEngine
+
+        pp_n, tp_n = mesh_cfg
+        engine = MeshEngine.from_params(
+            cfg, window, edge, pp=pp_n, tp=tp_n, batch=batch, max_seq=max_seq,
+        )
+        fused_tok_s = _measure_fused_chunks(engine, batch)
+        served = _measure_served(engine, batch)
+    else:
+        from dnet_tpu.core.engine import LocalEngine
+
+        kv = init_cache(model.kv_config(len(layers), batch, max_seq, "bfloat16"))
+        fused_tok_s = _measure_fused(model, window, edge, kv, batch)
+        engine = LocalEngine.from_params(
+            cfg, window, edge, batch=batch, max_seq=max_seq
+        )
+        served = _measure_served(engine, batch)
     tok_s = batch * served["tok_s"]  # tps_decoding is per-lane; lanes decode together
 
     # single-chip HBM roofline for decode: read all weights per token
@@ -270,9 +315,18 @@ def main() -> None:
         int(a.size) * a.dtype.itemsize
         for a in jax.tree.leaves((window, edge))
     )
-    metric = "served_decode_tok_s_llama1b_%s_1chip" % (
-        {0: "bf16", 4: "int4", 8: "int8"}[bits]
-    )
+    # --smoke measures a toy config: the metric name must say so (a smoke
+    # number under the llama1b name would be an actively misleading artifact)
+    model_tag = "smoke" if "--smoke" in sys.argv else "llama1b"
+    if mesh_cfg is not None:
+        metric = "served_decode_tok_s_%s_%s_mesh_pp%dtp%d" % (
+            model_tag, {0: "bf16", 4: "int4", 8: "int8"}[bits],
+            mesh_cfg[0], mesh_cfg[1],
+        )
+    else:
+        metric = "served_decode_tok_s_%s_%s_1chip" % (
+            model_tag, {0: "bf16", 4: "int4", 8: "int8"}[bits]
+        )
     if batch > 1:
         metric += f"_b{batch}"
     dev = jax.devices()[0]
@@ -280,8 +334,10 @@ def main() -> None:
         _chip_gen(dev), 819e9
     )
     # weight-bound decode bound: weights are read once per STEP, so N batch
-    # lanes share one read — the aggregate bound scales with batch
-    roofline = batch * hbm_bw / param_bytes
+    # lanes share one read — the aggregate bound scales with batch; a mesh
+    # splits the read across its chips (each reads only its shard)
+    n_chips = mesh_cfg[0] * mesh_cfg[1] if mesh_cfg is not None else 1
+    roofline = batch * n_chips * hbm_bw / param_bytes
     out = {
         "metric": metric,
         "value": round(tok_s, 2),

@@ -152,6 +152,7 @@ class LocalModelManager:
                         kv_quant_bits=kv_quant_bits,
                         weight_quant_bits=self.weight_quant_bits,
                         quant_group=self.weight_quant_group,
+                        prefix_cache_size=self.prefix_cache,
                     )
                     return engine, load_tokenizer(model_dir)
                 if self.batch_slots > 1 and not (dp == 1 and sp == 1):

@@ -151,15 +151,39 @@ class BatchedLocalAdapter(ApiAdapterBase):
         self._task: Optional[asyncio.Task] = None
         self._prefill_tasks: set = set()
 
+    SWEEP_INTERVAL_S = 60.0
+
     async def start(self) -> None:
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="compute")
         self._kick = asyncio.Event()
         self._task = asyncio.ensure_future(self._batch_loop())
+        self._sweep_task = asyncio.ensure_future(self._sweep_loop())
+
+    async def _sweep_loop(self) -> None:
+        """Periodic TTL sweep on the compute thread: a client that vanished
+        without reset_cache must not pin its slot forever (at capacity the
+        pool would reject every new request)."""
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self.SWEEP_INTERVAL_S)
+            if self._executor is None:
+                return
+            try:
+                n = await loop.run_in_executor(
+                    self._executor, self.engine.sweep_sessions
+                )
+                if n:
+                    log.info("TTL sweep freed %d idle sessions", n)
+            except Exception:
+                log.exception("session sweep failed")
 
     async def shutdown(self) -> None:
         if self._task:
             self._task.cancel()
             self._task = None
+        if getattr(self, "_sweep_task", None):
+            self._sweep_task.cancel()
+            self._sweep_task = None
         for t in list(self._prefill_tasks):
             t.cancel()
         self._prefill_tasks.clear()
@@ -197,8 +221,8 @@ class BatchedLocalAdapter(ApiAdapterBase):
                 # chunked prefill: one executor job per chunk, so queued
                 # batched decode steps run BETWEEN chunks — a long prompt
                 # stalls active lanes for at most one chunk's prefill.
-                # (PipelinedMeshEngine has no chunk API yet: it takes the
-                # single-shot _prefill fallback below.)
+                # (PipelinedMeshEngine has no prefill_chunk: its prefill is
+                # a single ring pass, the single-shot fallback below.)
                 task = asyncio.ensure_future(
                     self._prefill_chunked(nonce, list(token_ids), decoding, step)
                 )
@@ -220,7 +244,7 @@ class BatchedLocalAdapter(ApiAdapterBase):
                 )
             )
         else:
-            self._pending[nonce] = (token_ids[-1], decoding, step)
+            self._pending[nonce] = (token_ids[-1], decoding, step, budget)
             self._kick.set()
 
     def _prefill(self, nonce: str, ids: List[int], decoding: DecodingParams, step: int) -> None:
@@ -305,22 +329,26 @@ class BatchedLocalAdapter(ApiAdapterBase):
 
     def _batched_step(self, pending: Dict[str, tuple]) -> None:
         try:
-            reqs = {n: (tok, dec) for n, (tok, dec, _step) in pending.items()}
-            results, errors = self.engine.decode_batch(reqs)
+            reqs = {n: (tok, dec) for n, (tok, dec, _step, _b) in pending.items()}
+            # budgets widen the dispatch where the engine supports fused
+            # multi-rotation chunks (PipelinedMeshEngine): extras buffer
+            # engine-side and resolve later steps without a dispatch
+            budgets = {n: b for n, (_t, _d, _s, b) in pending.items()}
+            results, errors = self.engine.decode_batch(reqs, budgets=budgets)
         except Exception as exc:
             log.exception("batched decode step failed")
-            for nonce, (_tok, _dec, step) in pending.items():
+            for nonce, (_tok, _dec, step, _b) in pending.items():
                 self._futures.resolve(
                     TokenResult(nonce=nonce, token_id=-1, error=str(exc), step=step)
                 )
             return
         for nonce, res in results.items():
-            _tok, dec, step = pending[nonce]
+            _tok, dec, step, _b = pending[nonce]
             self._futures.resolve(
                 self.engine.token_result(nonce, res, step=step, decoding=dec)
             )
         for nonce, msg in errors.items():
-            _tok, _dec, step = pending[nonce]
+            _tok, _dec, step, _b = pending[nonce]
             self._futures.resolve(
                 TokenResult(nonce=nonce, token_id=-1, error=msg, step=step)
             )
@@ -355,10 +383,18 @@ class LocalAdapter(ApiAdapterBase):
         self._ramp: Dict[str, int] = {}  # nonce -> next chunk width
         self._buf_lock = threading.Lock()
 
+    SWEEP_INTERVAL_S = 60.0
+    # same periodic TTL sweep as the batched adapter (one implementation)
+    _sweep_loop = BatchedLocalAdapter._sweep_loop
+
     async def start(self) -> None:
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="compute")
+        self._sweep_task = asyncio.ensure_future(self._sweep_loop())
 
     async def shutdown(self) -> None:
+        if getattr(self, "_sweep_task", None):
+            self._sweep_task.cancel()
+            self._sweep_task = None
         if self._executor:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None

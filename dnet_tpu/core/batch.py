@@ -223,7 +223,9 @@ class BatchedEngine:
         return res
 
     def decode_batch(
-        self, requests: Dict[str, Tuple[int, DecodingParams]]
+        self,
+        requests: Dict[str, Tuple[int, DecodingParams]],
+        budgets: Optional[Dict[str, Optional[int]]] = None,
     ) -> Tuple[Dict[str, SampleResult], Dict[str, str]]:
         """One batched decode step for every (nonce -> last token) request.
         Slots not in `requests` stay frozen (active=False gates their KV

@@ -137,6 +137,82 @@ class MeshEngine:
             self.config.model_type, pp, tp, dp, sp, pp * tp * dp * sp,
         )
 
+    @classmethod
+    def from_params(
+        cls,
+        config: ModelConfig,
+        window_params,
+        edge_params,
+        *,
+        pp: int = 0,
+        tp: int = 1,
+        dp: int = 1,
+        sp: int = 1,
+        batch: int = 1,
+        max_seq: int = 2048,
+        param_dtype: str = "bfloat16",
+        kv_dtype: Optional[str] = None,
+        kv_quant_bits: int = 0,
+        kv_ttl_s: float = 600.0,
+        devices: Optional[Sequence] = None,
+    ) -> "MeshEngine":
+        """Build a mesh engine around already-materialised (host) params —
+        the zero-egress bench path (mirror of LocalEngine.from_params): the
+        serving hot loop and shardings are identical, only weight
+        provenance differs.  Params may already be quantized."""
+        self = cls.__new__(cls)
+        self.ckpt = None
+        self.config = config
+        model_cls = get_ring_model_cls(config.model_type)
+        self.model = model_cls(config, range(config.num_hidden_layers))
+        L = config.num_hidden_layers
+        segmented = getattr(self.model, "ring_phases", 1) > 1
+        if pp <= 0:
+            n_dev = len(list(devices) if devices is not None else jax.devices())
+            pp = max(n_dev // (tp * dp * sp), 1)
+            while pp > 1 and L % pp != 0 and not segmented:
+                pp -= 1
+        if L % pp != 0 and not segmented:
+            raise ValueError(f"pp={pp} must divide num_layers={L}")
+        self.mesh = build_mesh(pp=pp, tp=tp, dp=dp, sp=sp, devices=devices)
+        self.pp, self.tp, self.dp, self.sp = pp, tp, dp, sp
+        self.batch = batch * dp
+        self.max_seq = max_seq
+        self.param_dtype = jnp.dtype(param_dtype)
+        self.kv_dtype = kv_dtype or param_dtype
+        self.kv_quant_bits = kv_quant_bits
+        # params may arrive pre-quantized: detect for honest introspection,
+        # and run the same actionable divisibility check as __init__
+        from dnet_tpu.ops.quant import is_quantized
+
+        quantized = isinstance(window_params, dict) and any(
+            isinstance(v, dict) and is_quantized(v)
+            for v in window_params.values()
+        )
+        self.weight_quant_bits = 8 if quantized else 0
+        self.quant_group = 0
+        self.kv_ttl_s = kv_ttl_s
+        self.sessions = {}
+        self.plan = type("plan", (), {"streams_weights": False, "name": "fit"})()
+        self.prefix_cache = None
+        if isinstance(window_params, dict):
+            self._check_quant_sharding(window_params)
+        m = self.model
+        self._n_kv_layers = len(m.layers)
+        self._host_window = window_params
+        kv0 = m.init_kv(
+            self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
+            quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),
+        )
+        self.window_params, self.edge_params, self._kv_template = place_ring_state(
+            window_params, edge_params, kv0, self.mesh
+        )
+        self._step = make_ring_decode_fn(self.model, self.mesh, self._host_window)
+        self._decode_chunk = make_ring_chunk_fn(
+            self.model, self.mesh, self._host_window
+        )
+        return self
+
     def _check_quant_sharding(self, stacked: dict) -> None:
         """Fail fast with an actionable message when the scale-group axis of
         an in-sharded (row-parallel) weight cannot split over tp — otherwise

@@ -33,6 +33,7 @@ rows and is overwritten by the next prefill.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -56,15 +57,27 @@ def _bcast_from_rank(x, axis_name: str, rank: int):
     return lax.psum(masked, axis_name)
 
 
-def make_rotation_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int = 1):
-    """Build the jitted M-stage-step rotation program.
+def make_rotation_fn(
+    model, mesh: Mesh, window_params, n_slots: int, batch: int = 1,
+    n_steps: Optional[int] = None,
+):
+    """Build the jitted rotation program over `n_steps` stage-steps
+    (default M = one rotation; R*M fuses R rotations into ONE dispatch —
+    the chunked pipelined path: sampled tokens re-enter their slot on
+    device, so the host pays one dispatch + one packed read per R tokens
+    per slot instead of per rotation).
 
     Returned signature:
       (window_params, edge_params, x_state[PP,B,1,D], kv, tokens[M,B],
-       pos_vec[M], pos_state[PP], sp_stack, keys[M,2]u32, counts[M,B,V],
-       real_mask[M]bool, t0)
-      -> (results: SampleResult leaves stacked [M,B,...] in EXIT-STEP order,
-          x_state, kv, tokens, pos_vec, pos_state, keys, counts)
+       pos_vec[M], pos_state[PP], live_state[PP], enter_live[n_steps],
+       sp_stack, keys[M,2]u32, counts[M,B,V], t0)
+      -> (results: SampleResult leaves stacked [n_steps,B,...] in EXIT-STEP
+          order, x_state, kv, tokens, pos_vec, pos_state, live_state, keys,
+          counts)
+
+    enter_live is PER STEP (index j), not per slot: a slot's capacity can
+    flip mid-chunk, and the engine's host-side schedule simulation computes
+    the exact per-step flag.
 
     A token's write position AND its liveness travel WITH its hidden state
     (pos_state / live_state are ppermuted alongside x), because the ring
@@ -79,6 +92,7 @@ def make_rotation_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int 
     """
     PP = mesh.shape[AXIS_PP]
     M, B = n_slots, batch
+    n_steps = M if n_steps is None else n_steps
     has_kinds = getattr(model, "layer_kinds", None) is not None
 
     # x_state mentions AXIS_DP (size 1, enforced by the engine) purely so its
@@ -93,7 +107,7 @@ def make_rotation_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int 
         P(),  # pos_vec [M]
         P(AXIS_PP),  # pos_state [PP]
         P(AXIS_PP),  # live_state [PP] bool
-        P(),  # enter_live [M] bool (slot has a live session)
+        P(),  # enter_live [n_steps] bool (per-step: entry carries a real token)
         P(),  # sp_stack (SampleParams leaves [M])
         P(),  # keys [M, 2] uint32
         P(),  # counts [M, B, V]
@@ -131,7 +145,7 @@ def make_rotation_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int 
             x_in = jnp.where(my_pp == 0, x_embed, x)
             pos_entry = lax.dynamic_index_in_dim(pos_vec, n, keepdims=False)
             pos_in = jnp.where(my_pp == 0, pos_entry, pos_x)
-            live_entry = lax.dynamic_index_in_dim(enter_live, n, keepdims=False)
+            live_entry = lax.dynamic_index_in_dim(enter_live, j, keepdims=False)
             live_entry = lax.pcast(live_entry, AXIS_PP, to="varying")
             live_in = jnp.where(my_pp == 0, live_entry, live_x)
             pos_vec = lax.dynamic_update_index_in_dim(
@@ -201,7 +215,7 @@ def make_rotation_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int 
         (x, pos_x, live_x, kv, tokens, pos_vec, keys, counts), results = lax.scan(
             step,
             (x, pos_x, live_x, kv, tokens, pos_vec, keys, counts),
-            jnp.arange(M, dtype=jnp.int32),
+            jnp.arange(n_steps, dtype=jnp.int32),
         )
         return (results, x[None], kv, tokens, pos_vec, pos_x[None],
                 live_x[None], keys, counts)
@@ -309,6 +323,7 @@ class PipelinedMeshEngine:
         weight_quant_bits: int = 0,
         quant_group: int = 0,
         devices: Optional[Sequence] = None,
+        prefix_cache_size: int = 0,
     ):
         import numpy as np
 
@@ -355,7 +370,12 @@ class PipelinedMeshEngine:
         self.max_seq = max_seq
         self.window_params, self.edge_params = inner.window_params, inner.edge_params
 
-        self._rot = make_rotation_fn(self.model, self.mesh, inner._host_window, M, B)
+        # rotation programs cached per fused-rotation count R (R*M stage
+        # steps per dispatch); R=1 built eagerly, larger chunks on demand
+        self._host_window_ref = inner._host_window
+        self._rot_fns = {
+            1: make_rotation_fn(self.model, self.mesh, inner._host_window, M, B)
+        }
         self._prefill_fn = make_slot_prefill_fn(
             self.model, self.mesh, inner._host_window, M, B
         )
@@ -390,6 +410,19 @@ class PipelinedMeshEngine:
         self._dec: Dict[int, "DecodingParams"] = {}  # slot -> sampling params
         self._entries: Dict[int, list] = {i: [] for i in range(M)}  # entry steps
         self._buffer: Dict[str, list] = {}  # nonce -> ready SampleResults
+        self._last_used: Dict[str, float] = {}  # nonce -> wall time (TTL sweep)
+        self.prefix_cache = None
+        if prefix_cache_size > 0:
+            # snapshots are SLOT-ROW slices of the shared cache ([L, B, S,
+            # ...], mesh-sharded): restore writes the rows back into
+            # whichever slot the new request lands on
+            from dnet_tpu.core.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(prefix_cache_size)
+        # dispatched-but-unread rotation chunks: (deliveries [(j, nonce)],
+        # stacked SampleResult device arrays) — reads drain in dispatch order,
+        # overlapping the next chunk's compute
+        self._pending_rot: list = []
         self._np = np
 
     token_result = None  # set after class body (LocalEngine staticmethod)
@@ -408,11 +441,13 @@ class PipelinedMeshEngine:
         self.slot_of[nonce] = slot
         self._entries[slot] = []
         self._buffer[nonce] = []
+        self._last_used[nonce] = time.time()
         return slot
 
     def end_session(self, nonce: str) -> None:
         slot = self.slot_of.pop(nonce, None)
         self._buffer.pop(nonce, None)
+        self._last_used.pop(nonce, None)
         if slot is not None:
             self._dec.pop(slot, None)
             self._entries[slot] = []
@@ -426,7 +461,17 @@ class PipelinedMeshEngine:
         self.reset()
 
     def sweep_sessions(self, ttl_s: float = 600.0) -> int:
-        return 0  # slots are freed by end_session; no per-slot TTL yet
+        """Free slots whose nonce has been idle past the TTL — a client that
+        disconnected without adapter cleanup must not pin a slot forever
+        (at capacity, _alloc fails for every new request)."""
+        now = time.time()
+        dead = [
+            n for n, t in self._last_used.items()
+            if now - t > ttl_s and n in self.slot_of
+        ]
+        for n in dead:
+            self.end_session(n)
+        return len(dead)
 
     # ---- serving ------------------------------------------------------
     def prefill_and_sample(self, nonce, prompt_ids, decoding) -> SampleResult:
@@ -434,20 +479,44 @@ class PipelinedMeshEngine:
         from dnet_tpu.core.types import DecodingParams  # noqa: F401
 
         np = self._np
-        T = len(prompt_ids)
-        if T == 0:
+        full_ids = list(prompt_ids)
+        T_total = len(full_ids)
+        if T_total == 0:
             raise ValueError("empty prompt")
-        if T >= self.max_seq:
-            raise ValueError(f"prompt length {T} exceeds max_seq {self.max_seq}")
+        if T_total >= self.max_seq:
+            raise ValueError(
+                f"prompt length {T_total} exceeds max_seq {self.max_seq}"
+            )
         slot = self._alloc(nonce)
         B = self.slot_batch
-        Tpad = min(bucket_length(T), self.max_seq)
+        base, rest = 0, full_ids
+        if self.prefix_cache is not None:
+            hit = self.prefix_cache.lookup(full_ids)
+            if hit is not None:
+                base, kv_row = hit  # >= 1 token left by construction
+                self.kv = jax.tree.map(
+                    lambda big, row: big.at[:, slot * B : (slot + 1) * B].set(
+                        row.astype(big.dtype)
+                    ),
+                    self.kv, kv_row,
+                )
+                rest = full_ids[base:]
+        T = len(rest)
+        Tpad = min(bucket_length(T), self.max_seq - base)
         tokens = np.zeros((B, Tpad), dtype=np.int32)
-        tokens[:, :T] = np.asarray(list(prompt_ids), dtype=np.int32)
+        tokens[:, :T] = np.asarray(rest, dtype=np.int32)
         logits, self.kv = self._prefill_fn(
             self.window_params, self.edge_params, jnp.asarray(tokens),
-            self.kv, 0, T - 1, slot,
+            self.kv, base, T - 1, slot,
         )
+        if self.prefix_cache is not None:
+            self.prefix_cache.store(
+                full_ids,
+                jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(a, slot * B, B, axis=1),
+                    self.kv,
+                ),
+            )
         seed = decoding.seed
         if seed is None:
             seed = int.from_bytes(__import__("os").urandom(4), "little")
@@ -461,7 +530,7 @@ class PipelinedMeshEngine:
         counts0 = counts0.at[jnp.arange(B), res.token].add(1)
         # inject: the sampled token is this slot's first pipeline entry
         self.tokens = self.tokens.at[slot].set(res.token)
-        self.pos_vec = self.pos_vec.at[slot].set(T)
+        self.pos_vec = self.pos_vec.at[slot].set(T_total)
         self.keys = self.keys.at[slot].set(jax.random.key_data(key))
         self.counts = self.counts.at[slot].set(counts0)
         # kill the slot's stale in-flight token: between rotations, rank r
@@ -470,7 +539,7 @@ class PipelinedMeshEngine:
         r_star = (self.t0 - slot) % self.n_slots
         if r_star < self.pp:
             self.live_state = self.live_state.at[r_star].set(False)
-        self.slot_pos[slot] = T
+        self.slot_pos[slot] = T_total
         self._dec[slot] = decoding
         return res
 
@@ -493,55 +562,93 @@ class PipelinedMeshEngine:
             jnp.asarray(min_p), jnp.asarray(rep),
         )
 
-    def _rotate(self) -> None:
+    # fused-rotation widths tried largest-first (one compiled program per
+    # width actually used, same bounded-bucket discipline as
+    # LocalEngine.DECODE_CHUNK_BUCKETS)
+    ROTATION_BUCKETS = (8, 4, 2, 1)
+
+    def _rot_fn(self, R: int):
+        fn = self._rot_fns.get(R)
+        if fn is None:
+            fn = make_rotation_fn(
+                self.model, self.mesh, self._host_window_ref,
+                self.n_slots, self.slot_batch, n_steps=R * self.n_slots,
+            )
+            self._rot_fns[R] = fn
+        return fn
+
+    def _dispatch_chunk(self, R: int) -> None:
+        """Dispatch (async) R fused rotations: R*M stage-steps, one XLA
+        program, sampled tokens re-entering their slots on device.  The
+        delivery schedule (which exit step belongs to which nonce) is
+        simulated host-side at dispatch time — it depends only on the entry
+        bookkeeping, never on token VALUES, so the packed results can be
+        read later (overlapping the next chunk's compute)."""
         np = self._np
         M, PP = self.n_slots, self.pp
         nonce_of = {s: n for n, s in self.slot_of.items()}
-        # simulate the rotation's schedule on the host: which exits carry a
-        # real token (entered exactly PP-1 steps earlier) and which entries
-        # occur — this mirrors the device-side live-flag propagation, so the
-        # delivery mapping stays exact
         sim = {m: list(self._entries[m]) for m in range(M)}
-        deliveries = []  # (step index j, slot)
-        for j in range(M):
+        pos_sim = self.slot_pos.copy()
+        deliveries = []  # (step index j, nonce at dispatch time)
+        n_steps = R * M
+        enter_live = np.zeros(n_steps, dtype=bool)
+        for j in range(n_steps):
             t = self.t0 + j
             e_slot = (t - (PP - 1)) % M
             ent = sim[e_slot]
             if ent and ent[0] == t - (PP - 1):
                 ent.pop(0)
-                deliveries.append((j, e_slot))
+                if e_slot in nonce_of:
+                    deliveries.append((j, nonce_of[e_slot]))
             n_slot = t % M
-            # live slots below capacity feed one real token per step (must
-            # mirror the enter_live mask computed below)
-            if n_slot in nonce_of and self.slot_pos[n_slot] < self.max_seq:
+            # a live slot below capacity feeds one real token this step; the
+            # device consumes enter_live[j] at exactly this point in its scan
+            if n_slot in nonce_of and pos_sim[n_slot] < self.max_seq:
+                enter_live[j] = True
                 sim[n_slot].append(t)
-        # a slot at capacity must stop ENTERING (its next token would write
-        # past max_seq); its already-buffered tokens stay deliverable
-        enter_live = np.zeros(M, dtype=bool)
-        for m in nonce_of:
-            enter_live[m] = self.slot_pos[m] < self.max_seq
+            # pos_vec advances unconditionally at the entry step (device
+            # mirrors this); gated KV commits make the dead-slot write inert
+            pos_sim[n_slot] += 1
         (results, self.x_state, self.kv, self.tokens, self.pos_vec,
-         self.pos_state, self.live_state, self.keys, self.counts) = self._rot(
+         self.pos_state, self.live_state, self.keys, self.counts) = self._rot_fn(R)(
             self.window_params, self.edge_params, self.x_state, self.kv,
             self.tokens, self.pos_vec, self.pos_state, self.live_state,
             jnp.asarray(enter_live), self._sp_stack(), self.keys, self.counts,
             self.t0,
         )
-        toks = np.asarray(results.token)
-        lps = np.asarray(results.logprob)
-        tts = np.asarray(results.top_tokens)
-        tlps = np.asarray(results.top_logprobs)
-        for j, slot in deliveries:
-            nonce = nonce_of.get(slot)
-            if nonce is not None and nonce in self._buffer:
-                self._buffer[nonce].append(
-                    SampleResult(toks[j], lps[j], tts[j], tlps[j])
-                )
+        self._pending_rot.append((deliveries, results))
         self._entries = sim
-        self.slot_pos += 1  # device pos_vec advanced once per slot (at entry)
-        self.t0 += M
+        self.slot_pos += R  # one entry per slot per rotation
+        self.t0 += n_steps
 
-    def decode_batch(self, requests) -> Tuple[Dict[str, SampleResult], Dict[str, str]]:
+    def _drain_pending(self) -> None:
+        """Read every dispatched-but-unread chunk (ONE packed device->host
+        transfer per chunk) and route tokens to their nonce buffers.  A
+        nonce that ended between dispatch and drain has no buffer entry —
+        its tokens are dropped, exactly like LocalAdapter's aborted-chunk
+        leftovers."""
+        np = self._np
+        while self._pending_rot:
+            deliveries, results = self._pending_rot.pop(0)
+            toks = np.asarray(results.token)
+            lps = np.asarray(results.logprob)
+            tts = np.asarray(results.top_tokens)
+            tlps = np.asarray(results.top_logprobs)
+            for j, nonce in deliveries:
+                if nonce in self._buffer:
+                    self._buffer[nonce].append(
+                        SampleResult(toks[j], lps[j], tts[j], tlps[j])
+                    )
+
+    def decode_batch(
+        self, requests, budgets: Optional[Dict[str, Optional[int]]] = None
+    ) -> Tuple[Dict[str, SampleResult], Dict[str, str]]:
+        """One result per requested nonce; `budgets` (nonce -> remaining
+        tokens the driver will accept, None = unknown) widens the dispatch:
+        R fused rotations produce R tokens per slot in one program, the
+        extras resolving later decode_batch calls instantly from the
+        buffers.  Without budgets the behavior is the r2 one-rotation step.
+        """
         errors: Dict[str, str] = {}
         order: Dict[str, int] = {}
         for nonce, (_tok, dec) in requests.items():
@@ -551,23 +658,44 @@ class PipelinedMeshEngine:
                 continue
             self._dec[slot] = dec
             order[nonce] = slot
+            self._last_used[nonce] = time.time()
         if not order:
             return {}, errors
 
         def can_progress(nonce: str) -> bool:
-            """More tokens can still arrive: capacity to enter, or in flight."""
+            """More tokens can still arrive: capacity to enter, in flight,
+            or dispatched-but-unread."""
             slot = order[nonce]
             return (
-                self.slot_pos[slot] < self.max_seq or bool(self._entries[slot])
+                self.slot_pos[slot] < self.max_seq
+                or bool(self._entries[slot])
+                or bool(self._pending_rot)
             )
+
+        def pick_R(missing) -> int:
+            """Largest fused-rotation width no request would overshoot:
+            bounded by the smallest remaining budget MINUS that nonce's
+            in-flight ring entries (each will deliver a token before any new
+            entry from this chunk does) and by seq capacity."""
+            if not budgets:
+                return 1
+            cap = min(
+                max((budgets.get(n) or 1) - len(self._entries[order[n]]), 1)
+                for n in missing
+            )
+            cap = min(cap, *(int(self.max_seq - self.slot_pos[order[n]])
+                             for n in missing))
+            return next((b for b in self.ROTATION_BUCKETS if b <= cap), 1)
 
         # steady state: one rotation yields one token per active slot; a
         # freshly prefilled slot needs a second (its first entry is mid-ring)
         for _ in range(3):
+            self._drain_pending()
             missing = [n for n in order if not self._buffer.get(n)]
             if not missing or not any(can_progress(n) for n in missing):
                 break
-            self._rotate()
+            self._dispatch_chunk(pick_R(missing))
+        self._drain_pending()
         out: Dict[str, SampleResult] = {}
         for nonce, slot in order.items():
             buf = self._buffer.get(nonce)
@@ -600,7 +728,10 @@ class PipelinedMeshEngine:
         for step in range(1, max_tokens):
             if self.slot_pos[self.slot_of[nonce]] >= self.max_seq:
                 break
-            res_map, errs = self.decode_batch({nonce: (token, decoding)})
+            res_map, errs = self.decode_batch(
+                {nonce: (token, decoding)},
+                budgets={nonce: max_tokens - step},
+            )
             if errs:
                 raise RuntimeError(errs[nonce])
             row = res_map[nonce]

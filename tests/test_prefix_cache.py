@@ -204,7 +204,7 @@ def test_chunked_prefill_interleaves_with_decode(tiny_llama_dir):
         events.append("chunk")
         return orig_chunk(nonce, ids, seed)
 
-    def decode_spy(reqs):
+    def decode_spy(reqs, budgets=None):
         events.append("decode")
         return orig_decode(reqs)
 
@@ -240,3 +240,26 @@ def test_chunked_prefill_interleaves_with_decode(tiny_llama_dir):
     last_chunk = len(events) - 1 - events[::-1].index("chunk")
     between = events[first_chunk:last_chunk]
     assert "decode" in between, f"no decode interleaved: {events}"
+
+
+def test_pipelined_engine_prefix_cache(tiny_llama_dir, eight_devices):
+    """Slot-row snapshot/restore: a second request extending a cached prompt
+    prefills only the suffix and produces the identical stream."""
+    from dnet_tpu.core.types import DecodingParams
+    from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
+
+    eng = PipelinedMeshEngine(
+        tiny_llama_dir, pp=2, tp=1, slots=2, max_seq=64, param_dtype="float32",
+        prefix_cache_size=4,
+    )
+    dec = DecodingParams(temperature=0.0)
+    base = [256] + list(range(60, 76))  # >= min_tokens so the snapshot lands
+    ext = base + [101, 102]
+    cold = [r.token_id for r in eng.generate(ext, dec, max_tokens=6, nonce="c")]
+    # prime the cache with the base prompt, then extend it: the warm request
+    # must restore base's slot rows and prefill only the 2-token suffix
+    list(eng.generate(base, dec, max_tokens=1, nonce="p"))
+    assert eng.prefix_cache.stats["stores"] >= 1
+    warm = [r.token_id for r in eng.generate(ext, dec, max_tokens=6, nonce="w")]
+    assert eng.prefix_cache.stats["hits"] >= 1
+    assert warm == cold
