@@ -50,13 +50,17 @@ struct Span {
   uint64_t len;
 };
 
-std::mutex g_mu;
-std::unordered_map<int, Mapping> g_maps;
+// Intentionally leaked: the detached worker may still be running when
+// exit() destroys statics — it blocks on the cv (glibc pthread_cond_destroy
+// waits for waiters: deadlock) and locks g_mu / reads g_maps via lookup()
+// (use-after-destroy).  Never destructing any of them keeps exit safe.
+std::mutex& g_mu = *new std::mutex();
+std::unordered_map<int, Mapping>& g_maps = *new std::unordered_map<int, Mapping>();
 int g_next_handle = 1;
 
-std::mutex g_q_mu;
-std::condition_variable g_q_cv;
-std::deque<Span> g_queue;
+std::mutex& g_q_mu = *new std::mutex();
+std::condition_variable& g_q_cv = *new std::condition_variable();
+std::deque<Span>& g_queue = *new std::deque<Span>();
 std::atomic<int> g_pending{0};
 std::atomic<bool> g_worker_up{false};
 
