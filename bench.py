@@ -354,21 +354,40 @@ def main() -> None:
 
 
 def _compress_microbench() -> dict:
-    """DCN wire-format round-trip rates (smoke mode only)."""
+    """DCN wire-format round-trip rates (smoke mode only).  The receive
+    side is measured BOTH ways — host numpy decompress vs device-side
+    dequant+scatter (the serving path) — so the artifact shows the
+    receive-side improvement."""
+    import jax
     import numpy as np
 
-    from dnet_tpu.compression import compress_tensor, decompress_tensor
+    from dnet_tpu.compression import (
+        compress_tensor,
+        decompress_tensor,
+        decompress_tensor_device,
+    )
 
     x = np.random.default_rng(0).normal(size=(1, 64, 2048)).astype(np.float32)
     out = {}
     for name, bits in (("sparse_v1", 0), ("qsparse8_v1", 8)):
         p, d, s = compress_tensor(x, 0.5, quant_bits=bits)  # warm
+        jax.block_until_ready(decompress_tensor_device(p, d, s))  # compile
         t0 = time.perf_counter()
         for _ in range(5):
             p, d, s = compress_tensor(x, 0.5, quant_bits=bits)
             decompress_tensor(p, d, s)
         dt = (time.perf_counter() - t0) / 5
+        t0 = time.perf_counter()
+        for _ in range(5):
+            decompress_tensor(p, d, s)
+        host_ms = (time.perf_counter() - t0) / 5 * 1000
+        t0 = time.perf_counter()
+        for _ in range(5):
+            jax.block_until_ready(decompress_tensor_device(p, d, s))
+        dev_ms = (time.perf_counter() - t0) / 5 * 1000
         out[f"{name}_roundtrip_ms"] = round(dt * 1000, 2)
+        out[f"{name}_recv_host_ms"] = round(host_ms, 2)
+        out[f"{name}_recv_device_ms"] = round(dev_ms, 2)
         out[f"{name}_ratio"] = round(x.nbytes / len(p), 2)
     return out
 

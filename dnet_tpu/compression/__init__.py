@@ -16,6 +16,7 @@ from dnet_tpu.compression.ops import (
 from dnet_tpu.compression.wire import (
     compress_tensor,
     decompress_tensor,
+    decompress_tensor_device,
     is_compressed_dtype,
 )
 
@@ -26,5 +27,6 @@ __all__ = [
     "scatter_columns",
     "compress_tensor",
     "decompress_tensor",
+    "decompress_tensor_device",
     "is_compressed_dtype",
 ]
