@@ -46,9 +46,42 @@ model_catalog: List[CatalogEntry] = [
     # GPT-OSS MoE (20B/120B in reference catalog)
     CatalogEntry("openai/gpt-oss-20b", "gpt_oss", 20.9, 24, notes="MoE 32x, SWA alternating"),
     CatalogEntry("openai/gpt-oss-120b", "gpt_oss", 116.8, 36, notes="MoE 128x, SWA alternating"),
+    CatalogEntry("meta-llama/Llama-3.1-70B-Instruct", "llama", 70.6, 80),
     # DeepSeek-V2 arch (MLA)
     CatalogEntry("deepseek-ai/DeepSeek-V2-Lite-Chat", "deepseek_v2", 15.7, 27, notes="MLA"),
 ]
+
+
+def expanded_catalog() -> List[CatalogEntry]:
+    """One row per (model, quant variant) — the reference enumerates each
+    quant variant as its own catalog entry (src/dnet/api/catalog.py:4-175,
+    e.g. Qwen3-4B-MLX-{bf16,8bit,4bit}); here a variant is the same bf16
+    checkpoint served through ops/quant, addressed as `<id>:<variant>`
+    (resolve_variant).  The base id (implicit bf16) is listed too."""
+    out: List[CatalogEntry] = []
+    for e in model_catalog:
+        out.append(e)
+        for v in e.quant_variants:
+            out.append(
+                CatalogEntry(
+                    f"{e.id}:{v}", e.arch, e.params_b, e.n_layers,
+                    ci_test=False,
+                    notes=(e.notes + " " if e.notes else "") + f"{v} weights",
+                    quant_variants=(),
+                )
+            )
+    return out
+
+
+def split_variant(model_id: str) -> tuple:
+    """`<model>[:<quant>]` -> (base_id, weight_quant_bits | None).
+
+    Catalog-independent so `:int8` also works on local checkpoint dirs;
+    unknown suffixes are treated as part of the id (returns (id, None))."""
+    base, sep, variant = model_id.rpartition(":")
+    if sep and variant in QUANT_BITS:
+        return base, QUANT_BITS[variant]
+    return model_id, None
 
 
 def find_entry(model_id: str) -> Optional[CatalogEntry]:

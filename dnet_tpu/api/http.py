@@ -221,7 +221,11 @@ class ApiHTTPServer:
         )
 
     async def list_models(self, request: web.Request) -> web.Response:
-        data = [ModelInfo(id=e.id) for e in model_catalog]
+        # quant-variant aliases listed alongside base ids (reference-style
+        # per-variant catalog rows; `<id>:int8` resolves via resolve_variant)
+        from dnet_tpu.api.catalog import expanded_catalog
+
+        data = [ModelInfo(id=e.id) for e in expanded_catalog()]
         loaded = self.model_manager.current_model_id
         if loaded and all(m.id != loaded for m in data):
             data.append(ModelInfo(id=loaded))

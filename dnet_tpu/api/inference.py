@@ -100,6 +100,7 @@ class InferenceManager:
             top_k=req.top_k,
             min_p=req.min_p,
             repetition_penalty=req.repetition_penalty,
+            min_tokens_to_keep=req.min_tokens_to_keep,
             logprobs=req.logprobs_enabled,
             top_logprobs=req.top_logprobs,
             seed=req.seed,
@@ -163,6 +164,7 @@ class InferenceManager:
         pending = ""  # emitted-text buffer held back for stop-seq matching
         held_entries: list = []  # logprob entries for held-back tokens
         emitted_ahead = 0  # emitted chars owned by the oldest held entry
+        first_chunk = True  # first streamed delta carries role=assistant
         stopped_by_seq = False
 
         await self.adapter.reset_cache(nonce)
@@ -248,10 +250,17 @@ class InferenceManager:
                         model=req.model,
                         choices=[
                             ChatStreamChoice(
-                                delta=ChatChoiceDelta(content=delta), logprobs=logprobs
+                                # the FIRST delta carries the role, as the
+                                # OpenAI stream protocol (and client) expect
+                                delta=ChatChoiceDelta(
+                                    role=("assistant" if first_chunk else None),
+                                    content=delta,
+                                ),
+                                logprobs=logprobs,
                             )
                         ],
                     )
+                    first_chunk = False
                 if stopped:
                     finish_reason = "stop"
                     stopped_by_seq = True
@@ -272,10 +281,15 @@ class InferenceManager:
                     model=req.model,
                     choices=[
                         ChatStreamChoice(
-                            delta=ChatChoiceDelta(content=tail), logprobs=logprobs
+                            delta=ChatChoiceDelta(
+                                role=("assistant" if first_chunk else None),
+                                content=tail,
+                            ),
+                            logprobs=logprobs,
                         )
                     ],
                 )
+                first_chunk = False
 
             t_end = time.perf_counter()
             usage = Usage(
@@ -299,7 +313,17 @@ class InferenceManager:
             yield ChatCompletionChunk(
                 id=rid,
                 model=req.model,
-                choices=[ChatStreamChoice(finish_reason=finish_reason)],
+                choices=[
+                    ChatStreamChoice(
+                        # a stream with zero content deltas (immediate EOS /
+                        # whole output held back by a stop-seq) still owes
+                        # the client the initial role chunk
+                        delta=ChatChoiceDelta(
+                            role=("assistant" if first_chunk else None)
+                        ),
+                        finish_reason=finish_reason,
+                    )
+                ],
                 usage=usage,
                 metrics=metrics,
             )

@@ -74,14 +74,31 @@ class LocalModelManager:
         return self.inference.model_id
 
     def is_model_available(self, model_id: str) -> bool:
-        return resolve_model_dir(model_id, self.models_dir) is not None
+        from dnet_tpu.api.catalog import split_variant
+
+        return resolve_model_dir(split_variant(model_id)[0], self.models_dir) is not None
 
     async def load_model(self, model_id: str, max_seq: Optional[int] = None) -> float:
-        """Returns load time in seconds; raises on failure."""
-        model_dir = resolve_model_dir(model_id, self.models_dir)
+        """Returns load time in seconds; raises on failure.
+
+        `<id>:int8` / `<id>:int4` quant-variant aliases (catalog rows the
+        reference enumerates per model, src/dnet/api/catalog.py:4-175) load
+        the BASE checkpoint with weight-only quantization overridden."""
+        from dnet_tpu.api.catalog import split_variant
+
+        base_id, variant_bits = split_variant(model_id)
+        model_dir = resolve_model_dir(base_id, self.models_dir)
         if model_dir is None:
             raise FileNotFoundError(
                 f"model {model_id!r} not found locally (models_dir={self.models_dir})"
+            )
+        wq_bits = self.weight_quant_bits if variant_bits is None else variant_bits
+        wq_group = self.weight_quant_group
+        if variant_bits:
+            from dnet_tpu.ops.quant import DEFAULT_GROUP, DEFAULT_GROUP_Q4
+
+            wq_group = wq_group or (
+                DEFAULT_GROUP_Q4 if variant_bits == 4 else DEFAULT_GROUP
             )
         t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
@@ -150,8 +167,8 @@ class LocalModelManager:
                         param_dtype=self.param_dtype,
                         kv_dtype=kv_dtype,
                         kv_quant_bits=kv_quant_bits,
-                        weight_quant_bits=self.weight_quant_bits,
-                        quant_group=self.weight_quant_group,
+                        weight_quant_bits=wq_bits,
+                        quant_group=wq_group,
                         prefix_cache_size=self.prefix_cache,
                     )
                     return engine, load_tokenizer(model_dir)
@@ -172,8 +189,8 @@ class LocalModelManager:
                     param_dtype=self.param_dtype,
                     kv_dtype=kv_dtype,
                     kv_quant_bits=kv_quant_bits,
-                    weight_quant_bits=self.weight_quant_bits,
-                    quant_group=self.weight_quant_group,
+                    weight_quant_bits=wq_bits,
+                    quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
                 )
                 # the mesh chunk programs (K-step full-ring scans) are the
@@ -190,8 +207,8 @@ class LocalModelManager:
                     param_dtype=self.param_dtype,
                     kv_dtype=kv_dtype,
                     kv_quant_bits=kv_quant_bits,
-                    weight_quant_bits=self.weight_quant_bits,
-                    weight_quant_group=self.weight_quant_group,
+                    weight_quant_bits=wq_bits,
+                    weight_quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
                 )
             else:
@@ -203,8 +220,8 @@ class LocalModelManager:
                     param_dtype=self.param_dtype,
                     kv_dtype=kv_dtype,
                     kv_quant_bits=kv_quant_bits,
-                    weight_quant_bits=self.weight_quant_bits,
-                    weight_quant_group=self.weight_quant_group,
+                    weight_quant_bits=wq_bits,
+                    weight_quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
                 )
                 # compile the chunked decode widths now, not mid-stream on

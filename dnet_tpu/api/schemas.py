@@ -42,6 +42,9 @@ class SamplingRequest(BaseModel):
     top_k: int = Field(default=0, ge=0)
     min_p: float = Field(default=0.0, ge=0.0, le=1.0)
     repetition_penalty: float = Field(default=1.0, gt=0.0)
+    # filters never shrink the candidate set below this (reference
+    # DecodingConfig.min_tokens_to_keep, core/decoding/config.py:4-14)
+    min_tokens_to_keep: int = Field(default=1, ge=1)
     max_tokens: Optional[int] = Field(default=None, ge=1)
     max_completion_tokens: Optional[int] = Field(default=None, ge=1)
     stream: bool = False

@@ -109,7 +109,7 @@ class BatchedEngine:
             return res, kv, counts, key
 
         kv_axes = jax.tree.map(lambda _: 1, self.kv)
-        sp_axes = SampleParams(0, 0, 0, 0, 0)
+        sp_axes = SampleParams(0, 0, 0, 0, 0, 0)
         self._step = jax.jit(
             jax.vmap(
                 one,
@@ -243,6 +243,7 @@ class BatchedEngine:
         top_k = np.zeros(self.slots, dtype=np.int32)
         min_p = np.zeros(self.slots, dtype=np.float32)
         rep = np.ones(self.slots, dtype=np.float32)
+        mtk = np.ones(self.slots, dtype=np.int32)
         order: Dict[str, int] = {}
         for nonce, (tok, dec) in requests.items():
             slot = self.slot_of.get(nonce)
@@ -262,6 +263,7 @@ class BatchedEngine:
             top_k[slot] = dec.top_k
             min_p[slot] = dec.min_p
             rep[slot] = dec.repetition_penalty
+            mtk[slot] = dec.min_tokens_to_keep
             order[nonce] = slot
         if not order:
             return {}, errors
@@ -272,6 +274,7 @@ class BatchedEngine:
             top_k=jnp.asarray(top_k),
             min_p=jnp.asarray(min_p),
             repetition_penalty=jnp.asarray(rep),
+            min_tokens_to_keep=jnp.asarray(mtk),
         )
         res, self.kv, self.counts, self.keys = self._step(
             self.eng.window_params,

@@ -30,6 +30,10 @@ class SampleParams(NamedTuple):
     top_k: jnp.ndarray  # int32; 0 disables
     min_p: jnp.ndarray
     repetition_penalty: jnp.ndarray  # 1.0 disables
+    # filters may never shrink the candidate set below this many tokens
+    # (reference: min_tokens_to_keep, core/decoding/config.py:4-14, passed
+    # through make_sampler); 1 = only the argmax is guaranteed
+    min_tokens_to_keep: jnp.ndarray  # int32
 
     @classmethod
     def from_decoding(cls, d: DecodingParams) -> "SampleParams":
@@ -39,6 +43,7 @@ class SampleParams(NamedTuple):
             top_k=jnp.int32(d.top_k),
             min_p=jnp.float32(d.min_p),
             repetition_penalty=jnp.float32(d.repetition_penalty),
+            min_tokens_to_keep=jnp.int32(d.min_tokens_to_keep),
         )
 
 
@@ -152,8 +157,9 @@ def sample(
             keep_minp = probs >= params.min_p * pmax
 
             keep = keep_topk & keep_topp & keep_minp
-            # never mask everything: rank-0 always kept
-            keep = keep | (ranks == 0)
+            # never mask below min_tokens_to_keep candidates (>= 1: the
+            # argmax always survives)
+            keep = keep | (ranks < jnp.maximum(params.min_tokens_to_keep, 1))
             masked = jnp.where(keep, scaled, -jnp.inf)
         else:
             masked = scaled

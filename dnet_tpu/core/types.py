@@ -33,6 +33,9 @@ class DecodingParams:
     top_k: int = 0
     min_p: float = 0.0
     repetition_penalty: float = 1.0
+    # top-p/min-p/top-k may never filter below this many candidates
+    # (reference: core/decoding/config.py:4-14)
+    min_tokens_to_keep: int = 1
     logprobs: bool = False
     top_logprobs: int = 0
     seed: Optional[int] = None

@@ -551,15 +551,17 @@ class PipelinedMeshEngine:
         top_k = np.zeros(M, dtype=np.int32)
         min_p = np.zeros(M, dtype=np.float32)
         rep = np.ones(M, dtype=np.float32)
+        mtk = np.ones(M, dtype=np.int32)
         for slot, dec in self._dec.items():
             temp[slot] = dec.temperature
             top_p[slot] = dec.top_p
             top_k[slot] = dec.top_k
             min_p[slot] = dec.min_p
             rep[slot] = dec.repetition_penalty
+            mtk[slot] = dec.min_tokens_to_keep
         return SampleParams(
             jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(top_k),
-            jnp.asarray(min_p), jnp.asarray(rep),
+            jnp.asarray(min_p), jnp.asarray(rep), jnp.asarray(mtk),
         )
 
     # fused-rotation widths tried largest-first (one compiled program per

@@ -95,6 +95,33 @@ def test_invalid_body_400():
     run(go())
 
 
+def test_load_quant_variant_alias(tiny_llama_dir):
+    """`<id>:int8` (the catalog's quant-variant rows, also listed by
+    /v1/models) must load the BASE checkpoint served with int8 weights."""
+
+    async def go():
+        _, manager, server = make_stack()
+        client = await client_for(server)
+        r = await client.post(
+            "/v1/load_model", json={"model": f"{tiny_llama_dir}:int8"}
+        )
+        assert r.status == 200, await r.text()
+        assert manager.engine.weight_quant_bits == 8
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": f"{tiny_llama_dir}:int8",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2,
+                "temperature": 0,
+            },
+        )
+        assert r.status == 200, await r.text()
+        await client.close()
+
+    run(go())
+
+
 def test_load_and_chat_nonstreaming(tiny_llama_dir):
     async def go():
         _, _, server = make_stack()

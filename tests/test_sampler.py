@@ -84,3 +84,34 @@ def test_repetition_penalty():
     counts = jnp.asarray([[1, 1, 0]], dtype=jnp.int32)
     out = apply_repetition_penalty(logits, counts, jnp.float32(2.0))
     np.testing.assert_allclose(np.asarray(out[0]), [1.0, -4.0, 1.0])
+
+
+def test_min_tokens_to_keep_overrides_filters():
+    """Aggressive top-p/min-p must still leave min_tokens_to_keep candidates
+    reachable (reference DecodingConfig.min_tokens_to_keep)."""
+    import collections
+
+    import jax
+    import jax.numpy as jnp
+
+    from dnet_tpu.core.sampler import SampleParams, sample
+    from dnet_tpu.core.types import DecodingParams
+
+    # one dominant logit: top_p=0.01 would keep ONLY it; mtk=3 must keep 3
+    logits = jnp.asarray([[10.0, 9.9, 9.8, -50.0, -50.0]])
+    seen = set()
+    for i in range(40):
+        sp = SampleParams.from_decoding(
+            DecodingParams(temperature=1.0, top_p=0.01, min_tokens_to_keep=3)
+        )
+        res = sample(logits, sp, jax.random.key(i))
+        seen.add(int(res.token[0]))
+    assert seen == {0, 1, 2}, seen  # all three survivors sampled, no others
+
+    # default mtk=1 keeps only the argmax under the same top_p
+    seen1 = set()
+    for i in range(20):
+        sp = SampleParams.from_decoding(DecodingParams(temperature=1.0, top_p=0.01))
+        res = sample(logits, sp, jax.random.key(i))
+        seen1.add(int(res.token[0]))
+    assert seen1 == {0}
