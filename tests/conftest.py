@@ -20,6 +20,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's dominant cost is XLA compiles
+# (hundreds of tiny programs, recompiled identically every run).  With the
+# cache warm, repeat runs skip nearly all of them; CI restores the directory
+# between jobs (.github/workflows/ci.yml).
+_jax_cache = os.environ.get(
+    "DNET_TEST_JAX_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
+if _jax_cache != "off":
+    jax.config.update("jax_compilation_cache_dir", _jax_cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -11,6 +11,8 @@ from dnet_tpu.config import (
 )
 
 
+pytestmark = pytest.mark.core
+
 def test_defaults():
     s = Settings()
     assert s.grpc.max_message_mb == 64

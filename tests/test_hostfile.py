@@ -5,6 +5,8 @@ import pytest
 from dnet_tpu.utils.hostfile import StaticDiscovery, load_hostfile
 
 
+pytestmark = pytest.mark.core
+
 def test_ssh_style(tmp_path):
     hf = tmp_path / "hostfile"
     hf.write_text(

@@ -11,6 +11,8 @@ from dnet_tpu.utils.serialization import (
 )
 
 
+pytestmark = pytest.mark.core
+
 def test_roundtrip_f32():
     x = np.arange(12, dtype=np.float32).reshape(3, 4)
     payload, dt, shape = tensor_to_bytes(x)
