@@ -53,6 +53,7 @@ class LocalModelManager:
         kv_bits: int = 0,
         batch_slots: int = 1,
         prefix_cache: int = 0,
+        spec_lookahead: int = 0,
     ) -> None:
         self.inference = inference_manager
         self.models_dir = models_dir
@@ -63,6 +64,7 @@ class LocalModelManager:
         self.kv_bits = kv_bits
         self.batch_slots = batch_slots
         self.prefix_cache = prefix_cache
+        self.spec_lookahead = spec_lookahead
         # active when any axis is parallel or pp is left to infer (pp=0 with
         # another axis set, or an explicit pp)
         self.mesh = mesh if mesh and (any(v > 1 for v in mesh.values()) or mesh.get("pp", 0) > 1) else None
@@ -154,6 +156,12 @@ class LocalModelManager:
                             "DNET_API_PREFIX_CACHE is not supported by the "
                             "pipelined mesh engine; disabled"
                         )
+                    if self.spec_lookahead:
+                        log.warning(
+                            "DNET_API_SPEC_LOOKAHEAD is not supported by the "
+                            "pipelined mesh engine (per-slot acceptance "
+                            "lengths diverge); disabled"
+                        )
                     # staggered-microbatch pipeline: batch_slots concurrent
                     # sequences keep every pp rank busy every stage-step
                     from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
@@ -192,12 +200,19 @@ class LocalModelManager:
                     weight_quant_bits=wq_bits,
                     quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
+                    spec_lookahead=self.spec_lookahead,
                 )
                 # the mesh chunk programs (K-step full-ring scans) are the
                 # most expensive compiles in the codebase: do them now, not
                 # mid-stream on the first request's ramp
                 engine.warm_chunks()
             elif self.batch_slots > 1:
+                if self.spec_lookahead:
+                    log.warning(
+                        "DNET_API_SPEC_LOOKAHEAD is not supported with "
+                        "batch_slots>1 (per-lane acceptance lengths "
+                        "diverge); disabled"
+                    )
                 from dnet_tpu.core.batch import BatchedEngine
 
                 engine = BatchedEngine(
@@ -223,6 +238,7 @@ class LocalModelManager:
                     weight_quant_bits=wq_bits,
                     weight_quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
+                    spec_lookahead=self.spec_lookahead,
                 )
                 # compile the chunked decode widths now, not mid-stream on
                 # the first request's ramp

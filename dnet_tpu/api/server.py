@@ -50,6 +50,7 @@ async def serve_async(args) -> None:
         kv_bits=s.kv.bits,
         batch_slots=batch_slots,
         prefix_cache=s.api.prefix_cache,
+        spec_lookahead=s.api.spec_lookahead,
     )
 
     cluster_manager = None

@@ -234,6 +234,10 @@ class ApiSettings(_EnvGroup):
     # prefills only the new suffix (core/prefix_cache.py).  Exact-prefix
     # match; each snapshot is a full KV alloc.  Local/batched engines only.
     prefix_cache: int = 0
+    # >0 = prompt-lookup speculative decoding: draft that many tokens per
+    # verify forward (core/spec.py).  Greedy-exact; eligible requests emit
+    # 1..L+1 tokens per weight read.  Local and mesh engines (batch 1).
+    spec_lookahead: int = 0
 
 
 @dataclass

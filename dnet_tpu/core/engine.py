@@ -598,19 +598,7 @@ class LocalEngine:
                 sess = self.new_session(nonce, seed)
         else:
             fresh = sess.pos == 0  # explicit chunked continuation
-        if self.spec_lookahead > 0 and sess.hist is not None:
-            # commit the prompt to the spec history buffer; on a prefix-cache
-            # hit write the FULL prompt at 0 (the cached tokens were never
-            # fed through THIS session)
-            n_cached = len(full_ids) - len(prompt_ids)
-            ids = jnp.asarray(
-                np.broadcast_to(
-                    np.asarray(full_ids, dtype=np.int32), (self.batch, len(full_ids))
-                )
-            )
-            sess.hist = jax.lax.dynamic_update_slice_in_dim(
-                sess.hist, ids, sess.pos - n_cached, axis=1
-            )
+        self._commit_prompt_hist(sess, full_ids, prompt_ids)
         T = len(prompt_ids)
         # the PADDED width must also fit — dynamic_update_slice would clamp
         # the start index and silently shift the whole KV write otherwise
@@ -714,6 +702,23 @@ class LocalEngine:
         return res
 
     # ---- speculative decoding ----------------------------------------
+    def _commit_prompt_hist(self, sess, full_ids, prompt_ids) -> None:
+        """Commit the prompt to the spec history buffer; on a prefix-cache
+        hit write the FULL prompt at 0 (the cached tokens were never fed
+        through THIS session).  Shared by LocalEngine and MeshEngine
+        prefill (same hist contract, two execution substrates)."""
+        if self.spec_lookahead <= 0 or sess.hist is None:
+            return
+        n_cached = len(full_ids) - len(prompt_ids)
+        ids = jnp.asarray(
+            np.broadcast_to(
+                np.asarray(full_ids, dtype=np.int32), (self.batch, len(full_ids))
+            )
+        )
+        sess.hist = jax.lax.dynamic_update_slice_in_dim(
+            sess.hist, ids, sess.pos - n_cached, axis=1
+        )
+
     def spec_eligible(self, decoding: DecodingParams) -> bool:
         """Whether this engine + request pair may take the speculative path.
 
@@ -937,6 +942,15 @@ class LocalEngine:
                     if self.sessions[nonce].pos + b < self.max_seq:
                         self.decode_chunk(nonce, 0, dec, b)
                 self.decode_step(nonce, 0, dec)
+            finally:
+                self.end_session(nonce)
+        if self.spec_lookahead > 0:
+            # the verify block is the same compile class as the chunk scans;
+            # pay it here, not on the first eligible request's first block
+            self.end_session(nonce)
+            try:
+                self.prefill_and_sample(nonce, [0], DecodingParams(temperature=0.0))
+                self.decode_spec(nonce, 0, DecodingParams(temperature=0.0), 2)
             finally:
                 self.end_session(nonce)
         log.info(

@@ -62,11 +62,16 @@ class MeshEngine:
     pending_width = LocalEngine.pending_width
     WARM_DECODINGS = LocalEngine.WARM_DECODINGS
     warm_chunks = LocalEngine.warm_chunks
-    # speculative decoding is LocalEngine-only for now; the borrowed
-    # generate/adapter paths consult these and short-circuit to False
+    # speculative decoding: the ring verify program (make_ring_spec_fn)
+    # keeps LocalEngine's _spec_step contract, so the eligibility gates and
+    # the whole decode_spec driver are borrowed unchanged
     spec_lookahead = 0
     spec_eligible = LocalEngine.spec_eligible
     spec_worthwhile = LocalEngine.spec_worthwhile
+    SPEC_WARMUP_BLOCKS = LocalEngine.SPEC_WARMUP_BLOCKS
+    SPEC_MIN_TOKENS_PER_BLOCK = LocalEngine.SPEC_MIN_TOKENS_PER_BLOCK
+    decode_spec = LocalEngine.decode_spec
+    _commit_prompt_hist = LocalEngine._commit_prompt_hist
 
     def __init__(
         self,
@@ -85,6 +90,7 @@ class MeshEngine:
         weight_quant_bits: int = 0,
         quant_group: int = 0,  # 0 = quantizer default; must divide in/tp
         prefix_cache_size: int = 0,
+        spec_lookahead: int = 0,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -132,6 +138,13 @@ class MeshEngine:
         self._decode_chunk = make_ring_chunk_fn(
             self.model, self.mesh, self._host_window
         )
+        self.spec_lookahead = int(spec_lookahead)
+        if self.spec_lookahead > 0:
+            from dnet_tpu.parallel.ring import make_ring_spec_fn
+
+            self._spec_step = make_ring_spec_fn(
+                self.model, self.mesh, self._host_window, self.spec_lookahead
+            )
         log.info(
             "MeshEngine: %s over mesh pp=%d tp=%d dp=%d sp=%d (%d devices)",
             self.config.model_type, pp, tp, dp, sp, pp * tp * dp * sp,
@@ -309,6 +322,11 @@ class MeshEngine:
             pos=pos,
             key=jax.random.key(seed),
             counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
+            hist=(
+                jnp.zeros((self.batch, self.max_seq), dtype=jnp.int32)
+                if self.spec_lookahead > 0
+                else None
+            ),
         )
         self.sessions[nonce] = sess
         return sess
@@ -350,6 +368,7 @@ class MeshEngine:
                 prompt_ids = full_ids[n:]  # >= 1 token left by construction
             else:
                 sess = self.new_session(nonce, seed)
+        self._commit_prompt_hist(sess, full_ids, prompt_ids)
         T = len(prompt_ids)
         Tpad = min(bucket_length(T), self.max_seq - sess.pos)
         tokens = np.zeros((self.batch, Tpad), dtype=np.int32)

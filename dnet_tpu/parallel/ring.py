@@ -35,10 +35,12 @@ from dnet_tpu.parallel.mesh import (
 )
 
 
-def _ring_spmd(model, mesh: Mesh, window_params):
+def _ring_spmd(model, mesh: Mesh, window_params, full_logits: bool = False):
     """Construct the shard_map'd single-token ring step (un-jitted) and its
-    layer-kinds operand.  Shared by the per-step fn (make_ring_decode_fn)
-    and the chunked-scan fn (make_ring_chunk_fn)."""
+    layer-kinds operand.  Shared by the per-step fn (make_ring_decode_fn),
+    the chunked-scan fn (make_ring_chunk_fn), and — with full_logits=True,
+    which projects EVERY position instead of slicing last_idx — the
+    speculative verify fn (make_ring_spec_fn)."""
     PP = mesh.shape[AXIS_PP]
     phases = getattr(model, "ring_phases", 1)
     # sequence parallelism: KV shards over sp; queries/hidden replicate and
@@ -57,7 +59,8 @@ def _ring_spmd(model, mesh: Mesh, window_params):
         P(),  # last_idx scalar
         P(AXIS_PP) if has_kinds else P(),
     )
-    out_specs = (P(AXIS_DP, None), kv_spec(sp_axis is not None))
+    logits_spec = P(AXIS_DP, None, None) if full_logits else P(AXIS_DP, None)
+    out_specs = (logits_spec, kv_spec(sp_axis is not None))
 
     def spmd(window_params, edge_params, tokens, kv, pos, last_idx, kinds):
         my_pp = lax.axis_index(AXIS_PP)
@@ -91,6 +94,11 @@ def _ring_spmd(model, mesh: Mesh, window_params):
         x, kv = lax.fori_loop(0, phases * PP, stage_iter, (x, kv))
         # after PP hops the processed x is back on rank 0; ranks agree via
         # the ppermute ring, and rank 0 holds the final hidden state.
+        if full_logits:
+            # spec verify needs every position's argmax; T is tiny (L+1)
+            xs = model.normalize(edge_params, x)
+            logits = model.lm_project(edge_params, xs)  # [B, T, V]
+            return _bcast_from_rank0(logits, AXIS_PP), kv
         x_last = lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
         x_last = model.normalize(edge_params, x_last)
         logits = model.lm_project(edge_params, x_last)
@@ -167,6 +175,38 @@ def make_ring_chunk_fn(model, mesh: Mesh, window_params):
         return packed, last_tok, kv, key, counts
 
     return jax.jit(chunk, static_argnums=(8, 9), donate_argnums=(3, 7))
+
+
+def make_ring_spec_fn(model, mesh: Mesh, window_params, lookahead: int):
+    """Speculative verify block through the mesh ring: draft `lookahead`
+    tokens by prompt-lookup, run ONE ring pass over the [tok, drafts]
+    block (L+1 positions instead of 1 — the extra positions ride the same
+    PP stage-steps and ICI hops), greedily accept the agreeing prefix.
+
+    Keeps LocalEngine's `_spec_step` contract
+    ((wp, ep, tok, hist, kv, pos) -> (out, hist, kv), out[:, i] == -1
+    beyond the accepted prefix), so LocalEngine.decode_spec and the
+    serving adapter's spec path drive the mesh engine unchanged.
+    Drafting/acceptance run at the global-batch level outside shard_map,
+    exactly like chunked sampling (make_ring_chunk_fn)."""
+    from dnet_tpu.core.spec import accept_drafts, commit_history, ngram_draft
+
+    ring_full, kinds_arr = _ring_spmd(model, mesh, window_params, full_logits=True)
+    L = int(lookahead)
+
+    def spec_step(window_params, edge_params, tok, hist, kv, pos):
+        hist = commit_history(hist, pos, tok, jnp.int32(1))
+        drafts = ngram_draft(hist, pos + 1, L)  # [B, L]
+        hist = commit_history(hist, pos + 1, drafts, jnp.int32(L))
+        block = jnp.concatenate([tok, drafts], axis=1)  # [B, L+1]
+        logits, kv = ring_full(
+            window_params, edge_params, block, kv, pos, jnp.int32(L), kinds_arr
+        )
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        _, out = accept_drafts(preds, drafts)
+        return out, hist, kv
+
+    return jax.jit(spec_step, donate_argnums=(3, 4))
 
 
 def _bcast_from_rank0(x, axis_name: str):

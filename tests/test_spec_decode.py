@@ -158,6 +158,57 @@ def test_spec_gpt_oss_rotating_kv_ineligible(tmp_path_factory):
     assert not eng.spec_eligible(DecodingParams(temperature=0.0))
 
 
+# ---- mesh engine -----------------------------------------------------------
+
+
+@pytest.mark.parallel
+def test_mesh_spec_stream_matches_local(tiny_llama_dir, eight_devices):
+    """The mesh ring verify block (make_ring_spec_fn) must emit the same
+    greedy stream as the plain LocalEngine — one ring pass per 1..L+1
+    tokens over pp=2/tp=2."""
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    ids = [1, 7, 3, 11, 1, 7]
+    dec = DecodingParams(temperature=0.0)
+    want = [
+        r.token_id
+        for r in _spec_engine(tiny_llama_dir).generate(ids, dec, max_tokens=24)
+    ]
+    mesh = MeshEngine(
+        tiny_llama_dir, pp=2, tp=2, max_seq=128, param_dtype="float32",
+        spec_lookahead=4,
+    )
+    assert mesh.spec_eligible(dec)
+    got = [r.token_id for r in mesh.generate(ids, dec, max_tokens=24)]
+    assert got == want
+
+    # drive the verify blocks directly: the stream chains across blocks and
+    # the block count records real speculation
+    r0 = mesh.prefill_and_sample("s", ids, dec)
+    stream = [int(r0.token[0])]
+    while len(stream) < 17:
+        res = mesh.decode_spec("s", stream[-1], dec, 17 - len(stream))
+        assert res
+        stream.extend(int(r.token[0]) for r in res)
+    assert stream[:17] == want[:17]
+    sess = mesh.sessions["s"]
+    assert sess.spec_blocks > 0
+    assert sess.spec_emitted >= sess.spec_blocks
+
+
+@pytest.mark.parallel
+def test_mesh_spec_dp_ineligible(tiny_llama_dir, eight_devices):
+    """dp>1 folds lanes into the batch axis; per-lane acceptance lengths
+    diverge, so the borrowed batch==1 gate must refuse."""
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    mesh = MeshEngine(
+        tiny_llama_dir, pp=2, dp=2, max_seq=64, param_dtype="float32",
+        spec_lookahead=4,
+    )
+    assert not mesh.spec_eligible(DecodingParams(temperature=0.0))
+
+
 def test_spec_worthwhile_gate(tiny_llama_dir):
     """Low-acceptance sessions must fall back to chunked decode after the
     warmup (spec is only worth the per-block host sync when drafts land)."""
