@@ -133,10 +133,7 @@ class LocalModelManager:
                         while _pp > 1 and _L % _pp != 0:
                             _pp -= 1
                     _mcls = _cls(_cfg.model_type)
-                    if (
-                        not _mcls.supports_kv_commit
-                        or getattr(_mcls, "ring_phases", 1) > 1
-                    ):
+                    if not _mcls.supports_kv_commit:
                         log.warning(
                             "pipelined batching unsupported for %s; serving "
                             "sequential mesh",

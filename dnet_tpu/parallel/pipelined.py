@@ -57,6 +57,28 @@ def _bcast_from_rank(x, axis_name: str, rank: int):
     return lax.psum(masked, axis_name)
 
 
+# ---- multi-lap schedule ----------------------------------------------------
+# Segmented models (deepseek ring_phases=2) need each token to traverse the
+# ring `phases` times — lap p applies every rank's slice of segment p, so the
+# global layer order stays all-dense-then-all-moe.  The schedule generalizes
+# the single-lap rotation: a token occupies the ring for PHI = phases*PP
+# stage-steps; rank 0 takes a NEW entry only on steps whose arriving token
+# has finished its last lap, which happens in bursts of PP consecutive steps
+# every PHI (entry_open); entries cycle the M slots round-robin; the token
+# entering at step te exits at te + PHI - 1.  phases=1 reduces to the r2
+# schedule exactly: entry_open always, slot(t) = t mod M, exit latency PP-1.
+
+
+def _entry_open(t: int, pp: int, phases: int) -> bool:
+    return (t % (phases * pp)) < pp
+
+
+def _entry_slot(t: int, pp: int, phases: int, m: int) -> int:
+    """Slot fed by the entry at step t (valid only when _entry_open)."""
+    phi = phases * pp
+    return ((t // phi) * pp + (t % phi)) % m
+
+
 def make_rotation_fn(
     model, mesh: Mesh, window_params, n_slots: int, batch: int = 1,
     n_steps: Optional[int] = None,
@@ -69,11 +91,13 @@ def make_rotation_fn(
 
     Returned signature:
       (window_params, edge_params, x_state[PP,B,1,D], kv, tokens[M,B],
-       pos_vec[M], pos_state[PP], live_state[PP], enter_live[n_steps],
-       sp_stack, keys[M,2]u32, counts[M,B,V], t0)
+       pos_vec[M], pos_state[PP], live_state[PP], phase_state[PP],
+       entry_open[n_steps], enter_live[n_steps], entry_slot[n_steps],
+       exit_valid[n_steps], exit_slot[n_steps], sp_stack, keys[M,2]u32,
+       counts[M,B,V], t0)
       -> (results: SampleResult leaves stacked [n_steps,B,...] in EXIT-STEP
-          order, x_state, kv, tokens, pos_vec, pos_state, live_state, keys,
-          counts)
+          order, x_state, kv, tokens, pos_vec, pos_state, live_state,
+          phase_state, keys, counts)
 
     enter_live is PER STEP (index j), not per slot: a slot's capacity can
     flip mid-chunk, and the engine's host-side schedule simulation computes
@@ -88,11 +112,20 @@ def make_rotation_fn(
     a re-assigned or idle slot can neither corrupt the fresh prefill's KV
     rows nor clobber the injected entry token.  The engine kills the flag of
     a slot's in-flight token at injection time (it knows which rank holds
-    it: rank r carries slot (t0 - r) mod M between rotations).
+    it — see PipelinedMeshEngine.prefill_and_sample's stale-kill scan).
+
+    Segmented models (ring_phases > 1) run each token through `phases` laps:
+    a per-token phase travels with the hidden state the same way, entries
+    only open on steps whose arriving token has finished its last lap, and
+    the per-step schedule (entry_open / entry_slot / exit_valid / exit_slot)
+    is precomputed host-side from the closed-form multi-lap schedule
+    (_entry_open/_entry_slot) and consumed by the scan.
     """
     PP = mesh.shape[AXIS_PP]
     M, B = n_slots, batch
-    n_steps = M if n_steps is None else n_steps
+    phases = getattr(model, "ring_phases", 1)
+    PHI = phases * PP  # stage-steps a token occupies the ring
+    n_steps = M * phases if n_steps is None else n_steps
     has_kinds = getattr(model, "layer_kinds", None) is not None
 
     # x_state mentions AXIS_DP (size 1, enforced by the engine) purely so its
@@ -107,7 +140,12 @@ def make_rotation_fn(
         P(),  # pos_vec [M]
         P(AXIS_PP),  # pos_state [PP]
         P(AXIS_PP),  # live_state [PP] bool
+        P(AXIS_PP),  # phase_state [PP] int32 (current lap of in-flight token)
+        P(),  # entry_open [n_steps] bool (schedule: step takes an entry)
         P(),  # enter_live [n_steps] bool (per-step: entry carries a real token)
+        P(),  # entry_slot [n_steps] int32
+        P(),  # exit_valid [n_steps] bool (schedule: step finishes a token)
+        P(),  # exit_slot [n_steps] int32
         P(),  # sp_stack (SampleParams leaves [M])
         P(),  # keys [M, 2] uint32
         P(),  # counts [M, B, V]
@@ -117,49 +155,65 @@ def make_rotation_fn(
     res_spec = SampleResult(P(), P(), P(), P())
     out_specs = (
         res_spec, x_spec, kv_spec(False), P(), P(), P(AXIS_PP), P(AXIS_PP),
-        P(), P(),
+        P(AXIS_PP), P(), P(),
     )
 
     def spmd(window_params, edge_params, x_state, kv, tokens, pos_vec,
-             pos_state, live_state, enter_live, sp_stack, keys, counts,
+             pos_state, live_state, phase_state, entry_open, enter_live,
+             entry_slot, exit_valid, exit_slot, sp_stack, keys, counts,
              t0, kinds):
         my_pp = lax.axis_index(AXIS_PP)
         x = x_state[0]  # local [B, 1, D], device-varying over pp
         pos_x = pos_state[0]  # this rank's in-flight token position
         live_x = live_state[0]  # is this rank's in-flight token real?
+        phase_x = phase_state[0]  # this rank's in-flight token lap
 
         def step(carry, j):
-            x, pos_x, live_x, kv, tokens, pos_vec, keys, counts = carry
+            x, pos_x, live_x, phase_x, kv, tokens, pos_vec, keys, counts = carry
             t = t0 + j
-            n = jnp.mod(t, M)  # entry slot (invariant)
-            e = jnp.mod(t - (PP - 1), M)  # exit slot (invariant)
-            my_slot = jnp.mod(t - my_pp, M)  # this rank's slot (varying)
+            open_j = lax.dynamic_index_in_dim(entry_open, j, keepdims=False)
+            n = lax.dynamic_index_in_dim(entry_slot, j, keepdims=False)
+            e = lax.dynamic_index_in_dim(exit_slot, j, keepdims=False)
+            evalid_j = lax.dynamic_index_in_dim(exit_valid, j, keepdims=False)
 
-            # entry: rank 0 replaces its (just-drained) hidden with the
-            # entering token's embedding; the token's position is consumed
-            # from pos_vec NOW and rides along with the hidden thereafter
+            # entry: on schedule-open steps rank 0 replaces its (just-
+            # drained) hidden with the entering token's embedding; the
+            # token's position is consumed from pos_vec NOW and rides along
+            # with the hidden thereafter.  On closed steps the arriving
+            # token continues its next lap untouched.
+            take = (my_pp == 0) & open_j
             tok_in = lax.dynamic_index_in_dim(tokens, n, keepdims=False)  # [B]
             x_embed = model.embed(edge_params, tok_in[:, None])
             x_embed = lax.pcast(x_embed, AXIS_PP, to="varying")
             x_embed = lax.pcast(x_embed, AXIS_DP, to="varying")
-            x_in = jnp.where(my_pp == 0, x_embed, x)
+            x_in = jnp.where(take, x_embed, x)
             pos_entry = lax.dynamic_index_in_dim(pos_vec, n, keepdims=False)
-            pos_in = jnp.where(my_pp == 0, pos_entry, pos_x)
+            pos_in = jnp.where(take, pos_entry, pos_x)
             live_entry = lax.dynamic_index_in_dim(enter_live, j, keepdims=False)
             live_entry = lax.pcast(live_entry, AXIS_PP, to="varying")
-            live_in = jnp.where(my_pp == 0, live_entry, live_x)
+            live_in = jnp.where(take, live_entry, live_x)
+            phase_in = jnp.where(take, 0, phase_x)
             pos_vec = lax.dynamic_update_index_in_dim(
-                pos_vec, pos_entry + 1, n, axis=0
+                pos_vec, jnp.where(open_j, pos_entry + 1, pos_entry), n, axis=0
             )
+
+            # this rank's slot follows from its token's entry step:
+            # te = t - rank - PP*lap; slot = entry_slot(te) (closed form).
+            # Garbage tokens (cold ring) may compute an arbitrary slot — they
+            # never commit KV, so their reads/writes are inert.
+            te = t - my_pp - PP * phase_in
+            k_idx = (te // PHI) * PP + jnp.mod(te, PHI)
+            my_slot = jnp.mod(k_idx, M)
 
             # this rank's stage over its slot's KV slice; only live tokens
             # commit KV (stale/idle garbage writes nothing, anywhere)
             kv_slot = jax.tree.map(
                 lambda a: lax.dynamic_slice_in_dim(a, my_slot * B, B, axis=1), kv
             )
+            extra = {"phase": phase_in} if phases > 1 else {}
             x_out, kv_slot = model.apply_window(
                 window_params, x_in, kv_slot, pos_in,
-                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=live_in,
+                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=live_in, **extra,
             )
             kv = jax.tree.map(
                 lambda full, sl: lax.dynamic_update_slice_in_dim(
@@ -178,11 +232,15 @@ def make_rotation_fn(
             logits = lax.psum(logits, AXIS_DP)
 
             # the exiting token's own live flag decides realness (bcast from
-            # the last rank, where it resides this step)
-            real = lax.psum(
-                jnp.where(my_pp == PP - 1, live_in.astype(jnp.int32), 0),
-                AXIS_PP,
-            ) > 0
+            # the last rank, where it resides this step); schedule steps that
+            # finish no token (mid-lap arrivals) are never real
+            real = (
+                lax.psum(
+                    jnp.where(my_pp == PP - 1, live_in.astype(jnp.int32), 0),
+                    AXIS_PP,
+                )
+                > 0
+            ) & evalid_j
             old_key = lax.dynamic_index_in_dim(keys, e, keepdims=False)
             key = jax.random.wrap_key_data(old_key)
             key, step_key = jax.random.split(key)
@@ -204,33 +262,41 @@ def make_rotation_fn(
                 tokens, jnp.where(real, res.token, tok_e), e, axis=0
             )
 
-            # hand hidden states (and their position/liveness) one hop around
+            # hand hidden states (and their position/liveness/lap) one hop
+            # around; crossing the PP-1 -> 0 seam advances the lap counter
             perm = [(p, (p + 1) % PP) for p in range(PP)]
             x_next = lax.ppermute(x_out, AXIS_PP, perm)
             pos_next = lax.ppermute(pos_in, AXIS_PP, perm)
             live_next = lax.ppermute(live_in, AXIS_PP, perm)
-            return (x_next, pos_next, live_next, kv, tokens, pos_vec, keys,
-                    counts), res
+            phase_next = lax.ppermute(
+                phase_in + (my_pp == PP - 1).astype(jnp.int32), AXIS_PP, perm
+            )
+            return (x_next, pos_next, live_next, phase_next, kv, tokens,
+                    pos_vec, keys, counts), res
 
-        (x, pos_x, live_x, kv, tokens, pos_vec, keys, counts), results = lax.scan(
-            step,
-            (x, pos_x, live_x, kv, tokens, pos_vec, keys, counts),
-            jnp.arange(n_steps, dtype=jnp.int32),
+        (x, pos_x, live_x, phase_x, kv, tokens, pos_vec, keys, counts), results = (
+            lax.scan(
+                step,
+                (x, pos_x, live_x, phase_x, kv, tokens, pos_vec, keys, counts),
+                jnp.arange(n_steps, dtype=jnp.int32),
+            )
         )
         return (results, x[None], kv, tokens, pos_vec, pos_x[None],
-                live_x[None], keys, counts)
+                live_x[None], phase_x[None], keys, counts)
 
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    jitted = jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6, 7, 10, 11))
+    jitted = jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 15, 16))
     kinds_arr = (
         model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
     )
 
     def call(window_params, edge_params, x_state, kv, tokens, pos_vec,
-             pos_state, live_state, enter_live, sp_stack, keys, counts, t0):
+             pos_state, live_state, phase_state, entry_open, enter_live,
+             entry_slot, exit_valid, exit_slot, sp_stack, keys, counts, t0):
         return jitted(window_params, edge_params, x_state, kv, tokens, pos_vec,
-                      pos_state, live_state, enter_live, sp_stack, keys,
-                      counts, jnp.int32(t0), kinds_arr)
+                      pos_state, live_state, phase_state, entry_open,
+                      enter_live, entry_slot, exit_valid, exit_slot, sp_stack,
+                      keys, counts, jnp.int32(t0), kinds_arr)
 
     return call
 
@@ -243,6 +309,7 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
     """
     PP = mesh.shape[AXIS_PP]
     B = batch
+    phases = getattr(model, "ring_phases", 1)
     has_kinds = getattr(model, "layer_kinds", None) is not None
     in_specs = (
         window_param_specs(window_params),
@@ -263,17 +330,21 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
 
         def stage_iter(i, carry):
             x, kv_slot = carry
+            # segmented models take `phases` laps (lap p applies every
+            # rank's slice of segment p — parallel/ring.py's schedule)
+            extra = {"phase": i // PP} if phases > 1 else {}
             x_new, kv_slot = model.apply_window(
                 window_params, x, kv_slot, pos,
-                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=(i == my_pp),
-                t_real=last_idx + 1,
+                layer_kinds=kinds, tp_axis=AXIS_TP,
+                kv_commit=(jnp.mod(i, PP) == my_pp),
+                t_real=last_idx + 1, **extra,
             )
             x_next = lax.ppermute(
                 x_new, AXIS_PP, [(p, (p + 1) % PP) for p in range(PP)]
             )
             return (x_next, kv_slot)
 
-        x, kv_slot = lax.fori_loop(0, PP, stage_iter, (x, kv_slot))
+        x, kv_slot = lax.fori_loop(0, phases * PP, stage_iter, (x, kv_slot))
         kv = jax.tree.map(
             lambda full, sl: lax.dynamic_update_slice_in_dim(
                 full, sl, slot * B, axis=1
@@ -360,13 +431,13 @@ class PipelinedMeshEngine:
                 f"pipelined serving not supported for "
                 f"{inner.config.model_type} (no gated KV writes yet)"
             )
-        if getattr(inner.model, "ring_phases", 1) > 1:
-            raise NotImplementedError(
-                f"pipelined serving not supported for segmented "
-                f"{inner.config.model_type} (multi-lap ring pending)"
-            )
         self.config, self.model, self.mesh = inner.config, inner.model, inner.mesh
         self.pp, self.tp = inner.pp, inner.tp
+        # segmented models (deepseek ring_phases=2) take `phases` laps per
+        # token: one rotation is M*phases stage-steps and still yields one
+        # entry + one exit per slot (the multi-lap schedule's entry bursts
+        # cycle the slots round-robin — see _entry_open/_entry_slot)
+        self.phases = getattr(inner.model, "ring_phases", 1)
         self.max_seq = max_seq
         self.window_params, self.edge_params = inner.window_params, inner.edge_params
 
@@ -398,6 +469,10 @@ class PipelinedMeshEngine:
         )
         self.live_state = jax.device_put(
             jnp.zeros((self.pp,), dtype=bool),
+            NamedSharding(self.mesh, P(AXIS_PP)),
+        )
+        self.phase_state = jax.device_put(
+            jnp.zeros((self.pp,), dtype=jnp.int32),
             NamedSharding(self.mesh, P(AXIS_PP)),
         )
         self.keys = jax.device_put(jnp.zeros((M, 2), dtype=jnp.uint32), rep)
@@ -534,11 +609,18 @@ class PipelinedMeshEngine:
         self.keys = self.keys.at[slot].set(jax.random.key_data(key))
         self.counts = self.counts.at[slot].set(counts0)
         # kill the slot's stale in-flight token: between rotations, rank r
-        # carries slot (t0 - r) mod M — its live flag must not let old
+        # carries the token that entered at te = t0 - r - PP*lap (exactly one
+        # lap makes te an entry-open step) — its live flag must not let old
         # garbage commit KV into the rows this prefill just wrote
-        r_star = (self.t0 - slot) % self.n_slots
-        if r_star < self.pp:
-            self.live_state = self.live_state.at[r_star].set(False)
+        for r in range(self.pp):
+            for p in range(self.phases):
+                te = self.t0 - r - self.pp * p
+                if (
+                    te >= 0
+                    and _entry_open(te, self.pp, self.phases)
+                    and _entry_slot(te, self.pp, self.phases, self.n_slots) == slot
+                ):
+                    self.live_state = self.live_state.at[r].set(False)
         self.slot_pos[slot] = T_total
         self._dec[slot] = decoding
         return res
@@ -574,53 +656,72 @@ class PipelinedMeshEngine:
         if fn is None:
             fn = make_rotation_fn(
                 self.model, self.mesh, self._host_window_ref,
-                self.n_slots, self.slot_batch, n_steps=R * self.n_slots,
+                self.n_slots, self.slot_batch,
+                n_steps=R * self.n_slots * self.phases,
             )
             self._rot_fns[R] = fn
         return fn
 
     def _dispatch_chunk(self, R: int) -> None:
-        """Dispatch (async) R fused rotations: R*M stage-steps, one XLA
-        program, sampled tokens re-entering their slots on device.  The
+        """Dispatch (async) R fused rotations: R*M*phases stage-steps, one
+        XLA program, sampled tokens re-entering their slots on device.  The
         delivery schedule (which exit step belongs to which nonce) is
         simulated host-side at dispatch time — it depends only on the entry
         bookkeeping, never on token VALUES, so the packed results can be
         read later (overlapping the next chunk's compute)."""
         np = self._np
-        M, PP = self.n_slots, self.pp
+        M, PP, phases = self.n_slots, self.pp, self.phases
+        PHI = phases * PP
         nonce_of = {s: n for n, s in self.slot_of.items()}
         sim = {m: list(self._entries[m]) for m in range(M)}
         pos_sim = self.slot_pos.copy()
         deliveries = []  # (step index j, nonce at dispatch time)
-        n_steps = R * M
+        n_steps = R * M * phases
+        entry_open = np.zeros(n_steps, dtype=bool)
         enter_live = np.zeros(n_steps, dtype=bool)
+        entry_slot = np.zeros(n_steps, dtype=np.int32)
+        exit_valid = np.zeros(n_steps, dtype=bool)
+        exit_slot = np.zeros(n_steps, dtype=np.int32)
         for j in range(n_steps):
             t = self.t0 + j
-            e_slot = (t - (PP - 1)) % M
-            ent = sim[e_slot]
-            if ent and ent[0] == t - (PP - 1):
-                ent.pop(0)
-                if e_slot in nonce_of:
-                    deliveries.append((j, nonce_of[e_slot]))
-            n_slot = t % M
-            # a live slot below capacity feeds one real token this step; the
-            # device consumes enter_live[j] at exactly this point in its scan
-            if n_slot in nonce_of and pos_sim[n_slot] < self.max_seq:
-                enter_live[j] = True
-                sim[n_slot].append(t)
-            # pos_vec advances unconditionally at the entry step (device
-            # mirrors this); gated KV commits make the dead-slot write inert
-            pos_sim[n_slot] += 1
+            te = t - (PHI - 1)  # exit latency: phases laps of PP hops
+            if te >= 0 and _entry_open(te, PP, phases):
+                e_slot = _entry_slot(te, PP, phases, M)
+                exit_valid[j] = True
+                exit_slot[j] = e_slot
+                ent = sim[e_slot]
+                if ent and ent[0] == te:
+                    ent.pop(0)
+                    if e_slot in nonce_of:
+                        deliveries.append((j, nonce_of[e_slot]))
+            if _entry_open(t, PP, phases):
+                n_slot = _entry_slot(t, PP, phases, M)
+                entry_open[j] = True
+                entry_slot[j] = n_slot
+                # a live slot below capacity feeds one real token this step;
+                # the device consumes enter_live[j] at this point in its scan
+                if n_slot in nonce_of and pos_sim[n_slot] < self.max_seq:
+                    enter_live[j] = True
+                    sim[n_slot].append(t)
+                # pos_vec advances unconditionally at the entry step (device
+                # mirrors this); gated KV commits make dead-slot writes inert
+                pos_sim[n_slot] += 1
         (results, self.x_state, self.kv, self.tokens, self.pos_vec,
-         self.pos_state, self.live_state, self.keys, self.counts) = self._rot_fn(R)(
+         self.pos_state, self.live_state, self.phase_state, self.keys,
+         self.counts) = self._rot_fn(R)(
             self.window_params, self.edge_params, self.x_state, self.kv,
             self.tokens, self.pos_vec, self.pos_state, self.live_state,
-            jnp.asarray(enter_live), self._sp_stack(), self.keys, self.counts,
+            self.phase_state, jnp.asarray(entry_open), jnp.asarray(enter_live),
+            jnp.asarray(entry_slot), jnp.asarray(exit_valid),
+            jnp.asarray(exit_slot), self._sp_stack(), self.keys, self.counts,
             self.t0,
         )
         self._pending_rot.append((deliveries, results))
         self._entries = sim
-        self.slot_pos += R  # one entry per slot per rotation
+        # pos_sim IS the device pos_vec mirror; for phases>1 with
+        # n_slots % pp != 0 the entry bursts do NOT distribute exactly R
+        # entries per slot per chunk, so a blanket += R would desync
+        self.slot_pos = pos_sim
         self.t0 += n_steps
 
     def _drain_pending(self) -> None:
