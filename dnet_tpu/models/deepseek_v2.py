@@ -34,9 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dnet_tpu.core.kvcache import KVConfig, read_kv, write_kv
+from dnet_tpu.core.kvcache import KVConfig
 from dnet_tpu.models.base import ModelConfig, RingModel
-from dnet_tpu.ops.attention import attend, causal_mask
+from dnet_tpu.ops.attention import cached_attend, causal_mask, sp_causal_mask
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq
 from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
@@ -112,7 +112,9 @@ class DeepseekV2RingModel(RingModel):
         )
 
     # ---- pure compute -------------------------------------------------
-    def _attention(self, p, x, kvs, pos, mask, tp_axis=None, kv_commit=None):
+    def _attention(
+        self, p, x, kvs, pos, mask, tp_axis=None, kv_commit=None, sp_axis=None
+    ):
         cfg = self.config
         B, T, D = x.shape
         nope, rope_d, vd = self.qk_nope_head_dim, self.qk_rope_head_dim, self.v_head_dim
@@ -144,9 +146,14 @@ class DeepseekV2RingModel(RingModel):
         q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
         k_full = jnp.concatenate([k_nope, k_pe], axis=-1)
 
-        kvs = write_kv(kvs, k_full, v, pos, kv_commit=kv_commit)
-        kc, vc = read_kv(kvs)
-        attn = attend(q_full, kc, vc, mask=mask, scale=self.softmax_scale)
+        # shared body incl. the sp path: with sp_axis the cache holds this
+        # rank's sequence shard and attention runs as distributed
+        # flash-decoding with an LSE combine (ops/ring_attention.py) —
+        # MLA's asymmetric K/V head dims flow through unchanged
+        attn, kvs = cached_attend(
+            q_full, k_full, v, kvs, pos, mask,
+            kv_commit=kv_commit, sp_axis=sp_axis, scale=self.softmax_scale,
+        )
         out = attn.reshape(B, T, H * vd) @ dq(p["wo"])
         if tp_axis is not None:
             out = lax.psum(out, tp_axis)
@@ -229,8 +236,11 @@ class DeepseekV2RingModel(RingModel):
             out = routed.astype(flat.dtype) + shared
         return x + out.reshape(B, T, D)
 
-    def _layer(self, p: dict, x, kvs, pos, mask, tp_axis=None, kv_commit=None):
-        x, kvs = self._attention(p, x, kvs, pos, mask, tp_axis, kv_commit)
+    def _layer(
+        self, p: dict, x, kvs, pos, mask, tp_axis=None, kv_commit=None,
+        sp_axis=None,
+    ):
+        x, kvs = self._attention(p, x, kvs, pos, mask, tp_axis, kv_commit, sp_axis)
         if "e_gate" in p:
             x = self._moe(p, x, tp_axis)
         else:
@@ -241,10 +251,12 @@ class DeepseekV2RingModel(RingModel):
             x = x + out
         return x, kvs
 
-    def _scan_segment(self, seg, x, kv_seg, pos, mask, tp_axis, kv_commit):
+    def _scan_segment(self, seg, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis):
         def body(carry, per_layer):
             p, kvs = per_layer
-            xc, kvs = self._layer(p, carry, kvs, pos, mask, tp_axis, kv_commit)
+            xc, kvs = self._layer(
+                p, carry, kvs, pos, mask, tp_axis, kv_commit, sp_axis
+            )
             return xc, kvs
 
         return lax.scan(body, x, (seg, kv_seg))
@@ -270,12 +282,13 @@ class DeepseekV2RingModel(RingModel):
         all-dense-then-all-moe even though each pp rank holds a slice of
         both segments.
         """
-        if sp_axis is not None:
-            raise NotImplementedError(
-                "deepseek_v2 sequence parallelism is pending; run pp/tp"
-            )
         if mask is None:
-            mask = causal_mask(x.shape[1], kv["k"].shape[2], pos)
+            S_local = kv["k"].shape[2]
+            mask = (
+                causal_mask(x.shape[1], S_local, pos)
+                if sp_axis is None
+                else sp_causal_mask(x.shape[1], S_local, pos, sp_axis)
+            )
         dense = window_params.get("dense")
         moe = window_params.get("moe")
         Ld = dense["attn_norm"].shape[0] if dense is not None else 0
@@ -285,7 +298,7 @@ class DeepseekV2RingModel(RingModel):
                 return x, kv
             kv_seg = jax.tree.map(lambda a: a[:Ld], kv)
             x, kv_seg = self._scan_segment(
-                dense, x, kv_seg, pos, mask, tp_axis, kv_commit
+                dense, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis
             )
             kv = jax.tree.map(lambda f, s: f.at[:Ld].set(s), kv, kv_seg)
             return x, kv
@@ -295,7 +308,7 @@ class DeepseekV2RingModel(RingModel):
                 return x, kv
             kv_seg = jax.tree.map(lambda a: a[Ld:], kv)
             x, kv_seg = self._scan_segment(
-                moe, x, kv_seg, pos, mask, tp_axis, kv_commit
+                moe, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis
             )
             kv = jax.tree.map(lambda f, s: f.at[Ld:].set(s), kv, kv_seg)
             return x, kv

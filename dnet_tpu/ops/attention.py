@@ -81,7 +81,7 @@ def cached_attend(
         return attend(q, kc, vc, mask=mask, sinks=sinks, scale=scale), kvs
     kvs = write_kv_sp(kvs, k_new, v_new, pos, sp_axis, kv_commit)
     kc, vc = read_kv(kvs)
-    return sp_decode_attend(q, kc, vc, mask, sp_axis, sinks=sinks), kvs
+    return sp_decode_attend(q, kc, vc, mask, sp_axis, sinks=sinks, scale=scale), kvs
 
 
 def rotating_cached_attend(

@@ -58,12 +58,14 @@ def ring_attend(
     kv_positions: jnp.ndarray,
     axis_name: str,
     causal: bool = True,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Full ring attention inside shard_map: q is THIS rank's query block,
     k/v THIS rank's KV block; blocks rotate `sp` times around the axis.
 
     q_positions [Tq], kv_positions [S_local]: absolute token positions
     (rotate with the KV so causal masking stays correct).
+    `scale` overrides the Hd**-0.5 softmax scale (MLA YaRN mscale).
     Returns [B, Tq, H, Hd] in q.dtype.
     """
     SP = lax.psum(1, axis_name)
@@ -71,7 +73,7 @@ def ring_attend(
     KVH = k.shape[2]
     G = H // KVH
     q5 = (q.reshape(B, Tq, KVH, G, Hd).transpose(0, 2, 3, 1, 4).astype(jnp.float32)
-          * Hd**-0.5)  # [B,KVH,G,Tq,Hd]
+          * (Hd**-0.5 if scale is None else scale))  # [B,KVH,G,Tq,Hd]
 
     # accumulators become device-varying over the axis once folded with the
     # rank-local KV; mark them so the fori carry types line up
@@ -106,6 +108,7 @@ def sp_decode_attend(
     valid_local: jnp.ndarray,
     axis_name: str,
     sinks: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Distributed flash-decoding: q [B,T,H,Hd] replicated over the axis,
     k/v [B,S_local,KVH,Hd] this rank's KV shard, valid_local [T, S_local]
@@ -114,13 +117,14 @@ def sp_decode_attend(
     One cross-device LSE combine (pmax + 2x psum) merges the partials.
     sinks [H]: GPT-OSS attention-sink logits — a virtual key absorbing
     probability mass, folded into the global softmax denominator exactly
-    once (outside the psum).
+    once (outside the psum).  `scale` overrides the Hd**-0.5 softmax scale
+    (MLA YaRN mscale compensation must survive the sp path).
     """
     B, Tq, H, Hd = q.shape
     KVH = k_local.shape[2]
     G = H // KVH
     q5 = (q.reshape(B, Tq, KVH, G, Hd).transpose(0, 2, 3, 1, 4).astype(jnp.float32)
-          * Hd**-0.5)
+          * (Hd**-0.5 if scale is None else scale))
 
     scores = _block_scores(q5, k_local, valid_local)
     m_loc = jnp.max(scores, axis=-1)  # [B,KVH,G,Tq]

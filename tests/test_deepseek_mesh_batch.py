@@ -82,8 +82,26 @@ def test_mesh_prefill_logits_match(ds_dir, local, eight_devices):
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
 
 
-def test_pipelined_rejects_segmented(ds_dir, eight_devices):
+def test_mesh_sp_matches_local(ds_dir, local, eight_devices):
+    """MLA + sequence parallelism: KV (asymmetric K/V head dims) sharded
+    over sp=2, attention as distributed flash-decoding with an LSE combine
+    — greedy parity with single-device."""
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    ids = [7, 3, 11, 5]
+    dec = DecodingParams(temperature=0.0)
+    want = [r.token_id for r in local.generate(ids, dec, max_tokens=8)]
+    eng = MeshEngine(
+        ds_dir, pp=2, tp=1, sp=2, max_seq=64, param_dtype="float32"
+    )
+    got = [r.token_id for r in eng.generate(ids, dec, max_tokens=8)]
+    assert got == want
+
+
+def test_pipelined_accepts_segmented(ds_dir, eight_devices):
+    """Segmented models load into the multi-lap rotation program (full
+    stream parity: tests/test_pipelined_engine.py deepseek tests)."""
     from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
 
-    with pytest.raises(NotImplementedError, match="segmented"):
-        PipelinedMeshEngine(ds_dir, pp=2, tp=1, max_seq=32, param_dtype="float32")
+    eng = PipelinedMeshEngine(ds_dir, pp=2, tp=1, max_seq=32, param_dtype="float32")
+    assert eng.phases == 2

@@ -89,6 +89,32 @@ def test_sp_decode_matches_dense(sp_mesh, rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5, rtol=2e-5)
 
 
+def test_sp_decode_custom_scale_matches_dense(sp_mesh, rng):
+    """A non-default softmax scale (MLA YaRN mscale^2 compensation) must
+    survive the sp combine — sp_decode_attend used to hardcode Hd**-0.5."""
+    S, H, KVH, Hd = 32, 4, 2, 16
+    scale = 2.5 * Hd**-0.5  # what yarn mscale^2 does to MLA's base scale
+    q = jnp.asarray(rng.normal(0, 1, (1, 1, H, Hd)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (1, S, KVH, Hd)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (1, S, KVH, Hd)).astype(np.float32))
+    pos = 24
+    dense = attend(q, k, v, mask=(jnp.arange(S) <= pos)[None, :], scale=scale)
+    positions = jnp.arange(S)
+
+    def spmd(kb, vb, kvpos):
+        valid = (kvpos <= pos)[None, :]
+        return sp_decode_attend(q, kb, vb, valid, "sp", scale=scale)
+
+    fn = jax.shard_map(
+        spmd,
+        mesh=sp_mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P("sp")),
+        out_specs=P(),
+    )
+    out = fn(k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5, rtol=2e-5)
+
+
 def test_ring_attend_gqa_grouping(sp_mesh, rng):
     """H=8 over KVH=2 (G=4) grouping must match dense GQA."""
     S = 16
