@@ -49,6 +49,9 @@ model_catalog: List[CatalogEntry] = [
     CatalogEntry("meta-llama/Llama-3.1-70B-Instruct", "llama", 70.6, 80),
     # DeepSeek-V2 arch (MLA)
     CatalogEntry("deepseek-ai/DeepSeek-V2-Lite-Chat", "deepseek_v2", 15.7, 27, notes="MLA"),
+    # Mixtral sparse MoE (BASELINE config 4)
+    CatalogEntry("mistralai/Mixtral-8x7B-Instruct-v0.1", "mixtral", 46.7, 32, notes="MoE 8x top-2"),
+    CatalogEntry("mistralai/Mixtral-8x22B-Instruct-v0.1", "mixtral", 141.0, 56, notes="MoE 8x top-2"),
 ]
 
 

@@ -189,33 +189,12 @@ class DeepseekV2RingModel(RingModel):
             topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
         topk_w = topk_w * self.routed_scaling_factor
 
-        from dnet_tpu.ops.moe import moe_apply
-        from dnet_tpu.ops.quant import lead_dim
+        from dnet_tpu.ops.moe import moe_apply, swiglu_expert_closures
 
-        N = flat.shape[0]
-        E_local = lead_dim(p["e_gate"])
         topk_idx = topk_idx.astype(jnp.int32)
-
-        def effn(xe):  # per-expert buffers [E*, C*, D] -> [E*, C*, D]
-            gate = jnp.einsum("ecd,edf->ecf", xe, dq(p["e_gate"]))
-            up = jnp.einsum("ecd,edf->ecf", xe, dq(p["e_up"]))
-            return jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, dq(p["e_down"]))
-
-        def dense():  # scattered weights mask the all-local-experts einsum
-            weights = jnp.zeros_like(scores).at[
-                jnp.arange(N)[:, None], topk_idx
-            ].set(topk_w)  # [N, E] over the GLOBAL expert space
-            gate = jnp.einsum("nd,edf->nef", flat, dq(p["e_gate"]))
-            up = jnp.einsum("nd,edf->nef", flat, dq(p["e_up"]))
-            inner = jax.nn.silu(gate) * up
-            expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["e_down"]))
-            if tp_axis is not None:
-                e_off = lax.axis_index(tp_axis) * E_local
-                w_local = lax.dynamic_slice_in_dim(weights, e_off, E_local, axis=1)
-            else:
-                w_local = weights
-            return jnp.einsum("ned,ne->nd", expert_out, w_local.astype(flat.dtype))
-
+        effn, dense, E_local = swiglu_expert_closures(
+            p, flat, scores, topk_idx, topk_w, tp_axis
+        )
         routed, routed_partial = moe_apply(
             self.moe_impl, flat, topk_idx, topk_w, effn, E_local,
             self.moe_capacity_factor, k, tp_axis, dense,

@@ -75,14 +75,19 @@ class LlamaRingModel(RingModel):
             attn_out = lax.psum(attn_out, tp_axis)
         x = x + attn_out
 
-        h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+        x = self._mlp_block(p, x, tp_axis)
+        return x, kvs
+
+    def _mlp_block(self, p: dict, x: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
+        """Post-attention FFN incl. the residual add; subclass hook (mixtral
+        swaps in the sparse-MoE block)."""
+        h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
         gate = h @ dq(p["w_gate"])
         up = h @ dq(p["w_up"])
         mlp_out = (jax.nn.silu(gate) * up) @ dq(p["w_down"])
         if tp_axis is not None:
             mlp_out = lax.psum(mlp_out, tp_axis)
-        x = x + mlp_out
-        return x, kvs
+        return x + mlp_out
 
     def apply_window(
         self,

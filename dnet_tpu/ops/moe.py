@@ -253,3 +253,42 @@ def moe_a2a(
     # [E/R, R*C, D] -> [E, C, D]: return each rank's slice of every buffer
     ye = lax.all_to_all(ye, axis, split_axis=1, concat_axis=0, tiled=True)
     return gather_from_experts(ye, top_idx, pos, top_w)
+
+
+def swiglu_expert_closures(p, flat, scores, top_idx, top_w, tp_axis):
+    """The (effn, dense) closure pair shared by swiglu-expert MoE families
+    (mixtral, deepseek's routed experts): p holds stacked {"e_gate",
+    "e_up", "e_down"} expert weights, (in, out)-oriented on a leading
+    local-expert axis.  effn computes per-expert buffers [E*, C*, D];
+    dense() is the exact all-local-experts einsum masked by the scattered
+    routing weights, returning this rank's PARTIAL sum under tp (caller
+    psums at its residual seam).
+    """
+    import jax
+
+    from dnet_tpu.ops.quant import dq, lead_dim
+
+    N = flat.shape[0]
+    E_local = lead_dim(p["e_gate"])
+
+    def effn(xe):  # per-expert buffers [E*, C*, D] -> [E*, C*, D]
+        gate = jnp.einsum("ecd,edf->ecf", xe, dq(p["e_gate"]))
+        up = jnp.einsum("ecd,edf->ecf", xe, dq(p["e_up"]))
+        return jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, dq(p["e_down"]))
+
+    def dense():  # scattered weights mask the all-local-experts einsum
+        weights = jnp.zeros_like(scores).at[
+            jnp.arange(N)[:, None], top_idx
+        ].set(top_w)  # [N, E] over the GLOBAL expert space
+        gate = jnp.einsum("nd,edf->nef", flat, dq(p["e_gate"]))
+        up = jnp.einsum("nd,edf->nef", flat, dq(p["e_up"]))
+        inner = jax.nn.silu(gate) * up
+        expert_out = jnp.einsum("nef,efd->ned", inner, dq(p["e_down"]))
+        if tp_axis is not None:
+            e_off = lax.axis_index(tp_axis) * E_local
+            w_local = lax.dynamic_slice_in_dim(weights, e_off, E_local, axis=1)
+        else:
+            w_local = weights
+        return jnp.einsum("ned,ne->nd", expert_out, w_local.astype(flat.dtype))
+
+    return effn, dense, E_local

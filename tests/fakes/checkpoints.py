@@ -302,3 +302,69 @@ def make_tiny_llama(model_dir: str | Path, config: dict | None = None, seed: int
         tensors[p + "mlp.down_proj.weight"] = w(D, F)
     save_checkpoint(model_dir, cfg, tensors)
     return cfg
+
+
+TINY_MIXTRAL_CONFIG = {
+    "architectures": ["MixtralForCausalLM"],
+    "model_type": "mixtral",
+    "vocab_size": 261,
+    "hidden_size": 64,
+    "intermediate_size": 96,  # per-expert FFN width
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "num_local_experts": 4,
+    "num_experts_per_tok": 2,
+    "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0,
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "attention_bias": False,
+    "hidden_act": "silu",
+    "torch_dtype": "float32",
+    "bos_token_id": 256,
+    "eos_token_id": 257,
+    "sliding_window": None,
+    "output_router_logits": False,
+}
+
+
+def make_tiny_mixtral(model_dir: str | Path, config: dict | None = None, seed: int = 5) -> dict:
+    """Write a random-weight tiny Mixtral checkpoint (sparse top-k MoE)."""
+    cfg = dict(TINY_MIXTRAL_CONFIG)
+    if config:
+        cfg.update(config)
+    rng = np.random.default_rng(seed)
+    D = cfg["hidden_size"]
+    F = cfg["intermediate_size"]
+    V = cfg["vocab_size"]
+    H = cfg["num_attention_heads"]
+    KVH = cfg["num_key_value_heads"]
+    Hd = cfg.get("head_dim", D // H)
+    E = cfg["num_local_experts"]
+
+    def w(*shape, scale=0.05):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": w(V, D),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "self_attn.q_proj.weight"] = w(H * Hd, D)
+        tensors[p + "self_attn.k_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.v_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
+        tensors[p + "block_sparse_moe.gate.weight"] = w(E, D, scale=0.3)
+        for e in range(E):
+            q = p + f"block_sparse_moe.experts.{e}."
+            tensors[q + "w1.weight"] = w(F, D)
+            tensors[q + "w2.weight"] = w(D, F)
+            tensors[q + "w3.weight"] = w(F, D)
+    save_checkpoint(model_dir, cfg, tensors)
+    return cfg
