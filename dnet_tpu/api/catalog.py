@@ -38,6 +38,10 @@ model_catalog: List[CatalogEntry] = [
     CatalogEntry("meta-llama/Llama-3.3-70B-Instruct", "llama", 70.6, 80),
     CatalogEntry("NousResearch/Hermes-3-Llama-3.1-70B", "llama", 70.6, 80),
     CatalogEntry("NousResearch/Hermes-3-Llama-3.1-405B", "llama", 405.0, 126),
+    # Qwen2.5 family (BASELINE config 3; biased-qkv llama arch)
+    CatalogEntry("Qwen/Qwen2.5-7B-Instruct", "qwen2", 7.6, 28),
+    CatalogEntry("Qwen/Qwen2.5-32B-Instruct", "qwen2", 32.8, 64),
+    CatalogEntry("Qwen/Qwen2.5-72B-Instruct", "qwen2", 72.7, 80),
     # Qwen3 family (4B-32B in reference catalog)
     CatalogEntry("Qwen/Qwen3-4B", "qwen3", 4.0, 36, ci_test=True),
     CatalogEntry("Qwen/Qwen3-8B", "qwen3", 8.2, 36),

@@ -60,9 +60,16 @@ class LlamaRingModel(RingModel):
         KVH = out_dim(p["wk"]) // Hd
 
         h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ dq(p["wq"])).reshape(B, T, H, Hd)
-        k = (h @ dq(p["wk"])).reshape(B, T, KVH, Hd)
-        v = (h @ dq(p["wv"])).reshape(B, T, KVH, Hd)
+        # qkv biases are present only for families that ship them (qwen2);
+        # the per-family param dict is homogeneous so `in p` is static
+        q = h @ dq(p["wq"])
+        k = h @ dq(p["wk"])
+        v = h @ dq(p["wv"])
+        if "bq" in p:
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(B, T, H, Hd)
+        k = k.reshape(B, T, KVH, Hd)
+        v = v.reshape(B, T, KVH, Hd)
         q, k = self._qk_transform(p, q, k)  # subclass hook (qwen3 q/k norms)
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
@@ -133,7 +140,7 @@ class LlamaRingModel(RingModel):
         def t(name: str) -> np.ndarray:
             return np.ascontiguousarray(raw[name].T)  # HF [out,in] -> (in,out)
 
-        return {
+        out = {
             "attn_norm": raw["input_layernorm.weight"],
             "wq": t("self_attn.q_proj.weight"),
             "wk": t("self_attn.k_proj.weight"),
@@ -144,4 +151,11 @@ class LlamaRingModel(RingModel):
             "w_up": t("mlp.up_proj.weight"),
             "w_down": t("mlp.down_proj.weight"),
         }
+        # keyed on checkpoint CONTENTS, not family: llama checkpoints with
+        # attention_bias=true and qwen2/2.5 both ship qkv biases
+        if "self_attn.q_proj.bias" in raw:
+            out["bq"] = raw["self_attn.q_proj.bias"]
+            out["bk"] = raw["self_attn.k_proj.bias"]
+            out["bv"] = raw["self_attn.v_proj.bias"]
+        return out
 

@@ -1,0 +1,19 @@
+"""Qwen2 / Qwen2.5-family ring model.
+
+BASELINE config 3 names Qwen2.5-32B; the reference's catalog spans the
+same Qwen generations via MLX conversions (src/dnet/api/catalog.py).
+Architecturally Qwen2 is the llama decoder with BIASED q/k/v projections
+(o_proj and the MLP stay bias-free), so everything — attention, the
+content-keyed bias mapping, TP seams, KV/weight quant, sp flash-decoding,
+spec decode, pipelined serving — is inherited verbatim; the bias vectors
+shard over tp like every per-head vector (parallel/mesh.py _HEAD_VECTORS).
+The subclass exists to claim the `qwen2` model_type in the registry.
+"""
+
+from __future__ import annotations
+
+from dnet_tpu.models.llama import LlamaRingModel
+
+
+class Qwen2RingModel(LlamaRingModel):
+    model_type = "qwen2"

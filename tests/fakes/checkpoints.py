@@ -368,3 +368,63 @@ def make_tiny_mixtral(model_dir: str | Path, config: dict | None = None, seed: i
             tensors[q + "w3.weight"] = w(F, D)
     save_checkpoint(model_dir, cfg, tensors)
     return cfg
+
+
+TINY_QWEN2_CONFIG = {
+    "architectures": ["Qwen2ForCausalLM"],
+    "model_type": "qwen2",
+    "vocab_size": 261,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "rms_norm_eps": 1e-6,
+    "rope_theta": 1000000.0,
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "hidden_act": "silu",
+    "torch_dtype": "float32",
+    "bos_token_id": 256,
+    "eos_token_id": 257,
+}
+
+
+def make_tiny_qwen2(model_dir: str | Path, config: dict | None = None, seed: int = 6) -> dict:
+    """Write a random-weight tiny Qwen2/2.5 checkpoint (biased q/k/v)."""
+    cfg = dict(TINY_QWEN2_CONFIG)
+    if config:
+        cfg.update(config)
+    rng = np.random.default_rng(seed)
+    D = cfg["hidden_size"]
+    F = cfg["intermediate_size"]
+    V = cfg["vocab_size"]
+    H = cfg["num_attention_heads"]
+    KVH = cfg["num_key_value_heads"]
+    Hd = cfg.get("head_dim", D // H)
+
+    def w(*shape, scale=0.05):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": w(V, D),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "self_attn.q_proj.weight"] = w(H * Hd, D)
+        tensors[p + "self_attn.q_proj.bias"] = w(H * Hd, scale=0.1)
+        tensors[p + "self_attn.k_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.k_proj.bias"] = w(KVH * Hd, scale=0.1)
+        tensors[p + "self_attn.v_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.v_proj.bias"] = w(KVH * Hd, scale=0.1)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
+        tensors[p + "mlp.gate_proj.weight"] = w(F, D)
+        tensors[p + "mlp.up_proj.weight"] = w(F, D)
+        tensors[p + "mlp.down_proj.weight"] = w(D, F)
+    save_checkpoint(model_dir, cfg, tensors)
+    return cfg
