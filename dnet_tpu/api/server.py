@@ -34,6 +34,27 @@ async def serve_async(args) -> None:
         request_timeout_s=s.api.request_timeout_s,
         max_concurrent=max_concurrent,
     )
+    # Multi-process meshes are multi-CONTROLLER: every process must dispatch
+    # the same programs in lockstep, which a request-driven HTTP server
+    # cannot guarantee (a request arriving at one host would dispatch a
+    # collective the others never enter).  Request-driven multi-host serving
+    # is the gRPC shard ring (one dnet-shard per host); the distributed
+    # join is for SPMD batch/offline execution (parallel/mesh.py).
+    if s.mesh.num_processes > 1:
+        raise SystemExit(
+            "DNET_MESH_NUM_PROCESSES>1 with the HTTP API server would "
+            "deadlock on the first request (multi-controller mesh, single "
+            "dispatching host). Serve multi-host via the gRPC ring: run "
+            "dnet-shard on every host and dnet-api with --hostfile/UDP "
+            "discovery."
+        )
+    from dnet_tpu.parallel.mesh import ensure_distributed
+
+    if ensure_distributed(s.mesh.coordinator, s.mesh.num_processes, s.mesh.process_id):
+        log.info(
+            "joined single-process distributed runtime (coordinator %s)",
+            s.mesh.coordinator,
+        )
     env_mesh = {"pp": s.mesh.pp, "tp": s.mesh.tp, "dp": s.mesh.dp, "sp": s.mesh.sp}
     env_mesh_active = s.mesh.pp > 0 or s.mesh.tp > 1 or s.mesh.dp > 1 or s.mesh.sp > 1
     mesh = _parse_mesh(getattr(args, "mesh", "")) or (

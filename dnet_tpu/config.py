@@ -271,6 +271,14 @@ class MeshSettings(_EnvGroup):
     dp: int = 1
     sp: int = 1
     backend: str = ""  # "" = jax default
+    # multi-host pods: when set, jax.distributed.initialize() runs before
+    # the first backend use so jax.devices() spans every host of the slice
+    # and the mesh engines build over the GLOBAL device set (DCN-connected
+    # slices included) — the TPU analog of the reference's NCCL/MPI-style
+    # multi-node backend.  Format "host:port" of process 0.
+    coordinator: str = ""
+    num_processes: int = 0  # 0 = single-process (no distributed init)
+    process_id: int = 0
 
 
 @dataclass

@@ -23,6 +23,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP = "dp", "pp", "tp", "sp"
 
+_distributed_up = False
+
+
+def ensure_distributed(
+    coordinator: str = "", num_processes: int = 0, process_id: int = 0
+) -> bool:
+    """Join a multi-host JAX runtime (idempotent).
+
+    After joining, `jax.devices()` spans ALL hosts of the pod (ICI within
+    a slice, DCN across), so mesh programs shard over the global device
+    set.  This is a multi-CONTROLLER runtime: every process must dispatch
+    the same programs in lockstep (SPMD batch/offline execution — e.g.
+    each host running the same generate() script).  Request-driven HTTP
+    serving across hosts goes through the gRPC shard ring instead
+    (one dnet-shard per host; each shard may use its host-local mesh);
+    api/server.py fails fast on that combination.
+
+    Returns True when distributed mode is active.  num_processes == 0
+    (the default) is single-process: no-op.
+    """
+    global _distributed_up
+    if num_processes <= 0:
+        return False
+    if not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"DNET_MESH_PROCESS_ID={process_id} out of range for "
+            f"DNET_MESH_NUM_PROCESSES={num_processes}"
+        )
+    if num_processes > 1 and not coordinator:
+        raise ValueError(
+            "DNET_MESH_COORDINATOR (host:port of process 0) is required "
+            f"when DNET_MESH_NUM_PROCESSES={num_processes} > 1"
+        )
+    import jax  # local: keep module import light
+
+    try:  # detect a runtime user code initialized directly
+        already = jax._src.distributed.global_state.client is not None
+    except AttributeError:  # private layout changed: trust our own flag
+        already = False
+    if _distributed_up or already:
+        # already joined (by us or by user code calling jax.distributed
+        # directly); a different topology cannot be honored — say so
+        if not _distributed_up:
+            _distributed_up = True
+        if jax.process_count() != num_processes:
+            raise RuntimeError(
+                f"distributed runtime already initialized with "
+                f"{jax.process_count()} processes; cannot re-join as "
+                f"{num_processes}"
+            )
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator or None,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _distributed_up = True
+    return True
+
 
 def build_mesh(
     pp: int = 1,
