@@ -12,6 +12,7 @@ from dnet_tpu.api.http import ApiHTTPServer
 from dnet_tpu.api.inference import InferenceManager
 from dnet_tpu.api.model_manager import LocalModelManager
 from dnet_tpu.config import get_settings
+from dnet_tpu.parallel.mesh import parse_mesh as _parse_mesh
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
@@ -214,26 +215,6 @@ async def serve_async(args) -> None:
         await inference.adapter.shutdown()
 
 
-def _parse_mesh(spec: str) -> dict | None:
-    """'pp=4,tp=2' -> {"pp": 4, "tp": 2}.  pp=0 means infer from devices."""
-    if not spec:
-        return None
-    out = {}
-    for part in spec.split(","):
-        key, eq, val = part.partition("=")
-        key = key.strip()
-        if not eq or not val.strip():
-            raise ValueError(f"--mesh expects axis=value pairs; got {part!r}")
-        if key not in {"pp", "tp", "dp", "sp"}:
-            raise ValueError(f"unknown mesh axis {key!r} in --mesh (use pp/tp/dp/sp)")
-        try:
-            n = int(val)
-        except ValueError:
-            raise ValueError(f"--mesh {key}={val!r} is not an integer") from None
-        if n < 0 or (n == 0 and key != "pp"):
-            raise ValueError(f"--mesh {key}={n} must be positive (pp=0 = infer)")
-        out[key] = n
-    return out
 
 
 def serve(args) -> None:

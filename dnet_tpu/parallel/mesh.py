@@ -51,36 +51,61 @@ def ensure_distributed(
             f"DNET_MESH_PROCESS_ID={process_id} out of range for "
             f"DNET_MESH_NUM_PROCESSES={num_processes}"
         )
-    if num_processes > 1 and not coordinator:
-        raise ValueError(
-            "DNET_MESH_COORDINATOR (host:port of process 0) is required "
-            f"when DNET_MESH_NUM_PROCESSES={num_processes} > 1"
-        )
     import jax  # local: keep module import light
 
     try:  # detect a runtime user code initialized directly
         already = jax._src.distributed.global_state.client is not None
     except AttributeError:  # private layout changed: trust our own flag
         already = False
+    if not (_distributed_up or already) and not coordinator:
+        # jax's cluster auto-detection only works under Slurm/TPU/MPI
+        # metadata; anywhere else it raises an opaque internal error
+        raise ValueError(
+            "DNET_MESH_COORDINATOR (host:port of process 0) is required "
+            f"when DNET_MESH_NUM_PROCESSES={num_processes} >= 1"
+        )
     if _distributed_up or already:
         # already joined (by us or by user code calling jax.distributed
         # directly); a different topology cannot be honored — say so
         if not _distributed_up:
             _distributed_up = True
-        if jax.process_count() != num_processes:
+        if jax.process_count() != num_processes or jax.process_index() != process_id:
             raise RuntimeError(
-                f"distributed runtime already initialized with "
-                f"{jax.process_count()} processes; cannot re-join as "
-                f"{num_processes}"
+                f"distributed runtime already initialized as process "
+                f"{jax.process_index()}/{jax.process_count()}; cannot "
+                f"re-join as {process_id}/{num_processes}"
             )
         return True
     jax.distributed.initialize(
-        coordinator_address=coordinator or None,
+        coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id,
     )
     _distributed_up = True
     return True
+
+
+def parse_mesh(spec: str) -> Optional[Dict[str, int]]:
+    """'pp=4,tp=2' -> {"pp": 4, "tp": 2}.  pp=0 means infer from devices.
+    Shared by the server's --mesh flag and the offline generate CLI."""
+    if not spec:
+        return None
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        key, eq, val = part.partition("=")
+        key = key.strip()
+        if not eq or not val.strip():
+            raise ValueError(f"--mesh expects axis=value pairs; got {part!r}")
+        if key not in {"pp", "tp", "dp", "sp"}:
+            raise ValueError(f"unknown mesh axis {key!r} in --mesh (use pp/tp/dp/sp)")
+        try:
+            n = int(val)
+        except ValueError:
+            raise ValueError(f"--mesh {key}={val!r} is not an integer") from None
+        if n < 0 or (n == 0 and key != "pp"):
+            raise ValueError(f"--mesh {key}={n} must be positive (pp=0 = infer)")
+        out[key] = n
+    return out
 
 
 def build_mesh(

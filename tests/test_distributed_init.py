@@ -22,6 +22,9 @@ def test_config_validation():
         ensure_distributed("h:1", num_processes=2, process_id=2)
     with pytest.raises(ValueError, match="COORDINATOR"):
         ensure_distributed("", num_processes=2, process_id=0)
+    # num_processes=1 also needs it: jax's auto-detection is opaque off-pod
+    with pytest.raises(ValueError, match="COORDINATOR"):
+        ensure_distributed("", num_processes=1, process_id=0)
 
 
 def test_single_process_join_and_idempotence():
