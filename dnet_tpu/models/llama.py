@@ -75,7 +75,8 @@ class LlamaRingModel(RingModel):
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
         attn, kvs = cached_attend(
-            q, k, v, kvs, pos, mask, kv_commit=kv_commit, sp_axis=sp_axis
+            q, k, v, kvs, pos, mask, kv_commit=kv_commit, sp_axis=sp_axis,
+            causal=mask is None and sp_axis is None,
         )
         attn_out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
@@ -109,7 +110,9 @@ class LlamaRingModel(RingModel):
         sp_axis: Optional[str] = None,
         t_real=None,  # full-length caches overwrite padding before reading
     ) -> Tuple[jnp.ndarray, dict]:
-        if mask is None:
+        if mask is None and sp_axis is not None:
+            # sp masks are rank-local; the non-sp causal predicate stays
+            # implicit (mask=None) so cached_attend can take the flash path
             mask = self._window_mask(x.shape[1], kv["k"].shape[2], pos, sp_axis)
 
         def body(carry, per_layer):

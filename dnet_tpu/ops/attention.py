@@ -66,18 +66,32 @@ def cached_attend(
     sp_axis: Optional[str] = None,
     sinks: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> Tuple[jnp.ndarray, dict]:
     """Write the new k/v into one layer's cache slices and attend over the
     full cache — the shared body of every model's attention block.  With
     `sp_axis` the cache holds this rank's sequence shard and attention runs
     as distributed flash-decoding (`mask` must then be rank-local, e.g.
-    sp_causal_mask)."""
+    sp_causal_mask).  `causal=True` (mask ignored) declares the standard
+    prefill predicate — row i attends slots [0, pos+i] — unlocking the
+    Pallas flash kernel on TPU (O(T x Hd) memory instead of the dense
+    [.., T, S] score tensor; ops/flash_attention.py)."""
     from dnet_tpu.core.kvcache import read_kv, write_kv, write_kv_sp
     from dnet_tpu.ops.ring_attention import sp_decode_attend
 
+    if causal:
+        # the flag REPLACES the mask; a caller combining both would get
+        # full-causal attention instead of its restrictive mask
+        assert mask is None, "cached_attend: causal=True requires mask=None"
     if sp_axis is None:
         kvs = write_kv(kvs, k_new, v_new, pos, kv_commit)
         kc, vc = read_kv(kvs)
+        if causal and sinks is None:
+            from dnet_tpu.ops.flash_attention import flash_attend_causal
+
+            return flash_attend_causal(q, kc, vc, pos, scale=scale), kvs
+        if mask is None and causal:
+            mask = causal_mask(q.shape[1], kc.shape[1], pos)
         return attend(q, kc, vc, mask=mask, sinks=sinks, scale=scale), kvs
     kvs = write_kv_sp(kvs, k_new, v_new, pos, sp_axis, kv_commit)
     kc, vc = read_kv(kvs)

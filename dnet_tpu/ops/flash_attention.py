@@ -1,0 +1,191 @@
+"""Flash attention for causal prefill (Pallas on TPU, jnp fallback).
+
+The dense `ops.attention.attend` materializes the full [B, KVH, G, T, S]
+f32 score tensor — at long-context prefill that is the dominant HBM
+cost (a 8K x 8K f32 score block is 256 MB per head-group) and the reason
+chunked prefill exists.  This kernel streams KV tiles through VMEM with
+the online-softmax accumulator (m, l, acc) in scratch, so memory is
+O(T x Hd) regardless of S, and the MXU sees [bq, Hd] x [Hd, bk] tiles.
+
+Reference analog: the compression subsystem's Metal kernels show the
+reference's pattern of hand-written GPU kernels for hot ops
+(src/dnet/compression/kernels.py); attention is the TPU hot op worth the
+same treatment.  Scope: CAUSAL SELF-ATTENTION against a slot-addressed
+cache — query row i attends keys [0, pos + i] — which is exactly the
+llama-family prefill (`_window_mask` builds the same predicate).  Sinks,
+sliding windows, sp sharding, and MLA's asymmetric V stay on the dense
+path.
+
+TPU grids run sequentially over the LAST axis, so the KV-tile axis comes
+last and the scratch accumulator carries across its iterations; blocks
+strictly above the causal diagonal are skipped (`pl.when`), halving the
+work like every flash implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, bq: int, bk: int, scale: float, n_s: int):
+    """One (batch, head, q-tile, kv-tile) step of the online softmax.
+
+    q_ref/o_ref [1, bq, 1, Hd]; k_ref/v_ref [1, bk, 1, Hd] — blocks of the
+    NATIVE [B, T/S, heads, Hd] layouts (no transposed copies of the cache);
+    scratch m/l [bq, 1] f32, acc [bq, Hd] f32; pos_ref SMEM [1]."""
+    import jax.experimental.pallas as pl
+
+    tq = pl.program_id(2)
+    s = pl.program_id(3)
+    pos = pos_ref[0]
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # this q-tile's LAST row attends keys <= pos + tq*bq + bq - 1; a kv
+    # tile starting past that is fully masked for the whole tile -> skip
+    q_hi = pos + (tq + 1) * bq - 1
+
+    @pl.when(s * bk <= q_hi)
+    def _fold():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [bq, Hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, Hd]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        q_pos = pos + tq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = s * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+
+        m_prev = m_ref[:]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        p = jnp.exp(scores - m_new)  # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_ref[0, :, 0, :].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, Hd]
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = m_new
+
+    @pl.when(s == n_s - 1)
+    def _emit():
+        o_ref[0, :, 0, :] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("G", "scale", "bq", "bk", "interpret")
+)
+def _flash_pallas(q, k, v, pos, *, G: int, scale: float, bq: int,
+                  bk: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, Hd = q.shape
+    S = k.shape[1]
+    n_s = S // bk
+
+    # grid (batch, head, q-tile, kv-tile); kv-tile LAST so the scratch
+    # accumulator carries across its (sequential) iterations
+    grid = (B, H, T // bq, n_s)
+    kernel = functools.partial(
+        _flash_kernel, bq=bq, bk=bk, scale=scale, n_s=n_s
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # pos [1]
+            pl.BlockSpec((1, bq, 1, Hd), lambda b, h, tq, s: (b, tq, h, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, 1, Hd), lambda b, h, tq, s: (b, s, h // G, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, 1, Hd), lambda b, h, tq, s: (b, s, h // G, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, 1, Hd), lambda b, h, tq, s: (b, tq, h, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, T, H, Hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, Hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pos, q, k, v)
+
+
+def _pick_tile(n: int, target: int) -> int:
+    for t in (target, 128, 64, 32, 16, 8):
+        if t <= n and n % t == 0:
+            return t
+    return 0
+
+
+def _interpret() -> bool:
+    return os.environ.get("DNET_FLASH_INTERPRET", "") in {"1", "true"}
+
+
+def flash_eligible(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
+    """Kernel preconditions: self-attention layout (same K/V head dim),
+    GQA-divisible heads, tileable T/S, and a TPU backend (or the test
+    override forcing interpret mode)."""
+    if not _interpret() and jax.default_backend() != "tpu":
+        return False
+    B, T, H, Hd = q.shape
+    S, KVH = k.shape[1], k.shape[2]
+    return (
+        v.shape[-1] == Hd
+        and H % KVH == 0
+        and T >= 8
+        and _pick_tile(T, 128) > 0
+        and _pick_tile(S, 128) > 0
+    )
+
+
+def flash_attend_causal(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    pos,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal prefill attention: query row i attends cache slots [0, pos+i].
+
+    q [B, T, H, Hd]; k/v [B, S, KVH, Hd] (the full slot-addressed cache;
+    slots past pos+T are excluded by causality).  Equals
+    `attend(q, k, v, mask=causal_mask(T, S, pos))` — the Pallas kernel
+    runs on TPU (or under DNET_FLASH_INTERPRET=1 for CPU tests), the
+    dense op otherwise.
+    """
+    B, T, H, Hd = q.shape
+    S, KVH = k.shape[1], k.shape[2]
+    scale = Hd**-0.5 if scale is None else scale
+    if not flash_eligible(q, k, v):
+        from dnet_tpu.ops.attention import attend, causal_mask
+
+        return attend(q, k, v, mask=causal_mask(T, S, pos), scale=scale)
+    # native layouts throughout: BlockSpec index maps pick head h's KV row
+    # h // G directly, so neither the query nor the (much larger) cache is
+    # copied/transposed in HBM
+    return _flash_pallas(
+        q, k, v, jnp.asarray([pos], dtype=jnp.int32), G=H // KVH,
+        scale=float(scale),
+        bq=_pick_tile(T, 128), bk=_pick_tile(S, 128),
+        interpret=_interpret(),
+    )

@@ -1,0 +1,93 @@
+"""Pallas flash-attention prefill kernel vs the dense op (interpret mode)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dnet_tpu.ops.attention import attend, causal_mask
+
+pytestmark = pytest.mark.core
+
+
+@pytest.fixture(autouse=True)
+def _force_kernel(monkeypatch):
+    # run the REAL kernel via the pallas interpreter on CPU
+    monkeypatch.setenv("DNET_FLASH_INTERPRET", "1")
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.normal(size=shape).astype(np.float32))
+
+
+@pytest.mark.parametrize(
+    "B,T,H,KVH,Hd,S,pos",
+    [
+        (1, 16, 4, 4, 16, 32, 0),  # MHA, fresh cache
+        (2, 32, 4, 2, 16, 64, 8),  # GQA, continued session
+        (1, 8, 8, 2, 32, 8, 0),  # T == S, 4x grouping
+        (1, 64, 2, 1, 16, 256, 96),  # long cache, late chunk (MQA)
+    ],
+)
+def test_matches_dense_causal(rng, B, T, H, KVH, Hd, S, pos):
+    from dnet_tpu.ops.flash_attention import flash_attend_causal, flash_eligible
+
+    q = _rand(rng, B, T, H, Hd)
+    k = _rand(rng, B, S, KVH, Hd)
+    v = _rand(rng, B, S, KVH, Hd)
+    assert flash_eligible(q, k, v)
+    ref = attend(q, k, v, mask=causal_mask(T, S, pos))
+    out = flash_attend_causal(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_custom_scale(rng):
+    from dnet_tpu.ops.flash_attention import flash_attend_causal
+
+    q, k, v = _rand(rng, 1, 16, 2, 16), _rand(rng, 1, 32, 2, 16), _rand(rng, 1, 32, 2, 16)
+    scale = 0.33
+    ref = attend(q, k, v, mask=causal_mask(16, 32, 4), scale=scale)
+    out = flash_attend_causal(q, k, v, 4, scale=scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_garbage_slots_never_attended(rng):
+    """Cache slots past pos+T must not influence the output (they hold
+    stale garbage between sessions)."""
+    from dnet_tpu.ops.flash_attention import flash_attend_causal
+
+    T, S, pos = 8, 64, 4
+    q = _rand(rng, 1, T, 2, 16)
+    k = _rand(rng, 1, S, 2, 16)
+    v = _rand(rng, 1, S, 2, 16)
+    out = flash_attend_causal(q, k, v, pos)
+    k2 = k.at[:, pos + T:].set(1e4)  # poison unreachable slots
+    v2 = v.at[:, pos + T:].set(-1e4)
+    out2 = flash_attend_causal(q, k2, v2, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=0, rtol=0)
+
+
+def test_decode_width_falls_back(rng, monkeypatch):
+    """T=1 decode stays on the dense path (flash_eligible False) and is
+    still causal-exact."""
+    from dnet_tpu.ops.flash_attention import flash_attend_causal, flash_eligible
+
+    q, k, v = _rand(rng, 1, 1, 2, 16), _rand(rng, 1, 32, 2, 16), _rand(rng, 1, 32, 2, 16)
+    assert not flash_eligible(q, k, v)
+    ref = attend(q, k, v, mask=causal_mask(1, 32, 7))
+    out = flash_attend_causal(q, k, v, 7)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs(rng):
+    from dnet_tpu.ops.flash_attention import flash_attend_causal
+
+    q = _rand(rng, 1, 16, 2, 16).astype(jnp.bfloat16)
+    k = _rand(rng, 1, 32, 2, 16).astype(jnp.bfloat16)
+    v = _rand(rng, 1, 32, 2, 16).astype(jnp.bfloat16)
+    ref = attend(q, k, v, mask=causal_mask(16, 32, 0))
+    out = flash_attend_causal(q, k, v, 0)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2,
+    )
