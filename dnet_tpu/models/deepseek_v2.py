@@ -36,7 +36,7 @@ from jax import lax
 
 from dnet_tpu.core.kvcache import KVConfig
 from dnet_tpu.models.base import ModelConfig, RingModel
-from dnet_tpu.ops.attention import cached_attend, causal_mask, sp_causal_mask
+from dnet_tpu.ops.attention import cached_attend, sp_causal_mask
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq
 from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
@@ -149,10 +149,13 @@ class DeepseekV2RingModel(RingModel):
         # shared body incl. the sp path: with sp_axis the cache holds this
         # rank's sequence shard and attention runs as distributed
         # flash-decoding with an LSE combine (ops/ring_attention.py) —
-        # MLA's asymmetric K/V head dims flow through unchanged
+        # MLA's asymmetric K/V head dims flow through unchanged.  mask=None
+        # non-sp declares the plain causal predicate: prefill takes the
+        # Pallas flash kernel on TPU (ops/flash_attention.py)
         attn, kvs = cached_attend(
             q_full, k_full, v, kvs, pos, mask,
             kv_commit=kv_commit, sp_axis=sp_axis, scale=self.softmax_scale,
+            causal=mask is None and sp_axis is None,
         )
         out = attn.reshape(B, T, H * vd) @ dq(p["wo"])
         if tp_axis is not None:
@@ -261,13 +264,10 @@ class DeepseekV2RingModel(RingModel):
         all-dense-then-all-moe even though each pp rank holds a slice of
         both segments.
         """
-        if mask is None:
-            S_local = kv["k"].shape[2]
-            mask = (
-                causal_mask(x.shape[1], S_local, pos)
-                if sp_axis is None
-                else sp_causal_mask(x.shape[1], S_local, pos, sp_axis)
-            )
+        if mask is None and sp_axis is not None:
+            # sp masks are rank-local; the non-sp causal predicate stays
+            # implicit (mask=None) so cached_attend can take the flash path
+            mask = sp_causal_mask(x.shape[1], kv["k"].shape[2], pos, sp_axis)
         dense = window_params.get("dense")
         moe = window_params.get("moe")
         Ld = dense["attn_norm"].shape[0] if dense is not None else 0

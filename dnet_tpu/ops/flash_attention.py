@@ -11,10 +11,9 @@ Reference analog: the compression subsystem's Metal kernels show the
 reference's pattern of hand-written GPU kernels for hot ops
 (src/dnet/compression/kernels.py); attention is the TPU hot op worth the
 same treatment.  Scope: CAUSAL SELF-ATTENTION against a slot-addressed
-cache — query row i attends keys [0, pos + i] — which is exactly the
-llama-family prefill (`_window_mask` builds the same predicate).  Sinks,
-sliding windows, sp sharding, and MLA's asymmetric V stay on the dense
-path.
+cache — query row i attends keys [0, pos + i] — which is the llama-family
+and deepseek-MLA prefill predicate (V's head dim may differ from Q/K's).
+Sinks, sliding windows, and sp sharding stay on the dense path.
 
 TPU grids run sequentially over the LAST axis, so the KV-tile axis comes
 last and the scratch accumulator carries across its iterations; blocks
@@ -38,9 +37,9 @@ def _flash_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
                   *, bq: int, bk: int, scale: float, n_s: int):
     """One (batch, head, q-tile, kv-tile) step of the online softmax.
 
-    q_ref/o_ref [1, bq, 1, Hd]; k_ref/v_ref [1, bk, 1, Hd] — blocks of the
-    NATIVE [B, T/S, heads, Hd] layouts (no transposed copies of the cache);
-    scratch m/l [bq, 1] f32, acc [bq, Hd] f32; pos_ref SMEM [1]."""
+    q_ref/k_ref [.., Hd]; v_ref/o_ref [.., Vd] (MLA: Vd may differ) —
+    blocks of the NATIVE [B, T/S, heads, dim] layouts (no transposed copies
+    of the cache); scratch m/l [bq, 1] f32, acc [bq, Vd] f32; pos SMEM [1]."""
     import jax.experimental.pallas as pl
 
     tq = pl.program_id(2)
@@ -61,6 +60,8 @@ def _flash_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     def _fold():
         q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [bq, Hd]
         k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, Hd]
+        # v may have a different head dim (MLA caches qk_head_dim keys but
+        # v_head_dim values); acc is sized [bq, Vd]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -77,7 +78,7 @@ def _flash_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         pv = jax.lax.dot_general(
             p, v_ref[0, :, 0, :].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [bq, Hd]
+        )  # [bq, Vd]
         acc_ref[:] = acc_ref[:] * corr + pv
         m_ref[:] = m_new
 
@@ -98,6 +99,7 @@ def _flash_pallas(q, k, v, pos, *, G: int, scale: float, bq: int,
 
     B, T, H, Hd = q.shape
     S = k.shape[1]
+    Vd = v.shape[-1]
     n_s = S // bk
 
     # grid (batch, head, q-tile, kv-tile); kv-tile LAST so the scratch
@@ -115,16 +117,16 @@ def _flash_pallas(q, k, v, pos, *, G: int, scale: float, bq: int,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, 1, Hd), lambda b, h, tq, s: (b, s, h // G, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, 1, Hd), lambda b, h, tq, s: (b, s, h // G, 0),
+            pl.BlockSpec((1, bk, 1, Vd), lambda b, h, tq, s: (b, s, h // G, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, 1, Hd), lambda b, h, tq, s: (b, tq, h, 0),
+        out_specs=pl.BlockSpec((1, bq, 1, Vd), lambda b, h, tq, s: (b, tq, h, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, T, H, Hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, T, H, Vd), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, Hd), jnp.float32),
+            pltpu.VMEM((bq, Vd), jnp.float32),
         ],
         interpret=interpret,
     )(pos, q, k, v)
@@ -142,16 +144,15 @@ def _interpret() -> bool:
 
 
 def flash_eligible(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
-    """Kernel preconditions: self-attention layout (same K/V head dim),
-    GQA-divisible heads, tileable T/S, and a TPU backend (or the test
-    override forcing interpret mode)."""
+    """Kernel preconditions: GQA-divisible heads, tileable T/S, and a TPU
+    backend (or the test override forcing interpret mode).  V's head dim
+    may differ from Q/K's (MLA)."""
     if not _interpret() and jax.default_backend() != "tpu":
         return False
-    B, T, H, Hd = q.shape
+    T, H = q.shape[1], q.shape[2]
     S, KVH = k.shape[1], k.shape[2]
     return (
-        v.shape[-1] == Hd
-        and H % KVH == 0
+        H % KVH == 0
         and T >= 8
         and _pick_tile(T, 128) > 0
         and _pick_tile(S, 128) > 0
@@ -167,7 +168,7 @@ def flash_attend_causal(
 ) -> jnp.ndarray:
     """Causal prefill attention: query row i attends cache slots [0, pos+i].
 
-    q [B, T, H, Hd]; k/v [B, S, KVH, Hd] (the full slot-addressed cache;
+    q [B, T, H, Hd]; k [B, S, KVH, Hd], v [B, S, KVH, Vd] (the full cache;
     slots past pos+T are excluded by causality).  Equals
     `attend(q, k, v, mask=causal_mask(T, S, pos))` — the Pallas kernel
     runs on TPU (or under DNET_FLASH_INTERPRET=1 for CPU tests), the

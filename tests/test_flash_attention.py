@@ -40,6 +40,20 @@ def test_matches_dense_causal(rng, B, T, H, KVH, Hd, S, pos):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_asymmetric_v_head_dim(rng):
+    """MLA layout: K caches qk_head_dim but V caches v_head_dim."""
+    from dnet_tpu.ops.flash_attention import flash_attend_causal, flash_eligible
+
+    q = _rand(rng, 1, 16, 4, 24)  # qk head dim 24
+    k = _rand(rng, 1, 32, 4, 24)
+    v = _rand(rng, 1, 32, 4, 16)  # v head dim 16
+    assert flash_eligible(q, k, v)
+    ref = attend(q, k, v, mask=causal_mask(16, 32, 2))
+    out = flash_attend_causal(q, k, v, 2)
+    assert out.shape == (1, 16, 4, 16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_custom_scale(rng):
     from dnet_tpu.ops.flash_attention import flash_attend_causal
 
