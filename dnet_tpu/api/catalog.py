@@ -47,6 +47,8 @@ model_catalog: List[CatalogEntry] = [
     CatalogEntry("Qwen/Qwen3-8B", "qwen3", 8.2, 36),
     CatalogEntry("Qwen/Qwen3-14B", "qwen3", 14.8, 40),
     CatalogEntry("Qwen/Qwen3-32B", "qwen3", 32.8, 64),
+    CatalogEntry("Qwen/Qwen3-30B-A3B", "qwen3_moe", 30.5, 48, notes="MoE 128x top-8"),
+    CatalogEntry("Qwen/Qwen3-235B-A22B", "qwen3_moe", 235.0, 94, notes="MoE 128x top-8"),
     # GPT-OSS MoE (20B/120B in reference catalog)
     CatalogEntry("openai/gpt-oss-20b", "gpt_oss", 20.9, 24, notes="MoE 32x, SWA alternating"),
     CatalogEntry("openai/gpt-oss-120b", "gpt_oss", 116.8, 36, notes="MoE 128x, SWA alternating"),

@@ -42,6 +42,10 @@ def get_ring_model_cls(model_type: str) -> Type[RingModel]:
         from dnet_tpu.models import qwen2  # noqa: F401
     except ImportError:
         pass
+    try:
+        from dnet_tpu.models import qwen3_moe  # noqa: F401
+    except ImportError:
+        pass
 
     for sub in _all_subclasses(RingModel):
         if getattr(sub, "model_type", None) == model_type:

@@ -80,7 +80,8 @@ class ModelConfig:
             mlp_bias=d.get("mlp_bias", False),
             sliding_window=d.get("sliding_window") or 0,
             layer_types=d.get("layer_types"),
-            num_local_experts=d.get("num_local_experts", 0),
+            # mixtral/gpt_oss say num_local_experts; qwen3_moe says num_experts
+            num_local_experts=d.get("num_local_experts", d.get("num_experts", 0)),
             num_experts_per_tok=d.get("num_experts_per_tok", 0),
             extra=d,
         )

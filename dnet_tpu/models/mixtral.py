@@ -36,6 +36,9 @@ class MixtralRingModel(LlamaRingModel):
     quant_keys = frozenset(
         {"wq", "wk", "wv", "wo", "e_gate", "e_up", "e_down"}
     )  # router gate_w stays f32 (routing decisions are precision-sensitive)
+    # renormalize the kept top-k weights; always on for mixtral, config-read
+    # for qwen3_moe ("only diff with mixtral sparse moe block" per HF)
+    norm_topk_prob = True
 
     def _mlp_block(self, p: dict, x: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
         B, T, D = x.shape
@@ -48,7 +51,8 @@ class MixtralRingModel(LlamaRingModel):
         scores = jax.nn.softmax(logits, axis=-1)  # [N, E] f32
         k = self.config.num_experts_per_tok
         top_w, top_idx = lax.top_k(scores, k)
-        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+        if self.norm_topk_prob:
+            top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
         top_idx = top_idx.astype(jnp.int32)
 
         from dnet_tpu.ops.moe import moe_apply, swiglu_expert_closures

@@ -1,0 +1,71 @@
+"""Qwen3-MoE-family ring model (Qwen3-30B-A3B / 235B-A22B class).
+
+Qwen3 attention (per-head q/k RMS norms before RoPE) + the mixtral-style
+sparse MoE FFN — transformers' Qwen3MoeSparseMoeBlock is Mixtral's block
+with `norm_topk_prob` read from config ("only diff with mixtral sparse
+moe block"), so the whole compute path is inherited from MixtralRingModel
+and only the attention hook and HF weight names differ.  Supports the
+homogeneous all-MoE layout (every released Qwen3-MoE checkpoint);
+`mlp_only_layers` mixing dense layers in would need deepseek-style
+segmented stacking and fails fast instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from dnet_tpu.models.base import ModelConfig
+from dnet_tpu.models.mixtral import MixtralRingModel
+from dnet_tpu.models.qwen3 import Qwen3RingModel
+
+
+class Qwen3MoeRingModel(MixtralRingModel, Qwen3RingModel):
+    """MRO: Mixtral's _mlp_block (sparse MoE) + Qwen3's _qk_transform
+    (per-head q/k norms) over the shared llama decoder."""
+
+    model_type = "qwen3_moe"
+
+    def __init__(self, config: ModelConfig, layers):
+        super().__init__(config, layers)
+        # transformers Qwen3MoeConfig defaults norm_topk_prob to FALSE
+        # (unlike mixtral, which always renormalizes)
+        self.norm_topk_prob = bool(config.extra.get("norm_topk_prob", False))
+        mlp_only = set(config.extra.get("mlp_only_layers") or [])
+        step = config.extra.get("decoder_sparse_step", 1)
+        dense = [
+            a for a in self.layers
+            if a in mlp_only or (step > 1 and (a + 1) % step != 0)
+        ]
+        if dense:
+            raise NotImplementedError(
+                f"qwen3_moe with dense layers {dense} needs segmented "
+                f"stacking; only the homogeneous all-MoE layout is supported"
+            )
+
+    def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        def t(name: str) -> np.ndarray:
+            return np.ascontiguousarray(raw[name].T)
+
+        E = self.config.num_local_experts
+        return {
+            "attn_norm": raw["input_layernorm.weight"],
+            "wq": t("self_attn.q_proj.weight"),
+            "wk": t("self_attn.k_proj.weight"),
+            "wv": t("self_attn.v_proj.weight"),
+            "wo": t("self_attn.o_proj.weight"),
+            "q_norm": raw["self_attn.q_norm.weight"],
+            "k_norm": raw["self_attn.k_norm.weight"],
+            "mlp_norm": raw["post_attention_layernorm.weight"],
+            "gate_w": t("mlp.gate.weight"),  # [D, E] router
+            "e_gate": np.stack(
+                [t(f"mlp.experts.{e}.gate_proj.weight") for e in range(E)]
+            ),
+            "e_up": np.stack(
+                [t(f"mlp.experts.{e}.up_proj.weight") for e in range(E)]
+            ),
+            "e_down": np.stack(
+                [t(f"mlp.experts.{e}.down_proj.weight") for e in range(E)]
+            ),
+        }

@@ -428,3 +428,73 @@ def make_tiny_qwen2(model_dir: str | Path, config: dict | None = None, seed: int
         tensors[p + "mlp.down_proj.weight"] = w(D, F)
     save_checkpoint(model_dir, cfg, tensors)
     return cfg
+
+
+TINY_QWEN3_MOE_CONFIG = {
+    "architectures": ["Qwen3MoeForCausalLM"],
+    "model_type": "qwen3_moe",
+    "vocab_size": 261,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "moe_intermediate_size": 96,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "num_experts": 4,
+    "num_experts_per_tok": 2,
+    "norm_topk_prob": True,
+    "decoder_sparse_step": 1,
+    "mlp_only_layers": [],
+    "rms_norm_eps": 1e-6,
+    "rope_theta": 1000000.0,
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "attention_bias": False,
+    "hidden_act": "silu",
+    "torch_dtype": "float32",
+    "bos_token_id": 256,
+    "eos_token_id": 257,
+}
+
+
+def make_tiny_qwen3_moe(model_dir: str | Path, config: dict | None = None, seed: int = 7) -> dict:
+    """Write a random-weight tiny Qwen3-MoE checkpoint (q/k norms + MoE)."""
+    cfg = dict(TINY_QWEN3_MOE_CONFIG)
+    if config:
+        cfg.update(config)
+    rng = np.random.default_rng(seed)
+    D = cfg["hidden_size"]
+    F = cfg["moe_intermediate_size"]
+    V = cfg["vocab_size"]
+    H = cfg["num_attention_heads"]
+    KVH = cfg["num_key_value_heads"]
+    Hd = cfg.get("head_dim", D // H)
+    E = cfg["num_experts"]
+
+    def w(*shape, scale=0.05):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    tensors = {
+        "model.embed_tokens.weight": w(V, D),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": w(V, D),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32) + w(D, scale=0.01)
+        tensors[p + "self_attn.q_proj.weight"] = w(H * Hd, D)
+        tensors[p + "self_attn.k_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.v_proj.weight"] = w(KVH * Hd, D)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
+        tensors[p + "self_attn.q_norm.weight"] = np.ones(Hd, np.float32) + w(Hd, scale=0.01)
+        tensors[p + "self_attn.k_norm.weight"] = np.ones(Hd, np.float32) + w(Hd, scale=0.01)
+        tensors[p + "mlp.gate.weight"] = w(E, D, scale=0.3)
+        for e in range(E):
+            q = p + f"mlp.experts.{e}."
+            tensors[q + "gate_proj.weight"] = w(F, D)
+            tensors[q + "up_proj.weight"] = w(F, D)
+            tensors[q + "down_proj.weight"] = w(D, F)
+    save_checkpoint(model_dir, cfg, tensors)
+    return cfg
