@@ -73,7 +73,7 @@ class GptOssRingModel(RingModel):
 
     # ---- pure compute -------------------------------------------------
     def _attention(self, p, x, kvs, pos, mask, tp_axis, kv_commit, sp_axis=None,
-                   rotating_window: int = 0, t_real=None):
+                   rotating_window: int = 0, t_real=None, causal: bool = False):
         cfg = self.config
         B, T, D = x.shape
         Hd = cfg.head_dim
@@ -96,6 +96,7 @@ class GptOssRingModel(RingModel):
             attn, kvs = cached_attend(
                 q, k, v, kvs, pos, mask,
                 kv_commit=kv_commit, sp_axis=sp_axis, sinks=p["sinks"],
+                causal=causal,
             )
         out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
@@ -189,19 +190,28 @@ class GptOssRingModel(RingModel):
             # configured window, NOT the other half, or a both-halves-
             # sliding window would silently fall into the clamped-write path
             rotating = kind == 1 and 0 < W_cfg == S_h and sp_axis is None
-            m = None if rotating else self._kind_mask(kind, T, S_h, pos, sp_axis, mask)
+            # a full-attention half with no extra caller mask is the plain
+            # causal predicate: declare it (flash path) instead of
+            # materializing the mask
+            causal = kind == 0 and sp_axis is None and mask is None
+            m = (
+                None
+                if rotating or causal
+                else self._kind_mask(kind, T, S_h, pos, sp_axis, mask)
+            )
             W = self.config.sliding_window if rotating else 0
-            ctx[h] = (m, W)
+            ctx[h] = (m, W, causal)
 
         def body(carry, per):
             xc = carry
             kv_out = {}
             for i, h in enumerate(halves):
                 p, kvs = per[h]
-                m, W = ctx[h]
+                m, W, causal = ctx[h]
                 xc, kvs = self._attention(
                     p, xc, kvs, pos, m, tp_axis, kv_commit,
                     sp_axis=sp_axis, rotating_window=W, t_real=t_real,
+                    causal=causal,
                 )
                 xc = self._moe(p, xc, tp_axis)
                 kv_out[h] = kvs

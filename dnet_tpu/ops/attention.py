@@ -86,12 +86,10 @@ def cached_attend(
     if sp_axis is None:
         kvs = write_kv(kvs, k_new, v_new, pos, kv_commit)
         kc, vc = read_kv(kvs)
-        if causal and sinks is None:
+        if causal:
             from dnet_tpu.ops.flash_attention import flash_attend_causal
 
-            return flash_attend_causal(q, kc, vc, pos, scale=scale), kvs
-        if mask is None and causal:
-            mask = causal_mask(q.shape[1], kc.shape[1], pos)
+            return flash_attend_causal(q, kc, vc, pos, scale=scale, sinks=sinks), kvs
         return attend(q, kc, vc, mask=mask, sinks=sinks, scale=scale), kvs
     kvs = write_kv_sp(kvs, k_new, v_new, pos, sp_axis, kv_commit)
     kc, vc = read_kv(kvs)

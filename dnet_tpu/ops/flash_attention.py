@@ -11,9 +11,10 @@ Reference analog: the compression subsystem's Metal kernels show the
 reference's pattern of hand-written GPU kernels for hot ops
 (src/dnet/compression/kernels.py); attention is the TPU hot op worth the
 same treatment.  Scope: CAUSAL SELF-ATTENTION against a slot-addressed
-cache — query row i attends keys [0, pos + i] — which is the llama-family
-and deepseek-MLA prefill predicate (V's head dim may differ from Q/K's).
-Sinks, sliding windows, and sp sharding stay on the dense path.
+cache — query row i attends keys [0, pos + i] — covering llama-family,
+deepseek-MLA (V's head dim may differ from Q/K's), and gpt_oss
+full-attention prefill (per-head sink logits folded into the softmax
+denominator at emit).  Sliding windows and sp sharding stay dense.
 
 TPU grids run sequentially over the LAST axis, so the KV-tile axis comes
 last and the scratch accumulator carries across its iterations; blocks
@@ -33,15 +34,19 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _flash_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, bq: int, bk: int, scale: float, n_s: int):
+def _flash_kernel(pos_ref, sink_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                  acc_ref, *, bq: int, bk: int, scale: float, n_s: int):
     """One (batch, head, q-tile, kv-tile) step of the online softmax.
 
     q_ref/k_ref [.., Hd]; v_ref/o_ref [.., Vd] (MLA: Vd may differ) —
     blocks of the NATIVE [B, T/S, heads, dim] layouts (no transposed copies
-    of the cache); scratch m/l [bq, 1] f32, acc [bq, Vd] f32; pos SMEM [1]."""
+    of the cache); scratch m/l [bq, 1] f32, acc [bq, Vd] f32; pos SMEM [1];
+    sink_ref SMEM [H] per-head sink logits (GPT-OSS: a virtual key that
+    absorbs probability mass but contributes no value; NEG_INF = no sink,
+    exp underflows to an exact no-op)."""
     import jax.experimental.pallas as pl
 
+    h = pl.program_id(1)
     tq = pl.program_id(2)
     s = pl.program_id(3)
     pos = pos_ref[0]
@@ -84,15 +89,21 @@ def _flash_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(s == n_s - 1)
     def _emit():
+        # fold the sink into the global softmax denominator exactly once
+        # (same algebra as the dense op's virtual-key column)
+        sink = sink_ref[h]
+        m_fin = jnp.maximum(m_ref[:], sink)
+        corr = jnp.exp(m_ref[:] - m_fin)
+        l_fin = l_ref[:] * corr + jnp.exp(sink - m_fin)
         o_ref[0, :, 0, :] = (
-            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+            acc_ref[:] * corr / jnp.maximum(l_fin, 1e-30)
         ).astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit, static_argnames=("G", "scale", "bq", "bk", "interpret")
 )
-def _flash_pallas(q, k, v, pos, *, G: int, scale: float, bq: int,
+def _flash_pallas(q, k, v, pos, sinks, *, G: int, scale: float, bq: int,
                   bk: int, interpret: bool):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -113,6 +124,7 @@ def _flash_pallas(q, k, v, pos, *, G: int, scale: float, bq: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # pos [1]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # sinks [H]
             pl.BlockSpec((1, bq, 1, Hd), lambda b, h, tq, s: (b, tq, h, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, 1, Hd), lambda b, h, tq, s: (b, s, h // G, 0),
@@ -129,7 +141,7 @@ def _flash_pallas(q, k, v, pos, *, G: int, scale: float, bq: int,
             pltpu.VMEM((bq, Vd), jnp.float32),
         ],
         interpret=interpret,
-    )(pos, q, k, v)
+    )(pos, sinks, q, k, v)
 
 
 def _pick_tile(n: int, target: int) -> int:
@@ -165,14 +177,16 @@ def flash_attend_causal(
     v: jnp.ndarray,
     pos,
     scale: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Causal prefill attention: query row i attends cache slots [0, pos+i].
 
     q [B, T, H, Hd]; k [B, S, KVH, Hd], v [B, S, KVH, Vd] (the full cache;
     slots past pos+T are excluded by causality).  Equals
-    `attend(q, k, v, mask=causal_mask(T, S, pos))` — the Pallas kernel
-    runs on TPU (or under DNET_FLASH_INTERPRET=1 for CPU tests), the
-    dense op otherwise.
+    `attend(q, k, v, mask=causal_mask(T, S, pos), sinks=sinks)` — the
+    Pallas kernel runs on TPU (or under DNET_FLASH_INTERPRET=1 for CPU
+    tests), the dense op otherwise.  sinks [H]: per-head attention-sink
+    logits (GPT-OSS).
     """
     B, T, H, Hd = q.shape
     S, KVH = k.shape[1], k.shape[2]
@@ -180,12 +194,18 @@ def flash_attend_causal(
     if not flash_eligible(q, k, v):
         from dnet_tpu.ops.attention import attend, causal_mask
 
-        return attend(q, k, v, mask=causal_mask(T, S, pos), scale=scale)
+        return attend(q, k, v, mask=causal_mask(T, S, pos), scale=scale,
+                      sinks=sinks)
+    sink_arr = (
+        jnp.full((H,), NEG_INF, dtype=jnp.float32)
+        if sinks is None
+        else sinks.astype(jnp.float32)
+    )
     # native layouts throughout: BlockSpec index maps pick head h's KV row
     # h // G directly, so neither the query nor the (much larger) cache is
     # copied/transposed in HBM
     return _flash_pallas(
-        q, k, v, jnp.asarray([pos], dtype=jnp.int32), G=H // KVH,
+        q, k, v, jnp.asarray([pos], dtype=jnp.int32), sink_arr, G=H // KVH,
         scale=float(scale),
         bq=_pick_tile(T, 128), bk=_pick_tile(S, 128),
         interpret=_interpret(),

@@ -54,6 +54,20 @@ def test_asymmetric_v_head_dim(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_attention_sinks(rng):
+    """GPT-OSS sinks: a virtual key absorbing softmax mass, folded into
+    the flash denominator exactly once at emit."""
+    from dnet_tpu.ops.flash_attention import flash_attend_causal
+
+    q = _rand(rng, 1, 16, 4, 16)
+    k = _rand(rng, 1, 32, 2, 16)
+    v = _rand(rng, 1, 32, 2, 16)
+    sinks = jnp.asarray(np.linspace(-1.0, 2.0, 4), jnp.float32)
+    ref = attend(q, k, v, mask=causal_mask(16, 32, 4), sinks=sinks)
+    out = flash_attend_causal(q, k, v, 4, sinks=sinks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_custom_scale(rng):
     from dnet_tpu.ops.flash_attention import flash_attend_causal
 
