@@ -350,7 +350,47 @@ def main() -> None:
     }
     if "--smoke" in sys.argv:
         out.update(_compress_microbench())
+        if mesh_cfg is None:
+            out.update(_spec_microbench(cfg, window, edge, max_seq))
     print(json.dumps(out))
+
+
+def _spec_microbench(cfg, window, edge, max_seq: int) -> dict:
+    """Speculative decoding on a repetitive stream (smoke mode only): the
+    verify-forward path emits 1..L+1 tokens per weight read, so accepted
+    drafts multiply throughput; tokens/block records the acceptance rate
+    the gain came from."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    # batch pinned to 1: speculation is a batch-1 feature (acceptance
+    # length is per-lane; spec_eligible refuses larger batches), so this
+    # number is per-stream regardless of the bench's --batch flag
+    eng = LocalEngine.from_params(
+        cfg, window, edge, batch=1, max_seq=max_seq, spec_lookahead=4
+    )
+    # a repeating prompt gives prompt-lookup something to look up
+    ids = [1, 7, 3, 11] * 8
+    dec = DecodingParams(temperature=0.0)
+    eng.prefill_and_sample("warm", ids, dec)
+    eng.decode_spec("warm", ids[-1], dec, 8)  # compile the verify block
+    eng.decode_step("warm", ids[-1], dec)  # compile the budget<=1 fallback
+    eng.end_session("warm")
+    res = eng.prefill_and_sample("s", ids, dec)
+    tok = int(res.token[0])
+    t0 = time.perf_counter()
+    emitted = blocks = 0
+    while emitted < 128:
+        out = eng.decode_spec("s", tok, dec, 128 - emitted)
+        emitted += len(out)
+        blocks += 1
+        tok = int(out[-1].token[0])
+    dt = time.perf_counter() - t0
+    eng.end_session("s")
+    return {
+        "spec_tok_s": round(emitted / dt, 2),
+        "spec_tokens_per_block": round(emitted / blocks, 2),
+    }
 
 
 def _compress_microbench() -> dict:
