@@ -85,6 +85,21 @@ def test_mesh_a2a_ep_matches_local(qwen3_moe_dir, engine, eight_devices):
     assert got == want
 
 
+@pytest.mark.parallel
+def test_pipelined_matches_local(qwen3_moe_dir, engine, eight_devices):
+    """MoE + q/k norms through the staggered-microbatch rotation program."""
+    from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
+
+    ids = [256, 72, 101, 108]
+    dec = DecodingParams(temperature=0.0)
+    want = [r.token_id for r in engine.generate(ids, dec, max_tokens=8)]
+    pipe = PipelinedMeshEngine(
+        qwen3_moe_dir, pp=2, tp=2, slots=2, max_seq=64, param_dtype="float32"
+    )
+    got = [r.token_id for r in pipe.generate(ids, dec, max_tokens=8)]
+    assert got == want
+
+
 def test_no_renorm_matches_hf(tmp_path_factory):
     """norm_topk_prob omitted -> HF default FALSE (no renormalization);
     parity must hold for that routing too."""
