@@ -31,6 +31,8 @@ if _jax_cache != "off":
     jax.config.update("jax_compilation_cache_dir", _jax_cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 
+import contextlib  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -57,3 +59,56 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@contextlib.contextmanager
+def spawn_api_server(model_dir, env=None, ready_timeout_s: int = 180):
+    """Spawn a real `dnet_tpu.cli.api` subprocess serving `model_dir` and
+    yield its base URL once the preloaded model is serveable (/health turns
+    200 before the startup load completes, so readiness requires the model
+    field).  Shared by the integration/compat tiers — one place for the
+    port pick, readiness protocol, and kill-falls-back teardown."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    import httpx
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dnet_tpu.cli.api",
+            "--model", str(model_dir), "--http-port", str(port),
+        ],
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "DNET_API_MAX_SEQ_LEN": "128",
+            **os.environ,
+            **(env or {}),
+        },
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(ready_timeout_s):
+            try:
+                r = httpx.get(base + "/health", timeout=2)
+                if r.status_code == 200 and r.json().get("model"):
+                    break
+            except Exception:
+                pass
+            time.sleep(1)
+        else:
+            raise RuntimeError("server did not become ready with a model")
+        yield base
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()

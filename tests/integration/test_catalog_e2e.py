@@ -1,0 +1,49 @@
+"""Catalog-architecture e2e: spawn the real API server per model family
+and drive a chat completion through it.
+
+The reference's integration tier parameterizes over catalog entries with
+`ci_test: True` and asserts load + answer within timeouts
+(tests/integration/test_model_catalog.py:139-230 there).  Zero-egress
+analog: one tiny random-weight checkpoint per ARCHITECTURE the catalog's
+ci entries map to, served by a real `dnet_tpu.cli.api` subprocess
+(spawned through the shared conftest harness).
+"""
+
+import pytest
+
+from tests.conftest import spawn_api_server
+
+pytestmark = pytest.mark.integration
+
+FAMILIES = {
+    "llama": "make_tiny_llama",
+    "qwen3": "make_tiny_qwen3",
+    "gpt_oss": "make_tiny_gpt_oss",
+    "deepseek_v2": "make_tiny_deepseek_v2",
+    "mixtral": "make_tiny_mixtral",
+}
+
+
+@pytest.mark.parametrize("arch", sorted(FAMILIES))
+def test_family_serves_chat(arch, tmp_path):
+    import httpx
+
+    from tests.fakes import checkpoints
+
+    d = tmp_path / arch
+    getattr(checkpoints, FAMILIES[arch])(d)
+    with spawn_api_server(d, env={"DNET_API_MAX_SEQ_LEN": "64"}) as base:
+        r = httpx.post(
+            base + "/v1/chat/completions",
+            json={
+                "model": arch,
+                "messages": [{"role": "user", "content": "What is 2+2?"}],
+                "max_tokens": 4,
+                "temperature": 0.0,
+            },
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        out = r.json()
+        assert out["choices"][0]["finish_reason"] in ("stop", "length")
+        assert out["usage"]["completion_tokens"] >= 1

@@ -11,54 +11,19 @@ runs in EVERY image.
 
 from __future__ import annotations
 
-import contextlib
 import json
-import os
-import socket
-import subprocess
-import sys
-import time as _time
 
 import pytest
 
 pytestmark = pytest.mark.api
 
 
-@contextlib.contextmanager
 def _server(tiny_llama_dir):
-    """Spawn the real API server process serving the tiny checkpoint."""
-    import httpx
+    """Spawn the real API server process serving the tiny checkpoint
+    (shared conftest harness: port pick, readiness, kill-fallback)."""
+    from tests.conftest import spawn_api_server
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "dnet_tpu.cli.api",
-            "--model", str(tiny_llama_dir), "--http-port", str(port),
-        ],
-        env={**os.environ, "JAX_PLATFORMS": "cpu", "DNET_API_MAX_SEQ": "128"},
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
-    base = f"http://127.0.0.1:{port}"
-    try:
-        for _ in range(180):  # cold JAX init in the subprocess can be slow under CI load
-            # readiness = the preloaded model is actually serveable (health
-            # turns 200 before the startup load_model completes)
-            try:
-                r = httpx.get(base + "/health", timeout=2)
-                if r.status_code == 200 and r.json().get("model"):
-                    break
-            except Exception:
-                pass
-            _time.sleep(1)
-        else:
-            raise RuntimeError("server did not become ready with a model")
-        yield base
-    finally:
-        proc.terminate()
-        proc.wait(timeout=10)
+    return spawn_api_server(tiny_llama_dir)
 
 
 def test_wire_level_openai_compat(tiny_llama_dir):
