@@ -111,7 +111,9 @@ class LocalModelManager:
             kv_dtype, kv_quant_bits = resolve_kv_bits(self.kv_bits)
             if self.mesh is not None:
                 dp, sp = self.mesh.get("dp", 1), self.mesh.get("sp", 1)
-                use_pipelined = self.batch_slots > 1 and dp == 1 and sp == 1
+                # sp rides inside the rotation program (sharded KV); only dp
+                # still routes to the sequential mesh
+                use_pipelined = self.batch_slots > 1 and dp == 1
                 if use_pipelined:
                     # pre-check pipelined preconditions so an incompatible
                     # config degrades to the sequential mesh instead of
@@ -128,10 +130,12 @@ class LocalModelManager:
                     _tp = self.mesh.get("tp", 1)
                     _pp = self.mesh.get("pp", 0)
                     if _pp <= 0:
-                        _pp = max(len(_jax.devices()) // _tp, 1)
-                        _L = _cfg.num_hidden_layers
-                        while _pp > 1 and _L % _pp != 0:
-                            _pp -= 1
+                        from dnet_tpu.parallel.pipelined import resolve_pp
+
+                        _pp = resolve_pp(
+                            len(_jax.devices()), _tp, self.mesh.get("sp", 1),
+                            _cfg.num_hidden_layers,
+                        )
                     _mcls = _cls(_cfg.model_type)
                     if not _mcls.supports_kv_commit:
                         log.warning(
@@ -148,11 +152,6 @@ class LocalModelManager:
                         )
                         use_pipelined = False
                 if use_pipelined:
-                    if self.prefix_cache:
-                        log.warning(
-                            "DNET_API_PREFIX_CACHE is not supported by the "
-                            "pipelined mesh engine; disabled"
-                        )
                     if self.spec_lookahead:
                         log.warning(
                             "DNET_API_SPEC_LOOKAHEAD is not supported by the "
@@ -167,6 +166,7 @@ class LocalModelManager:
                         model_dir,
                         pp=self.mesh.get("pp", 0),
                         tp=self.mesh.get("tp", 1),
+                        sp=self.mesh.get("sp", 1),
                         slots=self.batch_slots,
                         max_seq=max_seq or self.max_seq,
                         param_dtype=self.param_dtype,
@@ -177,10 +177,10 @@ class LocalModelManager:
                         prefix_cache_size=self.prefix_cache,
                     )
                     return engine, load_tokenizer(model_dir)
-                if self.batch_slots > 1 and not (dp == 1 and sp == 1):
+                if self.batch_slots > 1 and dp > 1:
                     log.warning(
-                        "batch_slots>1 with dp/sp mesh axes: pipelined "
-                        "batching needs dp=sp=1; serving sequential mesh"
+                        "batch_slots>1 with a dp mesh axis: pipelined "
+                        "batching needs dp=1; serving sequential mesh"
                     )
                 from dnet_tpu.parallel.engine import MeshEngine
 

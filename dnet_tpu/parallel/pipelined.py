@@ -45,6 +45,7 @@ from dnet_tpu.core.sampler import SampleParams, SampleResult, sample
 from dnet_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_PP,
+    AXIS_SP,
     AXIS_TP,
     kv_spec,
     window_param_specs,
@@ -77,6 +78,17 @@ def _entry_slot(t: int, pp: int, phases: int, m: int) -> int:
     """Slot fed by the entry at step t (valid only when _entry_open)."""
     phi = phases * pp
     return ((t // phi) * pp + (t % phi)) % m
+
+
+def resolve_pp(n_dev: int, tp: int, sp: int, n_layers: int) -> int:
+    """Infer pp from the device budget: every remaining device becomes a
+    pipeline stage, decremented until it divides the layer count (the same
+    fallback as MeshEngine's inference).  Shared by the engine and the
+    serving manager's precheck so both always agree on the resolved pp."""
+    pp = max(n_dev // (tp * sp), 1)
+    while pp > 1 and n_layers % pp != 0:
+        pp -= 1
+    return pp
 
 
 def make_rotation_fn(
@@ -127,6 +139,10 @@ def make_rotation_fn(
     PHI = phases * PP  # stage-steps a token occupies the ring
     n_steps = M * phases if n_steps is None else n_steps
     has_kinds = getattr(model, "layer_kinds", None) is not None
+    # sequence parallelism: each sp rank holds a shard of every slot's KV
+    # sequence axis; decode attention runs as distributed flash-decoding
+    # (the same kv_spec/sp_axis plumbing as the sequential mesh ring)
+    sp_axis = AXIS_SP if mesh.shape.get(AXIS_SP, 1) > 1 else None
 
     # x_state mentions AXIS_DP (size 1, enforced by the engine) purely so its
     # vma matches the dp-varying kv inside the layer scan
@@ -135,7 +151,7 @@ def make_rotation_fn(
         window_param_specs(window_params),
         P(),  # edge params replicated
         x_spec,  # x_state [PP, B, 1, D]
-        kv_spec(False),  # [L, M*B, S, KVH, Hd]
+        kv_spec(sp_axis is not None),  # [L, M*B, S(/sp), KVH, Hd]
         P(),  # tokens [M, B]
         P(),  # pos_vec [M]
         P(AXIS_PP),  # pos_state [PP]
@@ -154,8 +170,8 @@ def make_rotation_fn(
     )
     res_spec = SampleResult(P(), P(), P(), P())
     out_specs = (
-        res_spec, x_spec, kv_spec(False), P(), P(), P(AXIS_PP), P(AXIS_PP),
-        P(AXIS_PP), P(), P(),
+        res_spec, x_spec, kv_spec(sp_axis is not None), P(), P(), P(AXIS_PP),
+        P(AXIS_PP), P(AXIS_PP), P(), P(),
     )
 
     def spmd(window_params, edge_params, x_state, kv, tokens, pos_vec,
@@ -213,7 +229,8 @@ def make_rotation_fn(
             extra = {"phase": phase_in} if phases > 1 else {}
             x_out, kv_slot = model.apply_window(
                 window_params, x_in, kv_slot, pos_in,
-                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=live_in, **extra,
+                layer_kinds=kinds, tp_axis=AXIS_TP, kv_commit=live_in,
+                sp_axis=sp_axis, **extra,
             )
             kv = jax.tree.map(
                 lambda full, sl: lax.dynamic_update_slice_in_dim(
@@ -311,14 +328,15 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
     B = batch
     phases = getattr(model, "ring_phases", 1)
     has_kinds = getattr(model, "layer_kinds", None) is not None
+    sp_axis = AXIS_SP if mesh.shape.get(AXIS_SP, 1) > 1 else None
     in_specs = (
         window_param_specs(window_params),
         P(),
         P(AXIS_DP),  # tokens [B, T]: dp-sharded batch matches the kv vma
-        kv_spec(False), P(), P(), P(),
+        kv_spec(sp_axis is not None), P(), P(), P(),
         P(AXIS_PP) if has_kinds else P(),
     )
-    out_specs = (P(), kv_spec(False))
+    out_specs = (P(), kv_spec(sp_axis is not None))
 
     def spmd(window_params, edge_params, tokens, kv, pos, last_idx, slot, kinds):
         my_pp = lax.axis_index(AXIS_PP)
@@ -337,7 +355,7 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
                 window_params, x, kv_slot, pos,
                 layer_kinds=kinds, tp_axis=AXIS_TP,
                 kv_commit=(jnp.mod(i, PP) == my_pp),
-                t_real=last_idx + 1, **extra,
+                sp_axis=sp_axis, t_real=last_idx + 1, **extra,
             )
             x_next = lax.ppermute(
                 x_new, AXIS_PP, [(p, (p + 1) % PP) for p in range(PP)]
@@ -386,6 +404,7 @@ class PipelinedMeshEngine:
         model_dir,
         pp: int = 0,
         tp: int = 1,
+        sp: int = 1,
         slots: int = 0,
         max_seq: int = 2048,
         param_dtype: str = "bfloat16",
@@ -400,19 +419,17 @@ class PipelinedMeshEngine:
 
         from dnet_tpu.parallel.engine import MeshEngine
 
-        # resolve pp before sizing the slot pool (same divisibility fallback
-        # as MeshEngine's inference)
+        # resolve pp before sizing the slot pool (shared helper: the serving
+        # manager's precheck must agree with this engine's resolution)
         if pp <= 0:
-            n_dev = len(list(devices) if devices is not None else jax.devices())
-            pp = max(n_dev // tp, 1)
             import json
             from pathlib import Path as _Path
 
+            n_dev = len(list(devices) if devices is not None else jax.devices())
             L = json.loads(
                 (_Path(model_dir) / "config.json").read_text()
             )["num_hidden_layers"]
-            while pp > 1 and L % pp != 0:
-                pp -= 1
+            pp = resolve_pp(n_dev, tp, sp, L)
         self.n_slots = M = slots if slots > 0 else pp
         if M < pp:
             raise ValueError(f"slots={M} must be >= pp={pp} to fill the pipeline")
@@ -420,7 +437,7 @@ class PipelinedMeshEngine:
         # the inner MeshEngine loads/shards params and builds the kv template
         # with batch = M*B (slots folded into the batch axis)
         self._inner = MeshEngine(
-            model_dir, pp=pp, tp=tp, dp=1, sp=1, batch=M * B, max_seq=max_seq,
+            model_dir, pp=pp, tp=tp, dp=1, sp=sp, batch=M * B, max_seq=max_seq,
             param_dtype=param_dtype, kv_dtype=kv_dtype,
             kv_quant_bits=kv_quant_bits, weight_quant_bits=weight_quant_bits,
             quant_group=quant_group, devices=devices,
@@ -432,7 +449,7 @@ class PipelinedMeshEngine:
                 f"{inner.config.model_type} (no gated KV writes yet)"
             )
         self.config, self.model, self.mesh = inner.config, inner.model, inner.mesh
-        self.pp, self.tp = inner.pp, inner.tp
+        self.pp, self.tp, self.sp = inner.pp, inner.tp, inner.sp
         # segmented models (deepseek ring_phases=2) take `phases` laps per
         # token: one rotation is M*phases stage-steps and still yields one
         # entry + one exit per slot (the multi-lap schedule's entry bursts
