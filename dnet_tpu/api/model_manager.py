@@ -223,6 +223,9 @@ class LocalModelManager:
                     weight_quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
                 )
+                # compile the batched step + fused-chunk widths now, not on
+                # the first request while every lane shares one executor
+                engine.warm_chunks()
             else:
                 from dnet_tpu.core.engine import LocalEngine
 

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import time
 from pathlib import Path
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -81,6 +81,9 @@ class BatchedEngine:
         self.last_used = np.zeros(slots, dtype=np.float64)
         self.slot_of: Dict[str, int] = {}  # nonce -> slot
         self._free: List[int] = list(range(slots))
+        # fused-chunk results not yet handed to the driver (nonce -> FIFO);
+        # dropped with the session like the pipelined engine's buffers
+        self._buffer: Dict[str, List[SampleResult]] = {}
         self._build()
 
     # ---- program ------------------------------------------------------
@@ -110,14 +113,46 @@ class BatchedEngine:
 
         kv_axes = jax.tree.map(lambda _: 1, self.kv)
         sp_axes = SampleParams(0, 0, 0, 0, 0, 0)
-        self._step = jax.jit(
-            jax.vmap(
-                one,
-                in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
-                out_axes=(0, kv_axes, 0, 0),
-            ),
-            donate_argnums=(3, 8),
+        self._vmapped = jax.vmap(
+            one,
+            in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
+            out_axes=(0, kv_axes, 0, 0),
         )
+        self._step = jax.jit(self._vmapped, donate_argnums=(3, 8))
+        # fused R-step chunks (budget-driven): sampled tokens re-enter their
+        # lanes on device, one dispatch + one packed read per R tokens
+        self._chunks: Dict[int, Any] = {}
+
+    # chunk widths tried largest-first (bounded compiled-program set, same
+    # discipline as LocalEngine.DECODE_CHUNK_BUCKETS)
+    CHUNK_BUCKETS = (16, 8, 4, 2)
+
+    def _chunk_fn(self, R: int):
+        fn = self._chunks.get(R)
+        if fn is None:
+            vstep = self._vmapped
+
+            def chunk(wp, ep, token, kv, pos, active, sp, keys, counts):
+                def body(carry, _):
+                    token, kv, pos, keys, counts = carry
+                    res, kv, counts, keys = vstep(
+                        wp, ep, token, kv, pos, active, sp, keys, counts
+                    )
+                    # active lanes chain their sampled token on device;
+                    # frozen lanes keep feeding their stale input (inert:
+                    # their KV/counts/keys writes are gated off)
+                    token = jnp.where(active[:, None], res.token, token)
+                    pos = pos + active.astype(pos.dtype)
+                    return (token, kv, pos, keys, counts), res
+
+                (_, kv, _, keys, counts), stacked = jax.lax.scan(
+                    body, (token, kv, pos, keys, counts), None, length=R
+                )
+                return stacked, kv, counts, keys
+
+            fn = jax.jit(chunk, donate_argnums=(3, 8))
+            self._chunks[R] = fn
+        return fn
 
     # ---- slot lifecycle ----------------------------------------------
     def alloc_slot(self, nonce: str) -> int:
@@ -132,6 +167,7 @@ class BatchedEngine:
         return slot
 
     def free_slot(self, nonce: str) -> None:
+        self._buffer.pop(nonce, None)
         slot = self.slot_of.pop(nonce, None)
         if slot is not None:
             self.counts = self.counts.at[slot].set(0)
@@ -231,10 +267,32 @@ class BatchedEngine:
         Slots not in `requests` stay frozen (active=False gates their KV
         write and counts).  Returns (results, per-nonce errors): a request
         whose slot vanished (client disconnect race) or hit max_seq fails
-        ALONE — it must never poison the rest of the batch."""
+        ALONE — it must never poison the rest of the batch.
+
+        `budgets` (nonce -> remaining tokens the driver will accept) widen
+        the dispatch into a fused R-step chunk: active lanes chain their
+        sampled tokens on device and the extra results buffer engine-side,
+        resolving later decode_batch calls instantly — the host pays one
+        dispatch + one packed read per R tokens per lane (the same contract
+        as LocalEngine.decode_chunk / the pipelined engine's rotations).
+        The active set is FIXED across a chunk, so the stream is
+        bit-identical to R serial steps with the same request set."""
         errors: Dict[str, str] = {}
         if not requests:
             return {}, errors
+        # buffered tokens from an earlier fused chunk resolve first
+        out_buf: Dict[str, SampleResult] = {}
+        now = time.time()
+        for nonce in list(requests):
+            buf = self._buffer.get(nonce)
+            if buf:
+                out_buf[nonce] = buf.pop(0)
+                slot = self.slot_of.get(nonce)
+                if slot is not None:
+                    self.last_used[slot] = now
+        requests = {n: r for n, r in requests.items() if n not in out_buf}
+        if not requests:
+            return out_buf, errors
         token = np.zeros((self.slots, 1), dtype=np.int32)
         active = np.zeros(self.slots, dtype=bool)
         pos = np.zeros(self.slots, dtype=np.int32)
@@ -266,7 +324,7 @@ class BatchedEngine:
             mtk[slot] = dec.min_tokens_to_keep
             order[nonce] = slot
         if not order:
-            return {}, errors
+            return out_buf, errors
 
         sp = SampleParams(
             temperature=jnp.asarray(temp),
@@ -276,7 +334,14 @@ class BatchedEngine:
             repetition_penalty=jnp.asarray(rep),
             min_tokens_to_keep=jnp.asarray(mtk),
         )
-        res, self.kv, self.counts, self.keys = self._step(
+        # fused-chunk width: bounded by the smallest remaining budget and
+        # by every active lane's sequence capacity
+        R = 1
+        if budgets:
+            cap = min((budgets.get(n) or 1) for n in order)
+            cap = min(cap, *(int(self.max_seq - self.pos[s]) for s in order.values()))
+            R = next((r for r in self.CHUNK_BUCKETS if r <= cap), 1)
+        args = (
             self.eng.window_params,
             self.eng.edge_params,
             jnp.asarray(token),
@@ -287,18 +352,62 @@ class BatchedEngine:
             self.keys,
             self.counts,
         )
+        if R > 1:
+            stacked, self.kv, self.counts, self.keys = self._chunk_fn(R)(*args)
+        else:
+            res, self.kv, self.counts, self.keys = self._step(*args)
         now = time.time()
-        out: Dict[str, SampleResult] = {}
+        out: Dict[str, SampleResult] = dict(out_buf)
+        if R > 1:
+            # ONE packed device->host read per field per chunk (the
+            # pipelined engine's drain pattern), then host-side slicing —
+            # per-element device gathers would reintroduce the dispatch
+            # overhead the fused chunk exists to remove
+            toks = np.asarray(stacked.token)
+            lps = np.asarray(stacked.logprob)
+            tts = np.asarray(stacked.top_tokens)
+            tlps = np.asarray(stacked.top_logprobs)
         for nonce, slot in order.items():
-            self.pos[slot] += 1
+            self.pos[slot] += R
             self.last_used[slot] = now
-            out[nonce] = SampleResult(
-                token=res.token[slot],
-                logprob=res.logprob[slot],
-                top_tokens=res.top_tokens[slot],
-                top_logprobs=res.top_logprobs[slot],
-            )
+            if R > 1:
+                rows = [
+                    SampleResult(toks[k, slot], lps[k, slot],
+                                 tts[k, slot], tlps[k, slot])
+                    for k in range(R)
+                ]
+                out[nonce] = rows[0]
+                self._buffer.setdefault(nonce, []).extend(rows[1:])
+            else:
+                out[nonce] = SampleResult(
+                    token=res.token[slot],
+                    logprob=res.logprob[slot],
+                    top_tokens=res.top_tokens[slot],
+                    top_logprobs=res.top_logprobs[slot],
+                )
         return out, errors
+
+    def warm_chunks(self) -> None:
+        """Compile the batched step and the fused-chunk widths up front with
+        a throwaway session, so the FIRST budgeted request doesn't stall
+        every concurrent lane on a multi-second scan compile (the batch loop
+        runs all lanes on one compute executor)."""
+        t0 = time.time()
+        dec = DecodingParams(temperature=0.0)
+        self.prefill_and_sample("__warm__", [0], dec)
+        slot = self.slot_of["__warm__"]
+        for r in (1,) + tuple(self.CHUNK_BUCKETS):
+            if self.pos[slot] + r < self.max_seq:
+                self.decode_batch(
+                    {"__warm__": (0, dec)},
+                    budgets={"__warm__": r} if r > 1 else None,
+                )
+                self._buffer.pop("__warm__", None)
+        self.end_session("__warm__")
+        log.info(
+            "[PROFILE] warmed batched chunk programs (%d widths) in %.1fs",
+            1 + len(self.CHUNK_BUCKETS), time.time() - t0,
+        )
 
     def generate(
         self,

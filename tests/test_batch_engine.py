@@ -175,6 +175,40 @@ def test_seeded_sampling_immune_to_other_traffic(tiny_llama_dir):
     assert run(0) == run(3)
 
 
+def test_budget_chunks_match_serial_steps(tiny_llama_dir):
+    """Budget-driven fused chunks (R steps in one dispatch, extras buffered
+    engine-side) must produce the exact serial stream, including a lane
+    frozen mid-chunk and a seeded sampled lane."""
+    from dnet_tpu.core.batch import BatchedEngine
+
+    dec = DecodingParams(temperature=0.0)
+    hot = DecodingParams(temperature=1.0, seed=9)
+    prompts = {"g": [256, 72, 101], "h": [256, 84, 104, 105]}
+
+    def run(budgeted: bool):
+        eng = BatchedEngine(tiny_llama_dir, slots=4, max_seq=64, param_dtype="float32")
+        decs = {"g": dec, "h": hot}
+        last = {
+            n: int(eng.prefill_and_sample(n, ids, decs[n]).token[0])
+            for n, ids in prompts.items()
+        }
+        got = {n: [t] for n, t in last.items()}
+        for step in range(1, 9):
+            reqs = {n: (last[n], decs[n]) for n in prompts}
+            if step > 4:
+                reqs.pop("g")  # g freezes; h keeps decoding
+            budgets = {n: 9 - step for n in reqs} if budgeted else None
+            out, errs = eng.decode_batch(reqs, budgets=budgets)
+            assert not errs, errs
+            for n, r in out.items():
+                last[n] = int(r.token[0])
+                got[n].append(last[n])
+        eng.close()
+        return got
+
+    assert run(budgeted=True) == run(budgeted=False)
+
+
 def test_deepseek_accepted_at_load(tmp_path_factory):
     """DeepSeek-V2 now gates its KV writes (supports_kv_commit), so the
     batched engine must accept it (full behavior covered by
