@@ -17,7 +17,9 @@ pytestmark = pytest.mark.integration
 
 FAMILIES = {
     "llama": "make_tiny_llama",
+    "qwen2": "make_tiny_qwen2",
     "qwen3": "make_tiny_qwen3",
+    "qwen3_moe": "make_tiny_qwen3_moe",
     "gpt_oss": "make_tiny_gpt_oss",
     "deepseek_v2": "make_tiny_deepseek_v2",
     "mixtral": "make_tiny_mixtral",
