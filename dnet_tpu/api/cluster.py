@@ -76,6 +76,7 @@ class ClusterManager:
                     d.hbm_bytes = p.get("hbm_bytes", 0) or d.hbm_bytes
                     d.host_ram_bytes = p.get("host_ram_bytes", 0)
                     d.chip_kind = p.get("device_kind", d.chip_kind)
+                    d.chip_count = p.get("local_device_count", 0) or d.chip_count
                 except (httpx.HTTPError, KeyError) as exc:
                     log.warning("profile of %s failed: %s", d.instance, exc)
 

@@ -317,6 +317,8 @@ class ApiHTTPServer:
                             "next_instance": a.next_instance,
                             "window_size": a.window_size,
                             "residency_size": a.residency_size,
+                            "mesh_tp": a.mesh_tp,
+                            "mesh_sp": a.mesh_sp,
                         }
                         for a in topo.assignments
                     ],
@@ -370,6 +372,8 @@ class ApiHTTPServer:
                             "instance": a.instance,
                             "layers": a.layers,
                             "next_instance": a.next_instance,
+                            "mesh_tp": a.mesh_tp,
+                            "mesh_sp": a.mesh_sp,
                         }
                         for a in topo.assignments
                     ],
@@ -428,6 +432,8 @@ class ApiHTTPServer:
                             "next_instance": a.next_instance,
                             "window_size": a.window_size,
                             "residency_size": a.residency_size,
+                            "mesh_tp": a.mesh_tp,
+                            "mesh_sp": a.mesh_sp,
                         }
                         for a in topo.assignments
                     ],

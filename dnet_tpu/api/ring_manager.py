@@ -52,6 +52,8 @@ def build_manual_topology(
                 layers=sorted(a["layers"]),
                 window_size=a.get("window_size", 0),
                 residency_size=a.get("residency_size", 0),
+                mesh_tp=a.get("mesh_tp", 0),
+                mesh_sp=a.get("mesh_sp", 0),
             )
         )
     las.sort(key=lambda a: a.min_layer)
@@ -148,6 +150,11 @@ class RingModelManager:
                     "api_callback_address": f"grpc://{self.api_callback_addr}",
                     "param_dtype": self.param_dtype,
                     "weight_quant_bits": self.weight_quant_bits,
+                    # mesh-backed shards: the solve (or manual topology) may
+                    # give this ring node a host-local tp/sp mesh; 0 defers
+                    # to the shard's own DNET_SHARD_MESH_* defaults
+                    "mesh_tp": a.mesh_tp,
+                    "mesh_sp": a.mesh_sp,
                 }
                 url = f"http://{dev.host}:{dev.http_port}/load_model"
                 r = await client.post(url, json=body)

@@ -284,6 +284,10 @@ class ManualAssignment(BaseModel):
     layers: List[int]
     window_size: int = 0
     residency_size: int = 0
+    # host-local mesh for this ring node (parallel/shard_mesh.py):
+    # 0 = shard default, 1 = single chip, -1 tp = all local chips
+    mesh_tp: int = 0
+    mesh_sp: int = 0
 
 
 class PrepareTopologyManualRequest(BaseModel):

@@ -251,6 +251,13 @@ class ShardSettings(_EnvGroup):
     models_dir: str = "~/.dnet-tpu/models"
     # per-layer repack cache for weight streaming (reference repack.py)
     repack_dir: str = "~/.dnet-tpu/repacked"
+    # host-local mesh under this shard's ring node: the layer window runs
+    # tensor-parallel (tp) / sequence-parallel (sp) across the host's ICI
+    # chips while ring hops stay gRPC/DCN (parallel/shard_mesh.py).
+    # tp=1/sp=1 = single-device; tp=-1 = every local device on the tp axis.
+    # A /load_model request with explicit mesh fields overrides these.
+    mesh_tp: int = 1
+    mesh_sp: int = 1
 
 
 @dataclass

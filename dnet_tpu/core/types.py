@@ -141,6 +141,11 @@ class LayerAssignment:
     next_instance: str = ""
     window_size: int = 0
     residency_size: int = 0
+    # host-local mesh under this ring node (parallel/shard_mesh.py): the
+    # window runs tensor/sequence-parallel over the shard's local chips.
+    # 0 = the shard's own DNET_SHARD_MESH_* default; 1 = single chip.
+    mesh_tp: int = 0
+    mesh_sp: int = 0
 
     @property
     def min_layer(self) -> int:

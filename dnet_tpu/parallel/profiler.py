@@ -74,6 +74,10 @@ def profile_device_quick(device=None) -> dict:
         "hbm_bw": hbm_bw,
         "host_to_hbm_bw": h2d,
         "host_ram_bytes": psutil.virtual_memory().total,
+        # chips this host can put behind ONE ring node (mesh-backed shard,
+        # parallel/shard_mesh.py); the solver aggregates the slice's
+        # FLOPs/HBM through DeviceInfo.chip_count
+        "local_device_count": jax.local_device_count(),
         **mem,
     }
 

@@ -52,6 +52,10 @@ class ModelProfile:
     # cannot execute k-round fit stacks yet: fail at SOLVE time, not on the
     # first request (core/engine.py:apply_round raises otherwise)
     multi_round_ok: bool = True
+    # KV-head count: mesh-backed shards shard KV heads over tp
+    # (parallel/mesh.py kv_spec), so a node's mesh_tp must divide this.
+    # 0 = unknown: leave mesh_tp unclamped.
+    tp_heads: int = 0
 
 
 @dataclass
@@ -64,18 +68,27 @@ class SolveResult:
 
 
 def device_throughput(d: DeviceInfo, m: ModelProfile) -> float:
-    """Per-layer decode time (s): max of FLOP time and HBM-read time."""
-    flops_t = m.layer_flops_per_token / max(d.flops_bf16, 1e9)
-    hbm_t = m.layer_bytes / max(d.hbm_bw, 1e9)
+    """Per-layer decode time (s): max of FLOP time and HBM-read time.
+
+    chip_count > 1 = a mesh-backed shard (parallel/shard_mesh.py): the ring
+    node is a whole host-local slice, so its FLOPs and aggregate HBM
+    bandwidth scale with the chips running the window tensor-parallel —
+    the solver sees ONE node with the slice's combined speed."""
+    c = max(d.chip_count, 1)
+    flops_t = m.layer_flops_per_token / max(d.flops_bf16 * c, 1e9)
+    hbm_t = m.layer_bytes / max(d.hbm_bw * c, 1e9)
     return max(flops_t, hbm_t)
 
 
 def hbm_layer_capacity(d: DeviceInfo, m: ModelProfile, reserve_frac: float = 0.15) -> int:
-    """How many layers fit in HBM after KV + edge + headroom."""
+    """How many layers fit in HBM after KV + edge + headroom.  A mesh-backed
+    shard (chip_count > 1) pools the slice's HBM: params and KV shard over
+    tp, only the edge weights replicate per chip."""
     if d.hbm_bytes <= 0:
         return m.num_layers  # unknown: assume everything fits
+    c = max(d.chip_count, 1)
     kv = m.kv_bytes_per_token_per_layer * m.seq_len
-    usable = d.hbm_bytes * (1 - reserve_frac) - m.edge_bytes
+    usable = d.hbm_bytes * c * (1 - reserve_frac) - m.edge_bytes * c
     per_layer = m.layer_bytes + kv
     return max(int(usable // per_layer), 0)
 
@@ -294,9 +307,21 @@ def solve_topology(
     """Full solve: order -> (w, n) -> merge -> k rounds -> assignments."""
     if not devices:
         raise ValueError("no devices")
-    devices = order_devices(devices)
+    # clamp each node's usable chip count BEFORE costing: mesh-backed
+    # shards shard KV heads over tp (kv_spec), so a 4-chip host serving a
+    # 2-kv-head model runs tp=2 — sizing its layer share with 4-chip pooled
+    # HBM would overcommit the 2 chips that actually serve
+    from dataclasses import replace as _dc_replace
+
+    clamped = []
+    for d in devices:
+        c = max(d.chip_count, 1)
+        while c > 1 and m.tp_heads > 0 and m.tp_heads % c != 0:
+            c -= 1
+        clamped.append(_dc_replace(d, chip_count=c) if c != d.chip_count else d)
+    devices = order_devices(clamped)
     heterogeneous = len(
-        {(d.chip_kind, round(d.flops_bf16 / 1e12, 1)) for d in devices}
+        {(d.chip_kind, d.chip_count, round(d.flops_bf16 / 1e12, 1)) for d in devices}
     ) > 1
     use_milp = solver == "milp" or (solver == "auto" and heterogeneous)
     result = (
@@ -321,13 +346,34 @@ def solve_topology(
     for i, d in enumerate(devs):
         layers = [a for r in per_dev_rounds[i] for a in r]
         window = 0 if n[i] >= w[i] else max(n[i] // 2, 1)
+        # multi-chip hosts serve their window tensor-parallel over the local
+        # slice (parallel/shard_mesh.py) — unless the solve streams weights
+        # on this node, which the mesh shard does not compose with: fall
+        # back to a single-chip shard there rather than failing at load
+        # chip_count is already clamped to a KV-head-divisible tp above
+        mesh_tp = max(d.chip_count, 1)
+        residency = 0 if n[i] >= w[i] else n[i]
+        if window > 0 and mesh_tp > 1:
+            # streaming does not compose with the mesh shard: fall back to
+            # one chip AND re-derive residency against single-chip HBM —
+            # the solve sized n[i] with the pooled multi-chip capacity
+            log.warning(
+                "%s: weight streaming assigned to a %d-chip host; mesh "
+                "sharding disabled for this node (streams on one chip)",
+                d.instance, mesh_tp,
+            )
+            mesh_tp = 1
+            n1 = min(w[i], hbm_layer_capacity(_dc_replace(d, chip_count=1), m))
+            window = 0 if n1 >= w[i] else max(n1 // 2, 1)
+            residency = 0 if n1 >= w[i] else n1
         assignments.append(
             LayerAssignment(
                 instance=d.instance,
                 layers=layers,
                 rounds=per_dev_rounds[i],
                 window_size=window,
-                residency_size=0 if n[i] >= w[i] else n[i],
+                residency_size=residency,
+    mesh_tp=mesh_tp,
             )
         )
     for i, a in enumerate(assignments):
@@ -401,6 +447,7 @@ def model_profile_from_checkpoint(
         kv_bytes = 2 * kvh * cfg.head_dim * 2
     return ModelProfile(
         model_id=str(model_dir),
+        tp_heads=cfg.num_key_value_heads or cfg.num_attention_heads or 0,
         multi_round_ok=cfg.model_type not in ("gpt_oss", "deepseek_v2"),
         num_layers=cfg.num_hidden_layers,
         layer_bytes=layer_bytes,

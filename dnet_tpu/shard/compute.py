@@ -43,24 +43,56 @@ class ShardCompute:
         kv_bits: int = 0,
         compress_frac: Optional[float] = None,
         weight_quant_bits: int = 0,
+        mesh_tp: int = 1,
+        mesh_sp: int = 1,
+        mesh_devices: Optional[Sequence] = None,
     ) -> None:
         from dnet_tpu.core.kvcache import resolve_kv_bits
 
         kv_dtype, kv_quant_bits = resolve_kv_bits(kv_bits)
-        self.engine = LocalEngine(
-            model_dir,
-            layers=layers,
-            max_seq=max_seq,
-            param_dtype=param_dtype,
-            kv_dtype=kv_dtype,
-            kv_ttl_s=kv_ttl_s,
-            shard_mode=True,
-            window_size=window_size,
-            residency_size=residency_size,
-            repack_dir=repack_dir,
-            kv_quant_bits=kv_quant_bits,
-            weight_quant_bits=weight_quant_bits,
-        )
+        if mesh_tp == -1:  # every local chip on the tp axis
+            n = len(mesh_devices) if mesh_devices is not None else jax.local_device_count()
+            mesh_tp = max(n // max(mesh_sp, 1), 1)
+        if mesh_tp * mesh_sp > 1:
+            # mesh-backed shard (VERDICT r3 next #1): this ring node's layer
+            # window runs SPMD over the host's local chips
+            if window_size or residency_size:
+                raise NotImplementedError(
+                    "weight streaming (window_size/residency_size) does not "
+                    "compose with a mesh-backed shard: streamed windows are "
+                    "host-resident per layer while the mesh shards resident "
+                    "params over chips — drop mesh_tp/mesh_sp or the window"
+                )
+            from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+
+            self.engine = MeshShardEngine(
+                model_dir,
+                layers=layers,
+                tp=mesh_tp,
+                sp=mesh_sp,
+                devices=mesh_devices,
+                max_seq=max_seq,
+                param_dtype=param_dtype,
+                kv_dtype=kv_dtype,
+                kv_ttl_s=kv_ttl_s,
+                kv_quant_bits=kv_quant_bits,
+                weight_quant_bits=weight_quant_bits,
+            )
+        else:
+            self.engine = LocalEngine(
+                model_dir,
+                layers=layers,
+                max_seq=max_seq,
+                param_dtype=param_dtype,
+                kv_dtype=kv_dtype,
+                kv_ttl_s=kv_ttl_s,
+                shard_mode=True,
+                window_size=window_size,
+                residency_size=residency_size,
+                repack_dir=repack_dir,
+                kv_quant_bits=kv_quant_bits,
+                weight_quant_bits=weight_quant_bits,
+            )
         self.layers = self.engine.model.layers
         self.wire_dtype = wire_dtype
         self.is_first = self.engine.model.is_first

@@ -39,6 +39,10 @@ class ShardLoadModelRequest(BaseModel):
     param_dtype: str = "bfloat16"
     wire_dtype: str = "bfloat16"
     weight_quant_bits: int = 0
+    # host-local mesh axes for this shard's window (parallel/shard_mesh.py):
+    # 0 = use the shard's own DNET_SHARD_MESH_* defaults; -1 tp = all chips
+    mesh_tp: int = 0
+    mesh_sp: int = 0
 
 
 class MeasureLatencyRequest(BaseModel):
@@ -76,6 +80,10 @@ class ShardHTTPServer:
     async def health(self, request: web.Request) -> web.Response:
         rt = self.shard.runtime
         compute = rt.compute
+        mesh = {}
+        if compute is not None:
+            eng = compute.engine
+            mesh = {"mesh_tp": getattr(eng, "tp", 1), "mesh_sp": getattr(eng, "sp", 1)}
         return web.json_response(
             {
                 "status": "ok",
@@ -84,6 +92,7 @@ class ShardHTTPServer:
                 "model": rt.model_path or None,
                 "layers": list(compute.layers) if compute else [],
                 "queue_depth": rt.queue_depth,
+                **mesh,
             }
         )
 

@@ -68,6 +68,8 @@ class ShardRuntime:
         repack_dir: str | None = None,
         kv_bits: int = 0,
         weight_quant_bits: int = 0,
+        mesh_tp: int = 1,
+        mesh_sp: int = 1,
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
@@ -87,6 +89,8 @@ class ShardRuntime:
                 repack_dir=repack_dir,
                 kv_bits=kv_bits,
                 weight_quant_bits=weight_quant_bits,
+                mesh_tp=mesh_tp,
+                mesh_sp=mesh_sp,
             )
             self.model_path = str(model_dir)
             log.info(

@@ -57,6 +57,10 @@ class Shard:
                 residency_size=req.residency_size,
                 kv_bits=req.kv_bits,
                 weight_quant_bits=req.weight_quant_bits,
+                # 0 = the shard's own deployment default (each host knows
+                # its chip count better than the API node does)
+                mesh_tp=req.mesh_tp or get_settings().shard.mesh_tp,
+                mesh_sp=req.mesh_sp or get_settings().shard.mesh_sp,
                 # engine ignores it unless plan_policy chose a streaming
                 # policy — no second copy of that decision here
                 repack_dir=get_settings().shard.repack_dir,

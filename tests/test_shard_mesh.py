@@ -1,0 +1,142 @@
+"""Mesh-backed gRPC shards: a ring node driving a host-local tp/sp mesh.
+
+Composes the two serving substrates (VERDICT r3 next #1): frames hop
+shard-to-shard exactly as in the process ring, but each shard's window math
+runs SPMD over its own device subset (parallel/shard_mesh.py).  Greedy
+streams must match the single-device LocalEngine bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+from dnet_tpu.core.types import ActivationMessage, DecodingParams
+
+pytestmark = [pytest.mark.shard, pytest.mark.parallel]
+
+
+def _ref_tokens(tiny_llama_dir, ids, n):
+    from dnet_tpu.core.engine import LocalEngine
+
+    eng = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+    out = [
+        r.token_id
+        for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=n)
+    ]
+    eng.close()
+    return out
+
+
+def _drive_ring(shards, ids, n):
+    """Token-by-token frames through a ShardCompute chain (greedy)."""
+    toks = []
+    dec = DecodingParams(temperature=0.0)
+    arr = np.asarray([ids], dtype=np.int32)
+    pos = 0
+    for step in range(n):
+        msg = ActivationMessage(
+            nonce="m", layer_id=-1, seq=step, dtype="tokens", shape=arr.shape,
+            data=arr.tobytes(), pos=pos, decoding=dec,
+        )
+        for sc in shards:
+            msg = sc.process(msg)
+        assert msg.is_final, f"step {step} did not finish at the tail shard"
+        pos += arr.shape[1]
+        toks.append(msg.token_id)
+        arr = np.asarray([[msg.token_id]], dtype=np.int32)
+    for sc in shards:
+        sc.engine.close()
+    return toks
+
+
+def test_two_mesh_shards_match_local(tiny_llama_dir, eight_devices):
+    """Two ring shards, each a tp=2 mesh over its own device pair."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=2, mesh_devices=eight_devices[0:2],
+    )
+    hi = ShardCompute(
+        tiny_llama_dir, [2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=2, mesh_devices=eight_devices[2:4],
+    )
+    from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+
+    assert isinstance(lo.engine, MeshShardEngine)
+    ids = [256, 72, 101, 108, 108, 111]
+    assert _drive_ring([lo, hi], ids, 6) == _ref_tokens(tiny_llama_dir, ids, 6)
+
+
+def test_mesh_shard_sp_axis(tiny_llama_dir, eight_devices):
+    """sp=2 inside one shard: KV shards over sequence, stream unchanged."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=1, mesh_sp=2,
+        mesh_devices=eight_devices[0:2],
+    )
+    hi = ShardCompute(
+        tiny_llama_dir, [2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32",
+    )
+    ids = [256, 84, 104, 101]
+    assert _drive_ring([lo, hi], ids, 5) == _ref_tokens(tiny_llama_dir, ids, 5)
+
+
+def test_mesh_shard_kround_schedule(tiny_llama_dir, eight_devices):
+    """Non-contiguous assignment (k rounds) on a mesh shard: the round
+    slicing path (_hidden_round) runs under shard_map too."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    # shard A holds layers 0,1 and 3; shard B holds 2 — A is visited twice
+    a = ShardCompute(
+        tiny_llama_dir, [0, 1, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=2, mesh_devices=eight_devices[0:2],
+    )
+    b = ShardCompute(
+        tiny_llama_dir, [2], max_seq=64, param_dtype="float32",
+        wire_dtype="float32",
+    )
+    dec = DecodingParams(temperature=0.0)
+    ids = [256, 72, 105]
+    toks = []
+    arr = np.asarray([ids], dtype=np.int32)
+    pos = 0
+    for step in range(4):
+        msg = ActivationMessage(
+            nonce="k", layer_id=-1, seq=step, dtype="tokens", shape=arr.shape,
+            data=arr.tobytes(), pos=pos, decoding=dec,
+        )
+        msg = a.process(msg)  # round [0,1]
+        msg = b.process(msg)  # layer 2
+        msg = a.process(msg)  # round [3] -> final token
+        assert msg.is_final
+        pos += arr.shape[1]
+        toks.append(msg.token_id)
+        arr = np.asarray([[msg.token_id]], dtype=np.int32)
+    a.engine.close()
+    b.engine.close()
+    assert toks == _ref_tokens(tiny_llama_dir, ids, 4)
+
+
+def test_mesh_rejects_weight_streaming(tiny_llama_dir, eight_devices):
+    from dnet_tpu.shard.compute import ShardCompute
+
+    with pytest.raises(NotImplementedError, match="streaming"):
+        ShardCompute(
+            tiny_llama_dir, [0, 1], max_seq=32, mesh_tp=2,
+            mesh_devices=eight_devices[0:2], window_size=1,
+        )
+
+
+def test_mesh_tp_auto_all_devices(tiny_llama_dir, eight_devices):
+    """mesh_tp=-1 = every provided device on the tp axis."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    sc = ShardCompute(
+        tiny_llama_dir, [0, 1, 2, 3], max_seq=32, param_dtype="float32",
+        mesh_tp=-1, mesh_devices=eight_devices[0:2],
+    )
+    assert sc.engine.tp == 2
+    sc.engine.close()
