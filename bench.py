@@ -440,6 +440,10 @@ def main() -> None:
     edge = jax.tree.map(jnp.asarray, edge)
     max_seq = 1024
 
+    # BEFORE any engine exists: a parity failure here flips the
+    # DNET_FLASH_DECODE kill-switch that engine tracing consults
+    flash_dec = _flash_decode_microbench()
+
     mesh_cfg = None
     if "--mesh" in sys.argv:  # e.g. --mesh 2x2 = pp2/tp2 over local devices
         try:
@@ -521,11 +525,64 @@ def main() -> None:
         "ttft_p50_ms": round(served["ttft_p50_ms"], 1),
         "device": getattr(dev, "device_kind", "") or jax.default_backend(),
     }
+    out.update(flash_dec)
     if "--smoke" in sys.argv:
         out.update(_compress_microbench())
         if mesh_cfg is None:
             out.update(_spec_microbench(cfg, window, edge, max_seq))
     print(json.dumps(out))
+
+
+def _flash_decode_microbench() -> dict:
+    """Long-cache decode attention: split-K Pallas kernel vs dense attend
+    (TPU only — the kernel is ineligible on CPU).  Runs BEFORE the serving
+    engine is built: a parity failure flips the DNET_FLASH_DECODE
+    kill-switch so the headline number never rides a miscompiled kernel."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.default_backend() == "cpu":
+        return {}
+    from dnet_tpu.ops.attention import attend, causal_mask
+    from dnet_tpu.ops.flash_decode import flash_decode_attend, flash_decode_eligible
+
+    B, H, KVH, Hd, S = 1, 32, 8, 128, 32768
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, 1, H, Hd), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, KVH, Hd), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, KVH, Hd), jnp.bfloat16)
+    if not flash_decode_eligible(q, k):
+        return {"flash_decode": "ineligible"}
+    dense = jax.jit(lambda q, k, v, p: attend(q, k, v, mask=causal_mask(1, S, p)))
+    kern = jax.jit(lambda q, k, v, p: flash_decode_attend(q, k, v, p))
+    out: dict = {}
+    try:
+        ref = np.asarray(dense(q, k, v, jnp.int32(S - 1)), np.float32)
+        got = np.asarray(kern(q, k, v, jnp.int32(S - 1)), np.float32)
+        err = float(np.max(np.abs(ref - got)))
+        out["flash_decode_max_err"] = round(err, 5)
+        if err > 3e-2:  # bf16 long-sum tolerance; beyond it = miscompile
+            os.environ["DNET_FLASH_DECODE"] = "0"
+            out["flash_decode"] = "parity failed; disabled for serving"
+            return out
+        for tag, pos in (("p2k", 2047), ("full", S - 1)):
+            for name, fn in (("dense", dense), ("kernel", kern)):
+                fn(q, k, v, jnp.int32(pos)).block_until_ready()  # compile
+                t0 = time.perf_counter()
+                for _ in range(20):
+                    r = fn(q, k, v, jnp.int32(pos))
+                r.block_until_ready()
+                out[f"flash_decode_{name}_us_{tag}"] = round(
+                    (time.perf_counter() - t0) / 20 * 1e6, 1
+                )
+    except Exception as exc:  # a lowering bug must not kill the headline
+        os.environ["DNET_FLASH_DECODE"] = "0"
+        out["flash_decode"] = f"error ({exc}); disabled for serving"[:300]
+    return out
 
 
 def _spec_microbench(cfg, window, edge, max_seq: int) -> dict:

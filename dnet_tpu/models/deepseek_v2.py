@@ -36,7 +36,7 @@ from jax import lax
 
 from dnet_tpu.core.kvcache import KVConfig
 from dnet_tpu.models.base import ModelConfig, RingModel
-from dnet_tpu.ops.attention import cached_attend, sp_causal_mask
+from dnet_tpu.ops.attention import cached_attend
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq
 from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
@@ -155,7 +155,7 @@ class DeepseekV2RingModel(RingModel):
         attn, kvs = cached_attend(
             q_full, k_full, v, kvs, pos, mask,
             kv_commit=kv_commit, sp_axis=sp_axis, scale=self.softmax_scale,
-            causal=mask is None and sp_axis is None,
+            causal=mask is None,
         )
         out = attn.reshape(B, T, H * vd) @ dq(p["wo"])
         if tp_axis is not None:
@@ -264,10 +264,9 @@ class DeepseekV2RingModel(RingModel):
         all-dense-then-all-moe even though each pp rank holds a slice of
         both segments.
         """
-        if mask is None and sp_axis is not None:
-            # sp masks are rank-local; the non-sp causal predicate stays
-            # implicit (mask=None) so cached_attend can take the flash path
-            mask = sp_causal_mask(x.shape[1], kv["k"].shape[2], pos, sp_axis)
+        # the causal predicate stays implicit (mask=None) under sp too:
+        # cached_attend owns the rank-local sp mask (or the TPU split-K
+        # flash-decode partials, which honor self.softmax_scale)
         dense = window_params.get("dense")
         moe = window_params.get("moe")
         Ld = dense["attn_norm"].shape[0] if dense is not None else 0

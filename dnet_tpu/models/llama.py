@@ -18,7 +18,7 @@ import numpy as np
 from jax import lax
 
 from dnet_tpu.models.base import ModelConfig, RingModel
-from dnet_tpu.ops.attention import cached_attend, causal_mask, sp_causal_mask
+from dnet_tpu.ops.attention import cached_attend
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq, out_dim
 from dnet_tpu.ops.rope import apply_rope, rope_frequencies
@@ -76,7 +76,7 @@ class LlamaRingModel(RingModel):
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
         attn, kvs = cached_attend(
             q, k, v, kvs, pos, mask, kv_commit=kv_commit, sp_axis=sp_axis,
-            causal=mask is None and sp_axis is None,
+            causal=mask is None,
         )
         attn_out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
@@ -110,10 +110,10 @@ class LlamaRingModel(RingModel):
         sp_axis: Optional[str] = None,
         t_real=None,  # full-length caches overwrite padding before reading
     ) -> Tuple[jnp.ndarray, dict]:
-        if mask is None and sp_axis is not None:
-            # sp masks are rank-local; the non-sp causal predicate stays
-            # implicit (mask=None) so cached_attend can take the flash path
-            mask = self._window_mask(x.shape[1], kv["k"].shape[2], pos, sp_axis)
+        # the causal predicate stays implicit (mask=None) under sp too:
+        # cached_attend owns the rank-local sp mask (or the TPU split-K
+        # flash-decode partials) — pre-building sp_causal_mask here would
+        # make the kernel path unreachable
 
         def body(carry, per_layer):
             xc = carry
@@ -126,14 +126,6 @@ class LlamaRingModel(RingModel):
 
         x, kv_out = lax.scan(body, x, (window_params, kv))
         return x, kv_out
-
-    @staticmethod
-    def _window_mask(T, S_local, pos, sp_axis):
-        """Causal mask; under sp the KV axis holds this rank's shard, so
-        causality is computed against absolute slot positions."""
-        if sp_axis is None:
-            return causal_mask(T, S_local, pos)
-        return sp_causal_mask(T, S_local, pos, sp_axis)
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
         return rms_norm(x, edge_params["final_norm"]["weight"], self.config.rms_norm_eps)

@@ -93,6 +93,27 @@ def cached_attend(
         return attend(q, kc, vc, mask=mask, sinks=sinks, scale=scale), kvs
     kvs = write_kv_sp(kvs, k_new, v_new, pos, sp_axis, kv_commit)
     kc, vc = read_kv(kvs)
+    if causal:
+        # sp decode with the plain causal predicate: the split-K Pallas
+        # kernel computes per-rank (acc, m, l) partials before the LSE
+        # combine.  Real-TPU only — interpret-mode pallas inside shard_map
+        # trips jax's vma tracking (ops/flash_decode.py) — with the dense
+        # distributed flash-decoding everywhere else.
+        import jax as _jax
+
+        from dnet_tpu.ops.flash_decode import (
+            flash_decode_eligible,
+            sp_flash_decode_attend,
+        )
+
+        if _jax.default_backend() == "tpu" and flash_decode_eligible(q, kc):
+            return (
+                sp_flash_decode_attend(
+                    q, kc, vc, pos, sp_axis, sinks=sinks, scale=scale
+                ),
+                kvs,
+            )
+        mask = sp_causal_mask(q.shape[1], kc.shape[1], pos, sp_axis)
     return sp_decode_attend(q, kc, vc, mask, sp_axis, sinks=sinks, scale=scale), kvs
 
 
@@ -121,6 +142,26 @@ def rotating_cached_attend(
 
     T = q.shape[1]
     W = kvs["k"].shape[1]
+    if T == 1 and kv_commit is None:
+        # SWA decode through the split-K kernel: write the ring FIRST, then
+        # attend the whole buffer with per-slot absolute positions
+        # reconstructed in-kernel (slot s holds the latest position <= pos
+        # congruent to s mod W).  Gated off under kv_commit: the dense path
+        # attends the new key even on non-committing pipeline ranks, and the
+        # kernel reads only the (unwritten) cache.
+        from dnet_tpu.ops.flash_decode import (
+            flash_decode_attend,
+            flash_decode_eligible,
+        )
+
+        if flash_decode_eligible(q, kvs["k"]):
+            kvs = write_kv_rotating(kvs, k_new, v_new, pos, None, t_real=t_real)
+            kc, vc = read_kv(kvs)
+            attn = flash_decode_attend(
+                q, kc, vc, pos, scale=scale, sinks=sinks, window=window,
+                rotating=True,
+            )
+            return attn, kvs
     k_prev, v_prev = read_kv(kvs)  # [B, W, KVH, Hd]
     keys = jnp.concatenate([k_prev, k_new.astype(k_prev.dtype)], axis=1)
     vals = jnp.concatenate([v_prev, v_new.astype(v_prev.dtype)], axis=1)

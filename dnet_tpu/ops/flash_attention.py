@@ -191,6 +191,16 @@ def flash_attend_causal(
     B, T, H, Hd = q.shape
     S, KVH = k.shape[1], k.shape[2]
     scale = Hd**-0.5 if scale is None else scale
+    if T == 1:
+        # decode: one query row against the (preallocated) cache — the
+        # split-K sibling kernel streams only the LIVE tiles
+        from dnet_tpu.ops.flash_decode import (
+            flash_decode_attend,
+            flash_decode_eligible,
+        )
+
+        if flash_decode_eligible(q, k):
+            return flash_decode_attend(q, k, v, pos, scale=scale, sinks=sinks)
     if not flash_eligible(q, k, v):
         from dnet_tpu.ops.attention import attend, causal_mask
 

@@ -373,7 +373,7 @@ def solve_topology(
                 rounds=per_dev_rounds[i],
                 window_size=window,
                 residency_size=residency,
-    mesh_tp=mesh_tp,
+                mesh_tp=mesh_tp,
             )
         )
     for i, a in enumerate(assignments):

@@ -50,9 +50,11 @@ class ShardCompute:
         from dnet_tpu.core.kvcache import resolve_kv_bits
 
         kv_dtype, kv_quant_bits = resolve_kv_bits(kv_bits)
+        mesh_sp = max(mesh_sp, 1)  # 0/negative = "no sp axis", not "no mesh"
         if mesh_tp == -1:  # every local chip on the tp axis
             n = len(mesh_devices) if mesh_devices is not None else jax.local_device_count()
-            mesh_tp = max(n // max(mesh_sp, 1), 1)
+            mesh_tp = n // mesh_sp
+        mesh_tp = max(mesh_tp, 1)
         if mesh_tp * mesh_sp > 1:
             # mesh-backed shard (VERDICT r3 next #1): this ring node's layer
             # window runs SPMD over the host's local chips

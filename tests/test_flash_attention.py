@@ -95,8 +95,9 @@ def test_garbage_slots_never_attended(rng):
 
 
 def test_decode_width_falls_back(rng, monkeypatch):
-    """T=1 decode stays on the dense path (flash_eligible False) and is
-    still causal-exact."""
+    """T=1 is not prefill-eligible (flash_eligible False) — it routes to
+    the split-K decode kernel (ops/flash_decode.py) — and stays
+    causal-exact either way."""
     from dnet_tpu.ops.flash_attention import flash_attend_causal, flash_eligible
 
     q, k, v = _rand(rng, 1, 1, 2, 16), _rand(rng, 1, 32, 2, 16), _rand(rng, 1, 32, 2, 16)
