@@ -111,9 +111,11 @@ class LocalModelManager:
             kv_dtype, kv_quant_bits = resolve_kv_bits(self.kv_bits)
             if self.mesh is not None:
                 dp, sp = self.mesh.get("dp", 1), self.mesh.get("sp", 1)
-                # sp rides inside the rotation program (sharded KV); only dp
-                # still routes to the sequential mesh
-                use_pipelined = self.batch_slots > 1 and dp == 1
+                # sp rides inside the rotation program (sharded KV) and dp
+                # shards slots over lanes (r4) — all four axes compose
+                use_pipelined = (
+                    self.batch_slots > 1 and self.batch_slots % dp == 0
+                )
                 if use_pipelined:
                     # pre-check pipelined preconditions so an incompatible
                     # config degrades to the sequential mesh instead of
@@ -133,10 +135,11 @@ class LocalModelManager:
                         from dnet_tpu.parallel.pipelined import resolve_pp
 
                         _pp = resolve_pp(
-                            len(_jax.devices()), _tp, self.mesh.get("sp", 1),
-                            _cfg.num_hidden_layers,
+                            len(_jax.devices()), _tp * dp,
+                            self.mesh.get("sp", 1), _cfg.num_hidden_layers,
                         )
                     _mcls = _cls(_cfg.model_type)
+                    _inst = _mcls(_cfg, range(_cfg.num_hidden_layers))
                     if not _mcls.supports_kv_commit:
                         log.warning(
                             "pipelined batching unsupported for %s; serving "
@@ -144,11 +147,21 @@ class LocalModelManager:
                             _cfg.model_type,
                         )
                         use_pipelined = False
-                    elif self.batch_slots < _pp:
+                    elif getattr(_inst, "no_pp_mesh", False) and _pp > 1:
+                        # interleaved mixed layouts can't pp-shard; the
+                        # sequential mesh (which forces pp=1) still serves
                         log.warning(
-                            "batch_slots=%d < pp=%d cannot fill the pipeline;"
-                            " serving sequential mesh (raise batch_slots)",
-                            self.batch_slots, _pp,
+                            "%s interleaved dense/moe layout cannot fill a "
+                            "pp=%d pipeline; serving sequential mesh",
+                            _cfg.model_type, _pp,
+                        )
+                        use_pipelined = False
+                    elif self.batch_slots // dp < _pp:
+                        log.warning(
+                            "batch_slots=%d gives %d slots per dp lane, < "
+                            "pp=%d: cannot fill the pipeline; serving "
+                            "sequential mesh (raise batch_slots)",
+                            self.batch_slots, self.batch_slots // dp, _pp,
                         )
                         use_pipelined = False
                 if use_pipelined:
@@ -167,6 +180,7 @@ class LocalModelManager:
                         pp=self.mesh.get("pp", 0),
                         tp=self.mesh.get("tp", 1),
                         sp=self.mesh.get("sp", 1),
+                        dp=dp,
                         slots=self.batch_slots,
                         max_seq=max_seq or self.max_seq,
                         param_dtype=self.param_dtype,
@@ -177,10 +191,11 @@ class LocalModelManager:
                         prefix_cache_size=self.prefix_cache,
                     )
                     return engine, load_tokenizer(model_dir)
-                if self.batch_slots > 1 and dp > 1:
+                if self.batch_slots > 1 and self.batch_slots % dp != 0:
                     log.warning(
-                        "batch_slots>1 with a dp mesh axis: pipelined "
-                        "batching needs dp=1; serving sequential mesh"
+                        "batch_slots=%d not divisible by dp=%d; pipelined "
+                        "batching needs whole lanes — serving sequential mesh",
+                        self.batch_slots, dp,
                     )
                 from dnet_tpu.parallel.engine import MeshEngine
 

@@ -481,10 +481,15 @@ class LocalEngine:
         m = self.model
         t_real = jnp.int32(x.shape[1] if t_real is None else t_real)
         if not self.plan.streams_weights:
-            if getattr(m, "pair_kinds", None) or getattr(m, "ring_phases", 1) > 1:
+            if (
+                getattr(m, "pair_kinds", None)
+                or getattr(m, "ring_phases", 1) > 1
+                or getattr(m, "segmented_stack", False)
+            ):
                 raise NotImplementedError(
-                    "multi-round rings need a flat layer stack "
-                    "(gpt_oss paired / deepseek segmented layouts pending)"
+                    "multi-round rings need a flat layer stack (gpt_oss "
+                    "paired / deepseek + mixed-qwen3_moe segmented layouts "
+                    "pending)"
                 )
             lo, hi = m.abs_to_local[run[0]], m.abs_to_local[run[-1]] + 1
             kinds = None if m.layer_kinds is None else m.layer_kinds[lo:hi]

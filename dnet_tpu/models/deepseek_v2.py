@@ -36,13 +36,14 @@ from jax import lax
 
 from dnet_tpu.core.kvcache import KVConfig
 from dnet_tpu.models.base import ModelConfig, RingModel
+from dnet_tpu.models.segments import TwoSegmentStackMixin
 from dnet_tpu.ops.attention import cached_attend
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq
 from dnet_tpu.ops.rope import apply_rope_interleaved, rope_frequencies
 
 
-class DeepseekV2RingModel(RingModel):
+class DeepseekV2RingModel(TwoSegmentStackMixin, RingModel):
     model_type = "deepseek_v2"
     supports_kv_commit = True
     ring_phases = 2  # mesh ring: lap 0 = dense slices, lap 1 = moe slices
@@ -233,16 +234,6 @@ class DeepseekV2RingModel(RingModel):
             x = x + out
         return x, kvs
 
-    def _scan_segment(self, seg, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis):
-        def body(carry, per_layer):
-            p, kvs = per_layer
-            xc, kvs = self._layer(
-                p, carry, kvs, pos, mask, tp_axis, kv_commit, sp_axis
-            )
-            return xc, kvs
-
-        return lax.scan(body, x, (seg, kv_seg))
-
     def apply_window(
         self,
         window_params,
@@ -262,43 +253,14 @@ class DeepseekV2RingModel(RingModel):
         `phase` (traced int, mesh ring only) selects ONE segment per call:
         the ring runs `ring_phases` laps so the global layer order stays
         all-dense-then-all-moe even though each pp rank holds a slice of
-        both segments.
+        both segments.  The segment machinery itself is shared with mixed
+        qwen3_moe (models/segments.py).
         """
         # the causal predicate stays implicit (mask=None) under sp too:
         # cached_attend owns the rank-local sp mask (or the TPU split-K
         # flash-decode partials, which honor self.softmax_scale)
-        dense = window_params.get("dense")
-        moe = window_params.get("moe")
-        Ld = dense["attn_norm"].shape[0] if dense is not None else 0
-
-        def run_dense(x, kv):
-            if dense is None:
-                return x, kv
-            kv_seg = jax.tree.map(lambda a: a[:Ld], kv)
-            x, kv_seg = self._scan_segment(
-                dense, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis
-            )
-            kv = jax.tree.map(lambda f, s: f.at[:Ld].set(s), kv, kv_seg)
-            return x, kv
-
-        def run_moe(x, kv):
-            if moe is None:
-                return x, kv
-            kv_seg = jax.tree.map(lambda a: a[Ld:], kv)
-            x, kv_seg = self._scan_segment(
-                moe, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis
-            )
-            kv = jax.tree.map(lambda f, s: f.at[Ld:].set(s), kv, kv_seg)
-            return x, kv
-
-        if phase is None:
-            x, kv = run_dense(x, kv)
-            return run_moe(x, kv)
-        return lax.cond(
-            phase == 0,
-            lambda args: run_dense(*args),
-            lambda args: run_moe(*args),
-            (x, kv),
+        return self._apply_segments(
+            window_params, x, kv, pos, mask, tp_axis, kv_commit, sp_axis, phase
         )
 
     def normalize(self, edge_params: dict, x: jnp.ndarray) -> jnp.ndarray:
@@ -317,48 +279,8 @@ class DeepseekV2RingModel(RingModel):
             out["moe"] = RingModel.stack_layers(per_layer[n_dense:])
         return out
 
-    def quantize_params(self, stacked, bits: int, scale_dtype=None, group_size: int = 0):
-        from dnet_tpu.ops.quant import quantize_tree
-
-        return {
-            seg: quantize_tree(
-                tree, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
-                group_size=group_size,
-            )
-            for seg, tree in stacked.items()
-        }
-
-    def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
-        seg = "moe" if "e_gate" in mapped else "dense"
-        return {seg: jax.tree.map(lambda v: v[None], mapped)}
-
-    def pad_mesh_segments(self, stacked: dict, pp: int):
-        """Zero-pad each segment's layer axis to a multiple of pp so its
-        stack shards evenly over the pipeline axis.  A zero layer is an
-        exact residual no-op (zero o/down/expert projections contribute
-        nothing), so padded numerics are unchanged.  Returns
-        (padded_stacked, n_kv_layers): the mesh KV cache is laid out
-        per-rank (each rank's dense rows then its moe rows)."""
-
-        def pad_seg(tree, target):
-            def pad(a):
-                n = target - a.shape[0]
-                if n == 0:
-                    return a
-                return np.concatenate(
-                    [a, np.zeros((n, *a.shape[1:]), dtype=a.dtype)], axis=0
-                )
-
-            return jax.tree.map(pad, tree)
-
-        out = {}
-        total = 0
-        for seg, tree in stacked.items():
-            L = jax.tree.leaves(tree)[0].shape[0]
-            target = -(-L // pp) * pp  # ceil to pp multiple
-            out[seg] = pad_seg(tree, target)
-            total += target
-        return out, total
+    # quantize_params / wrap_offload_layer / pad_mesh_segments come from
+    # TwoSegmentStackMixin (shared with mixed qwen3_moe)
 
     def map_layer(self, raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         def t(name):

@@ -1,0 +1,117 @@
+"""Two-segment stacked-window machinery, shared by every model whose layers
+split into two param layouts: deepseek_v2 (dense prefix + MoE suffix,
+first_k_dense_replace) and mixed-layout qwen3_moe (mlp_only_layers prefix).
+
+A window stacks as {"dense": ..., "moe": ...} (either key may be absent);
+execution scans the dense segment then the moe segment — correct whenever
+every dense layer precedes every MoE layer in the window, which the owning
+models guarantee before opting in.  On multi-lap pp rings (`ring_phases=2`)
+`phase` selects one segment per lap.  The mixin expects the host class to
+provide `_layer(p, x, kvs, pos, mask, tp_axis=, kv_commit=, sp_axis=)` and
+a `quant_keys` set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+from jax import lax
+
+
+class TwoSegmentStackMixin:
+    def _scan_segment(self, seg, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis):
+        def body(carry, per_layer):
+            p, kvs = per_layer
+            xc, kvs = self._layer(
+                p, carry, kvs, pos, mask, tp_axis=tp_axis, kv_commit=kv_commit,
+                sp_axis=sp_axis,
+            )
+            return xc, kvs
+
+        return lax.scan(body, x, (seg, kv_seg))
+
+    def _apply_segments(
+        self, window_params, x, kv, pos, mask, tp_axis, kv_commit, sp_axis,
+        phase,
+    ):
+        """Dense segment then moe segment; a missing segment is a no-op
+        (a shard's window may be single-kind).  `phase` (multi-lap pp ring)
+        selects one segment per lap."""
+        dense = window_params.get("dense")
+        moe = window_params.get("moe")
+        Ld = jax.tree.leaves(dense)[0].shape[0] if dense is not None else 0
+
+        def run_dense(x, kv):
+            if dense is None:
+                return x, kv
+            kv_seg = jax.tree.map(lambda a: a[:Ld], kv)
+            x, kv_seg = self._scan_segment(
+                dense, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis
+            )
+            kv = jax.tree.map(lambda f, s: f.at[:Ld].set(s), kv, kv_seg)
+            return x, kv
+
+        def run_moe(x, kv):
+            if moe is None:
+                return x, kv
+            kv_seg = jax.tree.map(lambda a: a[Ld:], kv)
+            x, kv_seg = self._scan_segment(
+                moe, x, kv_seg, pos, mask, tp_axis, kv_commit, sp_axis
+            )
+            kv = jax.tree.map(lambda f, s: f.at[Ld:].set(s), kv, kv_seg)
+            return x, kv
+
+        if phase is None:
+            x, kv = run_dense(x, kv)
+            return run_moe(x, kv)
+        return lax.cond(
+            phase == 0,
+            lambda args: run_dense(*args),
+            lambda args: run_moe(*args),
+            (x, kv),
+        )
+
+    def quantize_params(self, stacked, bits: int, scale_dtype=None, group_size: int = 0):
+        from dnet_tpu.ops.quant import quantize_tree
+
+        return {
+            seg: quantize_tree(
+                tree, self.quant_keys, bits=bits, scale_dtype=scale_dtype,
+                group_size=group_size,
+            )
+            for seg, tree in stacked.items()
+        }
+
+    def wrap_offload_layer(self, mapped: Dict[str, np.ndarray]):
+        seg = "moe" if "e_gate" in mapped else "dense"
+        return {seg: jax.tree.map(lambda v: v[None], mapped)}
+
+    def pad_mesh_segments(self, stacked: dict, pp: int):
+        """Zero-pad each segment's layer axis to a multiple of pp so its
+        stack shards evenly over the pipeline axis.  A zero layer is an
+        exact residual no-op (zero o/down/expert projections contribute
+        nothing), so padded numerics are unchanged.  Returns
+        (padded_stacked, n_kv_layers): the mesh KV cache is laid out
+        per-rank (each rank's dense rows then its moe rows)."""
+
+        def pad_seg(tree, target):
+            def pad(a):
+                n = target - a.shape[0]
+                if n == 0:
+                    return a
+                return np.concatenate(
+                    [a, np.zeros((n, *a.shape[1:]), dtype=a.dtype)], axis=0
+                )
+
+            return jax.tree.map(pad, tree)
+
+        out = {}
+        total = 0
+        for seg, tree in stacked.items():
+            L = jax.tree.leaves(tree)[0].shape[0]
+            target = -(-L // pp) * pp  # ceil to pp multiple
+            out[seg] = pad_seg(tree, target)
+            total += target
+        return out, total

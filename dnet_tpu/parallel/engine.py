@@ -100,6 +100,16 @@ class MeshEngine:
         # segmented models (ring_phases > 1) zero-pad each segment to pp
         # divisibility, so L need not divide evenly
         segmented = getattr(self.model, "ring_phases", 1) > 1
+        if getattr(self.model, "no_pp_mesh", False):
+            # interleaved mixed layouts (qwen3_moe decoder_sparse_step) have
+            # no multi-lap form: the pipeline cannot reproduce layer order
+            if pp > 1:
+                raise NotImplementedError(
+                    f"{self.config.model_type} with an interleaved dense/moe "
+                    f"layout cannot shard layers over pp={pp}; use tp/sp "
+                    f"axes or the gRPC shard ring"
+                )
+            pp = 1
         if pp <= 0:  # 0 = infer: use every remaining device for pipeline stages
             n_dev = len(list(devices) if devices is not None else jax.devices())
             pp = max(n_dev // (tp * dp * sp), 1)
@@ -180,6 +190,14 @@ class MeshEngine:
         self.model = model_cls(config, range(config.num_hidden_layers))
         L = config.num_hidden_layers
         segmented = getattr(self.model, "ring_phases", 1) > 1
+        if getattr(self.model, "no_pp_mesh", False):
+            if pp > 1:
+                raise NotImplementedError(
+                    f"{config.model_type} with an interleaved dense/moe "
+                    f"layout cannot shard layers over pp={pp}; use tp/sp "
+                    f"axes or the gRPC shard ring"
+                )
+            pp = 1
         if pp <= 0:
             n_dev = len(list(devices) if devices is not None else jax.devices())
             pp = max(n_dev // (tp * dp * sp), 1)

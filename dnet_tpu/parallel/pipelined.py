@@ -132,6 +132,16 @@ def make_rotation_fn(
     the per-step schedule (entry_open / entry_slot / exit_valid / exit_slot)
     is precomputed host-side from the closed-form multi-lap schedule
     (_entry_open/_entry_slot) and consumed by the scan.
+
+    Data parallelism shards SLOTS over dp lanes: `n_slots` is the PER-LANE
+    slot count and every lane runs this same schedule over its own slots
+    (global slot = lane * M + local), so capacity scales linearly with dp
+    while the compiled schedule stays lane-invariant.  All per-slot state
+    (tokens/pos_vec/keys/counts/sp_stack and the kv slot-batch axis) is
+    dp-sharded lane-major; only `enter_live` is genuinely per-lane data
+    (which slots carry real requests) and arrives [dp, n_steps].  Sampling
+    runs per lane on its own slots — dp-varying by construction, which is
+    why no identity psum over dp appears anywhere (r3's dp=1 pin).
     """
     PP = mesh.shape[AXIS_PP]
     M, B = n_slots, batch
@@ -144,34 +154,35 @@ def make_rotation_fn(
     # (the same kv_spec/sp_axis plumbing as the sequential mesh ring)
     sp_axis = AXIS_SP if mesh.shape.get(AXIS_SP, 1) > 1 else None
 
-    # x_state mentions AXIS_DP (size 1, enforced by the engine) purely so its
-    # vma matches the dp-varying kv inside the layer scan
-    x_spec = P(AXIS_PP, AXIS_DP)
+    x_spec = P(AXIS_PP, AXIS_DP)  # x_state [PP, DP*B, 1, D]
     in_specs = (
         window_param_specs(window_params),
         P(),  # edge params replicated
-        x_spec,  # x_state [PP, B, 1, D]
-        kv_spec(sp_axis is not None),  # [L, M*B, S(/sp), KVH, Hd]
-        P(),  # tokens [M, B]
-        P(),  # pos_vec [M]
-        P(AXIS_PP),  # pos_state [PP]
-        P(AXIS_PP),  # live_state [PP] bool
-        P(AXIS_PP),  # phase_state [PP] int32 (current lap of in-flight token)
+        x_spec,
+        kv_spec(sp_axis is not None),  # [L, DP*M*B, S(/sp), KVH, Hd]
+        P(AXIS_DP),  # tokens [DP*M, B]
+        P(AXIS_DP),  # pos_vec [DP*M]
+        P(AXIS_PP, AXIS_DP),  # pos_state [PP, DP]
+        P(AXIS_PP, AXIS_DP),  # live_state [PP, DP] bool
+        P(AXIS_PP, AXIS_DP),  # phase_state [PP, DP] int32 (lap of in-flight token)
         P(),  # entry_open [n_steps] bool (schedule: step takes an entry)
-        P(),  # enter_live [n_steps] bool (per-step: entry carries a real token)
-        P(),  # entry_slot [n_steps] int32
+        P(AXIS_DP),  # enter_live [DP, n_steps] bool (per-lane real-entry flag)
+        P(),  # entry_slot [n_steps] int32 (lane-local slot)
         P(),  # exit_valid [n_steps] bool (schedule: step finishes a token)
-        P(),  # exit_slot [n_steps] int32
-        P(),  # sp_stack (SampleParams leaves [M])
-        P(),  # keys [M, 2] uint32
-        P(),  # counts [M, B, V]
+        P(),  # exit_slot [n_steps] int32 (lane-local slot)
+        P(AXIS_DP),  # sp_stack (SampleParams leaves [DP*M])
+        P(AXIS_DP),  # keys [DP*M, 2] uint32
+        P(AXIS_DP),  # counts [DP*M, B, V]
         P(),  # t0 scalar
         P(AXIS_PP) if has_kinds else P(),
     )
-    res_spec = SampleResult(P(), P(), P(), P())
+    res_spec = SampleResult(
+        P(None, AXIS_DP), P(None, AXIS_DP), P(None, AXIS_DP), P(None, AXIS_DP)
+    )  # leaves [n_steps, DP*B, ...]: every lane emits its own exit row
     out_specs = (
-        res_spec, x_spec, kv_spec(sp_axis is not None), P(), P(), P(AXIS_PP),
-        P(AXIS_PP), P(AXIS_PP), P(), P(),
+        res_spec, x_spec, kv_spec(sp_axis is not None), P(AXIS_DP), P(AXIS_DP),
+        P(AXIS_PP, AXIS_DP), P(AXIS_PP, AXIS_DP), P(AXIS_PP, AXIS_DP),
+        P(AXIS_DP), P(AXIS_DP),
     )
 
     def spmd(window_params, edge_params, x_state, kv, tokens, pos_vec,
@@ -179,10 +190,11 @@ def make_rotation_fn(
              entry_slot, exit_valid, exit_slot, sp_stack, keys, counts,
              t0, kinds):
         my_pp = lax.axis_index(AXIS_PP)
-        x = x_state[0]  # local [B, 1, D], device-varying over pp
-        pos_x = pos_state[0]  # this rank's in-flight token position
-        live_x = live_state[0]  # is this rank's in-flight token real?
-        phase_x = phase_state[0]  # this rank's in-flight token lap
+        x = x_state[0]  # local [B, 1, D], device-varying over pp (and dp)
+        pos_x = pos_state[0, 0]  # this (pp, lane) rank's in-flight position
+        live_x = live_state[0, 0]  # is this rank's in-flight token real?
+        phase_x = phase_state[0, 0]  # this rank's in-flight token lap
+        live_row = enter_live[0]  # this lane's per-step real-entry flags
 
         def step(carry, j):
             x, pos_x, live_x, phase_x, kv, tokens, pos_vec, keys, counts = carry
@@ -200,12 +212,13 @@ def make_rotation_fn(
             take = (my_pp == 0) & open_j
             tok_in = lax.dynamic_index_in_dim(tokens, n, keepdims=False)  # [B]
             x_embed = model.embed(edge_params, tok_in[:, None])
+            # tokens are dp-sharded, so the embedding is already dp-varying;
+            # only the pp axis needs the explicit cast
             x_embed = lax.pcast(x_embed, AXIS_PP, to="varying")
-            x_embed = lax.pcast(x_embed, AXIS_DP, to="varying")
             x_in = jnp.where(take, x_embed, x)
             pos_entry = lax.dynamic_index_in_dim(pos_vec, n, keepdims=False)
             pos_in = jnp.where(take, pos_entry, pos_x)
-            live_entry = lax.dynamic_index_in_dim(enter_live, j, keepdims=False)
+            live_entry = lax.dynamic_index_in_dim(live_row, j, keepdims=False)
             live_entry = lax.pcast(live_entry, AXIS_PP, to="varying")
             live_in = jnp.where(take, live_entry, live_x)
             phase_in = jnp.where(take, 0, phase_x)
@@ -243,10 +256,9 @@ def make_rotation_fn(
             x_last = model.normalize(edge_params, x_out)
             logits = model.lm_project(edge_params, x_last)[:, 0]  # [B, V]
             logits = _bcast_from_rank(logits, AXIS_PP, PP - 1)
-            # dp is pinned to 1: this psum is an identity that casts the
-            # dp-varying logits back to invariant so the sampling state
-            # (tokens/keys/counts carries, replicated out_specs) stays clean
-            logits = lax.psum(logits, AXIS_DP)
+            # no dp collective here: each lane samples its OWN slot's exit —
+            # the sampling state (tokens/keys/counts) is dp-sharded, so
+            # dp-varying logits are exactly right (r3's identity psum gone)
 
             # the exiting token's own live flag decides realness (bcast from
             # the last rank, where it resides this step); schedule steps that
@@ -298,8 +310,8 @@ def make_rotation_fn(
                 jnp.arange(n_steps, dtype=jnp.int32),
             )
         )
-        return (results, x[None], kv, tokens, pos_vec, pos_x[None],
-                live_x[None], phase_x[None], keys, counts)
+        return (results, x[None], kv, tokens, pos_vec, pos_x[None, None],
+                live_x[None, None], phase_x[None, None], keys, counts)
 
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     jitted = jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6, 7, 8, 15, 16))
@@ -321,8 +333,12 @@ def make_rotation_fn(
 def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: int = 1):
     """Sequential ring pass (parallel/ring.py schedule) writing ONE slot's KV.
 
-    (window_params, edge_params, tokens[B,T], kv, pos, last_idx, slot)
+    (window_params, edge_params, tokens[B,T], kv, pos, last_idx, slot, lane)
       -> (logits[B,V], kv)
+
+    `slot` is lane-local; `lane` selects the dp lane that owns the request —
+    every lane traces the same pass (SPMD), but only the owning lane's
+    kv_commit fires and only its logits survive the dp broadcast.
     """
     PP = mesh.shape[AXIS_PP]
     B = batch
@@ -332,19 +348,22 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
     in_specs = (
         window_param_specs(window_params),
         P(),
-        P(AXIS_DP),  # tokens [B, T]: dp-sharded batch matches the kv vma
-        kv_spec(sp_axis is not None), P(), P(), P(),
+        P(),  # tokens [B, T] replicated: every lane traces the same pass
+        kv_spec(sp_axis is not None), P(), P(), P(), P(),
         P(AXIS_PP) if has_kinds else P(),
     )
     out_specs = (P(), kv_spec(sp_axis is not None))
 
-    def spmd(window_params, edge_params, tokens, kv, pos, last_idx, slot, kinds):
+    def spmd(window_params, edge_params, tokens, kv, pos, last_idx, slot, lane,
+             kinds):
         my_pp = lax.axis_index(AXIS_PP)
+        mine = lax.axis_index(AXIS_DP) == lane
         kv_slot = jax.tree.map(
             lambda a: lax.dynamic_slice_in_dim(a, slot * B, B, axis=1), kv
         )
         x = model.embed(edge_params, tokens)
         x = lax.pcast(x, AXIS_PP, to="varying")
+        x = lax.pcast(x, AXIS_DP, to="varying")
 
         def stage_iter(i, carry):
             x, kv_slot = carry
@@ -354,7 +373,7 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
             x_new, kv_slot = model.apply_window(
                 window_params, x, kv_slot, pos,
                 layer_kinds=kinds, tp_axis=AXIS_TP,
-                kv_commit=(jnp.mod(i, PP) == my_pp),
+                kv_commit=(jnp.mod(i, PP) == my_pp) & mine,
                 sp_axis=sp_axis, t_real=last_idx + 1, **extra,
             )
             x_next = lax.ppermute(
@@ -373,7 +392,8 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
         x_last = model.normalize(edge_params, x_last)
         logits = model.lm_project(edge_params, x_last)
         logits = _bcast_from_rank(logits, AXIS_PP, 0)
-        logits = lax.psum(logits, AXIS_DP)  # identity at dp=1: vma cast only
+        # keep the owning lane's logits and replicate (bcast, not identity)
+        logits = lax.psum(jnp.where(mine, logits, jnp.zeros_like(logits)), AXIS_DP)
         return logits[:, 0], kv
 
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
@@ -382,9 +402,10 @@ def make_slot_prefill_fn(model, mesh: Mesh, window_params, n_slots: int, batch: 
         model.layer_kinds if has_kinds else jnp.zeros((), dtype=jnp.int32)
     )
 
-    def call(window_params, edge_params, tokens, kv, pos, last_idx, slot):
+    def call(window_params, edge_params, tokens, kv, pos, last_idx, slot, lane=0):
         return jitted(window_params, edge_params, tokens, kv, jnp.int32(pos),
-                      jnp.int32(last_idx), jnp.int32(slot), kinds_arr)
+                      jnp.int32(last_idx), jnp.int32(slot), jnp.int32(lane),
+                      kinds_arr)
 
     return call
 
@@ -405,6 +426,7 @@ class PipelinedMeshEngine:
         pp: int = 0,
         tp: int = 1,
         sp: int = 1,
+        dp: int = 1,
         slots: int = 0,
         max_seq: int = 2048,
         param_dtype: str = "bfloat16",
@@ -429,15 +451,27 @@ class PipelinedMeshEngine:
             L = json.loads(
                 (_Path(model_dir) / "config.json").read_text()
             )["num_hidden_layers"]
-            pp = resolve_pp(n_dev, tp, sp, L)
-        self.n_slots = M = slots if slots > 0 else pp
-        if M < pp:
-            raise ValueError(f"slots={M} must be >= pp={pp} to fill the pipeline")
+            pp = resolve_pp(n_dev, tp * dp, sp, L)
+        # dp shards SLOTS: dp lanes each run the same per-lane schedule over
+        # M_local slots (global slot = lane * M_local + local) — capacity
+        # scales linearly, the schedule stays lane-invariant
+        self.dp = dp = max(dp, 1)
+        self.n_slots = M = slots if slots > 0 else pp * dp
+        if M % dp != 0:
+            raise ValueError(f"slots={M} must be divisible by dp={dp}")
+        self.m_local = M_local = M // dp
+        if M_local < pp:
+            raise ValueError(
+                f"slots={M} gives {M_local} per dp lane; need >= pp={pp} "
+                f"to fill the pipeline"
+            )
         self.slot_batch = B = 1
         # the inner MeshEngine loads/shards params and builds the kv template
-        # with batch = M*B (slots folded into the batch axis)
+        # with batch = dp*M_local*B (lanes x slots folded into the batch axis,
+        # lane-major so the dp sharding blocks align with global slot ids)
         self._inner = MeshEngine(
-            model_dir, pp=pp, tp=tp, dp=1, sp=sp, batch=M * B, max_seq=max_seq,
+            model_dir, pp=pp, tp=tp, dp=dp, sp=sp, batch=M_local * B,
+            max_seq=max_seq,
             param_dtype=param_dtype, kv_dtype=kv_dtype,
             kv_quant_bits=kv_quant_bits, weight_quant_bits=weight_quant_bits,
             quant_group=quant_group, devices=devices,
@@ -458,42 +492,44 @@ class PipelinedMeshEngine:
         self.max_seq = max_seq
         self.window_params, self.edge_params = inner.window_params, inner.edge_params
 
-        # rotation programs cached per fused-rotation count R (R*M stage
-        # steps per dispatch); R=1 built eagerly, larger chunks on demand
+        # rotation programs cached per fused-rotation count R (R*M_local
+        # stage steps per dispatch); R=1 built eagerly, larger on demand
         self._host_window_ref = inner._host_window
         self._rot_fns = {
-            1: make_rotation_fn(self.model, self.mesh, inner._host_window, M, B)
+            1: make_rotation_fn(
+                self.model, self.mesh, inner._host_window, M_local, B
+            )
         }
         self._prefill_fn = make_slot_prefill_fn(
-            self.model, self.mesh, inner._host_window, M, B
+            self.model, self.mesh, inner._host_window, M_local, B
         )
 
         from jax.sharding import NamedSharding
 
         D = self.config.hidden_size
         V = self.config.vocab_size
-        rep = NamedSharding(self.mesh, P())
+        lane_sh = NamedSharding(self.mesh, P(AXIS_DP))  # slot-major over lanes
         self.x_state = jax.device_put(
-            jnp.zeros((self.pp, B, 1, D), dtype=jnp.dtype(param_dtype)),
+            jnp.zeros((self.pp, dp * B, 1, D), dtype=jnp.dtype(param_dtype)),
             NamedSharding(self.mesh, P(AXIS_PP, AXIS_DP)),
         )
-        self.kv = inner._kv_template  # [L, M*B, S, ...] mesh-sharded, live
-        self.tokens = jax.device_put(jnp.zeros((M, B), dtype=jnp.int32), rep)
-        self.pos_vec = jax.device_put(jnp.zeros((M,), dtype=jnp.int32), rep)
+        self.kv = inner._kv_template  # [L, dp*M_local*B, S, ...] mesh-sharded
+        self.tokens = jax.device_put(jnp.zeros((M, B), dtype=jnp.int32), lane_sh)
+        self.pos_vec = jax.device_put(jnp.zeros((M,), dtype=jnp.int32), lane_sh)
+        pp_dp = NamedSharding(self.mesh, P(AXIS_PP, AXIS_DP))
         self.pos_state = jax.device_put(
-            jnp.zeros((self.pp,), dtype=jnp.int32),
-            NamedSharding(self.mesh, P(AXIS_PP)),
+            jnp.zeros((self.pp, dp), dtype=jnp.int32), pp_dp
         )
         self.live_state = jax.device_put(
-            jnp.zeros((self.pp,), dtype=bool),
-            NamedSharding(self.mesh, P(AXIS_PP)),
+            jnp.zeros((self.pp, dp), dtype=bool), pp_dp
         )
         self.phase_state = jax.device_put(
-            jnp.zeros((self.pp,), dtype=jnp.int32),
-            NamedSharding(self.mesh, P(AXIS_PP)),
+            jnp.zeros((self.pp, dp), dtype=jnp.int32), pp_dp
         )
-        self.keys = jax.device_put(jnp.zeros((M, 2), dtype=jnp.uint32), rep)
-        self.counts = jax.device_put(jnp.zeros((M, B, V), dtype=jnp.int32), rep)
+        self.keys = jax.device_put(jnp.zeros((M, 2), dtype=jnp.uint32), lane_sh)
+        self.counts = jax.device_put(
+            jnp.zeros((M, B, V), dtype=jnp.int32), lane_sh
+        )
         self.t0 = 0
 
         self.slot_of: Dict[str, int] = {}
@@ -597,9 +633,10 @@ class PipelinedMeshEngine:
         Tpad = min(bucket_length(T), self.max_seq - base)
         tokens = np.zeros((B, Tpad), dtype=np.int32)
         tokens[:, :T] = np.asarray(rest, dtype=np.int32)
+        lane, local = divmod(slot, self.m_local)
         logits, self.kv = self._prefill_fn(
             self.window_params, self.edge_params, jnp.asarray(tokens),
-            self.kv, base, T - 1, slot,
+            self.kv, base, T - 1, local, lane,
         )
         if self.prefix_cache is not None:
             self.prefix_cache.store(
@@ -628,16 +665,18 @@ class PipelinedMeshEngine:
         # kill the slot's stale in-flight token: between rotations, rank r
         # carries the token that entered at te = t0 - r - PP*lap (exactly one
         # lap makes te an entry-open step) — its live flag must not let old
-        # garbage commit KV into the rows this prefill just wrote
+        # garbage commit KV into the rows this prefill just wrote.  The
+        # schedule is lane-local, so the match is against the LOCAL slot and
+        # the kill lands on this lane's column of live_state.
         for r in range(self.pp):
             for p in range(self.phases):
                 te = self.t0 - r - self.pp * p
                 if (
                     te >= 0
                     and _entry_open(te, self.pp, self.phases)
-                    and _entry_slot(te, self.pp, self.phases, self.n_slots) == slot
+                    and _entry_slot(te, self.pp, self.phases, self.m_local) == local
                 ):
-                    self.live_state = self.live_state.at[r].set(False)
+                    self.live_state = self.live_state.at[r, lane].set(False)
         self.slot_pos[slot] = T_total
         self._dec[slot] = decoding
         return res
@@ -673,8 +712,8 @@ class PipelinedMeshEngine:
         if fn is None:
             fn = make_rotation_fn(
                 self.model, self.mesh, self._host_window_ref,
-                self.n_slots, self.slot_batch,
-                n_steps=R * self.n_slots * self.phases,
+                self.m_local, self.slot_batch,
+                n_steps=R * self.m_local * self.phases,
             )
             self._rot_fns[R] = fn
         return fn
@@ -687,15 +726,15 @@ class PipelinedMeshEngine:
         bookkeeping, never on token VALUES, so the packed results can be
         read later (overlapping the next chunk's compute)."""
         np = self._np
-        M, PP, phases = self.n_slots, self.pp, self.phases
+        M_local, PP, phases, DP = self.m_local, self.pp, self.phases, self.dp
         PHI = phases * PP
         nonce_of = {s: n for n, s in self.slot_of.items()}
-        sim = {m: list(self._entries[m]) for m in range(M)}
+        sim = {m: list(self._entries[m]) for m in range(self.n_slots)}
         pos_sim = self.slot_pos.copy()
-        deliveries = []  # (step index j, nonce at dispatch time)
-        n_steps = R * M * phases
+        deliveries = []  # (step index j, lane, nonce at dispatch time)
+        n_steps = R * M_local * phases
         entry_open = np.zeros(n_steps, dtype=bool)
-        enter_live = np.zeros(n_steps, dtype=bool)
+        enter_live = np.zeros((DP, n_steps), dtype=bool)
         entry_slot = np.zeros(n_steps, dtype=np.int32)
         exit_valid = np.zeros(n_steps, dtype=bool)
         exit_slot = np.zeros(n_steps, dtype=np.int32)
@@ -703,26 +742,32 @@ class PipelinedMeshEngine:
             t = self.t0 + j
             te = t - (PHI - 1)  # exit latency: phases laps of PP hops
             if te >= 0 and _entry_open(te, PP, phases):
-                e_slot = _entry_slot(te, PP, phases, M)
+                e_local = _entry_slot(te, PP, phases, M_local)
                 exit_valid[j] = True
-                exit_slot[j] = e_slot
-                ent = sim[e_slot]
-                if ent and ent[0] == te:
-                    ent.pop(0)
-                    if e_slot in nonce_of:
-                        deliveries.append((j, nonce_of[e_slot]))
+                exit_slot[j] = e_local
+                # every dp lane exits its own slot at this step
+                for lane in range(DP):
+                    g = lane * M_local + e_local
+                    ent = sim[g]
+                    if ent and ent[0] == te:
+                        ent.pop(0)
+                        if g in nonce_of:
+                            deliveries.append((j, lane, nonce_of[g]))
             if _entry_open(t, PP, phases):
-                n_slot = _entry_slot(t, PP, phases, M)
+                n_local = _entry_slot(t, PP, phases, M_local)
                 entry_open[j] = True
-                entry_slot[j] = n_slot
+                entry_slot[j] = n_local
                 # a live slot below capacity feeds one real token this step;
-                # the device consumes enter_live[j] at this point in its scan
-                if n_slot in nonce_of and pos_sim[n_slot] < self.max_seq:
-                    enter_live[j] = True
-                    sim[n_slot].append(t)
-                # pos_vec advances unconditionally at the entry step (device
-                # mirrors this); gated KV commits make dead-slot writes inert
-                pos_sim[n_slot] += 1
+                # lane d's device consumes enter_live[d, j] in its scan
+                for lane in range(DP):
+                    g = lane * M_local + n_local
+                    if g in nonce_of and pos_sim[g] < self.max_seq:
+                        enter_live[lane, j] = True
+                        sim[g].append(t)
+                    # pos_vec advances unconditionally at the entry step
+                    # (device mirrors this); gated KV commits make
+                    # dead-slot writes inert
+                    pos_sim[g] += 1
         (results, self.x_state, self.kv, self.tokens, self.pos_vec,
          self.pos_state, self.live_state, self.phase_state, self.keys,
          self.counts) = self._rot_fn(R)(
@@ -748,16 +793,18 @@ class PipelinedMeshEngine:
         its tokens are dropped, exactly like LocalAdapter's aborted-chunk
         leftovers."""
         np = self._np
+        B = self.slot_batch
         while self._pending_rot:
             deliveries, results = self._pending_rot.pop(0)
-            toks = np.asarray(results.token)
+            toks = np.asarray(results.token)  # [n_steps, DP*B]
             lps = np.asarray(results.logprob)
             tts = np.asarray(results.top_tokens)
             tlps = np.asarray(results.top_logprobs)
-            for j, nonce in deliveries:
+            for j, lane, nonce in deliveries:
                 if nonce in self._buffer:
+                    sl = slice(lane * B, (lane + 1) * B)
                     self._buffer[nonce].append(
-                        SampleResult(toks[j], lps[j], tts[j], tlps[j])
+                        SampleResult(toks[j, sl], lps[j, sl], tts[j, sl], tlps[j, sl])
                     )
 
     def decode_batch(

@@ -475,6 +475,14 @@ def make_tiny_qwen3_moe(model_dir: str | Path, config: dict | None = None, seed:
     def w(*shape, scale=0.05):
         return rng.normal(0.0, scale, size=shape).astype(np.float32)
 
+    # mixed layouts (mlp_only_layers / decoder_sparse_step): dense layers
+    # carry a plain swiglu MLP at intermediate_size, like transformers
+    mlp_only = set(cfg.get("mlp_only_layers") or [])
+    step = cfg.get("decoder_sparse_step", 1)
+
+    def is_moe(i: int) -> bool:
+        return i not in mlp_only and (step <= 1 or (i + 1) % step == 0)
+
     tensors = {
         "model.embed_tokens.weight": w(V, D),
         "model.norm.weight": np.ones(D, dtype=np.float32),
@@ -490,11 +498,17 @@ def make_tiny_qwen3_moe(model_dir: str | Path, config: dict | None = None, seed:
         tensors[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
         tensors[p + "self_attn.q_norm.weight"] = np.ones(Hd, np.float32) + w(Hd, scale=0.01)
         tensors[p + "self_attn.k_norm.weight"] = np.ones(Hd, np.float32) + w(Hd, scale=0.01)
-        tensors[p + "mlp.gate.weight"] = w(E, D, scale=0.3)
-        for e in range(E):
-            q = p + f"mlp.experts.{e}."
-            tensors[q + "gate_proj.weight"] = w(F, D)
-            tensors[q + "up_proj.weight"] = w(F, D)
-            tensors[q + "down_proj.weight"] = w(D, F)
+        if is_moe(i):
+            tensors[p + "mlp.gate.weight"] = w(E, D, scale=0.3)
+            for e in range(E):
+                q = p + f"mlp.experts.{e}."
+                tensors[q + "gate_proj.weight"] = w(F, D)
+                tensors[q + "up_proj.weight"] = w(F, D)
+                tensors[q + "down_proj.weight"] = w(D, F)
+        else:
+            Fd = cfg["intermediate_size"]
+            tensors[p + "mlp.gate_proj.weight"] = w(Fd, D)
+            tensors[p + "mlp.up_proj.weight"] = w(Fd, D)
+            tensors[p + "mlp.down_proj.weight"] = w(D, Fd)
     save_checkpoint(model_dir, cfg, tensors)
     return cfg

@@ -132,11 +132,125 @@ def test_no_renorm_matches_hf(tmp_path_factory):
     )
 
 
-def test_mixed_dense_layers_fail_fast(tmp_path_factory):
+def _hf_ref(d, ids):
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen3MoeForCausalLM
+
+    hf = Qwen3MoeForCausalLM.from_pretrained(d, torch_dtype=torch.float32).eval()
+    with torch.no_grad():
+        return hf(torch.tensor([ids], dtype=torch.long)).logits[0].numpy()
+
+
+def test_prefix_dense_layers_match_hf(tmp_path_factory):
+    """mlp_only_layers prefix: two-segment stacking (deepseek's scheme) —
+    HF forward parity on the flat engine."""
     from tests.fakes.checkpoints import make_tiny_qwen3_moe
+
     from dnet_tpu.core.engine import LocalEngine
 
-    d = tmp_path_factory.mktemp("q3moe_mixed")
-    make_tiny_qwen3_moe(d, config={"mlp_only_layers": [0]})
-    with pytest.raises(NotImplementedError, match="dense layers"):
-        LocalEngine(d, max_seq=32, param_dtype="float32")
+    d = tmp_path_factory.mktemp("q3moe_prefix")
+    make_tiny_qwen3_moe(d, config={"mlp_only_layers": [0, 1]})
+    eng = LocalEngine(d, max_seq=64, param_dtype="float32")
+    assert eng.model.mixed and eng.model.prefix_mixed
+    assert eng.model.ring_phases == 2
+    ids = [256, 72, 101, 108]
+    ref = _hf_ref(d, ids)
+    logits = eng.prefill("p", ids)
+    np.testing.assert_allclose(
+        np.asarray(logits[0], np.float32), ref[-1], atol=2e-3, rtol=2e-3
+    )
+    eng.close()
+
+
+def test_prefix_mixed_mesh_ring_matches_local(tmp_path_factory, eight_devices):
+    """Prefix-mixed layout through the pp2/tp2 multi-lap mesh ring."""
+    from tests.fakes.checkpoints import make_tiny_qwen3_moe
+
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    d = tmp_path_factory.mktemp("q3moe_prefix_mesh")
+    make_tiny_qwen3_moe(d, config={"mlp_only_layers": [0, 1]})
+    local = LocalEngine(d, max_seq=64, param_dtype="float32")
+    ids = [256, 72, 101, 108]
+    dec = DecodingParams(temperature=0.0)
+    want = [r.token_id for r in local.generate(ids, dec, max_tokens=6)]
+    mesh = MeshEngine(d, pp=2, tp=2, max_seq=64, param_dtype="float32")
+    got = [r.token_id for r in mesh.generate(ids, dec, max_tokens=6)]
+    assert got == want
+    local.close()
+
+
+def test_interleaved_sparse_step_matches_hf(tmp_path_factory):
+    """decoder_sparse_step=2 (alternating dense/moe): the order-preserving
+    mixed scan — HF forward parity + greedy stream."""
+    from tests.fakes.checkpoints import make_tiny_qwen3_moe
+
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path_factory.mktemp("q3moe_interleave")
+    make_tiny_qwen3_moe(d, config={"decoder_sparse_step": 2})
+    eng = LocalEngine(d, max_seq=64, param_dtype="float32")
+    assert eng.model.mixed and not eng.model.prefix_mixed
+    ids = [256, 72, 101, 108]
+    ref = _hf_ref(d, ids)
+    logits = eng.prefill("p", ids)
+    np.testing.assert_allclose(
+        np.asarray(logits[0], np.float32), ref[-1], atol=2e-3, rtol=2e-3
+    )
+    eng.end_session("p")
+    # decode path (single-token steps through the mixed scan)
+    got = [r.token_id for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=5)]
+    assert len(got) == 5
+    eng.close()
+
+
+def test_interleaved_rejects_pp_mesh(tmp_path_factory, eight_devices):
+    from tests.fakes.checkpoints import make_tiny_qwen3_moe
+
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    d = tmp_path_factory.mktemp("q3moe_interleave_pp")
+    make_tiny_qwen3_moe(d, config={"decoder_sparse_step": 2})
+    with pytest.raises(NotImplementedError, match="interleaved"):
+        MeshEngine(d, pp=2, max_seq=32, param_dtype="float32")
+
+
+def test_interleaved_tp_mesh_matches_local(tmp_path_factory, eight_devices):
+    """Interleaved layout on a tp=2 (pp=1) mesh: psum seams inside the
+    cond-dispatched mixed scan."""
+    from tests.fakes.checkpoints import make_tiny_qwen3_moe
+
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.parallel.engine import MeshEngine
+
+    d = tmp_path_factory.mktemp("q3moe_interleave_tp")
+    make_tiny_qwen3_moe(d, config={"decoder_sparse_step": 2})
+    local = LocalEngine(d, max_seq=64, param_dtype="float32")
+    ids = [256, 90, 66]
+    dec = DecodingParams(temperature=0.0)
+    want = [r.token_id for r in local.generate(ids, dec, max_tokens=5)]
+    mesh = MeshEngine(d, pp=1, tp=2, max_seq=64, param_dtype="float32")
+    got = [r.token_id for r in mesh.generate(ids, dec, max_tokens=5)]
+    assert got == want
+    local.close()
+
+
+def test_all_dense_degenerate_is_flat(tmp_path_factory):
+    """mlp_only_layers covering every layer: homogeneous dense — flat
+    stacking, no segment machinery, stream works."""
+    from tests.fakes.checkpoints import make_tiny_qwen3_moe
+
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path_factory.mktemp("q3moe_alldense")
+    make_tiny_qwen3_moe(d, config={"mlp_only_layers": [0, 1, 2, 3]})
+    eng = LocalEngine(d, max_seq=64, param_dtype="float32")
+    assert not eng.model.mixed and getattr(eng.model, "ring_phases", 1) == 1
+    ids = [256, 72, 101, 108]
+    ref = _hf_ref(d, ids)
+    logits = eng.prefill("p", ids)
+    np.testing.assert_allclose(
+        np.asarray(logits[0], np.float32), ref[-1], atol=2e-3, rtol=2e-3
+    )
+    eng.close()
