@@ -219,14 +219,10 @@ class LocalModelManager:
                 # mid-stream on the first request's ramp
                 engine.warm_chunks()
             elif self.batch_slots > 1:
-                if self.spec_lookahead:
-                    log.warning(
-                        "DNET_API_SPEC_LOOKAHEAD is not supported with "
-                        "batch_slots>1 (per-lane acceptance lengths "
-                        "diverge); disabled"
-                    )
                 from dnet_tpu.core.batch import BatchedEngine
 
+                # per-lane acceptance (r4): greedy lanes speculate and
+                # advance unevenly; sampled lanes take the plain batched step
                 engine = BatchedEngine(
                     model_dir,
                     slots=self.batch_slots,
@@ -237,6 +233,7 @@ class LocalModelManager:
                     weight_quant_bits=wq_bits,
                     weight_quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
+                    spec_lookahead=self.spec_lookahead,
                 )
                 # compile the batched step + fused-chunk widths now, not on
                 # the first request while every lane shares one executor

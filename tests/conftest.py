@@ -37,6 +37,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--real-model",
+        default="",
+        help="HF repo id for the real-checkpoint integration test "
+        "(tests/integration/test_real_model.py); requires network",
+    )
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

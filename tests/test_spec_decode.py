@@ -221,3 +221,117 @@ def test_spec_worthwhile_gate(tiny_llama_dir):
     sess.spec_emitted = 16  # 2.0 tok/block
     assert eng.spec_worthwhile("g")
     assert eng.spec_worthwhile("unknown-nonce")  # unknown sessions don't gate
+
+
+# ---- speculative decoding x continuous batching (per-lane acceptance) ----
+
+
+@pytest.fixture(scope="module")
+def spec_batched(tiny_llama_dir):
+    from dnet_tpu.core.batch import BatchedEngine
+
+    eng = BatchedEngine(
+        tiny_llama_dir, slots=4, max_seq=128, param_dtype="float32",
+        spec_lookahead=4,
+    )
+    yield eng
+    eng.close()
+
+
+def test_batched_spec_matches_serial(tiny_llama_dir, spec_batched):
+    """Two greedy lanes speculating concurrently == serial LocalEngine
+    streams (repetitive prompts so prompt-lookup has material)."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    dec = DecodingParams(temperature=0.0)
+    prompts = [[7, 3, 11, 7, 3, 11, 7, 3], [5, 9, 5, 9, 5, 9]]
+    ref = LocalEngine(tiny_llama_dir, max_seq=128, param_dtype="float32")
+    want = {
+        i: [r.token_id for r in ref.generate(p, dec, max_tokens=12)]
+        for i, p in enumerate(prompts)
+    }
+    ref.close()
+
+    eng = spec_batched
+    toks = {}
+    for i, p in enumerate(prompts):
+        res = eng.prefill_and_sample(f"s{i}", p, dec)
+        toks[i] = [int(res.token[0])]
+    while any(len(toks[i]) < 12 for i in toks):
+        reqs = {
+            f"s{i}": (toks[i][-1], dec)
+            for i in toks if len(toks[i]) < 12
+        }
+        budgets = {f"s{i}": 12 - len(toks[i]) for i in toks if len(toks[i]) < 12}
+        results, errors = eng.decode_batch(reqs, budgets=budgets)
+        assert not errors
+        for nonce, row in results.items():
+            i = int(nonce[1:])
+            toks[i].append(int(row.token[0]))
+    for i in toks:
+        eng.end_session(f"s{i}")
+    assert {i: t[:12] for i, t in toks.items()} == want
+
+
+def test_batched_spec_lanes_advance_unevenly(tiny_llama_dir, spec_batched):
+    """A highly repetitive lane accepts more drafts per block than a
+    non-repetitive one: after one spec round their positions differ."""
+    from dnet_tpu.core.types import DecodingParams
+
+    dec = DecodingParams(temperature=0.0)
+    eng = spec_batched
+    rep = [7, 3, 11, 7, 3, 11, 7, 3, 11, 7, 3]
+    plain = [250, 13, 99]
+    ra = eng.prefill_and_sample("rep", rep, dec)
+    rb = eng.prefill_and_sample("plain", plain, dec)
+    pos0 = {n: int(eng.pos[eng.slot_of[n]]) for n in ("rep", "plain")}
+    results, errors = eng.decode_batch(
+        {"rep": (int(ra.token[0]), dec), "plain": (int(rb.token[0]), dec)},
+        budgets={"rep": 16, "plain": 16},
+    )
+    assert not errors and set(results) == {"rep", "plain"}
+    adv = {n: int(eng.pos[eng.slot_of[n]]) - pos0[n] for n in ("rep", "plain")}
+    # both lanes advanced by their own acceptance; each >= 1 token
+    assert adv["rep"] >= 1 and adv["plain"] >= 1
+    # acceptance stats recorded per lane
+    assert eng._spec_stats["rep"][0] == 1 and eng._spec_stats["plain"][0] == 1
+    eng.end_session("rep")
+    eng.end_session("plain")
+
+
+def test_batched_spec_mixed_with_sampled(tiny_llama_dir, spec_batched):
+    """A greedy (spec) lane and a seeded sampled (plain) lane share one
+    decode_batch round; both match their serial references."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    greedy = DecodingParams(temperature=0.0)
+    sampled = DecodingParams(temperature=0.9, top_p=0.9, seed=42)
+    gp = [7, 3, 11, 7, 3, 11, 7]
+    sp = [250, 99, 13]
+    ref = LocalEngine(tiny_llama_dir, max_seq=128, param_dtype="float32")
+    want_g = [r.token_id for r in ref.generate(gp, greedy, max_tokens=8)]
+    want_s = [r.token_id for r in ref.generate(sp, sampled, max_tokens=8)]
+    ref.close()
+
+    eng = spec_batched
+    tg = [int(eng.prefill_and_sample("g", gp, greedy).token[0])]
+    ts = [int(eng.prefill_and_sample("s", sp, sampled).token[0])]
+    while len(tg) < 8 or len(ts) < 8:
+        reqs, budgets = {}, {}
+        if len(tg) < 8:
+            reqs["g"] = (tg[-1], greedy)
+            budgets["g"] = 8 - len(tg)
+        if len(ts) < 8:
+            reqs["s"] = (ts[-1], sampled)
+            budgets["s"] = 8 - len(ts)
+        results, errors = eng.decode_batch(reqs, budgets=budgets)
+        assert not errors
+        if "g" in results:
+            tg.append(int(results["g"].token[0]))
+        if "s" in results:
+            ts.append(int(results["s"].token[0]))
+    eng.end_session("g")
+    eng.end_session("s")
+    assert tg[:8] == want_g and ts[:8] == want_s
