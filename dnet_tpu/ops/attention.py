@@ -85,6 +85,23 @@ def cached_attend(
         assert mask is None, "cached_attend: causal=True requires mask=None"
     if sp_axis is None:
         kvs = write_kv(kvs, k_new, v_new, pos, kv_commit)
+        if causal and q.shape[1] == 1 and "k_scale" in kvs:
+            # quantized decode: dequantize tile-by-tile INSIDE the split-K
+            # kernel — read_kv would first materialize a full f32 cache copy
+            # through HBM, erasing the quantization's bandwidth win
+            from dnet_tpu.ops.flash_decode import (
+                flash_decode_attend,
+                flash_decode_eligible,
+            )
+
+            if flash_decode_eligible(q, kvs["k"]):
+                return (
+                    flash_decode_attend(
+                        q, kvs["k"], kvs["v"], pos, scale=scale, sinks=sinks,
+                        k_scale=kvs["k_scale"], v_scale=kvs["v_scale"],
+                    ),
+                    kvs,
+                )
         kc, vc = read_kv(kvs)
         if causal:
             from dnet_tpu.ops.flash_attention import flash_attend_causal
@@ -156,6 +173,13 @@ def rotating_cached_attend(
 
         if flash_decode_eligible(q, kvs["k"]):
             kvs = write_kv_rotating(kvs, k_new, v_new, pos, None, t_real=t_real)
+            if "k_scale" in kvs:  # quantized ring: dequant inside the kernel
+                attn = flash_decode_attend(
+                    q, kvs["k"], kvs["v"], pos, scale=scale, sinks=sinks,
+                    window=window, rotating=True,
+                    k_scale=kvs["k_scale"], v_scale=kvs["v_scale"],
+                )
+                return attn, kvs
             kc, vc = read_kv(kvs)
             attn = flash_decode_attend(
                 q, kc, vc, pos, scale=scale, sinks=sinks, window=window,

@@ -41,21 +41,42 @@ from dnet_tpu.ops.flash_attention import _interpret, _pick_tile
 NEG_INF = -1e30
 
 
-def _decode_kernel(scal_ref, q_ref, k_ref, v_ref, sink_ref, o_ref, *rest,
+def _decode_kernel(scal_ref, q_ref, k_ref, v_ref, *rest,
                    bk: int, scale: float, n_s: int, window: int,
-                   rotating: bool, with_lse: bool):
+                   rotating: bool, with_lse: bool, qbits: int = 0):
     """One (batch, kv-head, kv-tile) fold of the online softmax.
 
     scal_ref SMEM [2] = (pos, offset): pos is the query's absolute
     position, offset the absolute position of this cache shard's slot 0
     (nonzero only under sp).  q [G, Hd] is the whole GQA group — one cache
-    tile read is amortized over all G query heads sharing it."""
+    tile read is amortized over all G query heads sharing it.
+
+    qbits 8/4: the cache tiles arrive QUANTIZED (int8, or int4 nibbles
+    packed pairwise along the head dim) with per-(slot, head) f32 scales —
+    dequantization happens here in VMEM, so the HBM traffic is the
+    quantized bytes, not a full-cache f32 materialization (the read_kv
+    dense path's cost)."""
     import jax.experimental.pallas as pl
 
+    if qbits:
+        ks_ref, vs_ref, *rest = rest
     if with_lse:
-        m_out, l_out, m_ref, l_ref, acc_ref = rest
+        sink_ref, o_ref, m_out, l_out, m_ref, l_ref, acc_ref = rest
     else:
-        m_ref, l_ref, acc_ref = rest
+        sink_ref, o_ref, m_ref, l_ref, acc_ref = rest
+
+    def dequant(ref, scale_ref):
+        """[bk, D] f32 from a (possibly quantized) cache tile."""
+        t = ref[0, :, 0, :]
+        if qbits == 0:
+            return t.astype(jnp.float32)
+        if qbits == 8:
+            return t.astype(jnp.float32) * scale_ref[0, :, 0, :]
+        # packed int4: ONE owner of the nibble format (kvcache's unpack is
+        # pure jnp + shape-polymorphic, so it lowers inside the kernel too)
+        from dnet_tpu.core.kvcache import _unpack_q4
+
+        return _unpack_q4(t) * scale_ref[0, :, 0, :]
     s = pl.program_id(2)
     # full read + static index (not scal_ref[0]): ref indexing discharges
     # to dynamic_slice, which interpret-mode vma tracking rejects when the
@@ -80,7 +101,7 @@ def _decode_kernel(scal_ref, q_ref, k_ref, v_ref, sink_ref, o_ref, *rest,
     @pl.when(tile_live)
     def _fold():
         q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [G, Hd]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, Hd]
+        k = dequant(k_ref, ks_ref if qbits else None)  # [bk, Hd]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -104,7 +125,8 @@ def _decode_kernel(scal_ref, q_ref, k_ref, v_ref, sink_ref, o_ref, *rest,
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v_ref[0, :, 0, :].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p, dequant(v_ref, vs_ref if qbits else None),
+            (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [G, Vd]
         acc_ref[:] = acc_ref[:] * corr + pv
@@ -131,17 +153,22 @@ def _decode_kernel(scal_ref, q_ref, k_ref, v_ref, sink_ref, o_ref, *rest,
 @functools.partial(
     jax.jit,
     static_argnames=("G", "scale", "bk", "window", "rotating", "with_lse",
-                     "interpret", "vma"),
+                     "interpret", "vma", "qbits"),
 )
 def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
                    window: int, rotating: bool, with_lse: bool,
-                   interpret: bool, vma: tuple = ()):
+                   interpret: bool, vma: tuple = (), qbits: int = 0,
+                   k_scale=None, v_scale=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, Hd = q.shape
     S = k.shape[1]
-    Vd = v.shape[-1]
+    # quantized tiles are narrower in storage (int4 packs pairs); the value
+    # head dim that reaches the accumulator is the DEQUANTIZED width
+    Vd = v.shape[-1] * (2 if qbits == 4 else 1)
+    Hd_k = k.shape[-1]  # stored key width (Hd, or Hd/2 packed)
+    Vd_k = v.shape[-1]
     KVH = H // G
     n_s = S // bk
 
@@ -159,10 +186,19 @@ def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
 
     in_specs = [
         pl.BlockSpec((1, 1, G, Hd), lambda b, kh, s, scal: (b, 0, kh, 0)),
-        pl.BlockSpec((1, bk, 1, Hd), kv_map),
-        pl.BlockSpec((1, bk, 1, Vd), kv_map),
-        pl.BlockSpec((1, G), lambda b, kh, s, scal: (kh, 0)),  # sinks [KVH, G]
+        pl.BlockSpec((1, bk, 1, Hd_k), kv_map),
+        pl.BlockSpec((1, bk, 1, Vd_k), kv_map),
     ]
+    extra_in = ()
+    if qbits:
+        in_specs += [
+            pl.BlockSpec((1, bk, 1, 1), kv_map),  # k_scale
+            pl.BlockSpec((1, bk, 1, 1), kv_map),  # v_scale
+        ]
+        extra_in = (k_scale, v_scale)
+    in_specs.append(
+        pl.BlockSpec((1, G), lambda b, kh, s, scal: (kh, 0))  # sinks [KVH, G]
+    )
     # inside shard_map the partials are device-varying over the sp axis;
     # check_vma demands the output declare it (vma=() outside shard_map)
     kw = {"vma": frozenset(vma)} if vma else {}
@@ -186,9 +222,10 @@ def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
     ]
     kernel = functools.partial(
         _decode_kernel, bk=bk, scale=scale, n_s=n_s, window=window,
-        rotating=rotating, with_lse=with_lse,
+        rotating=rotating, with_lse=with_lse, qbits=qbits,
     )
     if vma:
+        assert qbits == 0, "sp flash decode reads a dequantized shard"
         # inside shard_map the scalars are device-varying, and vma tracking
         # rejects data-dependent block index maps on varying values — drop
         # the dead-tile clamp (each rank's S/sp shard is mostly live under
@@ -221,7 +258,7 @@ def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
     )
     return pl.pallas_call(
         kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret
-    )(scalars, q, k, v, sinks)
+    )(scalars, q, k, v, *extra_in, sinks)
 
 
 def flash_decode_eligible(q: jnp.ndarray, k: jnp.ndarray) -> bool:
@@ -249,6 +286,8 @@ def flash_decode_attend(
     window: int = 0,
     rotating: bool = False,
     offset=None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Single-token decode attention against the (full, preallocated) cache.
 
@@ -256,7 +295,12 @@ def flash_decode_attend(
     dense `attend` with the causal mask at `pos` (linear caches) or the
     rotating sliding-window mask (rotating=True, window=W ring buffers,
     cache written BEFORE the call).  `offset`: absolute position of slot 0
-    (sp shards).  Caller must check flash_decode_eligible."""
+    (sp shards).  With `k_scale`/`v_scale` ([B, S, KVH, 1] f32) the cache
+    arrives QUANTIZED — int8, or packed-int4 uint8 with half-width head
+    dims — and dequantizes tile-by-tile in VMEM, reading only the
+    quantized bytes from HBM (the dense path materializes a full f32
+    cache copy through read_kv first).  Caller must check
+    flash_decode_eligible."""
     B, T, H, Hd = q.shape
     KVH = k.shape[2]
     G = H // KVH
@@ -270,10 +314,14 @@ def flash_decode_attend(
         [jnp.asarray(pos, jnp.int32),
          jnp.asarray(0 if offset is None else offset, jnp.int32)]
     )
+    qbits = 0
+    if k_scale is not None:
+        qbits = 4 if k.dtype == jnp.uint8 else 8
     return _decode_pallas(
         q, k, v, scalars, sink_arr, G=G, scale=float(scale),
         bk=_pick_tile(k.shape[1], 256), window=int(window),
         rotating=bool(rotating), with_lse=False, interpret=_interpret(),
+        qbits=qbits, k_scale=k_scale, v_scale=v_scale,
     )
 
 

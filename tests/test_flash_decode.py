@@ -186,3 +186,104 @@ def test_sp_partials_merge_matches_dense(rng, pos):
     got = o_glob / jnp.maximum(l_glob.reshape(B, 1, H, 1), 1e-30)
     want = attend(q, k, v, mask=causal_mask(1, S, pos))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantized_cache_matches_dense(rng, bits):
+    """Fused in-kernel dequant (int8 / packed int4 + per-slot scales) ==
+    dense attend over the read_kv-dequantized cache."""
+    import jax.numpy as jnp
+
+    from dnet_tpu.core.kvcache import KVConfig, init_cache, read_kv, write_kv
+    from dnet_tpu.ops.attention import attend, causal_mask
+    from dnet_tpu.ops.flash_decode import flash_decode_attend
+
+    B, S, H, KVH, Hd = 1, 32, 4, 2, 16
+    cfg = KVConfig(
+        n_layers=1, batch=B, max_seq=S, n_kv_heads=KVH, head_dim=Hd,
+        quant_bits=bits,
+    )
+    kvs = {k: v[0] for k, v in init_cache(cfg).items()}  # strip layer axis
+    pos = 0
+    for t in range(10):  # token-by-token writes, like real decode
+        k_new = jnp.asarray(rng.normal(size=(B, 1, KVH, Hd)), jnp.float32)
+        v_new = jnp.asarray(rng.normal(size=(B, 1, KVH, Hd)), jnp.float32)
+        kvs = write_kv(kvs, k_new, v_new, jnp.int32(t))
+        pos = t
+    q = jnp.asarray(rng.normal(size=(B, 1, H, Hd)), jnp.float32)
+    kc, vc = read_kv(kvs)
+    want = attend(q, kc, vc, mask=causal_mask(1, S, pos))
+    got = flash_decode_attend(
+        q, kvs["k"], kvs["v"], jnp.int32(pos),
+        k_scale=kvs["k_scale"], v_scale=kvs["v_scale"],
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_rotating_quantized_matches_dense(rng, bits):
+    """Quantized SWA ring buffer (the gpt_oss sliding layer's layout):
+    per-slot scale rotation + in-kernel dequant + in-kernel ring-position
+    reconstruction, all composed, vs the dense rotating reference."""
+    import jax.numpy as jnp
+
+    from dnet_tpu.core.kvcache import KVConfig, init_cache, read_kv, write_kv_rotating
+    from dnet_tpu.ops.attention import attend
+    from dnet_tpu.ops.flash_decode import flash_decode_attend
+
+    B, W, window, H, KVH, Hd = 1, 16, 12, 4, 2, 16
+    cfg = KVConfig(
+        n_layers=1, batch=B, max_seq=64, n_kv_heads=KVH, head_dim=Hd,
+        sliding_window=W, quant_bits=bits,
+    )
+    kvs = {k: v[0] for k, v in init_cache(cfg).items()}
+    pos = 0
+    for t in range(25):  # wraps the ring (25 > W): scales rotate too
+        k_new = jnp.asarray(rng.normal(size=(B, 1, KVH, Hd)), jnp.float32)
+        v_new = jnp.asarray(rng.normal(size=(B, 1, KVH, Hd)), jnp.float32)
+        kvs = write_kv_rotating(kvs, k_new, v_new, jnp.int32(t))
+        pos = t
+    q = jnp.asarray(rng.normal(size=(B, 1, H, Hd)), jnp.float32)
+    kc, vc = read_kv(kvs)
+    s = np.arange(W)[None, :]
+    a = pos - np.mod(pos - s, W)
+    mask = jnp.asarray((a >= 0) & (a > pos - window))
+    want = attend(q, kc, vc, mask=mask)
+    got = flash_decode_attend(
+        q, kvs["k"], kvs["v"], jnp.int32(pos), window=window, rotating=True,
+        k_scale=kvs["k_scale"], v_scale=kvs["v_scale"],
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_engine_stream_quantized_kv(tiny_llama_dir, bits):
+    """Serving hot loop with a quantized cache + the fused-dequant kernel:
+    greedy stream equals the dense quantized path token for token."""
+    import os
+
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    ids = [256, 72, 101, 108]
+    eng = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype="float32", kv_quant_bits=bits
+    )
+    got = [
+        r.token_id
+        for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+    ]
+    eng.close()
+    ref_env = os.environ.pop("DNET_FLASH_INTERPRET")
+    try:
+        eng = LocalEngine(
+            tiny_llama_dir, max_seq=64, param_dtype="float32", kv_quant_bits=bits
+        )
+        want = [
+            r.token_id
+            for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=6)
+        ]
+        eng.close()
+    finally:
+        os.environ["DNET_FLASH_INTERPRET"] = ref_env
+    assert got == want
