@@ -116,14 +116,12 @@ def cached_attend(
         # combine.  Real-TPU only — interpret-mode pallas inside shard_map
         # trips jax's vma tracking (ops/flash_decode.py) — with the dense
         # distributed flash-decoding everywhere else.
-        import jax as _jax
-
         from dnet_tpu.ops.flash_decode import (
-            flash_decode_eligible,
             sp_flash_decode_attend,
+            sp_flash_eligible,
         )
 
-        if _jax.default_backend() == "tpu" and flash_decode_eligible(q, kc):
+        if sp_flash_eligible(q, kc):
             return (
                 sp_flash_decode_attend(
                     q, kc, vc, pos, sp_axis, sinks=sinks, scale=scale
@@ -173,17 +171,16 @@ def rotating_cached_attend(
 
         if flash_decode_eligible(q, kvs["k"]):
             kvs = write_kv_rotating(kvs, k_new, v_new, pos, None, t_real=t_real)
-            if "k_scale" in kvs:  # quantized ring: dequant inside the kernel
-                attn = flash_decode_attend(
-                    q, kvs["k"], kvs["v"], pos, scale=scale, sinks=sinks,
-                    window=window, rotating=True,
-                    k_scale=kvs["k_scale"], v_scale=kvs["v_scale"],
-                )
-                return attn, kvs
-            kc, vc = read_kv(kvs)
+            # quantized rings pass raw tiles + scales (dequant in-kernel);
+            # a None k_scale selects the unquantized kernel path
+            if "k_scale" in kvs:
+                kc, vc = kvs["k"], kvs["v"]
+            else:
+                kc, vc = read_kv(kvs)
             attn = flash_decode_attend(
                 q, kc, vc, pos, scale=scale, sinks=sinks, window=window,
-                rotating=True,
+                rotating=True, k_scale=kvs.get("k_scale"),
+                v_scale=kvs.get("v_scale"),
             )
             return attn, kvs
     k_prev, v_prev = read_kv(kvs)  # [B, W, KVH, Hd]

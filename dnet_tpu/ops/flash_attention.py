@@ -155,11 +155,31 @@ def _interpret() -> bool:
     return os.environ.get("DNET_FLASH_INTERPRET", "") in {"1", "true"}
 
 
+def _under_manual_mesh() -> bool:
+    """True when tracing inside shard_map (mesh ring / mesh-shard programs).
+
+    pallas_call outputs under check_vma shard_map must declare their
+    varying axes, which these kernels' implicit seams don't — the flash
+    paths fall back to the dense ops there (exactly r3's behavior) rather
+    than failing the whole mesh program's trace.  The explicit sp
+    composition (sp_flash_decode_attend) declares its vma and bypasses
+    this gate."""
+    try:
+        return bool(jax.sharding.get_abstract_mesh().manual_axes)
+    except Exception:
+        # fail CLOSED: if this probe breaks (the API is private-ish), the
+        # dense ops serve everywhere — slower, but a trace-time vma crash
+        # inside a mesh program would take serving down entirely
+        return True
+
+
 def flash_eligible(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
     """Kernel preconditions: GQA-divisible heads, tileable T/S, and a TPU
     backend (or the test override forcing interpret mode).  V's head dim
     may differ from Q/K's (MLA)."""
     if not _interpret() and jax.default_backend() != "tpu":
+        return False
+    if _under_manual_mesh():
         return False
     T, H = q.shape[1], q.shape[2]
     S, KVH = k.shape[1], k.shape[2]

@@ -261,6 +261,12 @@ def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
     )(scalars, q, k, v, *extra_in, sinks)
 
 
+def _shape_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
+    T, H = q.shape[1], q.shape[2]
+    S, KVH = k.shape[1], k.shape[2]
+    return T == 1 and H % KVH == 0 and S >= 8 and _pick_tile(S, 256) > 0
+
+
 def flash_decode_eligible(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     """T=1, GQA-divisible heads, tileable cache length, TPU backend (or the
     DNET_FLASH_INTERPRET test override).  DNET_FLASH_DECODE=0 is the
@@ -271,9 +277,29 @@ def flash_decode_eligible(q: jnp.ndarray, k: jnp.ndarray) -> bool:
         return False
     if not _interpret() and jax.default_backend() != "tpu":
         return False
-    T, H = q.shape[1], q.shape[2]
-    S, KVH = k.shape[1], k.shape[2]
-    return T == 1 and H % KVH == 0 and S >= 8 and _pick_tile(S, 256) > 0
+    from dnet_tpu.ops.flash_attention import _under_manual_mesh
+
+    if _under_manual_mesh():
+        # inside shard_map (mesh ring / mesh-backed shard programs) the
+        # kernel's outputs would need explicit vma declarations; the dense
+        # path serves there, the sp composition has its own entry point
+        return False
+    return _shape_ok(q, k)
+
+
+def sp_flash_eligible(q: jnp.ndarray, k_local: jnp.ndarray) -> bool:
+    """Eligibility for the sequence-parallel composition, which runs INSIDE
+    shard_map by construction (it declares its outputs' vma itself) and is
+    real-TPU only (interpret-mode pallas under shard_map trips jax's vma
+    tracking on the kernel body)."""
+    import os
+
+    return (
+        os.environ.get("DNET_FLASH_DECODE", "1") != "0"
+        and jax.default_backend() == "tpu"
+        and not _interpret()
+        and _shape_ok(q, k_local)
+    )
 
 
 def flash_decode_attend(

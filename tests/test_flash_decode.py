@@ -287,3 +287,26 @@ def test_engine_stream_quantized_kv(tiny_llama_dir, bits):
     finally:
         os.environ["DNET_FLASH_INTERPRET"] = ref_env
     assert got == want
+
+
+def test_manual_mesh_gates_kernel_off(tiny_llama_dir, eight_devices):
+    """Inside shard_map (mesh programs) the implicit flash seams must fall
+    back to dense — pallas outputs there would need explicit vma
+    declarations — so a mesh-shard engine stream with interpret forced on
+    still matches the plain stream (and does not fail the trace)."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+    from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+
+    ids = [256, 72, 101, 108]
+    dec = DecodingParams(temperature=0.0)
+    local = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+    want = [r.token_id for r in local.generate(ids, dec, max_tokens=5)]
+    local.close()
+    eng = MeshShardEngine(
+        tiny_llama_dir, layers=range(4), tp=2, devices=eight_devices[:2],
+        max_seq=64, param_dtype="float32",
+    )
+    got = [r.token_id for r in eng.generate(ids, dec, max_tokens=5)]
+    eng.close()
+    assert got == want
