@@ -208,17 +208,28 @@ class ApiHTTPServer:
         return web.json_response(result.model_dump(exclude_none=True))
 
     async def embeddings(self, request: web.Request) -> web.Response:
-        """Schema-validated but unimplemented, like the reference (its
-        embeddings schema exists in api/models.py with no serving path)."""
+        """Mean-pooled final-hidden-state embeddings (BEYOND the reference,
+        whose embeddings schema exists in api/models.py with no serving
+        path).  Local/batched strategies serve; ring mode — where shards
+        never ship hidden states to the API node — answers 501."""
         from dnet_tpu.api.schemas import EmbeddingsRequest
 
         try:
-            EmbeddingsRequest.model_validate(await request.json())
+            req = EmbeddingsRequest.model_validate(await request.json())
         except (json.JSONDecodeError, ValidationError) as exc:
             return _json_error(400, f"invalid request: {exc}")
-        return _json_error(
-            501, "embeddings are not served by this deployment", "not_implemented"
-        )
+        gate = self._gate()
+        if gate is not None:
+            return gate
+        try:
+            result = await self.inference.embeddings(req)
+        except NotImplementedError as exc:
+            return _json_error(501, str(exc), "not_implemented")
+        except ValueError as exc:
+            return _json_error(400, str(exc))
+        except Exception as exc:
+            return self._map_inference_errors(exc)
+        return web.json_response(result.model_dump())
 
     async def list_models(self, request: web.Request) -> web.Response:
         # quant-variant aliases listed alongside base ids (reference-style

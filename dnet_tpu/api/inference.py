@@ -330,6 +330,49 @@ class InferenceManager:
         finally:
             await self.adapter.reset_cache(nonce)
 
+    async def embeddings(self, req) -> "EmbeddingsResponse":
+        """Serve /v1/embeddings: mean-pooled final-hidden-state vectors
+        (beyond the reference, which schemas the route but never serves
+        it).  Accepts the full OpenAI input envelope — a string, a list of
+        strings, a token list, or a batch of token lists — and the base64
+        encoding_format."""
+        from dnet_tpu.api.schemas import (
+            EmbeddingData,
+            EmbeddingsResponse,
+            EmbeddingsUsage,
+        )
+
+        raw = req.input
+        if isinstance(raw, str):
+            batches = [self.tokenizer.encode(raw)]
+        elif raw and isinstance(raw[0], str):
+            batches = [self.tokenizer.encode(s) for s in raw]
+        elif raw and isinstance(raw[0], list):
+            batches = [list(ids) for ids in raw]
+        else:
+            batches = [list(raw)]
+        if any(not b for b in batches):
+            raise ValueError("embeddings input contains an empty entry")
+        vecs = await self.adapter.embed(batches)
+        if req.encoding_format == "base64":
+            import base64
+
+            import numpy as np
+
+            vecs = [
+                base64.b64encode(
+                    np.asarray(v, dtype=np.float32).tobytes()
+                ).decode("ascii")
+                for v in vecs
+            ]
+        data = [EmbeddingData(index=i, embedding=v) for i, v in enumerate(vecs)]
+        n_tok = sum(len(b) for b in batches)
+        return EmbeddingsResponse(
+            data=data,
+            model=self.model_id or req.model,
+            usage=EmbeddingsUsage(prompt_tokens=n_tok, total_tokens=n_tok),
+        )
+
     async def generate_completion(self, req) -> "CompletionResponse":
         """Legacy /v1/completions (non-streaming): aggregate the same decode
         stream into a text_completion object."""

@@ -138,6 +138,26 @@ class EmbeddingsRequest(BaseModel):
     user: Optional[str] = None
 
 
+class EmbeddingData(BaseModel):
+    object: Literal["embedding"] = "embedding"
+    index: int
+    # list of floats, or a base64 little-endian f32 buffer
+    # (encoding_format="base64", the OpenAI client's compact transfer mode)
+    embedding: Union[List[float], str]
+
+
+class EmbeddingsUsage(BaseModel):
+    prompt_tokens: int
+    total_tokens: int
+
+
+class EmbeddingsResponse(BaseModel):
+    object: Literal["list"] = "list"
+    data: List[EmbeddingData]
+    model: str
+    usage: EmbeddingsUsage
+
+
 class CompletionLogprobs(BaseModel):
     """OpenAI text_completion logprobs shape (NOT the chat shape)."""
 

@@ -23,6 +23,19 @@ from typing import Dict, List, Optional
 from dnet_tpu.core.types import DecodingParams, TokenResult
 from dnet_tpu.utils.logger import get_logger
 
+
+async def _embed_on_executor(hidden_fn, executor, ids_list):
+    """Mean-pool hidden_states per input on the adapter's compute executor
+    (session bookkeeping must not race concurrent decode steps)."""
+    import numpy as np
+
+    loop = asyncio.get_running_loop()
+    out: List[List[float]] = []
+    for ids in ids_list:
+        h = await loop.run_in_executor(executor, hidden_fn, ids)  # [T, D]
+        out.append([float(v) for v in np.mean(h, axis=0)])
+    return out
+
 log = get_logger()
 
 
@@ -74,6 +87,16 @@ class ApiAdapterBase(abc.ABC):
     def max_seq(self) -> Optional[int]:
         """Sequence capacity of the serving path, when known."""
         return None
+
+    async def embed(self, ids_list: List[List[int]]) -> List[List[float]]:
+        """Mean-pooled final-hidden-state embeddings, one vector per input
+        (beyond the reference, which never serves /v1/embeddings).
+        Default: unsupported — the gRPC ring's shards never ship hidden
+        states back to the API node, and the mesh ring program only emits
+        logits.  Local/batched adapters override."""
+        raise NotImplementedError(
+            f"embeddings unsupported on {type(self).__name__}"
+        )
 
 
 class _TokenFutures:
@@ -204,6 +227,16 @@ class BatchedLocalAdapter(ApiAdapterBase):
 
     def max_seq(self) -> Optional[int]:
         return self.engine.max_seq
+
+    async def embed(self, ids_list: List[List[int]]) -> List[List[float]]:
+        # the inner LocalEngine produces the hidden states; the batched
+        # program itself only decodes
+        fn = getattr(self.engine.eng, "hidden_states", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"embeddings unsupported on {type(self.engine).__name__}"
+            )
+        return await _embed_on_executor(fn, self._executor, ids_list)
 
     async def send_tokens(
         self,
@@ -408,6 +441,14 @@ class LocalAdapter(ApiAdapterBase):
 
     def max_seq(self) -> Optional[int]:
         return self.engine.max_seq
+
+    async def embed(self, ids_list: List[List[int]]) -> List[List[float]]:
+        fn = getattr(self.engine, "hidden_states", None)
+        if fn is None:  # mesh engines: the ring program only emits logits
+            raise NotImplementedError(
+                f"embeddings unsupported on {type(self.engine).__name__}"
+            )
+        return await _embed_on_executor(fn, self._executor, ids_list)
 
     async def send_tokens(
         self,

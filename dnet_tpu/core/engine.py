@@ -673,6 +673,34 @@ class LocalEngine:
         ):
             self.prefix_cache.store(list(full_ids), sess.kv)
 
+    def hidden_states(self, prompt_ids: Sequence[int]) -> np.ndarray:
+        """Final-norm'd hidden states for a prompt — the embeddings serving
+        primitive (BEYOND the reference, which schemas /v1/embeddings but
+        never serves it).  One forward over a throwaway session, no
+        sampling; works under every weight policy via run_layers.  Returns
+        float32 [T, D] (callers pool)."""
+        ids = list(prompt_ids)
+        if not ids:
+            raise ValueError("empty embeddings input")
+        if len(ids) > self.max_seq:
+            raise ValueError(
+                f"input length {len(ids)} exceeds max_seq {self.max_seq}"
+            )
+        T = len(ids)
+        Tpad = min(bucket_length(T), self.max_seq)
+        tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
+        tokens[:, :T] = np.asarray(ids, dtype=np.int32)
+        nonce = "__embed__"
+        self.end_session(nonce)
+        sess = self.new_session(nonce, seed=0)
+        try:
+            x = self.model.embed(self.edge_params, jnp.asarray(tokens))
+            x = self.run_layers(sess, x, 0, t_real=T)
+            h = self.model.normalize(self.edge_params, x)
+            return np.asarray(h[0, :T], dtype=np.float32)
+        finally:
+            self.end_session(nonce)
+
     def decode_step(self, nonce: str, token_id: int, decoding: DecodingParams) -> SampleResult:
         sess = self.sessions[nonce]
         if sess.pos >= self.max_seq:

@@ -286,14 +286,53 @@ def test_legacy_completions_and_embeddings(tiny_llama_dir):
         assert set(lp) == {"tokens", "token_logprobs", "top_logprobs", "text_offset"}
         assert len(lp["tokens"]) == len(lp["token_logprobs"]) == len(lp["text_offset"])
 
-        # batch prompts rejected; embeddings schema-validated but 501
+        # batch prompts rejected
         r = await client.post(
             "/v1/completions",
             json={"model": "tiny", "prompt": ["a", "b"], "max_tokens": 1},
         )
         assert r.status == 400
+
+        # embeddings SERVE on the local strategy (beyond the reference):
+        # one vector per input, hidden-size wide, deterministic
         r = await client.post("/v1/embeddings", json={"model": "tiny", "input": "x"})
-        assert r.status == 501
+        assert r.status == 200, await r.text()
+        out = await r.json()
+        assert out["object"] == "list" and len(out["data"]) == 1
+        vec = out["data"][0]["embedding"]
+        assert len(vec) == 64  # tiny llama hidden_size
+        assert out["usage"]["prompt_tokens"] >= 1
+
+        # batch of strings -> one vector each, same text = same vector
+        r = await client.post(
+            "/v1/embeddings", json={"model": "tiny", "input": ["x", "hello"]}
+        )
+        batch = (await r.json())["data"]
+        assert [d["index"] for d in batch] == [0, 1]
+        assert batch[0]["embedding"] == vec
+
+        # base64 round-trips to the float vector
+        import base64
+
+        import numpy as np
+
+        r = await client.post(
+            "/v1/embeddings",
+            json={"model": "tiny", "input": "x", "encoding_format": "base64"},
+        )
+        b64 = (await r.json())["data"][0]["embedding"]
+        dec = np.frombuffer(base64.b64decode(b64), dtype=np.float32)
+        np.testing.assert_allclose(dec, np.asarray(vec, np.float32), rtol=1e-6)
+
+        # token-id inputs work; empty entries are 400
+        r = await client.post(
+            "/v1/embeddings", json={"model": "tiny", "input": [1, 2, 3]}
+        )
+        assert r.status == 200
+        r = await client.post(
+            "/v1/embeddings", json={"model": "tiny", "input": [[]]}
+        )
+        assert r.status == 400
         r = await client.post("/v1/embeddings", json={"model": "tiny"})
         assert r.status == 400
         await client.close()
