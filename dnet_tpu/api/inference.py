@@ -104,6 +104,7 @@ class InferenceManager:
             logprobs=req.logprobs_enabled,
             top_logprobs=req.top_logprobs,
             seed=req.seed,
+            logit_bias=req.logit_bias_ids(),
         )
 
     def _logprob_entry(self, result, text: str) -> LogprobEntry:

@@ -53,6 +53,36 @@ class SamplingRequest(BaseModel):
     n: int = Field(default=1, ge=1, le=1)  # >1 unsupported (parity w/ reference)
     user: Optional[str] = None
     profile: bool = False  # dnet extension: include perf metrics in final chunk
+    # OpenAI logit_bias: token-id (stringified, per the OpenAI wire shape)
+    # -> additive bias in [-100, 100].  APPLIED here (the reference's
+    # DecodingConfig carries the field unused, src/dnet/api/models.py:70).
+    logit_bias: Optional[Dict[str, float]] = None
+
+    @field_validator("logit_bias")
+    @classmethod
+    def _check_logit_bias(cls, v):
+        if not v:
+            return v
+        from dnet_tpu.core.sampler import MAX_LOGIT_BIAS
+
+        if len(v) > MAX_LOGIT_BIAS:
+            raise ValueError(
+                f"logit_bias supports at most {MAX_LOGIT_BIAS} entries"
+            )
+        for tid, b in v.items():
+            # ascii-decimal only: isdigit() admits unicode digits that
+            # int() rejects, and token ids are never negative
+            if not str(tid).isdecimal():
+                raise ValueError(f"logit_bias key {tid!r} is not a token id")
+            if not -100.0 <= b <= 100.0:
+                raise ValueError("logit_bias values must be in [-100, 100]")
+        return v
+
+    def logit_bias_ids(self) -> Optional[Dict[int, float]]:
+        """Int-keyed form for DecodingParams (OpenAI sends string keys)."""
+        if not self.logit_bias:
+            return None
+        return {int(t): float(b) for t, b in self.logit_bias.items()}
 
     _default_max_tokens: int = 256
 

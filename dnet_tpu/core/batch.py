@@ -35,7 +35,14 @@ import numpy as np
 
 from dnet_tpu.core.engine import LocalEngine
 from dnet_tpu.core.kvcache import init_cache
-from dnet_tpu.core.sampler import SampleParams, SampleResult, sample
+from dnet_tpu.core.sampler import (
+    MAX_LOGIT_BIAS,
+    MAX_TOP_LOGPROBS,
+    SampleParams,
+    SampleResult,
+    encode_logit_bias,
+    sample,
+)
 from dnet_tpu.core.types import DecodingParams, TokenResult
 from dnet_tpu.utils.logger import get_logger
 
@@ -146,7 +153,7 @@ class BatchedEngine:
             return res, kv, counts, key
 
         kv_axes = jax.tree.map(lambda _: 1, self.kv)
-        sp_axes = SampleParams(0, 0, 0, 0, 0, 0)
+        sp_axes = SampleParams(0, 0, 0, 0, 0, 0, 0, 0)
         self._vmapped = jax.vmap(
             one,
             in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
@@ -389,6 +396,7 @@ class BatchedEngine:
                     and dec.temperature == 0.0
                     and not dec.logprobs
                     and dec.repetition_penalty == 1.0
+                    and not dec.logit_bias  # verify argmaxes are unbiased
                     and budget > 1
                     and self.pos[slot] + self.spec_lookahead + 1 <= self.max_seq
                     and self._spec_worthwhile(nonce)
@@ -411,6 +419,8 @@ class BatchedEngine:
         min_p = np.zeros(self.slots, dtype=np.float32)
         rep = np.ones(self.slots, dtype=np.float32)
         mtk = np.ones(self.slots, dtype=np.int32)
+        b_ids = np.full((self.slots, MAX_LOGIT_BIAS), -1, dtype=np.int32)
+        b_vals = np.zeros((self.slots, MAX_LOGIT_BIAS), dtype=np.float32)
         order: Dict[str, int] = {}
         for nonce, (tok, dec) in requests.items():
             slot = self.slot_of.get(nonce)
@@ -431,6 +441,7 @@ class BatchedEngine:
             min_p[slot] = dec.min_p
             rep[slot] = dec.repetition_penalty
             mtk[slot] = dec.min_tokens_to_keep
+            b_ids[slot], b_vals[slot] = encode_logit_bias(dec.logit_bias)
             order[nonce] = slot
         if not order:
             return out_buf, errors
@@ -442,6 +453,8 @@ class BatchedEngine:
             min_p=jnp.asarray(min_p),
             repetition_penalty=jnp.asarray(rep),
             min_tokens_to_keep=jnp.asarray(mtk),
+            bias_ids=jnp.asarray(b_ids),
+            bias_vals=jnp.asarray(b_vals),
         )
         # fused-chunk width: bounded by the smallest remaining budget and
         # by every active lane's sequence capacity
@@ -512,8 +525,6 @@ class BatchedEngine:
         """One vmapped verify block over the speculating lanes.  Each lane
         emits 1..L+1 tokens (its own acceptance); the first returns now and
         the rest buffer, so lanes genuinely advance unevenly."""
-        from dnet_tpu.core.sampler import MAX_TOP_LOGPROBS
-
         token = np.zeros((self.slots, 1), dtype=np.int32)
         active = np.zeros(self.slots, dtype=bool)
         pos = np.zeros(self.slots, dtype=np.int32)

@@ -770,6 +770,7 @@ class LocalEngine:
             and decoding.temperature == 0.0
             and not decoding.logprobs
             and decoding.repetition_penalty == 1.0
+            and not decoding.logit_bias  # verify argmaxes are unbiased
         )
 
     # adaptive gate thresholds: a spec block costs one (L+1)-wide forward +
@@ -955,6 +956,9 @@ class LocalEngine:
         DecodingParams(),  # greedy: temperature 0, no filters
         DecodingParams(temperature=1.0),  # API-default sampled, no filters
         DecodingParams(temperature=0.7, top_p=0.9),  # sampled + filters
+        # bias=True is its own plan dimension: warm it so the first
+        # logit_bias request doesn't stall mid-stream on the compile
+        DecodingParams(logit_bias={0: 0.0}),
     )
 
     def warm_chunks(self) -> None:

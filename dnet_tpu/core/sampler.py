@@ -20,6 +20,28 @@ import jax.numpy as jnp
 from dnet_tpu.core.types import DecodingParams
 
 MAX_TOP_LOGPROBS = 20  # static upper bound (OpenAI API max); request slices host-side
+# static per-request logit_bias capacity (OpenAI caps the dict at 300 keys;
+# practical use is a handful — the scatter cost scales with this width)
+MAX_LOGIT_BIAS = 64
+
+
+def encode_logit_bias(bias) -> tuple:
+    """dict {token_id: bias} -> fixed-width (ids [MAX], vals [MAX]) numpy
+    arrays, id -1 padding (scattered with mode=drop).  None = no bias."""
+    import numpy as np
+
+    ids = np.full((MAX_LOGIT_BIAS,), -1, dtype=np.int32)
+    vals = np.zeros((MAX_LOGIT_BIAS,), dtype=np.float32)
+    if bias:
+        if len(bias) > MAX_LOGIT_BIAS:
+            raise ValueError(
+                f"logit_bias supports at most {MAX_LOGIT_BIAS} entries; "
+                f"got {len(bias)}"
+            )
+        for i, (t, b) in enumerate(sorted(bias.items())):
+            ids[i] = int(t)
+            vals[i] = float(b)
+    return ids, vals
 
 
 class SampleParams(NamedTuple):
@@ -34,9 +56,15 @@ class SampleParams(NamedTuple):
     # (reference: min_tokens_to_keep, core/decoding/config.py:4-14, passed
     # through make_sampler); 1 = only the argmax is guaranteed
     min_tokens_to_keep: jnp.ndarray  # int32
+    # OpenAI logit_bias: fixed-width (ids, additive values); -1 ids drop.
+    # The reference carries the field in its DecodingConfig but never
+    # applies it (src/dnet/api/models.py:70 "NOTE: unused") — here it bites.
+    bias_ids: jnp.ndarray  # [MAX_LOGIT_BIAS] int32
+    bias_vals: jnp.ndarray  # [MAX_LOGIT_BIAS] f32
 
     @classmethod
     def from_decoding(cls, d: DecodingParams) -> "SampleParams":
+        ids, vals = encode_logit_bias(getattr(d, "logit_bias", None))
         return cls(
             temperature=jnp.float32(d.temperature),
             top_p=jnp.float32(d.top_p),
@@ -44,6 +72,8 @@ class SampleParams(NamedTuple):
             min_p=jnp.float32(d.min_p),
             repetition_penalty=jnp.float32(d.repetition_penalty),
             min_tokens_to_keep=jnp.int32(d.min_tokens_to_keep),
+            bias_ids=jnp.asarray(ids),
+            bias_vals=jnp.asarray(vals),
         )
 
 
@@ -64,6 +94,7 @@ class SamplePlan(NamedTuple):
     filters: bool  # any of top_p < 1 / top_k > 0 / min_p > 0 active
     logprobs: bool  # request wants logprob + top-logprob outputs
     penalty: bool  # repetition_penalty != 1
+    bias: bool = False  # logit_bias present: scatter-add before everything
 
     @classmethod
     def from_decoding(cls, d: DecodingParams) -> "SamplePlan":
@@ -72,11 +103,16 @@ class SamplePlan(NamedTuple):
             filters=(d.top_p < 1.0) or (d.top_k > 0) or (d.min_p > 0.0),
             logprobs=bool(d.logprobs),
             penalty=d.repetition_penalty != 1.0,
+            bias=bool(getattr(d, "logit_bias", None)),
         )
 
 
 # the everything-on plan: default for callers that keep all knobs traced
-FULL_PLAN = SamplePlan(greedy=False, filters=True, logprobs=True, penalty=True)
+# (bias included: its ids default to -1 = dropped, so unbiased requests
+# through FULL_PLAN still sample identically)
+FULL_PLAN = SamplePlan(
+    greedy=False, filters=True, logprobs=True, penalty=True, bias=True
+)
 
 
 class SampleResult(NamedTuple):
@@ -124,6 +160,17 @@ def sample(
     """
     if plan is None:
         plan = FULL_PLAN
+    if plan.bias:
+        # additive logit_bias before every other knob: greedy argmax,
+        # filters, and reported logprobs all see the biased distribution
+        # (OpenAI semantics).  Padded (-1) AND out-of-vocab ids scatter a
+        # zero — jax would otherwise wrap/clip them onto real vocab rows
+        # and silently force/ban an unrelated token.
+        V = logits.shape[-1]
+        in_vocab = (params.bias_ids >= 0) & (params.bias_ids < V)
+        vals = jnp.where(in_vocab, params.bias_vals, 0.0)
+        ids = jnp.clip(params.bias_ids, 0, V - 1)
+        logits = logits.astype(jnp.float32).at[:, ids].add(vals)
     if plan.penalty and token_counts is not None:
         logits = apply_repetition_penalty(
             logits, token_counts, params.repetition_penalty

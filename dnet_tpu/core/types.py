@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -39,6 +39,10 @@ class DecodingParams:
     logprobs: bool = False
     top_logprobs: int = 0
     seed: Optional[int] = None
+    # OpenAI logit_bias {token_id: additive bias in [-100, 100]}: the
+    # reference carries the field but never applies it
+    # (src/dnet/api/models.py:70 "NOTE: unused"); here it reaches sampling
+    logit_bias: Optional[Dict[int, float]] = None
 
 
 @dataclass

@@ -41,7 +41,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dnet_tpu.core.sampler import SampleParams, SampleResult, sample
+from dnet_tpu.core.sampler import (
+    MAX_LOGIT_BIAS,
+    SampleParams,
+    SampleResult,
+    encode_logit_bias,
+    sample,
+)
 from dnet_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_PP,
@@ -690,6 +696,8 @@ class PipelinedMeshEngine:
         min_p = np.zeros(M, dtype=np.float32)
         rep = np.ones(M, dtype=np.float32)
         mtk = np.ones(M, dtype=np.int32)
+        b_ids = np.full((M, MAX_LOGIT_BIAS), -1, dtype=np.int32)
+        b_vals = np.zeros((M, MAX_LOGIT_BIAS), dtype=np.float32)
         for slot, dec in self._dec.items():
             temp[slot] = dec.temperature
             top_p[slot] = dec.top_p
@@ -697,9 +705,11 @@ class PipelinedMeshEngine:
             min_p[slot] = dec.min_p
             rep[slot] = dec.repetition_penalty
             mtk[slot] = dec.min_tokens_to_keep
+            b_ids[slot], b_vals[slot] = encode_logit_bias(dec.logit_bias)
         return SampleParams(
             jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(top_k),
             jnp.asarray(min_p), jnp.asarray(rep), jnp.asarray(mtk),
+            jnp.asarray(b_ids), jnp.asarray(b_vals),
         )
 
     # fused-rotation widths tried largest-first (one compiled program per

@@ -40,7 +40,9 @@ def pack(obj: dict) -> bytes:
 
 
 def unpack(data: bytes) -> dict:
-    return msgpack.unpackb(data, raw=False)
+    # strict_map_key off: DecodingParams.logit_bias rides the wire with
+    # integer token-id keys
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
 
 
 # ---- frames ---------------------------------------------------------------

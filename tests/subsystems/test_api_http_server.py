@@ -232,6 +232,56 @@ def test_stop_sequence(tiny_llama_dir):
     run(go())
 
 
+def test_logit_bias_steers_serving(tiny_llama_dir):
+    """OpenAI logit_bias through the full HTTP surface: +100 on one token
+    forces every greedy step to emit it (the reference carries the field
+    unused); out-of-range values are 400."""
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server)
+        r = await client.post("/v1/load_model", json={"model": str(tiny_llama_dir)})
+        assert r.status == 200, await r.text()
+
+        forced = 65  # "A" in the byte tokenizer
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "temperature": 0,
+                "logit_bias": {str(forced): 100.0},
+            },
+        )
+        assert r.status == 200, await r.text()
+        content = (await r.json())["choices"][0]["message"]["content"]
+        assert content == "AAAA"
+
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 1,
+                "logit_bias": {str(forced): 101.0},
+            },
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 1,
+                "logit_bias": {"not-a-token": 1.0},
+            },
+        )
+        assert r.status == 400
+        await client.close()
+
+    run(go())
+
+
 def test_legacy_completions_and_embeddings(tiny_llama_dir):
     async def go():
         _, _, server = make_stack()

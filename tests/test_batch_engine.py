@@ -220,3 +220,21 @@ def test_deepseek_accepted_at_load(tmp_path_factory):
     make_tiny_deepseek_v2(d)
     eng = BatchedEngine(d, slots=2, max_seq=32, param_dtype="float32")
     assert eng.model.supports_kv_commit
+
+
+def test_logit_bias_per_lane(tiny_llama_dir):
+    """Two lanes with DIFFERENT biases in one batched step: each lane's
+    forced token wins only on its own lane."""
+    from dnet_tpu.core.batch import BatchedEngine
+    from dnet_tpu.core.types import DecodingParams
+
+    eng = BatchedEngine(tiny_llama_dir, slots=2, max_seq=64, param_dtype="float32")
+    da = DecodingParams(temperature=0.0, logit_bias={65: 100.0})
+    db = DecodingParams(temperature=0.0, logit_bias={66: 100.0})
+    eng.prefill_and_sample("a", [256, 72], da)
+    eng.prefill_and_sample("b", [256, 73], db)
+    results, errors = eng.decode_batch({"a": (65, da), "b": (66, db)})
+    assert not errors
+    assert int(results["a"].token[0]) == 65
+    assert int(results["b"].token[0]) == 66
+    eng.close()

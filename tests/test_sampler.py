@@ -115,3 +115,67 @@ def test_min_tokens_to_keep_overrides_filters():
         res = sample(logits, sp, jax.random.key(i))
         seen1.add(int(res.token[0]))
     assert seen1 == {0}
+
+
+# ---- logit_bias (OpenAI semantics; the reference never applies it) ----
+
+
+def test_logit_bias_forces_token(rng):
+    """+100 on a low-logit token dominates greedy argmax."""
+    import jax
+
+    from dnet_tpu.core.sampler import SamplePlan, SampleParams, sample
+
+    logits = jnp.asarray(rng.normal(size=(1, 32)), jnp.float32)
+    loser = int(jnp.argmin(logits[0]))
+    d = DecodingParams(temperature=0.0, logit_bias={loser: 100.0})
+    res = sample(
+        logits, SampleParams.from_decoding(d), jax.random.key(0),
+        plan=SamplePlan.from_decoding(d),
+    )
+    assert int(res.token[0]) == loser
+
+
+def test_logit_bias_suppresses_token(rng):
+    """-100 on the argmax bans it even under stochastic sampling."""
+    import jax
+
+    from dnet_tpu.core.sampler import SamplePlan, SampleParams, sample
+
+    logits = jnp.asarray(rng.normal(size=(1, 32)), jnp.float32)
+    winner = int(jnp.argmax(logits[0]))
+    d = DecodingParams(temperature=1.0, logit_bias={winner: -100.0})
+    sp = SampleParams.from_decoding(d)
+    plan = SamplePlan.from_decoding(d)
+    for seed in range(8):
+        res = sample(logits, sp, jax.random.key(seed), plan=plan)
+        assert int(res.token[0]) != winner
+
+
+def test_logit_bias_absent_is_exact_noop(rng):
+    """FULL_PLAN carries the bias machinery; empty bias must not perturb
+    a single logit (padded ids scatter zeros)."""
+    import jax
+
+    from dnet_tpu.core.sampler import SampleParams, sample
+
+    logits = jnp.asarray(rng.normal(size=(2, 32)), jnp.float32)
+    d0 = DecodingParams(temperature=0.7, top_p=0.9, seed=3)
+    key = jax.random.key(3)
+    a = sample(logits, SampleParams.from_decoding(d0), key)
+    b = sample(
+        logits,
+        SampleParams.from_decoding(
+            DecodingParams(temperature=0.7, top_p=0.9, seed=3, logit_bias={})
+        ),
+        key,
+    )
+    assert (a.token == b.token).all()
+    np.testing.assert_array_equal(np.asarray(a.logprob), np.asarray(b.logprob))
+
+
+def test_logit_bias_cap():
+    from dnet_tpu.core.sampler import MAX_LOGIT_BIAS, encode_logit_bias
+
+    with np.testing.assert_raises(ValueError):
+        encode_logit_bias({i: 1.0 for i in range(MAX_LOGIT_BIAS + 1)})
