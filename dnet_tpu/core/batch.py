@@ -34,7 +34,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from dnet_tpu.core.engine import LocalEngine
-from dnet_tpu.core.kvcache import init_cache
 from dnet_tpu.core.sampler import (
     MAX_LOGIT_BIAS,
     MAX_TOP_LOGPROBS,
@@ -43,7 +42,7 @@ from dnet_tpu.core.sampler import (
     encode_logit_bias,
     sample,
 )
-from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.core.types import DecodingParams
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()

@@ -13,7 +13,6 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from functools import partial
 from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Sequence
 

@@ -489,3 +489,33 @@ def test_dp_seeded_sampling_matches_local(tiny_llama_dir, eight_devices, local):
     got = [r.token_id for r in eng.generate(ids, dec, max_tokens=6, nonce="s")]
     assert eng.slot_of.get("s") is None  # generate() ends its session
     assert got == want
+
+
+def test_dp_sp_axes_compose(tiny_llama_dir, eight_devices, local):
+    """All three rotation axes at once (pp2 x dp2 x sp2 = 8 devices):
+    lane-sharded slots with sp-sharded KV, greedy parity per lane."""
+    from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
+
+    eng = PipelinedMeshEngine(
+        tiny_llama_dir, pp=2, tp=1, dp=2, sp=2, slots=4, max_seq=64,
+        param_dtype="float32",
+    )
+    dec = DecodingParams(temperature=0.0)
+    prompts = [[256, 72, 105], [256, 90], [256, 66, 121], [256, 65]]
+    want = {
+        i: [r.token_id for r in local.generate(p, dec, max_tokens=5)]
+        for i, p in enumerate(prompts)
+    }
+    toks = {}
+    for i, p in enumerate(prompts):
+        res = eng.prefill_and_sample(f"x{i}", p, dec)
+        toks[i] = [int(res.token[0])]
+    for _ in range(4):
+        reqs = {f"x{i}": (toks[i][-1], dec) for i in range(4)}
+        results, errors = eng.decode_batch(reqs)
+        assert not errors
+        for i in range(4):
+            toks[i].append(int(results[f"x{i}"].token[0]))
+    for i in range(4):
+        eng.end_session(f"x{i}")
+    assert toks == want
