@@ -210,8 +210,9 @@ class ApiHTTPServer:
     async def embeddings(self, request: web.Request) -> web.Response:
         """Mean-pooled final-hidden-state embeddings (BEYOND the reference,
         whose embeddings schema exists in api/models.py with no serving
-        path).  Local/batched strategies serve; ring mode — where shards
-        never ship hidden states to the API node — answers 501."""
+        path).  Local/batched/mesh strategies serve; the gRPC ring —
+        where shards never ship hidden states to the API node — answers
+        501."""
         from dnet_tpu.api.schemas import EmbeddingsRequest
 
         try:

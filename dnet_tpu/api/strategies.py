@@ -92,8 +92,9 @@ class ApiAdapterBase(abc.ABC):
         """Mean-pooled final-hidden-state embeddings, one vector per input
         (beyond the reference, which never serves /v1/embeddings).
         Default: unsupported — the gRPC ring's shards never ship hidden
-        states back to the API node, and the mesh ring program only emits
-        logits.  Local/batched adapters override."""
+        states back to the API node.  The local adapter serves it for
+        Local AND Mesh engines (both expose hidden_states), the batched
+        adapter via its inner engine."""
         raise NotImplementedError(
             f"embeddings unsupported on {type(self).__name__}"
         )
@@ -229,9 +230,13 @@ class BatchedLocalAdapter(ApiAdapterBase):
         return self.engine.max_seq
 
     async def embed(self, ids_list: List[List[int]]) -> List[List[float]]:
-        # the inner LocalEngine produces the hidden states; the batched
-        # program itself only decodes
-        fn = getattr(self.engine.eng, "hidden_states", None)
+        # the inner engine produces the hidden states (BatchedEngine wraps a
+        # LocalEngine as .eng, PipelinedMeshEngine a MeshEngine as ._inner);
+        # the batched programs themselves only decode
+        inner = getattr(self.engine, "eng", None) or getattr(
+            self.engine, "_inner", None
+        )
+        fn = getattr(inner, "hidden_states", None)
         if fn is None:
             raise NotImplementedError(
                 f"embeddings unsupported on {type(self.engine).__name__}"
@@ -444,7 +449,7 @@ class LocalAdapter(ApiAdapterBase):
 
     async def embed(self, ids_list: List[List[int]]) -> List[List[float]]:
         fn = getattr(self.engine, "hidden_states", None)
-        if fn is None:  # mesh engines: the ring program only emits logits
+        if fn is None:
             raise NotImplementedError(
                 f"embeddings unsupported on {type(self.engine).__name__}"
             )

@@ -414,3 +414,39 @@ class MeshEngine:
         return LocalEngine.generate(
             self, prompt_ids, decoding, max_tokens, eos_token_ids, nonce
         )
+
+    def hidden_states(self, prompt_ids: Sequence[int]) -> np.ndarray:
+        """Embeddings primitive through the mesh ring (LocalEngine's
+        contract: float32 [T, D] of final-norm'd hidden states).  The ring
+        pass runs over a throwaway KV; the program compiles lazily on the
+        first embeddings request."""
+        ids = list(prompt_ids)
+        if not ids:
+            raise ValueError("empty embeddings input")
+        if len(ids) > self.max_seq:
+            raise ValueError(
+                f"input length {len(ids)} exceeds max_seq {self.max_seq}"
+            )
+        if not hasattr(self, "_hidden_fn"):
+            from dnet_tpu.parallel.ring import make_ring_hidden_fn
+
+            self._hidden_fn = make_ring_hidden_fn(
+                self.model, self.mesh, self._host_window
+            )
+            # throwaway KV operand, built ONCE: the hidden fn never donates
+            # it and t_real masks its (stale) contents, so every embeddings
+            # request reuses the same placed buffers
+            kv0 = self.model.init_kv(
+                self._n_kv_layers, self.batch, self.max_seq, self.kv_dtype,
+                quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),
+            )
+            _, _, self._hidden_kv = place_ring_state({}, {}, kv0, self.mesh)
+        T = len(ids)
+        Tpad = min(bucket_length(T), self.max_seq)
+        tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
+        tokens[:, :T] = np.asarray(ids, dtype=np.int32)
+        h, _ = self._hidden_fn(
+            self.window_params, self.edge_params, jnp.asarray(tokens),
+            self._hidden_kv, jnp.int32(0), jnp.int32(T - 1),
+        )
+        return np.asarray(h[0, :T], dtype=np.float32)

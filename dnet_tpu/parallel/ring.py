@@ -35,12 +35,14 @@ from dnet_tpu.parallel.mesh import (
 )
 
 
-def _ring_spmd(model, mesh: Mesh, window_params, full_logits: bool = False):
+def _ring_spmd(model, mesh: Mesh, window_params, full_logits: bool = False,
+               hidden_out: bool = False):
     """Construct the shard_map'd single-token ring step (un-jitted) and its
     layer-kinds operand.  Shared by the per-step fn (make_ring_decode_fn),
-    the chunked-scan fn (make_ring_chunk_fn), and — with full_logits=True,
-    which projects EVERY position instead of slicing last_idx — the
-    speculative verify fn (make_ring_spec_fn)."""
+    the chunked-scan fn (make_ring_chunk_fn), the speculative verify fn
+    (make_ring_spec_fn, full_logits=True: every position projected), and
+    the embeddings fn (make_ring_hidden_fn, hidden_out=True: final-norm'd
+    hidden states instead of the lm projection)."""
     PP = mesh.shape[AXIS_PP]
     phases = getattr(model, "ring_phases", 1)
     # sequence parallelism: KV shards over sp; queries/hidden replicate and
@@ -59,7 +61,9 @@ def _ring_spmd(model, mesh: Mesh, window_params, full_logits: bool = False):
         P(),  # last_idx scalar
         P(AXIS_PP) if has_kinds else P(),
     )
-    logits_spec = P(AXIS_DP, None, None) if full_logits else P(AXIS_DP, None)
+    logits_spec = (
+        P(AXIS_DP, None, None) if (full_logits or hidden_out) else P(AXIS_DP, None)
+    )
     out_specs = (logits_spec, kv_spec(sp_axis is not None))
 
     def spmd(window_params, edge_params, tokens, kv, pos, last_idx, kinds):
@@ -94,6 +98,10 @@ def _ring_spmd(model, mesh: Mesh, window_params, full_logits: bool = False):
         x, kv = lax.fori_loop(0, phases * PP, stage_iter, (x, kv))
         # after PP hops the processed x is back on rank 0; ranks agree via
         # the ppermute ring, and rank 0 holds the final hidden state.
+        if hidden_out:
+            # embeddings path: every position's final-norm'd hidden state
+            xs = model.normalize(edge_params, x)
+            return _bcast_from_rank0(xs, AXIS_PP), kv
         if full_logits:
             # spec verify needs every position's argmax; T is tiny (L+1)
             xs = model.normalize(edge_params, x)
@@ -207,6 +215,22 @@ def make_ring_spec_fn(model, mesh: Mesh, window_params, lookahead: int):
         return out, hist, kv
 
     return jax.jit(spec_step, donate_argnums=(3, 4))
+
+
+def make_ring_hidden_fn(model, mesh: Mesh, window_params):
+    """One ring pass returning final-norm'd hidden states [B, T, D] —
+    the embeddings primitive for mesh-served models (the twin of
+    LocalEngine.hidden_states).  KV is a throwaway: not donated, caller
+    discards it."""
+    fn, kinds_arr = _ring_spmd(model, mesh, window_params, hidden_out=True)
+    jitted = jax.jit(fn)
+
+    def call(window_params, edge_params, tokens, kv, pos, last_idx):
+        return jitted(
+            window_params, edge_params, tokens, kv, pos, last_idx, kinds_arr
+        )
+
+    return call
 
 
 def _bcast_from_rank0(x, axis_name: str):

@@ -258,3 +258,14 @@ def test_mesh_serve_vs_fused(tiny_llama_dir, eight_devices):
         f"mesh served {served_tok_s:.1f} tok/s vs fused {fused_tok_s:.1f} "
         f"(ratio {ratio:.2f} < 0.8): serving overhead not amortized"
     )
+
+
+def test_hidden_states_match_local(local, mesh_engine):
+    """Embeddings primitive through the ring: final-norm'd hidden states
+    equal the single-device engine's (so /v1/embeddings serves identically
+    whichever substrate backs the adapter)."""
+    ids = [256, 72, 101, 108]
+    ref = local.hidden_states(ids)
+    got = mesh_engine.hidden_states(ids)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)

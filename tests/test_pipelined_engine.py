@@ -519,3 +519,32 @@ def test_dp_sp_axes_compose(tiny_llama_dir, eight_devices, local):
     for i in range(4):
         eng.end_session(f"x{i}")
     assert toks == want
+
+
+def test_embeddings_via_batched_adapter(tiny_llama_dir, eight_devices, local):
+    """/v1/embeddings on the pipelined-mesh serving path: the adapter
+    resolves the inner MeshEngine's hidden_states."""
+    import asyncio
+
+    from dnet_tpu.api.strategies import BatchedLocalAdapter
+    from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
+
+    eng = PipelinedMeshEngine(
+        tiny_llama_dir, pp=2, tp=1, slots=2, max_seq=64, param_dtype="float32"
+    )
+    ids = [256, 72, 101]
+    ref = local.hidden_states(ids).mean(axis=0)
+
+    async def go():
+        adapter = BatchedLocalAdapter(eng)
+        await adapter.start()
+        try:
+            vecs = await adapter.embed([ids])
+        finally:
+            await adapter.shutdown()
+        return vecs
+
+    vecs = asyncio.run(go())
+    import numpy as np
+
+    np.testing.assert_allclose(np.asarray(vecs[0]), ref, atol=1e-4, rtol=1e-4)
