@@ -105,6 +105,10 @@ class InferenceManager:
             top_logprobs=req.top_logprobs,
             seed=req.seed,
             logit_bias=req.logit_bias_ids(),
+            # EOS ids ride along so ring decode grants can halt shard-side
+            stop_token_ids=tuple(self.tokenizer.eos_token_ids)
+            if self.tokenizer is not None
+            else (),
         )
 
     def _logprob_entry(self, result, text: str) -> LogprobEntry:

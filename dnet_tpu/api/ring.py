@@ -35,6 +35,7 @@ class RingApiAdapter(ApiAdapterBase):
         ring_client_factory: Optional[Callable[[str], object]] = None,
         max_seq_len: Optional[int] = None,
         stream_idle_s: float = 300.0,
+        auto_steps: int = 0,
     ) -> None:
         from dnet_tpu.transport.grpc_transport import RingClient
 
@@ -50,6 +51,14 @@ class RingApiAdapter(ApiAdapterBase):
         self._sweeper: Optional[asyncio.Task] = None
         self._pos_state: Dict[str, int] = {}  # nonce -> next absolute position
         self._shard_clients: Dict[str, object] = {}
+        # decode grants (ring self-continuation): a frame may authorize the
+        # tail shard to feed up to `auto_steps` sampled tokens straight back
+        # into the ring, so those steps cost no API round trip.  Tokens for
+        # granted steps can arrive BEFORE the driver awaits them — they
+        # stash in _early until send_tokens registers the future.
+        self._auto_steps = max(int(auto_steps), 0)
+        self._granted: Dict[str, int] = {}  # nonce -> highest granted step
+        self._early: Dict[tuple, TokenResult] = {}
 
     async def start(self) -> None:
         self._head_client = self._make_client(self.head_addr)
@@ -85,6 +94,9 @@ class RingApiAdapter(ApiAdapterBase):
         inference.py:118)."""
         self._futures.cancel_nonce(nonce)
         self._pos_state.pop(nonce, None)
+        self._granted.pop(nonce, None)
+        for key in [k for k in self._early if k[0] == nonce]:
+            self._early.pop(key, None)
         if self._streams is not None:
             await self._streams.end_stream(nonce)
 
@@ -109,6 +121,16 @@ class RingApiAdapter(ApiAdapterBase):
         if self._streams is None:
             raise RuntimeError("adapter not started")
         self._futures.expect(nonce, step)
+        if step > 0 and step <= self._granted.get(nonce, -1):
+            # this step's token is already being produced by the ring
+            # itself (decode grant) — no frame; resolve now if it beat us
+            early = self._early.pop((nonce, step), None)
+            if early is not None:
+                self._futures.resolve(early)
+            return
+        auto = 0
+        if self._auto_steps > 0 and budget is not None and budget > 1:
+            auto = min(self._auto_steps, budget - 1)
         payload, dtype, shape = tensor_to_bytes(
             np.asarray([token_ids], dtype=np.int32)
         )
@@ -123,7 +145,12 @@ class RingApiAdapter(ApiAdapterBase):
             callback_url=self.callback_url,
             decoding=asdict(decoding),
             t_sent=time.time(),
+            auto_steps=auto,
         )
+        if auto:
+            self._granted[nonce] = step + auto
+            # each granted step appends exactly one token
+            self._pos_state[nonce] = self._pos_state.get(nonce, 0) + auto
         await self._streams.send(nonce, frame)
 
     # positions: step 0 injects the whole prompt at pos 0; each later step
@@ -141,6 +168,12 @@ class RingApiAdapter(ApiAdapterBase):
 
     def resolve_token(self, result: TokenResult) -> None:
         if not self._futures.resolve(result):
+            if result.step <= self._granted.get(result.nonce, -1):
+                # a granted step raced ahead of the driver's await: hold it
+                # until send_tokens registers the future (bounded by the
+                # grant window; reset_cache clears leftovers)
+                self._early[(result.nonce, result.step)] = result
+                return
             log.warning("unmatched token for nonce %s step %d", result.nonce, result.step)
 
     async def _idle_sweep(self) -> None:

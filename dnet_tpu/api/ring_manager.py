@@ -122,27 +122,18 @@ class RingModelManager:
         by_instance = {d.instance: d for d in topo.devices}
         max_seq = max_seq or self.max_seq
 
-        # k-round schedules wrap the ring: even the tail shard forwards its
-        # mid-round hidden frames to the head (final tokens still go to the
-        # API callback), so it needs a live next hop
-        multi_round = any(
-            len(_contiguous_runs(a.layers)) > 1 for a in topo.assignments
-        )
         async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
             for a in topo.assignments:
                 dev = by_instance[a.instance]
                 nxt = by_instance.get(a.next_instance)
-                is_last_hop = (
-                    not multi_round
-                    and a.next_instance == topo.assignments[0].instance
-                )
                 body = {
                     "model_path": model_id,
                     "layers": a.layers,
-                    # the last shard calls back to the API; it has no ring next
-                    "next_node": None
-                    if is_last_hop
-                    else {"host": nxt.host, "grpc_port": nxt.grpc_port},
+                    # the ring is fully wired, tail included: the tail's
+                    # next IS the head, which carries k-round mid-frames
+                    # AND decode-grant continuations (final tokens still go
+                    # to the API callback)
+                    "next_node": {"host": nxt.host, "grpc_port": nxt.grpc_port},
                     "window_size": a.window_size,
                     "residency_size": a.residency_size,
                     "kv_bits": topo.kv_bits,
@@ -168,6 +159,7 @@ class RingModelManager:
 
         head = by_instance[topo.head_instance()]
         from dnet_tpu.api.ring import RingApiAdapter
+        from dnet_tpu.config import get_settings
 
         old = self.inference.adapter
         adapter = RingApiAdapter(
@@ -178,6 +170,7 @@ class RingModelManager:
                 for a in topo.assignments
             ],
             max_seq_len=max_seq,
+            auto_steps=get_settings().api.ring_auto_steps,
         )
         await adapter.start()
         self.inference.adapter = adapter

@@ -238,6 +238,12 @@ class ApiSettings(_EnvGroup):
     # verify forward (core/spec.py).  Greedy-exact; eligible requests emit
     # 1..L+1 tokens per weight read.  Local and mesh engines (batch 1).
     spec_lookahead: int = 0
+    # ring decode grants: a token frame may authorize the TAIL shard to
+    # feed up to this many sampled tokens straight back into the ring
+    # (tail -> head hop), removing the per-token API round trip.  The tail
+    # halts on EOS / cache capacity; overshoot past a stop SEQUENCE is
+    # discarded like local decode chunks.  0 disables.
+    ring_auto_steps: int = 16
 
 
 @dataclass

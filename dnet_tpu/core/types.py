@@ -43,6 +43,9 @@ class DecodingParams:
     # reference carries the field but never applies it
     # (src/dnet/api/models.py:70 "NOTE: unused"); here it reaches sampling
     logit_bias: Optional[Dict[int, float]] = None
+    # EOS ids for SHARD-side stop checks (ring self-continuation halts on
+    # them without waiting for the API); sampling itself ignores this
+    stop_token_ids: tuple = ()
 
 
 @dataclass
@@ -68,6 +71,12 @@ class ActivationMessage:
     logprob: Optional[float] = None
     top_logprobs: Optional[list] = None
     error: str = ""
+    # ring self-continuation (decode grants): how many more tokens the tail
+    # shard may feed back into the ring without an API round trip, and —
+    # on a final message — the (token, pos, remaining_steps, next_seq)
+    # continuation the adapter should inject at the head
+    auto_steps: int = 0
+    cont: Optional[tuple] = None
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0

@@ -146,10 +146,29 @@ class RingAdapter:
             callback_url=msg.callback_url,
             decoding=_decoding_dict(msg),
             t_sent=time.time(),
+            auto_steps=msg.auto_steps,
         )
         await streams.send(msg.nonce, frame)
 
     async def _send_token(self, msg: ActivationMessage) -> None:
+        if msg.cont is not None:
+            # decode grant: feed the sampled token straight back into the
+            # ring BEFORE the API callback — the next step's compute starts
+            # while the token is still in flight to the API
+            try:
+                await self._send_continuation(msg)
+            except Exception as exc:
+                # the API already skipped sending frames for the granted
+                # steps; without a signal it would block request_timeout_s
+                # on the next await.  An error token for the NEXT step
+                # fails the request fast instead.
+                log.exception("continuation injection failed for %s", msg.nonce)
+                try:
+                    await self._send_error_token(
+                        msg, msg.cont[3], f"decode-grant continuation failed: {exc}"
+                    )
+                except Exception:
+                    log.exception("error-token delivery failed for %s", msg.nonce)
         addr = parse_callback(msg.callback_url)
         if not addr:
             log.error("final token for %s has no callback", msg.nonce)
@@ -175,6 +194,48 @@ class RingAdapter:
             msg.nonce,
             (time.perf_counter() - t0) * 1e3,
         )
+
+    async def _send_error_token(
+        self, msg: ActivationMessage, step: int, error: str
+    ) -> None:
+        addr = parse_callback(msg.callback_url)
+        if not addr:
+            return
+        client = self._cb_clients.get(addr)
+        if client is None:
+            client = self._make_cb_client(addr)
+            self._cb_clients[addr] = client
+        await client.send_token(
+            TokenPayload(nonce=msg.nonce, step=step, token_id=-1, error=error)
+        )
+
+    async def _send_continuation(self, msg: ActivationMessage) -> None:
+        """Inject the tail's sampled token as the nonce's next entry frame.
+        The tail's ring successor IS the head (assignments are ring-ordered,
+        so last.next == first); multi-round rings relay by layer ownership."""
+        import numpy as np
+
+        from dnet_tpu.utils.serialization import tensor_to_bytes
+
+        token_id, pos, steps, seq = msg.cont
+        payload, _dtype, shape = tensor_to_bytes(
+            np.asarray([[token_id]], dtype=np.int32)
+        )
+        frame = ActivationFrame(
+            nonce=msg.nonce,
+            seq=seq,
+            layer_id=-1,
+            pos=pos,
+            dtype="tokens",
+            shape=shape,
+            payload=payload,
+            callback_url=msg.callback_url,
+            decoding=_decoding_dict(msg),
+            auto_steps=steps,
+            t_sent=time.time(),
+        )
+        streams = self._ensure_next()
+        await streams.send(msg.nonce, frame)
 
     # ---- cache / sweeping ----------------------------------------------------
     async def reset_cache(self, nonce: str = "") -> None:

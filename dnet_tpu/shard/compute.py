@@ -195,8 +195,21 @@ class ShardCompute:
         (hidden-state hop or final sampled token)."""
         eng = self.engine
         nonce = msg.nonce
-        sess = eng.sessions.get(nonce) or eng.new_session(nonce, msg.decoding.seed)
         pos = msg.pos
+        sess = eng.sessions.get(nonce)
+        if sess is None:
+            if pos > 0:
+                # a mid-stream frame with no session is STALE — a decode
+                # grant still circulating after the driver's stop-sequence
+                # reset, or a TTL-swept request.  Recreating the session
+                # would allocate a full KV cache for garbage compute (and
+                # post-reset zombies would pin it until the next sweep);
+                # an error final fails the (already-dead) request fast.
+                raise ValueError(
+                    f"no session for {nonce!r} at pos {pos} "
+                    f"(reset or expired); dropping frame"
+                )
+            sess = eng.new_session(nonce, msg.decoding.seed)
 
         if len(self.rounds) > 1:
             return self._process_round(msg, sess)
@@ -229,7 +242,7 @@ class ShardCompute:
                 )
                 sess.pos = pos + T
                 sess.last_used = time.time()
-                return self._final_message(msg, res)
+                return self._final_message(msg, res, sess)
             else:
                 x, sess.kv = eng._hidden(
                     eng.window_params, x, sess.kv, jnp.int32(pos), jnp.int32(T)
@@ -255,7 +268,7 @@ class ShardCompute:
 
             res = sample(logits, sp, step_key, token_counts=sess.counts)
             sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
-            return self._final_message(msg, res)
+            return self._final_message(msg, res, sess)
 
         # hidden hop to the next shard: slice off the padding, cast to wire
         out = np.asarray(x[:, :T])
@@ -278,9 +291,11 @@ class ShardCompute:
             pos=pos,
             callback_url=msg.callback_url,
             decoding=msg.decoding,
+            # the decode grant must reach the TAIL: it rides every hop
+            auto_steps=msg.auto_steps,
         )
 
-    def _final_message(self, msg: ActivationMessage, res) -> ActivationMessage:
+    def _final_message(self, msg: ActivationMessage, res, sess) -> ActivationMessage:
         decoding = msg.decoding
         token_result = LocalEngine.token_result(msg.nonce, res, step=msg.seq, decoding=decoding)
         out = ActivationMessage(
@@ -297,6 +312,21 @@ class ShardCompute:
             logprob=token_result.logprob,
             top_logprobs=token_result.top_logprobs,
         )
+        # decode grant (ring self-continuation): with budget left, a
+        # non-stop token, and cache capacity, the sampled token re-enters
+        # the ring directly — the adapter injects `cont` at the head while
+        # the API receives this token in parallel, removing the per-token
+        # API round trip the reference pays (its driver re-injects every
+        # token, src/dnet/api/strategies/ring.py:125-209)
+        stops = tuple(decoding.stop_token_ids or ())
+        if (
+            msg.auto_steps > 0
+            and token_result.token_id not in stops
+            and sess.pos < self.engine.max_seq
+        ):
+            out.cont = (
+                token_result.token_id, sess.pos, msg.auto_steps - 1, msg.seq + 1
+            )
         return out
 
     def sweep_sessions(self) -> int:

@@ -62,6 +62,8 @@ class ActivationFrame:
     callback_url: str = ""  # grpc://host:port for the final token
     decoding: dict = field(default_factory=dict)
     t_sent: float = 0.0
+    # decode grant: tokens the tail may self-continue without an API hop
+    auto_steps: int = 0
 
     def to_bytes(self) -> bytes:
         d = asdict(self)
@@ -86,6 +88,7 @@ class ActivationFrame:
             pos=self.pos,
             callback_url=self.callback_url,
             decoding=dec,
+            auto_steps=self.auto_steps,
         )
 
 

@@ -245,3 +245,178 @@ def test_two_shard_k2_rounds_match_single_engine(tiny_llama_dir, reference_token
             await ring.stop()
 
     asyncio.run(go())
+
+
+def test_decode_grants_match_and_skip_api_hops(tiny_llama_dir, reference_tokens):
+    """Ring self-continuation: with auto_steps granted, the tail feeds its
+    sampled token straight back to the head — the stream is identical to
+    the per-token protocol but the API sends ONE frame for the whole
+    request instead of one per token."""
+    prompt_ids, expected = reference_tokens
+
+    async def go():
+        ring = Ring(tiny_llama_dir)
+        await ring.start()
+        # tail -> head link (ring fully wired, as ring_manager now loads it)
+        ring.a1.configure_topology("s0:1")
+        api_frames = []
+        try:
+            api = RingApiAdapter(
+                head_addr="s0:1",
+                callback_url="grpc://api:1",
+                shard_grpc_addrs=["s0:1", "s1:1"],
+                ring_client_factory=lambda addr: FakeRingClient(
+                    addr,
+                    on_frame=lambda f: (
+                        api_frames.append(f),
+                        _ingress_ack(ring.a0, f),
+                    )[1],
+                ),
+                max_seq_len=64,
+                auto_steps=8,
+            )
+            await api.start()
+            got = []
+            dec = DecodingParams(temperature=0.0)
+            send = list(prompt_ids)
+            n = len(expected)
+            for step in range(n):
+                await api.send_tokens("g1", send, dec, step, budget=n - step)
+                payload = await _wait_token(ring.tokens, step)
+                api.resolve_token(payload.to_result())
+                result = await api.await_token("g1", step, timeout=10.0)
+                assert not result.error, result.error
+                got.append(result.token_id)
+                send = [result.token_id]
+            assert got == expected
+            # one prompt frame granted the whole budget; decode steps rode
+            # the ring without touching the API->head stream
+            assert len(api_frames) == 1, [f.seq for f in api_frames]
+            assert api_frames[0].auto_steps == n - 1
+            await api.shutdown()
+        finally:
+            await ring.stop()
+
+    asyncio.run(go())
+
+
+def test_decode_grants_stop_on_eos(tiny_llama_dir):
+    """The tail halts self-continuation when it samples a stop token: no
+    stray frames keep looping the ring after EOS."""
+
+    async def go():
+        ring = Ring(tiny_llama_dir)
+        await ring.start()
+        ring.a1.configure_topology("s0:1")
+        try:
+            api = RingApiAdapter(
+                head_addr="s0:1",
+                callback_url="grpc://api:1",
+                shard_grpc_addrs=["s0:1", "s1:1"],
+                ring_client_factory=lambda addr: FakeRingClient(
+                    addr, on_frame=lambda f: _ingress_ack(ring.a0, f)
+                ),
+                max_seq_len=64,
+                auto_steps=8,
+            )
+            await api.start()
+            # find the greedy continuation: whatever token follows the
+            # prompt becomes the "EOS" for the real run
+            dec = DecodingParams(temperature=0.0)
+            await api.send_tokens("probe", [256, 72, 105], dec, 0, budget=1)
+            first = (await _wait_token(ring.tokens, 0)).token_id
+            api.resolve_token(TokenPayload(nonce="probe", step=0, token_id=first).to_result())
+            await api.await_token("probe", 0, timeout=10.0)
+            await api.reset_cache("probe")
+
+            dec_eos = DecodingParams(temperature=0.0, stop_token_ids=(first,))
+            await api.send_tokens("e1", [256, 72, 105], dec_eos, 0, budget=8)
+            payload = await _wait_token(ring.tokens, 0)
+            assert payload.token_id == first
+            await asyncio.sleep(0.5)  # any illegal continuation would land now
+            # the tail sampled EOS at step 0 -> no continuation entered the
+            # ring, so exactly one token ever reached the API
+            assert len([p for p in ring.tokens if p.nonce == "e1"]) == 1
+            await api.shutdown()
+        finally:
+            await ring.stop()
+
+    asyncio.run(go())
+
+
+def test_stale_frame_without_session_errors_fast(tiny_llama_dir):
+    """A mid-stream frame whose session is gone (post-reset grant leftover,
+    TTL-swept request) must NOT recreate a session — it fails the frame
+    with an error final instead of allocating zombie KV."""
+
+    async def go():
+        rt = ShardRuntime("solo")
+        tokens = []
+        adapter = RingAdapter(
+            rt,
+            ring_client_factory=lambda addr: FakeRingClient(addr),
+            callback_client_factory=lambda addr: FakeCallbackClient(addr, tokens),
+        )
+        loop = asyncio.get_running_loop()
+        rt.start(loop)
+        await adapter.start()
+        await loop.run_in_executor(
+            None,
+            lambda: rt.load_model_core(
+                str(tiny_llama_dir), [0, 1, 2, 3], max_seq=64,
+                param_dtype="float32",
+            ),
+        )
+        from dnet_tpu.transport.protocol import ActivationFrame
+        import numpy as np
+        from dnet_tpu.utils.serialization import tensor_to_bytes
+
+        payload, _dt, shape = tensor_to_bytes(np.asarray([[7]], dtype=np.int32))
+        frame = ActivationFrame(
+            nonce="ghost", seq=3, layer_id=-1, pos=5, dtype="tokens",
+            shape=shape, payload=payload, callback_url="grpc://api:1",
+        )
+        ok, _ = await adapter.ingress_frame(frame)
+        assert ok
+        p = await _wait_token(tokens, 3)
+        assert p.error and "no session" in p.error
+        assert len(rt.compute.engine.sessions) == 0  # no zombie allocated
+        await adapter.shutdown()
+        rt.stop()
+
+    asyncio.run(go())
+
+
+def test_failed_continuation_fails_fast(tiny_llama_dir):
+    """If the tail cannot inject the continuation (dead tail->head link),
+    the granted NEXT step gets an error token instead of leaving the
+    driver to burn its full await timeout."""
+
+    async def go():
+        ring = Ring(tiny_llama_dir)
+        await ring.start()
+        # tail deliberately NOT wired: continuation injection must fail
+        ring.a1.configure_topology("")
+        try:
+            api = RingApiAdapter(
+                head_addr="s0:1",
+                callback_url="grpc://api:1",
+                shard_grpc_addrs=["s0:1", "s1:1"],
+                ring_client_factory=lambda addr: FakeRingClient(
+                    addr, on_frame=lambda f: _ingress_ack(ring.a0, f)
+                ),
+                max_seq_len=64,
+                auto_steps=8,
+            )
+            await api.start()
+            dec = DecodingParams(temperature=0.0)
+            await api.send_tokens("f1", [256, 72, 105], dec, 0, budget=8)
+            p0 = await _wait_token(ring.tokens, 0)
+            assert not p0.error
+            p1 = await _wait_token(ring.tokens, 1)  # the fast-fail signal
+            assert p1.error and "continuation" in p1.error
+            await api.shutdown()
+        finally:
+            await ring.stop()
+
+    asyncio.run(go())
