@@ -143,9 +143,12 @@ class RingModelManager:
                     "weight_quant_bits": self.weight_quant_bits,
                     # mesh-backed shards: the solve (or manual topology) may
                     # give this ring node a host-local tp/sp mesh; 0 defers
-                    # to the shard's own DNET_SHARD_MESH_* defaults
+                    # to the shard's own DNET_SHARD_MESH_* defaults.  sp
+                    # must divide the LOAD-time max_seq (the solve checked
+                    # its own seq_len, which may differ) — drop it here
+                    # rather than failing every shard load.
                     "mesh_tp": a.mesh_tp,
-                    "mesh_sp": a.mesh_sp,
+                    "mesh_sp": self._check_sp(a, max_seq),
                 }
                 url = f"http://{dev.host}:{dev.http_port}/load_model"
                 r = await client.post(url, json=body)
@@ -181,6 +184,17 @@ class RingModelManager:
         dt = time.perf_counter() - t0
         log.info("ring model %s loaded across %d shard(s) in %.1fs", model_id, len(topo.assignments), dt)
         return dt
+
+    @staticmethod
+    def _check_sp(a, max_seq: int) -> int:
+        if a.mesh_sp > 1 and max_seq % a.mesh_sp != 0:
+            log.warning(
+                "%s: planned mesh_sp=%d does not divide max_seq_len=%d; "
+                "serving without sequence parallelism on this node",
+                a.instance, a.mesh_sp, max_seq,
+            )
+            return 1
+        return a.mesh_sp
 
     async def unload_model(self) -> None:
         topo = self.cluster.current_topology

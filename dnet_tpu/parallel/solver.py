@@ -314,7 +314,9 @@ def solve_topology(
     from dataclasses import replace as _dc_replace
 
     clamped = []
+    orig_chips = {}  # instance -> physical chip count (pre-clamp)
     for d in devices:
+        orig_chips[d.instance] = max(d.chip_count, 1)
         c = max(d.chip_count, 1)
         while c > 1 and m.tp_heads > 0 and m.tp_heads % c != 0:
             c -= 1
@@ -350,19 +352,31 @@ def solve_topology(
         # slice (parallel/shard_mesh.py) — unless the solve streams weights
         # on this node, which the mesh shard does not compose with: fall
         # back to a single-chip shard there rather than failing at load
-        # chip_count is already clamped to a KV-head-divisible tp above
+        # chip_count is already clamped to a KV-head-divisible tp above;
+        # chips the clamp left over become a SEQUENCE-parallel axis (KV
+        # shards over them) instead of idling — e.g. a 4-chip host serving
+        # a 2-kv-head model runs tp=2 x sp=2.  The cost model stays on the
+        # clamped count (conservative: sp's KV-capacity win is unmodeled).
         mesh_tp = max(d.chip_count, 1)
+        mesh_sp = 1
+        spare = orig_chips.get(d.instance, mesh_tp) // mesh_tp
+        # largest sp <= spare dividing the sequence (all-or-nothing would
+        # idle chips whenever the full spare count doesn't divide)
+        for s in range(spare, 1, -1):
+            if m.seq_len % s == 0:
+                mesh_sp = s
+                break
         residency = 0 if n[i] >= w[i] else n[i]
-        if window > 0 and mesh_tp > 1:
+        if window > 0 and (mesh_tp > 1 or mesh_sp > 1):
             # streaming does not compose with the mesh shard: fall back to
             # one chip AND re-derive residency against single-chip HBM —
             # the solve sized n[i] with the pooled multi-chip capacity
             log.warning(
                 "%s: weight streaming assigned to a %d-chip host; mesh "
                 "sharding disabled for this node (streams on one chip)",
-                d.instance, mesh_tp,
+                d.instance, orig_chips.get(d.instance, mesh_tp),
             )
-            mesh_tp = 1
+            mesh_tp, mesh_sp = 1, 1
             n1 = min(w[i], hbm_layer_capacity(_dc_replace(d, chip_count=1), m))
             window = 0 if n1 >= w[i] else max(n1 // 2, 1)
             residency = 0 if n1 >= w[i] else n1
@@ -373,7 +387,11 @@ def solve_topology(
                 rounds=per_dev_rounds[i],
                 window_size=window,
                 residency_size=residency,
+                # both axes EXPLICIT (1 = pinned single, never 0 = "shard
+                # default"): a shard-side DNET_SHARD_MESH_* env must not
+                # override a solve that decided against the mesh
                 mesh_tp=mesh_tp,
+                mesh_sp=mesh_sp,
             )
         )
     for i, a in enumerate(assignments):

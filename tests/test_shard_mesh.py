@@ -140,3 +140,21 @@ def test_mesh_tp_auto_all_devices(tiny_llama_dir, eight_devices):
     )
     assert sc.engine.tp == 2
     sc.engine.close()
+
+
+def test_mesh_shard_tp_and_sp_combined(tiny_llama_dir, eight_devices):
+    """tp=2 x sp=2 in ONE shard (the solver's 4-chip 2-kv-head plan):
+    heads shard over tp, KV sequence over sp, stream unchanged."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=2, mesh_sp=2,
+        mesh_devices=eight_devices[0:4],
+    )
+    hi = ShardCompute(
+        tiny_llama_dir, [2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32",
+    )
+    ids = [256, 72, 101, 108]
+    assert _drive_ring([lo, hi], ids, 5) == _ref_tokens(tiny_llama_dir, ids, 5)

@@ -173,3 +173,71 @@ def test_deal_rounds_uneven():
     for dev in rounds:
         for ch in dev:
             assert ch == list(range(ch[0], ch[0] + len(ch)))
+
+
+def test_leftover_chips_become_sp():
+    """A 4-chip host serving a 2-kv-head model clamps tp to 2 and turns the
+    two leftover chips into a sequence-parallel axis instead of idling."""
+    from dnet_tpu.core.types import DeviceInfo
+    from dnet_tpu.parallel.solver import ModelProfile, solve_topology
+
+    devs = [
+        DeviceInfo(
+            instance=f"s{i}", host=f"h{i}", http_port=1, grpc_port=2,
+            chip_count=4, flops_bf16=1e12, hbm_bw=1e11, host_to_hbm_bw=1e10,
+            hbm_bytes=16 << 30, host_ram_bytes=64 << 30,
+        )
+        for i in range(2)
+    ]
+    m = ModelProfile(
+        model_id="m", num_layers=8, layer_bytes=50 << 20,
+        layer_flops_per_token=1e8, kv_bytes_per_token_per_layer=1024,
+        seq_len=4096, tp_heads=2,
+    )
+    topo = solve_topology(devs, m)
+    for a in topo.assignments:
+        assert a.mesh_tp == 2 and a.mesh_sp == 2, (a.mesh_tp, a.mesh_sp)
+
+
+def test_sp_skipped_when_seq_not_divisible():
+    from dnet_tpu.core.types import DeviceInfo
+    from dnet_tpu.parallel.solver import ModelProfile, solve_topology
+
+    devs = [
+        DeviceInfo(
+            instance="s0", host="h0", http_port=1, grpc_port=2,
+            chip_count=4, flops_bf16=1e12, hbm_bw=1e11, host_to_hbm_bw=1e10,
+            hbm_bytes=16 << 30, host_ram_bytes=64 << 30,
+        )
+    ]
+    m = ModelProfile(
+        model_id="m", num_layers=8, layer_bytes=50 << 20,
+        layer_flops_per_token=1e8, kv_bytes_per_token_per_layer=1024,
+        seq_len=4095, tp_heads=2,  # 4095 % 2 != 0: sp must stay 1
+    )
+    topo = solve_topology(devs, m)
+    a = topo.assignments[0]
+    assert a.mesh_tp == 2 and a.mesh_sp == 1  # explicit single, never "shard default"
+
+
+def test_sp_picks_largest_divisor():
+    """6 chips, 2 kv heads, seq 4096: tp=2 and sp=2 (not 3, which doesn't
+    divide the sequence) — partial spare beats idling all of it."""
+    from dnet_tpu.core.types import DeviceInfo
+    from dnet_tpu.parallel.solver import ModelProfile, solve_topology
+
+    devs = [
+        DeviceInfo(
+            instance="s0", host="h0", http_port=1, grpc_port=2,
+            chip_count=6, flops_bf16=1e12, hbm_bw=1e11, host_to_hbm_bw=1e10,
+            hbm_bytes=16 << 30, host_ram_bytes=64 << 30,
+        )
+    ]
+    m = ModelProfile(
+        model_id="m", num_layers=8, layer_bytes=50 << 20,
+        layer_flops_per_token=1e8, kv_bytes_per_token_per_layer=1024,
+        seq_len=4096, tp_heads=2,
+    )
+    topo = solve_topology(devs, m)
+    a = topo.assignments[0]
+    assert a.mesh_tp == 2 and a.mesh_sp == 2
