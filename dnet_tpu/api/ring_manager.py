@@ -121,6 +121,7 @@ class RingModelManager:
         t0 = time.perf_counter()
         by_instance = {d.instance: d for d in topo.devices}
         max_seq = max_seq or self.max_seq
+        spec = self._spec_lookahead_for(topo, model_dir, max_seq)
 
         async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
             for a in topo.assignments:
@@ -149,6 +150,10 @@ class RingModelManager:
                     # rather than failing every shard load.
                     "mesh_tp": a.mesh_tp,
                     "mesh_sp": self._check_sp(a, max_seq),
+                    # ring speculation: head drafts, tail verifies
+                    # (0 when the topology/model can't rewind — see
+                    # _spec_lookahead_for)
+                    "spec_lookahead": spec,
                 }
                 url = f"http://{dev.host}:{dev.http_port}/load_model"
                 r = await client.post(url, json=body)
@@ -184,6 +189,45 @@ class RingModelManager:
         dt = time.perf_counter() - t0
         log.info("ring model %s loaded across %d shard(s) in %.1fs", model_id, len(topo.assignments), dt)
         return dt
+
+    def _spec_lookahead_for(self, topo, model_dir, max_seq: int) -> int:
+        """Ring speculation preconditions the API can check up front: a
+        configured lookahead, a single-round non-streaming topology, and a
+        rewind-safe cache layout.  Shards still re-check their own
+        invariants at load."""
+        from dnet_tpu.config import get_settings
+
+        L = get_settings().api.spec_lookahead
+        if L <= 0:
+            return 0
+        if any(
+            len(_contiguous_runs(a.layers)) > 1 or a.window_size > 0
+            for a in topo.assignments
+        ):
+            log.info("ring speculation off: k-round or streaming topology")
+            return 0
+        try:
+            import json
+            from pathlib import Path
+
+            from dnet_tpu.models import ModelConfig, get_ring_model_cls
+
+            cfg = ModelConfig.from_hf(
+                json.loads((Path(model_dir) / "config.json").read_text())
+            )
+            model = get_ring_model_cls(cfg.model_type)(
+                cfg, range(cfg.num_hidden_layers)
+            )
+            if not model.kv_rewindable(max_seq):
+                log.info(
+                    "ring speculation off: %s cache cannot rewind",
+                    cfg.model_type,
+                )
+                return 0
+        except Exception as exc:
+            log.warning("ring speculation off (model probe failed: %s)", exc)
+            return 0
+        return L
 
     @staticmethod
     def _check_sp(a, max_seq: int) -> int:

@@ -73,6 +73,35 @@ def ngram_draft(
     return jnp.where(found[:, None], cont, fallback)
 
 
+def ngram_draft_np(hist, pos: int, lookahead: int, ngram: int = 2):
+    """Host-side single-lane prompt-lookup draft (numpy), used by the gRPC
+    ring's HEAD shard where the history lives host-side: same semantics as
+    `ngram_draft` — most recent earlier occurrence of the trailing `ngram`,
+    propose what followed; no match degrades to repeating the last token."""
+    import numpy as np
+
+    hist = np.asarray(hist)
+    if pos < ngram + 1:
+        return np.full(lookahead, int(hist[max(pos - 1, 0)]), dtype=np.int64)
+    key = hist[pos - ngram : pos]
+    best = -1
+    # candidate windows must END at or before the key starts (j + ngram <=
+    # pos - ngram), matching the device version's validity mask exactly
+    for j in range(pos - 2 * ngram, -1, -1):  # latest match wins
+        if np.array_equal(hist[j : j + ngram], key):
+            best = j
+            break
+    if best < 0:
+        return np.full(lookahead, int(hist[pos - 1]), dtype=np.int64)
+    start = best + ngram
+    cont = hist[start : start + lookahead]
+    if len(cont) < lookahead:
+        cont = np.concatenate(
+            [cont, np.full(lookahead - len(cont), int(hist[pos - 1]))]
+        )
+    return cont.astype(np.int64)
+
+
 def accept_drafts(preds: jnp.ndarray, drafts: jnp.ndarray):
     """Greedy acceptance: how far do the model's own argmaxes agree?
 

@@ -77,6 +77,13 @@ class ActivationMessage:
     # continuation the adapter should inject at the head
     auto_steps: int = 0
     cont: Optional[tuple] = None
+    # ring speculation: drafts ride a widened verify block head -> tail;
+    # committed tokens ride the continuation tail -> head (hist commit);
+    # extra_finals [(seq, token_id), ...] are the block's additional
+    # accepted tokens, delivered as separate API callbacks by the adapter
+    drafts: list = field(default_factory=list)
+    committed: list = field(default_factory=list)
+    extra_finals: Optional[list] = None
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0

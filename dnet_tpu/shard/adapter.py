@@ -147,6 +147,7 @@ class RingAdapter:
             decoding=_decoding_dict(msg),
             t_sent=time.time(),
             auto_steps=msg.auto_steps,
+            drafts=list(msg.drafts),
         )
         await streams.send(msg.nonce, frame)
 
@@ -188,10 +189,17 @@ class RingAdapter:
         )
         t0 = time.perf_counter()
         await client.send_token(payload)
+        # a verify block's additionally accepted tokens (ring speculation):
+        # one callback per step, in step order behind the primary
+        for step, token_id in msg.extra_finals or ():
+            await client.send_token(
+                TokenPayload(nonce=msg.nonce, step=step, token_id=int(token_id))
+            )
         log.info(
-            "[PROFILE] token step=%d nonce=%s rpc=%.2fms",
+            "[PROFILE] token step=%d nonce=%s n=%d rpc=%.2fms",
             msg.seq,
             msg.nonce,
+            1 + len(msg.extra_finals or ()),
             (time.perf_counter() - t0) * 1e3,
         )
 
@@ -232,6 +240,7 @@ class RingAdapter:
             callback_url=msg.callback_url,
             decoding=_decoding_dict(msg),
             auto_steps=steps,
+            committed=list(msg.committed),
             t_sent=time.time(),
         )
         streams = self._ensure_next()

@@ -46,6 +46,7 @@ class ShardCompute:
         mesh_tp: int = 1,
         mesh_sp: int = 1,
         mesh_devices: Optional[Sequence] = None,
+        spec_lookahead: int = 0,
     ) -> None:
         from dnet_tpu.core.kvcache import resolve_kv_bits
 
@@ -118,6 +119,20 @@ class ShardCompute:
         self.compress_frac = compress_frac
         # 8 -> qsparse8_v1 (int8-affine kept columns), 0 -> sparse_v1
         self.compress_quant_bits = t.compress_quant_bits
+        # ring speculation (composed with decode grants): the HEAD widens
+        # granted continuation entries into [tok, drafts] verify blocks
+        # (prompt-lookup against a host-side history), the TAIL verifies
+        # the block's argmaxes and emits the accepted prefix.  The API's
+        # load fan-out only enables this on single-round, non-streaming,
+        # rewind-safe rings; each shard re-checks its own invariants.
+        self.spec_lookahead = int(spec_lookahead)
+        self._spec_ok = (
+            self.spec_lookahead > 0
+            and len(self.rounds) == 1
+            and not self.engine.plan.streams_weights
+            and self.engine.model.kv_rewindable(self.engine.max_seq)
+        )
+        self._hist: dict[str, np.ndarray] = {}  # head-side draft history
 
     @property
     def max_layer(self) -> int:
@@ -130,8 +145,10 @@ class ShardCompute:
     def reset(self, nonce: str = "") -> None:
         if nonce:
             self.engine.end_session(nonce)
+            self._hist.pop(nonce, None)
         else:
             self.engine.reset()
+            self._hist.clear()
 
     def _decode_payload(self, msg: ActivationMessage, pos: int):
         """Incoming hidden frame -> padded device array + real length.
@@ -211,6 +228,22 @@ class ShardCompute:
                 )
             sess = eng.new_session(nonce, msg.decoding.seed)
 
+        if msg.is_tokens and self.is_first and self._spec_ok:
+            # HEAD: record entries in the draft history; widen eligible
+            # granted continuations into [tok, drafts] verify blocks
+            msg = self._spec_widen(msg)
+
+        if msg.drafts and self.is_last:
+            # TAIL: a verify block — full-position argmaxes, emit the
+            # accepted prefix (1..L+1 tokens per ring lap).  A single-shard
+            # ring verifies its own widened token block.
+            if not self._spec_ok:
+                raise ValueError(
+                    "verify block arrived but this shard cannot speculate "
+                    "(k rounds, streaming weights, or a rotating cache)"
+                )
+            return self._spec_verify(msg, sess)
+
         if len(self.rounds) > 1:
             return self._process_round(msg, sess)
 
@@ -252,6 +285,98 @@ class ShardCompute:
         sess.last_used = time.time()
         return self._emit(msg, sess, x, T, pos, self.is_last, self.max_layer)
 
+    # ---- ring speculation (head widen / tail verify) -------------------
+    def _spec_widen(self, msg: ActivationMessage) -> ActivationMessage:
+        """HEAD: maintain the nonce's input history and, for an eligible
+        granted continuation (1 greedy token mid-stream), widen it into a
+        [tok, d_1..d_L] verify block with prompt-lookup drafts."""
+        from dnet_tpu.core.spec import ngram_draft_np
+
+        ids = msg.tokens().reshape(-1)
+        pos = msg.pos
+        hist = self._hist.get(msg.nonce)
+        if hist is None or pos == 0:
+            hist = np.zeros(self.engine.max_seq, dtype=np.int64)
+            self._hist[msg.nonce] = hist
+        k = len(msg.committed)
+        if k:  # the previous block's accepted tokens, in input positions
+            hist[pos - k + 1 : pos + 1] = msg.committed
+        end = min(pos + len(ids), len(hist))
+        hist[pos:end] = ids[: end - pos]
+        dec = msg.decoding
+        L = self.spec_lookahead
+        if not (
+            msg.auto_steps > 0
+            and len(ids) == 1
+            and pos > 0
+            and dec.temperature == 0.0
+            and not dec.logprobs
+            and dec.repetition_penalty == 1.0
+            and not dec.logit_bias
+            and pos + L + 1 <= self.engine.max_seq
+        ):
+            return msg
+        drafts = ngram_draft_np(hist, pos + 1, L)
+        hist[pos + 1 : pos + 1 + L] = drafts  # speculative; commits overwrite
+        block = np.concatenate([ids, drafts]).astype(np.int32)[None, :]
+        msg.data = block.tobytes()
+        msg.shape = block.shape
+        msg.drafts = [int(d) for d in drafts]
+        return msg
+
+    def _spec_verify(self, msg: ActivationMessage, sess) -> ActivationMessage:
+        """TAIL: run the verify block through this window, take argmaxes at
+        every real position, emit the agreeing prefix + first correction
+        (clamped to the grant), and hand the accepted tokens back to the
+        head via the continuation for its history."""
+        eng = self.engine
+        pos = msg.pos
+        if msg.is_tokens:  # single-shard ring: embed the widened block here
+            tokens, T = self._embed_tokens(msg, pos)
+            x = eng.model.embed(eng.edge_params, tokens)
+        else:
+            x, T = self._decode_payload(msg, pos)
+        x, sess.kv = eng._hidden(
+            eng.window_params, x, sess.kv, jnp.int32(pos), jnp.int32(T)
+        )
+        h = eng.model.normalize(eng.edge_params, x[:, :T])
+        logits = eng.model.lm_project(eng.edge_params, h)  # [1, T, V]
+        preds = np.asarray(jnp.argmax(logits, axis=-1))[0].astype(np.int64)
+        drafts = np.asarray(msg.drafts, dtype=np.int64)
+        agree = preds[: len(drafts)] == drafts
+        n_accept = int(np.argmin(np.concatenate([agree, [False]]).astype(np.int32)))
+        # this frame's OWN token (step seq) is free — it was granted by the
+        # frame that injected it; only the extras consume the running grant
+        emitted = min(n_accept + 1, msg.auto_steps + 1)
+        toks = [int(t) for t in preds[:emitted]]
+        stops = tuple(msg.decoding.stop_token_ids or ())
+        for i, t in enumerate(toks):  # truncate at EOS: later tokens are dead
+            if t in stops:
+                toks = toks[: i + 1]
+                break
+        emitted = len(toks)
+        sess.pos = pos + emitted
+        sess.last_used = time.time()
+        out = ActivationMessage(
+            nonce=msg.nonce,
+            layer_id=self.max_layer,
+            seq=msg.seq,
+            dtype="token",
+            shape=(1,),
+            pos=pos,
+            callback_url=msg.callback_url,
+            decoding=msg.decoding,
+            is_final=True,
+            token_id=toks[0],
+            extra_finals=[(msg.seq + i, toks[i]) for i in range(1, emitted)],
+        )
+        remaining = msg.auto_steps - (emitted - 1) - 1  # extras, then the
+        # next continuation's own token, both come out of this grant
+        if remaining >= 0 and toks[-1] not in stops and sess.pos < eng.max_seq:
+            out.cont = (toks[-1], sess.pos, remaining, msg.seq + emitted)
+            out.committed = toks  # input positions pos+1 .. pos+emitted
+        return out
+
     def _emit(
         self, msg: ActivationMessage, sess, x, T: int, pos: int,
         is_tail: bool, out_layer: int,
@@ -291,8 +416,10 @@ class ShardCompute:
             pos=pos,
             callback_url=msg.callback_url,
             decoding=msg.decoding,
-            # the decode grant must reach the TAIL: it rides every hop
+            # the decode grant (and any verify drafts) must reach the TAIL:
+            # they ride every hop
             auto_steps=msg.auto_steps,
+            drafts=list(msg.drafts),
         )
 
     def _final_message(self, msg: ActivationMessage, res, sess) -> ActivationMessage:
@@ -330,7 +457,14 @@ class ShardCompute:
         return out
 
     def sweep_sessions(self) -> int:
-        return self.engine.sweep_sessions()
+        n = self.engine.sweep_sessions()
+        if self._hist:
+            # prune draft histories whose session died (TTL sweep, failed
+            # reset RPC): each entry pins a max_seq int64 array
+            live = self.engine.sessions
+            for nonce in [k for k in self._hist if k not in live]:
+                self._hist.pop(nonce, None)
+        return n
 
     def health(self) -> dict:
         return {

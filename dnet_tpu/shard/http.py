@@ -43,6 +43,9 @@ class ShardLoadModelRequest(BaseModel):
     # 0 = use the shard's own DNET_SHARD_MESH_* defaults; -1 tp = all chips
     mesh_tp: int = 0
     mesh_sp: int = 0
+    # ring speculation (head drafts / tail verifies, shard/compute.py);
+    # the API only sets this on single-round rewind-safe rings
+    spec_lookahead: int = 0
 
 
 class MeasureLatencyRequest(BaseModel):

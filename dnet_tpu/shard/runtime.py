@@ -70,6 +70,7 @@ class ShardRuntime:
         weight_quant_bits: int = 0,
         mesh_tp: int = 1,
         mesh_sp: int = 1,
+        spec_lookahead: int = 0,
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
@@ -91,6 +92,7 @@ class ShardRuntime:
                 weight_quant_bits=weight_quant_bits,
                 mesh_tp=mesh_tp,
                 mesh_sp=mesh_sp,
+                spec_lookahead=spec_lookahead,
             )
             self.model_path = str(model_dir)
             log.info(

@@ -61,6 +61,7 @@ class Shard:
                 # its chip count better than the API node does)
                 mesh_tp=req.mesh_tp or get_settings().shard.mesh_tp,
                 mesh_sp=req.mesh_sp or get_settings().shard.mesh_sp,
+                spec_lookahead=req.spec_lookahead,
                 # engine ignores it unless plan_policy chose a streaming
                 # policy — no second copy of that decision here
                 repack_dir=get_settings().shard.repack_dir,

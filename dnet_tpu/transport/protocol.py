@@ -64,6 +64,11 @@ class ActivationFrame:
     t_sent: float = 0.0
     # decode grant: tokens the tail may self-continue without an API hop
     auto_steps: int = 0
+    # ring speculation: drafted token ids riding a widened verify block
+    # (head -> tail), and the block's accepted tokens riding the
+    # continuation (tail -> head, committed to the head's draft history)
+    drafts: List[int] = field(default_factory=list)
+    committed: List[int] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         d = asdict(self)
@@ -89,6 +94,8 @@ class ActivationFrame:
             callback_url=self.callback_url,
             decoding=dec,
             auto_steps=self.auto_steps,
+            drafts=list(self.drafts),
+            committed=list(self.committed),
         )
 
 

@@ -55,6 +55,10 @@ def cluster(tiny_llama_dir, tmp_path_factory):
         # driving its local ICI slice
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
         "DNET_API_PARAM_DTYPE": "float32",
+        # ring speculation rides the decode grants on every greedy request
+        # in this module: the determinism/equality assertions below verify
+        # the composed path end to end over real gRPC
+        "DNET_API_SPEC_LOOKAHEAD": "4",
         "DNET_LOG_TO_FILE": "0",
     }
     # shards resolve the model path directly (absolute), no models_dir needed

@@ -420,3 +420,70 @@ def test_failed_continuation_fails_fast(tiny_llama_dir):
             await ring.stop()
 
     asyncio.run(go())
+
+
+def test_ring_speculation_matches_and_saves_laps(tiny_llama_dir):
+    """Grants + speculation composed: the head widens continuations into
+    verify blocks, the tail emits 1..L+1 tokens per ring lap — the greedy
+    stream equals LocalEngine token for token, in FEWER ring laps."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    ids = [7, 3, 11, 7, 3, 11, 7, 3]  # repetitive: drafts accept
+    eng = LocalEngine(tiny_llama_dir, max_seq=128, param_dtype="float32")
+    n = 12
+    expected = [
+        r.token_id
+        for r in eng.generate(ids, DecodingParams(temperature=0.0), max_tokens=n)
+    ]
+    eng.close()
+
+    async def go():
+        ring = Ring(tiny_llama_dir)
+        await ring.start()
+        # spec-enable both shards (the API's load fan-out would set this)
+        for rt in (ring.s0, ring.s1):
+            rt.compute.spec_lookahead = 4
+            rt.compute._spec_ok = True
+        ring.a1.configure_topology("s0:1")
+        continuations = []
+        orig_to_s0 = ring._to_s0
+
+        async def counting_to_s0(frame):
+            continuations.append(frame)
+            return await orig_to_s0(frame)
+
+        ring.a1._make_ring_client = lambda addr: FakeRingClient(
+            addr, on_frame=counting_to_s0
+        )
+        try:
+            api = RingApiAdapter(
+                head_addr="s0:1",
+                callback_url="grpc://api:1",
+                shard_grpc_addrs=["s0:1", "s1:1"],
+                ring_client_factory=lambda addr: FakeRingClient(
+                    addr, on_frame=lambda f: _ingress_ack(ring.a0, f)
+                ),
+                max_seq_len=128,
+                auto_steps=16,
+            )
+            await api.start()
+            got = []
+            dec = DecodingParams(temperature=0.0)
+            send = list(ids)
+            for step in range(n):
+                await api.send_tokens("sp1", send, dec, step, budget=n - step)
+                payload = await _wait_token(ring.tokens, step)
+                api.resolve_token(payload.to_result())
+                result = await api.await_token("sp1", step, timeout=15.0)
+                assert not result.error, result.error
+                got.append(result.token_id)
+                send = [result.token_id]
+            assert got == expected
+            # speculation emitted multiple tokens per lap: the tail->head
+            # continuation count must be well under one per generated token
+            assert 0 < len(continuations) < n - 1, len(continuations)
+            await api.shutdown()
+        finally:
+            await ring.stop()
+
+    asyncio.run(go())
