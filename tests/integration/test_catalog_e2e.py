@@ -34,7 +34,12 @@ def test_family_serves_chat(arch, tmp_path):
 
     d = tmp_path / arch
     getattr(checkpoints, FAMILIES[arch])(d)
-    with spawn_api_server(d, env={"DNET_API_MAX_SEQ_LEN": "64"}) as base:
+    # generous readiness: MoE families pay heavy first compiles, and a
+    # loaded machine (parallel CI groups, local concurrent runs) stretches
+    # the startup well past the default window
+    with spawn_api_server(
+        d, env={"DNET_API_MAX_SEQ_LEN": "64"}, ready_timeout_s=300
+    ) as base:
         r = httpx.post(
             base + "/v1/chat/completions",
             json={
