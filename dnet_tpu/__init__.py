@@ -9,7 +9,7 @@ share a slice, or over gRPC/DCN when they do not.  Layer weights stream
 between host DRAM and TPU HBM so models larger than total HBM can run.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 import os as _os
 

@@ -487,3 +487,53 @@ def test_ring_speculation_matches_and_saves_laps(tiny_llama_dir):
             await ring.stop()
 
     asyncio.run(go())
+
+
+def test_seeded_sampling_with_grants_matches_local(tiny_llama_dir):
+    """Stochastic seeded stream under decode grants: grant-driven steps use
+    the tail's same per-session key chain as API-driven steps, so the ring
+    equals LocalEngine for the same seed (speculation correctly skips
+    sampled requests)."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    ids = [256, 72, 105]
+    dec = DecodingParams(temperature=0.9, top_p=0.9, seed=77)
+    eng = LocalEngine(tiny_llama_dir, max_seq=64, param_dtype="float32")
+    expected = [r.token_id for r in eng.generate(ids, dec, max_tokens=6)]
+    eng.close()
+
+    async def go():
+        ring = Ring(tiny_llama_dir)
+        await ring.start()
+        for rt in (ring.s0, ring.s1):  # spec enabled but ineligible (sampled)
+            rt.compute.spec_lookahead = 4
+            rt.compute._spec_ok = True
+        ring.a1.configure_topology("s0:1")
+        try:
+            api = RingApiAdapter(
+                head_addr="s0:1",
+                callback_url="grpc://api:1",
+                shard_grpc_addrs=["s0:1", "s1:1"],
+                ring_client_factory=lambda addr: FakeRingClient(
+                    addr, on_frame=lambda f: _ingress_ack(ring.a0, f)
+                ),
+                max_seq_len=64,
+                auto_steps=8,
+            )
+            await api.start()
+            got = []
+            send = list(ids)
+            for step in range(6):
+                await api.send_tokens("rs1", send, dec, step, budget=6 - step)
+                payload = await _wait_token(ring.tokens, step)
+                api.resolve_token(payload.to_result())
+                result = await api.await_token("rs1", step, timeout=10.0)
+                assert not result.error, result.error
+                got.append(result.token_id)
+                send = [result.token_id]
+            assert got == expected
+            await api.shutdown()
+        finally:
+            await ring.stop()
+
+    asyncio.run(go())
