@@ -24,6 +24,7 @@ import json
 import statistics
 import sys
 import time
+from typing import Optional
 
 
 def _measure_fused(model, window, edge, kv, batch: int, n_steps: int = 64) -> float:
@@ -115,6 +116,7 @@ def _measure_served(engine, batch: int) -> dict:
     async def run() -> dict:
         await adapter.start()
         metrics = []
+        prompt_tokens = 0
         for i in range(4):  # request 0 is the compile warmup
             r = await manager.generate(req)
             if i > 0:
@@ -122,13 +124,36 @@ def _measure_served(engine, batch: int) -> dict:
                     f"expected {max_tokens} tokens, got {r.usage.completion_tokens}"
                 )
                 metrics.append(r.metrics)
+                prompt_tokens = r.usage.prompt_tokens
         await adapter.shutdown()
         return {
             "tok_s": statistics.median(m.tps_decoding for m in metrics),
             "ttft_p50_ms": statistics.median(m.ttfb_ms for m in metrics),
+            # mean live context during decode, for the MFU attention term
+            "mean_ctx": prompt_tokens + max_tokens // 2,
         }
 
     return asyncio.run(run())
+
+
+def _emit(out: dict, diagnostics: Optional[dict] = None) -> None:
+    """Final result emission.  ONE compact JSON line on stdout — the driver
+    parses exactly (and only) the last stdout line, and r4's attempts array
+    grew past its capture window ("parsed": null).  Diagnostics (attempt
+    logs, tracebacks, env dumps) go to stderr and a BENCH_DIAG.json side
+    file instead, so they stay in the artifact trail without ever touching
+    the parsed line."""
+    diagnostics = diagnostics or out.pop("diagnostics", None)
+    out.pop("diagnostics", None)
+    if diagnostics:
+        payload = json.dumps({"diagnostics": diagnostics})
+        print(payload, file=sys.stderr)
+        try:
+            with open("BENCH_DIAG.json", "w") as f:
+                f.write(payload)
+        except OSError:
+            pass
+    print(json.dumps(out))
 
 
 def _diagnostics(exc=None) -> dict:
@@ -291,20 +316,21 @@ def _orchestrate() -> None:
             out = json.loads(line)
         except Exception as exc:
             out = {"error": f"bench under {name} failed: {exc}"[:500]}
-        out.setdefault("diagnostics", {})
-        out["diagnostics"]["attempts"] = attempts
-        out["diagnostics"]["init_strategy"] = name
-        print(json.dumps(out))
+        diag = out.pop("diagnostics", {}) or {}
+        diag["attempts"] = attempts
+        out["init_strategy"] = name
+        _emit(out, diag)
         raise SystemExit(0 if "value" in out else 1)
     # no strategy reached an accelerator: CPU fallback, with the full
     # attempt log attached (>= 3 diagnosed strategies, VERDICT r3 next #2)
     inner = _cpu_fallback_number()
+    diag = {**_diagnostics(), "attempts": attempts}
+    diag.update(inner.pop("diagnostics", {}) or {})
     out = {
         **inner,
         "tpu_error": "no accelerator-init strategy succeeded",
-        "diagnostics": {**_diagnostics(), "attempts": attempts},
     }
-    print(json.dumps(out))
+    _emit(out, diag)
     raise SystemExit(0 if "value" in out else 1)
 
 
@@ -493,9 +519,7 @@ def main() -> None:
     if batch > 1:
         metric += f"_b{batch}"
     dev = jax.devices()[0]
-    hbm_bw = {"v5e": 819e9, "v5litepod": 819e9, "v6e": 1640e9, "v4": 1228e9}.get(
-        _chip_gen(dev), 819e9
-    )
+    hbm_bw, peak_flops = CHIP_SPECS[_chip_gen(dev)]
     # weight-bound decode bound: weights are read once per STEP, so N batch
     # lanes share one read — the aggregate bound scales with batch; a mesh
     # splits the read across its chips (each reads only its shard)
@@ -514,6 +538,25 @@ def main() -> None:
     else:
         vs_baseline = round(tok_s / fused_tok_s, 4)
         basis = "own_fused_ceiling_cpu"
+    # MFU: model FLOPs/token from the config (2 MACs per weight in every
+    # matmul + the two attention matmuls over the mean live context of the
+    # served run), against the chip generation's bf16 peak on TPU — or
+    # against THIS device's measured matmul rate on the CPU fallback, so
+    # the number never pretends a CPU run hit TPU silicon.  Decode is
+    # HBM-bound, so single-chip decode MFU is expected to be small; the
+    # point is roofline context the driver can judge, not a big number.
+    fpt = _flops_per_token(cfg, mean_ctx=served["mean_ctx"])
+    if on_accel:
+        mfu = tok_s * fpt / (n_chips * peak_flops)
+        mfu_basis = "chip_peak_bf16"
+    else:
+        from dnet_tpu.parallel.profiler import profile_device_quick
+
+        # the forced-host "devices" of a CPU mesh share one host's cores,
+        # and profile_device_quick already measures the whole host — no
+        # per-chip multiply here
+        mfu = tok_s * fpt / profile_device_quick()["flops_bf16"]
+        mfu_basis = "measured_matmul_cpu"
     out = {
         "metric": metric,
         "value": round(tok_s, 2),
@@ -524,13 +567,36 @@ def main() -> None:
         "serve_vs_fused": round(tok_s / fused_tok_s, 4),
         "ttft_p50_ms": round(served["ttft_p50_ms"], 1),
         "device": getattr(dev, "device_kind", "") or jax.default_backend(),
+        "flops_per_token": int(fpt),
+        "mfu": round(mfu, 6),
+        "mfu_basis": mfu_basis,
     }
     out.update(flash_dec)
     if "--smoke" in sys.argv:
         out.update(_compress_microbench())
         if mesh_cfg is None:
             out.update(_spec_microbench(cfg, window, edge, max_seq))
-    print(json.dumps(out))
+    _emit(out)
+
+
+def _flops_per_token(cfg, mean_ctx: int) -> float:
+    """Model FLOPs per decoded token from the config alone (2 FLOPs per
+    weight in every matmul — qkv/o/mlp per layer plus the lm head — and
+    the two attention matmuls QK^T and PV over the mean live context).
+    Independent of weight quantization: int8/int4 packing changes bytes
+    read, not MACs performed.  Ref self-metrics analog:
+    /root/reference/src/dnet/api/inference.py:216-233 (tokens/sec); this
+    adds the FLOPs numerator the MFU judgment needs."""
+    h = cfg.hidden_size
+    H = cfg.num_attention_heads
+    KVH = cfg.num_key_value_heads
+    Hd = cfg.head_dim
+    qkv = h * (H * Hd + 2 * KVH * Hd)
+    o = H * Hd * h
+    mlp = 3 * h * cfg.intermediate_size
+    per_layer = 2 * (qkv + o + mlp) + 4 * mean_ctx * H * Hd
+    lm_head = 2 * h * cfg.vocab_size
+    return float(cfg.num_hidden_layers * per_layer + lm_head)
 
 
 def _flash_decode_microbench() -> dict:
@@ -698,6 +764,16 @@ def _compress_microbench() -> dict:
         out[f"{name}_recv_device_ms"] = round(dev_ms, 2)
         out[f"{name}_ratio"] = round(x.nbytes / len(p), 2)
     return out
+
+
+# one row per chip generation: (HBM bandwidth B/s, bf16 peak FLOP/s) —
+# _chip_gen falls back to v5e, so every lookup is total
+CHIP_SPECS = {
+    "v6e": (1640e9, 918e12),
+    "v5e": (819e9, 197e12),
+    "v5litepod": (819e9, 197e12),
+    "v4": (1228e9, 275e12),
+}
 
 
 def _chip_gen(dev) -> str:
