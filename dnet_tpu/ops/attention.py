@@ -113,9 +113,10 @@ def cached_attend(
     if causal:
         # sp decode with the plain causal predicate: the split-K Pallas
         # kernel computes per-rank (acc, m, l) partials before the LSE
-        # combine.  Real-TPU only — interpret-mode pallas inside shard_map
-        # trips jax's vma tracking (ops/flash_decode.py) — with the dense
-        # distributed flash-decoding everywhere else.
+        # combine — on TPU as the real kernel (declared output vma), under
+        # DNET_FLASH_INTERPRET=1 as the jnp tile-fold emulation (pallas
+        # interpret inside shard_map is broken; ops/flash_decode.py), with
+        # the dense distributed flash-decoding everywhere else.
         from dnet_tpu.ops.flash_decode import (
             sp_flash_decode_attend,
             sp_flash_eligible,

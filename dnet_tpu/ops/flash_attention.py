@@ -101,10 +101,10 @@ def _flash_kernel(pos_ref, sink_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("G", "scale", "bq", "bk", "interpret")
+    jax.jit, static_argnames=("G", "scale", "bq", "bk", "interpret", "vma")
 )
 def _flash_pallas(q, k, v, pos, sinks, *, G: int, scale: float, bq: int,
-                  bk: int, interpret: bool):
+                  bk: int, interpret: bool, vma: tuple = ()):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -112,6 +112,9 @@ def _flash_pallas(q, k, v, pos, sinks, *, G: int, scale: float, bq: int,
     S = k.shape[1]
     Vd = v.shape[-1]
     n_s = S // bk
+    # inside shard_map the output is device-varying over the inputs' mesh
+    # axes; check_vma requires the declaration (vma=() outside shard_map)
+    kw = {"vma": frozenset(vma)} if vma else {}
 
     # grid (batch, head, q-tile, kv-tile); kv-tile LAST so the scratch
     # accumulator carries across its (sequential) iterations
@@ -134,7 +137,7 @@ def _flash_pallas(q, k, v, pos, sinks, *, G: int, scale: float, bq: int,
         ],
         out_specs=pl.BlockSpec((1, bq, 1, Vd), lambda b, h, tq, s: (b, tq, h, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, T, H, Vd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, T, H, Vd), q.dtype, **kw),
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -142,6 +145,63 @@ def _flash_pallas(q, k, v, pos, sinks, *, G: int, scale: float, bq: int,
         ],
         interpret=interpret,
     )(pos, sinks, q, k, v)
+
+
+def _flash_emulate(q, k, v, pos, sinks, *, scale: float, bk: int):
+    """Plain-jnp twin of _flash_kernel: the same tile-by-tile online-softmax
+    fold (f32, same operation order), for executed coverage where pallas
+    cannot run — interpret mode inside shard_map discharges the kernel to a
+    jaxpr whose constants stay vma-invariant (r4 diagnosis), so CPU mesh
+    tests and dryruns run this emulation; real TPU runs the kernel.
+
+    Folding every kv tile (no above-diagonal skip) is exact: tile 0 always
+    holds an attendable key (slot 0 is causal for every row when pos >= 0),
+    so m is finite after the first fold and a fully-masked later tile
+    contributes exp(NEG_INF - m) == 0.0 to l/acc and leaves m unchanged —
+    a bitwise no-op in f32."""
+    from jax import lax
+
+    B, T, H, Hd = q.shape
+    S, KVH = k.shape[1], k.shape[2]
+    G = H // KVH
+    Vd = v.shape[-1]
+    n_s = S // bk
+    qf = q.reshape(B, T, KVH, G, Hd).astype(jnp.float32) * scale
+
+    def fold(carry, s):
+        m, l, acc = carry  # [B,KVH,G,T,1] x2, [B,KVH,G,T,Vd]
+        k_t = lax.dynamic_slice_in_dim(k, s * bk, bk, 1).astype(jnp.float32)
+        v_t = lax.dynamic_slice_in_dim(v, s * bk, bk, 1).astype(jnp.float32)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qf, k_t)  # [B,KVH,G,T,bk]
+        q_pos = pos + jnp.arange(T)[:, None]
+        k_pos = s * bk + jnp.arange(bk)[None, :]
+        scores = jnp.where((k_pos <= q_pos)[None, None, None], scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bkgts,bskd->bkgtd", p, v_t)
+        return (m_new, l, acc), None
+
+    init = (
+        jnp.full((B, KVH, G, T, 1), NEG_INF, jnp.float32),
+        jnp.zeros((B, KVH, G, T, 1), jnp.float32),
+        jnp.zeros((B, KVH, G, T, Vd), jnp.float32),
+    )
+    # the fold's outputs are varying over the inputs' mesh axes; the scan
+    # carry must enter with the same vma (fresh zeros are invariant)
+    axes = _vma_union(q, k, v, pos) or frozenset()
+    if axes:
+        init = tuple(
+            lax.pcast(x, tuple(sorted(axes)), to="varying") for x in init
+        )
+    (m, l, acc), _ = lax.scan(fold, init, jnp.arange(n_s))
+    sink = sinks.astype(jnp.float32).reshape(KVH, G)[None, :, :, None, None]
+    m_fin = jnp.maximum(m, sink)
+    corr = jnp.exp(m - m_fin)
+    l_fin = l * corr + jnp.exp(sink - m_fin)
+    out = acc * corr / jnp.maximum(l_fin, 1e-30)  # [B,KVH,G,T,Vd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, Vd).astype(q.dtype)
 
 
 def _pick_tile(n: int, target: int) -> int:
@@ -155,31 +215,63 @@ def _interpret() -> bool:
     return os.environ.get("DNET_FLASH_INTERPRET", "") in {"1", "true"}
 
 
-def _under_manual_mesh() -> bool:
-    """True when tracing inside shard_map (mesh ring / mesh-shard programs).
+_PROBE_WARNED = False
 
-    pallas_call outputs under check_vma shard_map must declare their
-    varying axes, which these kernels' implicit seams don't — the flash
-    paths fall back to the dense ops there (exactly r3's behavior) rather
-    than failing the whole mesh program's trace.  The explicit sp
-    composition (sp_flash_decode_attend) declares its vma and bypasses
-    this gate."""
+
+def _under_manual_mesh():
+    """True when tracing inside shard_map (mesh ring / mesh-shard programs),
+    False outside, None when the probe itself fails.
+
+    Inside shard_map the kernels still run (r5): pallas_call outputs carry
+    explicit vma declarations derived from the inputs' varying axes
+    (`_vma_union`), and interpret mode — where pallas under shard_map is
+    fundamentally broken (discharged-jaxpr constants stay vma-invariant) —
+    runs the plain-jnp tile-fold emulation instead.  None makes callers
+    fail CLOSED to the dense ops with ONE logged warning (the probe API is
+    private-ish; a silent False after a jax upgrade would be an invisible
+    perf cliff, a silent True a permanent kernel blackout)."""
+    global _PROBE_WARNED
     try:
         return bool(jax.sharding.get_abstract_mesh().manual_axes)
+    except Exception as exc:
+        if not _PROBE_WARNED:
+            _PROBE_WARNED = True
+            import logging
+
+            logging.getLogger("dnet").warning(
+                "manual-mesh probe failed (%s: %s); flash kernels disabled "
+                "— dense attention serves everywhere", type(exc).__name__, exc
+            )
+        return None
+
+
+def _vma_union(*xs):
+    """Union of the inputs' varying mesh axes (shard_map vma) — what a
+    pallas_call's outputs must declare under check_vma.  None if the probe
+    API is unavailable (callers fall back to dense)."""
+    if not hasattr(jax, "typeof"):
+        return None
+    out = frozenset()
+    try:
+        for x in xs:
+            out |= frozenset(
+                getattr(jax.typeof(jnp.asarray(x)), "vma", frozenset())
+            )
     except Exception:
-        # fail CLOSED: if this probe breaks (the API is private-ish), the
-        # dense ops serve everywhere — slower, but a trace-time vma crash
-        # inside a mesh program would take serving down entirely
-        return True
+        return None
+    return out
 
 
 def flash_eligible(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
     """Kernel preconditions: GQA-divisible heads, tileable T/S, and a TPU
     backend (or the test override forcing interpret mode).  V's head dim
-    may differ from Q/K's (MLA)."""
+    may differ from Q/K's (MLA).  Inside shard_map the kernel runs with
+    explicit output vma (or the jnp emulation under interpret); only a
+    broken mesh/vma probe falls back to dense (warned once)."""
     if not _interpret() and jax.default_backend() != "tpu":
         return False
-    if _under_manual_mesh():
+    um = _under_manual_mesh()
+    if um is None or (um and _vma_union(q, k, v) is None):
         return False
     T, H = q.shape[1], q.shape[2]
     S, KVH = k.shape[1], k.shape[2]
@@ -231,6 +323,21 @@ def flash_attend_causal(
         if sinks is None
         else sinks.astype(jnp.float32)
     )
+    if _under_manual_mesh():
+        if _interpret():
+            # CPU mesh tests: pallas-in-shard_map interpret is broken, the
+            # jnp emulation executes the identical fold
+            return _flash_emulate(
+                q, k, v, pos, sink_arr, scale=float(scale),
+                bk=_pick_tile(S, 128),
+            )
+        vset = _vma_union(q, k, v, pos, sink_arr) or frozenset()
+        return _flash_pallas(
+            q, k, v, jnp.asarray([pos], dtype=jnp.int32), sink_arr,
+            G=H // KVH, scale=float(scale),
+            bq=_pick_tile(T, 128), bk=_pick_tile(S, 128),
+            interpret=False, vma=tuple(sorted(vset)),
+        )
     # native layouts throughout: BlockSpec index maps pick head h's KV row
     # h // G directly, so neither the query nor the (much larger) cache is
     # copied/transposed in HBM

@@ -36,7 +36,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from dnet_tpu.ops.flash_attention import _interpret, _pick_tile
+from dnet_tpu.ops.flash_attention import (
+    _interpret,
+    _pick_tile,
+    _under_manual_mesh,
+    _vma_union,
+)
 
 NEG_INF = -1e30
 
@@ -153,12 +158,12 @@ def _decode_kernel(scal_ref, q_ref, k_ref, v_ref, *rest,
 @functools.partial(
     jax.jit,
     static_argnames=("G", "scale", "bk", "window", "rotating", "with_lse",
-                     "interpret", "vma", "qbits"),
+                     "interpret", "vma", "qbits", "scal_varying"),
 )
 def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
                    window: int, rotating: bool, with_lse: bool,
                    interpret: bool, vma: tuple = (), qbits: int = 0,
-                   k_scale=None, v_scale=None):
+                   k_scale=None, v_scale=None, scal_varying: bool = False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -224,12 +229,15 @@ def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
         _decode_kernel, bk=bk, scale=scale, n_s=n_s, window=window,
         rotating=rotating, with_lse=with_lse, qbits=qbits,
     )
-    if vma:
+    if vma and scal_varying:
         assert qbits == 0, "sp flash decode reads a dequantized shard"
-        # inside shard_map the scalars are device-varying, and vma tracking
-        # rejects data-dependent block index maps on varying values — drop
-        # the dead-tile clamp (each rank's S/sp shard is mostly live under
-        # long context) and read the scalars from SMEM instead
+        # sp: the scalars carry a device-varying offset (axis_index), and
+        # vma tracking rejects data-dependent block index maps on varying
+        # values — drop the dead-tile clamp (each rank's S/sp shard is
+        # mostly live under long context) and read the scalars from SMEM
+        # instead.  With INVARIANT scalars (tp/mesh-shard decode) the
+        # prefetch-grid path below keeps the clamp and just declares the
+        # outputs' vma.
         in_specs2 = [
             pl.BlockSpec(memory_space=pltpu.SMEM),  # scalars [2]
             pl.BlockSpec((1, 1, G, Hd), lambda b, kh, s: (b, 0, kh, 0)),
@@ -261,6 +269,98 @@ def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
     )(scalars, q, k, v, *extra_in, sinks)
 
 
+def _decode_emulate(q, k, v, scalars, sinks, *, G: int, scale: float,
+                    bk: int, window: int, rotating: bool, with_lse: bool,
+                    qbits: int = 0, k_scale=None, v_scale=None):
+    """Plain-jnp twin of _decode_kernel: the SAME tile-by-tile online-
+    softmax fold (f32, same operation order, same dead-tile gating), for
+    executed coverage where pallas cannot run — interpret mode inside
+    shard_map discharges the kernel to a jaxpr whose constants stay
+    vma-invariant (r4 diagnosis).  CPU mesh tests, dryruns, and the sp
+    composition's interpret path run this emulation; real TPU runs the
+    kernel.  Dead tiles are gated exactly like the kernel's `tile_live`
+    (an sp rank whose shard lies entirely past `pos` must emit m=NEG_INF,
+    l=0 partials, which fold-all would corrupt)."""
+    from jax import lax
+
+    B, T, H, _ = q.shape
+    S = k.shape[1]
+    KVH = H // G
+    n_s = S // bk
+    Vd = v.shape[-1] * (2 if qbits == 4 else 1)
+    pos = scalars[0]
+    offset = scalars[1]
+    if rotating:
+        live = jnp.minimum(pos + 1, jnp.int32(S))
+    else:
+        live = pos + 1 - offset
+    qf = q[:, 0].reshape(B, KVH, G, -1).astype(jnp.float32) * scale
+
+    def dequant(t, sc):
+        if qbits == 0:
+            return t.astype(jnp.float32)
+        if qbits == 8:
+            return t.astype(jnp.float32) * sc
+        from dnet_tpu.core.kvcache import _unpack_q4
+
+        return _unpack_q4(t) * sc
+
+    def fold(carry, s):
+        m, l, acc = carry
+        k_t = lax.dynamic_slice_in_dim(k, s * bk, bk, 1)
+        v_t = lax.dynamic_slice_in_dim(v, s * bk, bk, 1)
+        ks_t = lax.dynamic_slice_in_dim(k_scale, s * bk, bk, 1) if qbits else None
+        vs_t = lax.dynamic_slice_in_dim(v_scale, s * bk, bk, 1) if qbits else None
+        kf = dequant(k_t, ks_t)  # [B, bk, KVH, Hd]
+        vf = dequant(v_t, vs_t)  # [B, bk, KVH, Vd]
+        scores = jnp.einsum("bkgd,bskd->bkgs", qf, kf)  # [B, KVH, G, bk]
+        slot = s * bk + jnp.arange(bk)
+        if rotating:
+            k_abs = pos - jnp.mod(pos - slot, jnp.int32(S))
+            valid = (k_abs >= 0) & (k_abs > pos - jnp.int32(window))
+        else:
+            k_abs = offset + slot
+            valid = k_abs <= pos
+        scores = jnp.where(valid[None, None, None, :], scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum("bkgs,bskd->bkgd", p, vf)
+        live_t = s * bk < live
+        return (
+            jnp.where(live_t, m_new, m),
+            jnp.where(live_t, l_new, l),
+            jnp.where(live_t, acc_new, acc),
+        ), None
+
+    init = (
+        jnp.full((B, KVH, G, 1), NEG_INF, jnp.float32),
+        jnp.zeros((B, KVH, G, 1), jnp.float32),
+        jnp.zeros((B, KVH, G, Vd), jnp.float32),
+    )
+    # the fold's outputs are varying over the inputs' mesh axes; the scan
+    # carry must enter with the same vma (fresh zeros are invariant)
+    axes = _vma_union(q, k, v, scalars) or frozenset()
+    if axes:
+        init = tuple(
+            lax.pcast(x, tuple(sorted(axes)), to="varying") for x in init
+        )
+    (m, l, acc), _ = lax.scan(fold, init, jnp.arange(n_s))
+    if with_lse:
+        return (
+            acc.reshape(B, 1, H, Vd),
+            m[..., 0],
+            l[..., 0],
+        )
+    sink = sinks.astype(jnp.float32).reshape(KVH, G)[None, :, :, None]
+    m_fin = jnp.maximum(m, sink)
+    corr = jnp.exp(m - m_fin)
+    l_fin = l * corr + jnp.exp(sink - m_fin)
+    out = acc * corr / jnp.maximum(l_fin, 1e-30)
+    return out.reshape(B, 1, H, Vd).astype(q.dtype)
+
+
 def _shape_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     T, H = q.shape[1], q.shape[2]
     S, KVH = k.shape[1], k.shape[2]
@@ -270,34 +370,34 @@ def _shape_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
 def flash_decode_eligible(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     """T=1, GQA-divisible heads, tileable cache length, TPU backend (or the
     DNET_FLASH_INTERPRET test override).  DNET_FLASH_DECODE=0 is the
-    operator kill-switch back to the dense decode path."""
+    operator kill-switch back to the dense decode path.  Inside shard_map
+    (mesh ring / mesh-backed shard programs) the kernel runs with explicit
+    output vma declarations — or the jnp tile-fold emulation under
+    interpret mode; only a broken mesh/vma probe gates to dense (warned
+    once in _under_manual_mesh)."""
     import os
 
     if os.environ.get("DNET_FLASH_DECODE", "1") == "0":
         return False
     if not _interpret() and jax.default_backend() != "tpu":
         return False
-    from dnet_tpu.ops.flash_attention import _under_manual_mesh
-
-    if _under_manual_mesh():
-        # inside shard_map (mesh ring / mesh-backed shard programs) the
-        # kernel's outputs would need explicit vma declarations; the dense
-        # path serves there, the sp composition has its own entry point
+    um = _under_manual_mesh()
+    if um is None or (um and _vma_union(q, k) is None):
         return False
     return _shape_ok(q, k)
 
 
 def sp_flash_eligible(q: jnp.ndarray, k_local: jnp.ndarray) -> bool:
     """Eligibility for the sequence-parallel composition, which runs INSIDE
-    shard_map by construction (it declares its outputs' vma itself) and is
-    real-TPU only (interpret-mode pallas under shard_map trips jax's vma
-    tracking on the kernel body)."""
+    shard_map by construction: the split-K kernel with declared output vma
+    on TPU, the jnp tile-fold emulation under DNET_FLASH_INTERPRET=1 (the
+    LSE combine — pmax/psum — is the same code either way, so CPU mesh
+    tests execute the composition's algebra)."""
     import os
 
     return (
         os.environ.get("DNET_FLASH_DECODE", "1") != "0"
-        and jax.default_backend() == "tpu"
-        and not _interpret()
+        and (jax.default_backend() == "tpu" or _interpret())
         and _shape_ok(q, k_local)
     )
 
@@ -343,6 +443,26 @@ def flash_decode_attend(
     qbits = 0
     if k_scale is not None:
         qbits = 4 if k.dtype == jnp.uint8 else 8
+    if _under_manual_mesh():
+        if _interpret():
+            return _decode_emulate(
+                q, k, v, scalars, sink_arr, G=G, scale=float(scale),
+                bk=_pick_tile(k.shape[1], 256), window=int(window),
+                rotating=bool(rotating), with_lse=False,
+                qbits=qbits, k_scale=k_scale, v_scale=v_scale,
+            )
+        probe = (q, k, v, scalars, sink_arr) + (
+            (k_scale, v_scale) if qbits else ()
+        )
+        vset = _vma_union(*probe) or frozenset()
+        return _decode_pallas(
+            q, k, v, scalars, sink_arr, G=G, scale=float(scale),
+            bk=_pick_tile(k.shape[1], 256), window=int(window),
+            rotating=bool(rotating), with_lse=False, interpret=False,
+            qbits=qbits, k_scale=k_scale, v_scale=v_scale,
+            vma=tuple(sorted(vset)),
+            scal_varying=bool(_vma_union(scalars)),
+        )
     return _decode_pallas(
         q, k, v, scalars, sink_arr, G=G, scale=float(scale),
         bk=_pick_tile(k.shape[1], 256), window=int(window),
@@ -377,11 +497,21 @@ def sp_flash_decode_attend(
         [jnp.asarray(pos, jnp.int32), jnp.asarray(offset, jnp.int32)]
     )
     sink_arr = jnp.full((KVH, G), NEG_INF, dtype=jnp.float32)
-    o, m, l = _decode_pallas(
-        q, k_local, v_local, scalars, sink_arr, G=G, scale=float(scale),
-        bk=_pick_tile(S_local, 256), window=0, rotating=False, with_lse=True,
-        interpret=_interpret(), vma=(axis_name,),
-    )  # o [B,1,H,Vd] unnormalized f32; m/l [B,KVH,G]
+    if _interpret():
+        # CPU mesh coverage: emulated per-rank partials, REAL collectives —
+        # the LSE-combine algebra below executes unchanged
+        o, m, l = _decode_emulate(
+            q, k_local, v_local, scalars, sink_arr, G=G, scale=float(scale),
+            bk=_pick_tile(S_local, 256), window=0, rotating=False,
+            with_lse=True,
+        )
+    else:
+        o, m, l = _decode_pallas(
+            q, k_local, v_local, scalars, sink_arr, G=G, scale=float(scale),
+            bk=_pick_tile(S_local, 256), window=0, rotating=False,
+            with_lse=True, interpret=False, vma=(axis_name,),
+            scal_varying=True,
+        )  # o [B,1,H,Vd] unnormalized f32; m/l [B,KVH,G]
     m_glob = lax.pmax(m, axis_name)
     if sinks is not None:
         sink = sinks.astype(jnp.float32).reshape(KVH, G)[None]

@@ -155,8 +155,8 @@ def test_sp_partials_merge_matches_dense(rng, pos):
     on each half of the KV sequence (offset = rank * S_local) and merge the
     unnormalized partials with the same log-sum-exp combine
     sp_flash_decode_attend performs with pmax/psum.  (The collective form
-    itself is TPU-only: interpret-mode pallas inside shard_map trips jax's
-    vma tracking, so CPU validates the kernel + merge math directly.)"""
+    executes on the CPU mesh too — tests/test_flash_mesh.py — via the
+    tile-fold emulation; this test pins the KERNEL's with_lse partials.)"""
     import jax.numpy as jnp
 
     from dnet_tpu.ops.attention import attend, causal_mask
@@ -289,11 +289,11 @@ def test_engine_stream_quantized_kv(tiny_llama_dir, bits):
     assert got == want
 
 
-def test_manual_mesh_gates_kernel_off(tiny_llama_dir, eight_devices):
-    """Inside shard_map (mesh programs) the implicit flash seams must fall
-    back to dense — pallas outputs there would need explicit vma
-    declarations — so a mesh-shard engine stream with interpret forced on
-    still matches the plain stream (and does not fail the trace)."""
+def test_mesh_shard_engine_stream_with_flash_live(tiny_llama_dir, eight_devices):
+    """Inside shard_map (mesh-backed shard engine) the flash seams now run
+    (r5): the tile-fold emulation under interpret mode, the real kernel
+    with declared output vma on TPU.  The engine stream with interpret
+    forced on must match the plain single-device stream token for token."""
     from dnet_tpu.core.engine import LocalEngine
     from dnet_tpu.core.types import DecodingParams
     from dnet_tpu.parallel.shard_mesh import MeshShardEngine
