@@ -49,7 +49,7 @@ class RingApiAdapter(ApiAdapterBase):
         self._max_seq = max_seq_len
         self._stream_idle_s = stream_idle_s
         self._sweeper: Optional[asyncio.Task] = None
-        self._pos_state: Dict[str, int] = {}  # nonce -> next absolute position
+        self._pos_state: Dict[str, int] = {}  # nonce -> prompt length (pos derives from step)
         self._shard_clients: Dict[str, object] = {}
         # decode grants (ring self-continuation): a frame may authorize the
         # tail shard to feed up to `auto_steps` sampled tokens straight back
@@ -149,19 +149,20 @@ class RingApiAdapter(ApiAdapterBase):
         )
         if auto:
             self._granted[nonce] = step + auto
-            # each granted step appends exactly one token
-            self._pos_state[nonce] = self._pos_state.get(nonce, 0) + auto
         await self._streams.send(nonce, frame)
 
-    # positions: step 0 injects the whole prompt at pos 0; each later step
-    # appends exactly one token.
     def _pos_for(self, nonce: str, step: int, n_tokens: int) -> int:
+        """Step 0 injects the whole prompt at pos 0; every later step
+        appends exactly ONE token, so pos is DERIVED (prompt_len + step - 1)
+        rather than counted.  Grants need no pre-advance bookkeeping, and a
+        grant that halts early (EOS, stop sequence, error) cannot leave a
+        skewed counter behind for later frames — each frame's pos is
+        recomputed from its step."""
         if step == 0:
-            self._pos_state[nonce] = n_tokens
+            self._pos_state[nonce] = n_tokens  # prompt length
             return 0
-        pos = self._pos_state.get(nonce, 0)
-        self._pos_state[nonce] = pos + n_tokens
-        return pos
+        assert n_tokens == 1, "post-prompt frames carry exactly one token"
+        return self._pos_state.get(nonce, 0) + step - 1
 
     async def await_token(self, nonce: str, step: int, timeout: float) -> TokenResult:
         return await self._futures.wait(nonce, step, timeout)

@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--raw", action="store_true",
                    help="feed prompts verbatim (no chat template)")
+    p.add_argument(
+        "--spec", type=int, default=0, metavar="L",
+        help="speculative decoding lookahead (greedy only; 0 = off) — "
+        "works on the local engine and on --mesh engines alike",
+    )
     return p
 
 
@@ -88,12 +93,14 @@ def main(argv=None) -> int:
             pp=mesh_kw.get("pp", 0), tp=mesh_kw.get("tp", 1),
             dp=mesh_kw.get("dp", 1), sp=mesh_kw.get("sp", 1),
             max_seq=args.max_seq, param_dtype=args.param_dtype,
+            spec_lookahead=args.spec,
         )
     else:
         from dnet_tpu.core.engine import LocalEngine
 
         engine = LocalEngine(
-            model_dir, max_seq=args.max_seq, param_dtype=args.param_dtype
+            model_dir, max_seq=args.max_seq, param_dtype=args.param_dtype,
+            spec_lookahead=args.spec,
         )
     tokenizer = load_tokenizer(model_dir)
     dec = DecodingParams(

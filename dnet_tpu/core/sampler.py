@@ -20,9 +20,10 @@ import jax.numpy as jnp
 from dnet_tpu.core.types import DecodingParams
 
 MAX_TOP_LOGPROBS = 20  # static upper bound (OpenAI API max); request slices host-side
-# static per-request logit_bias capacity (OpenAI caps the dict at 300 keys;
-# practical use is a handful — the scatter cost scales with this width)
-MAX_LOGIT_BIAS = 64
+# static per-request logit_bias capacity — the full OpenAI API cap (300
+# keys), so no valid client request is rejected; the scatter cost scales
+# with this width but stays trivial next to a vocab-sized logits row
+MAX_LOGIT_BIAS = 300
 
 
 def encode_logit_bias(bias) -> tuple:

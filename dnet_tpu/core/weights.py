@@ -208,10 +208,15 @@ class WeightCache:
         max_resident: int,
         prefetch_workers: int = 2,
         device=None,
+        put_fn=None,
     ) -> None:
         self.store = store
         self.max_resident = max_resident
         self.device = device
+        # custom host->device placement (host pytree -> device pytree):
+        # mesh-backed shards stream each layer as tp/sp-SHARDED device_puts
+        # (parallel/shard_mesh.py) instead of whole-layer single-chip copies
+        self.put_fn = put_fn
         self._lock = threading.Lock()
         self._futures: Dict[int, Future] = {}  # layer -> Future[device params]
         self._resident: Dict[int, dict] = {}  # layer -> device params
@@ -226,9 +231,12 @@ class WeightCache:
     def _load_to_device(self, layer: int) -> dict:
         host = self.store.layer_host(layer)
         t0 = time.perf_counter()
-        dev = jax.tree.map(
-            lambda v: jax.device_put(_bf16_view(v), self.device), host
-        )
+        if self.put_fn is not None:
+            dev = self.put_fn(jax.tree.map(_bf16_view, host))
+        else:
+            dev = jax.tree.map(
+                lambda v: jax.device_put(_bf16_view(v), self.device), host
+            )
         jax.block_until_ready(dev)
         log.info(
             "[PROFILE] HBM-load layer %d in %.1fms", layer, (time.perf_counter() - t0) * 1e3

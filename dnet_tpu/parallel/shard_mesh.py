@@ -70,6 +70,10 @@ class MeshShardEngine(LocalEngine):
         kv_quant_bits: int = 0,
         weight_quant_bits: int = 0,
         weight_quant_group: int = 0,
+        window_size: int = 0,
+        residency_size: int = 0,
+        repack_dir: Optional[str] = None,
+        spec_lookahead: int = 0,
     ) -> None:
         if tp * sp < 1:
             raise ValueError(f"mesh axes tp={tp} sp={sp} must be positive")
@@ -85,9 +89,13 @@ class MeshShardEngine(LocalEngine):
             kv_dtype=kv_dtype,
             kv_ttl_s=kv_ttl_s,
             shard_mode=True,
+            window_size=window_size,
+            residency_size=residency_size,
+            repack_dir=repack_dir,
             kv_quant_bits=kv_quant_bits,
             weight_quant_bits=weight_quant_bits,
             weight_quant_group=weight_quant_group,
+            spec_lookahead=spec_lookahead,
         )
 
     # quant scale-group divisibility: same fail-fast as the full mesh ring
@@ -120,6 +128,42 @@ class MeshShardEngine(LocalEngine):
             raise NotImplementedError(
                 f"weight quantization not supported for {self.config.model_type}"
             )
+        if self.plan.streams_weights:
+            # streaming x mesh (VERDICT r4 next #2): each window layer
+            # streams host->mesh as tp/sp-SHARDED device_puts — the window
+            # lives across the slice's pooled HBM, not one chip's.  The
+            # host store and residency machinery are LocalEngine's
+            # (core/weights.py); only the placement differs.
+            # Ref prefetch pipeline analog:
+            # /root/reference/src/dnet/shard/policies/offload.py:395-421
+            from dnet_tpu.core.weights import HostLayerStore, WeightCache
+            from dnet_tpu.parallel.mesh import shard_window_params
+
+            store = HostLayerStore(
+                self.ckpt,
+                m,
+                param_dtype=str(self.param_dtype),
+                repack_dir=self._repack_dir,
+                weight_quant_bits=self.weight_quant_bits,
+                weight_quant_group=self.weight_quant_group,
+            )
+            probe = store.layer_host(m.layers[0])
+            if self.weight_quant_bits:
+                self._check_quant_sharding(probe)
+            self._window_specs = window_param_specs(probe)
+            self.weight_cache = WeightCache(
+                store,
+                max_resident=self.plan.residency,
+                put_fn=lambda host: shard_window_params(host, self.mesh),
+            )
+            w = self.plan.window_size
+            self._windows = [
+                m.layers[i : i + w] for i in range(0, len(m.layers), w)
+            ]
+            self.window_params = None
+            self.weight_cache.prefetch(self._windows[0])
+            self._load_edge(t0)
+            return
         per_layer = [m.map_layer(self.ckpt.load_layer_raw(a)) for a in m.layers]
         stacked = m.stack_layers(per_layer)
         if self.weight_quant_bits:
@@ -129,8 +173,15 @@ class MeshShardEngine(LocalEngine):
             )
             self._check_quant_sharding(stacked)
         host_window = jax.tree.map(self._np_cast, stacked)
+        self._window_specs = window_param_specs(host_window)
+        self.window_params, _, _ = place_ring_state(host_window, {}, {}, self.mesh)
+        self._load_edge(t0)
+
+    def _load_edge(self, t0: float) -> None:
+        """Edge load/prune/quantize/place, shared by the resident and
+        streaming branches (pruning identical to LocalEngine._load_params)."""
+        m = self.model
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())
-        # shard-mode edge pruning, identical to LocalEngine._load_params
         tied = self.config.tie_word_embeddings
         if not (m.is_first or (m.is_last and tied)):
             edge_raw.pop("embed", None)
@@ -143,22 +194,15 @@ class MeshShardEngine(LocalEngine):
                 group_size=self.weight_quant_group,
             )
         edge = jax.tree.map(self._np_cast, edge_raw)
-        self._window_specs = window_param_specs(host_window)
-        self.window_params, self.edge_params, _ = place_ring_state(
-            host_window, edge, {}, self.mesh
-        )
+        _, self.edge_params, _ = place_ring_state({}, edge, {}, self.mesh)
         log.info(
-            "[PROFILE] mesh-shard placed %d layers over tp=%d sp=%d in %.2fs",
+            "[PROFILE] mesh-shard %s %d layers over tp=%d sp=%d in %.2fs",
+            "streams" if self.plan.streams_weights else "placed",
             len(m.layers), self.tp, self.sp, time.perf_counter() - t0,
         )
 
     # ---- jitted step functions ---------------------------------------
     def _build_fns(self) -> None:
-        if self.spec_lookahead > 0:
-            raise NotImplementedError(
-                "speculative decoding inside a mesh shard is not wired; "
-                "run spec on the API-side engines"
-            )
         model, mesh = self.model, self.mesh
         sp_axis = AXIS_SP if self.sp > 1 else None
         has_kinds = getattr(model, "layer_kinds", None) is not None
@@ -194,6 +238,39 @@ class MeshShardEngine(LocalEngine):
             return core(window_params, x, kv, pos, t_real, k)
 
         self._hidden = jax.jit(hidden_step, donate_argnums=(2,))
+
+        if self.plan.streams_weights:
+            # streaming feeds _hidden SINGLE-layer trees whose structure can
+            # vary layer to layer (two-segment models wrap each layer as
+            # {"dense": ...} OR {"moe": ...}, models/segments.py:87-89), but
+            # shard_map bakes in_specs at build time — so dispatch on the
+            # incoming tree structure and build one program per structure
+            # (same retrace-on-structure behavior LocalEngine streaming gets
+            # from plain jit)
+            progs: dict = {}
+
+            def hidden_stream(window_params, x, kv, pos, t_real, kinds=None):
+                key = jax.tree.structure(window_params)
+                fn = progs.get(key)
+                if fn is None:
+                    seg_core = jax.shard_map(
+                        window_core, mesh=mesh,
+                        in_specs=(
+                            window_param_specs(window_params),
+                            P(), kvs, P(), P(), P(),
+                        ),
+                        out_specs=out_specs,
+                    )
+
+                    def step(wp, x, kv, pos, t_real, kinds=None, _c=seg_core):
+                        k = kinds if kinds is not None else kinds_arr
+                        return _c(wp, x, kv, pos, t_real, k)
+
+                    fn = jax.jit(step, donate_argnums=(2,))
+                    progs[key] = fn
+                return fn(window_params, x, kv, pos, t_real, kinds)
+
+            self._hidden = hidden_stream
 
         def hidden_round(window_params, x, kv, pos, t_real, lo, hi, kinds=None):
             """One ring ROUND (k-round schedule): static [lo, hi) slice of
@@ -273,6 +350,30 @@ class MeshShardEngine(LocalEngine):
             decode_chunk_fn, static_argnums=(8, 9), donate_argnums=(3, 7)
         )
 
+        L = self.spec_lookahead
+        if L > 0:
+            # engine-level speculation over the mesh (VERDICT r4 next #5):
+            # LocalEngine's _spec_step contract with the window pass routed
+            # through the shard_map core — drafting/history stay host-shaped,
+            # the (L+1)-wide verify forward runs SPMD.  The eligibility
+            # gates and the decode_spec driver are inherited unchanged.
+            from dnet_tpu.core.spec import accept_drafts, commit_history, ngram_draft
+
+            def spec_step_fn(window_params, edge_params, tok, hist, kv, pos):
+                hist = commit_history(hist, pos, tok, jnp.int32(1))
+                drafts = ngram_draft(hist, pos + 1, L)  # [B, L]
+                hist = commit_history(hist, pos + 1, drafts, jnp.int32(L))
+                block = jnp.concatenate([tok, drafts], axis=1)  # [B, L+1]
+                x = model.embed(edge_params, block)
+                x, kv = core(window_params, x, kv, pos, jnp.int32(L + 1), kinds_arr)
+                x = model.normalize(edge_params, x)
+                logits = model.lm_project(edge_params, x)  # [B, L+1, V]
+                preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                _, out = accept_drafts(preds, drafts)
+                return out, hist, kv
+
+            self._spec_step = jax.jit(spec_step_fn, donate_argnums=(3, 4))
+
     # ---- sessions -----------------------------------------------------
     def new_session(
         self, nonce: str, seed: Optional[int] = None, kv=None, pos: int = 0
@@ -283,17 +384,41 @@ class MeshShardEngine(LocalEngine):
         shards the sequence axis, which a rotating SWA window would alias."""
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
+        kv_list = None
         if kv is None:
-            kv0 = self.model.init_kv(
-                len(self.model.layers), self.batch, self.max_seq, self.kv_dtype,
-                quant_bits=self.kv_quant_bits, rotating=(self.sp == 1),
-            )
-            _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
+            if self.plan.streams_weights:
+                # streaming: one mesh-placed cache per layer, matching the
+                # per-layer _hidden invocations of _stream_windows
+                from dnet_tpu.core.kvcache import init_cache
+
+                kv_list = []
+                for _ in self.model.layers:
+                    kv0 = init_cache(
+                        self.model.kv_config(
+                            1, self.batch, self.max_seq, self.kv_dtype,
+                            quant_bits=self.kv_quant_bits,
+                        )
+                    )
+                    _, _, kv0 = place_ring_state({}, {}, kv0, self.mesh)
+                    kv_list.append(kv0)
+            else:
+                kv0 = self.model.init_kv(
+                    len(self.model.layers), self.batch, self.max_seq,
+                    self.kv_dtype, quant_bits=self.kv_quant_bits,
+                    rotating=(self.sp == 1),
+                )
+                _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
         sess = Session(
             kv=kv,
+            kv_list=kv_list,
             pos=pos,
             key=jax.random.key(seed),
             counts=jnp.zeros((self.batch, self.config.vocab_size), dtype=jnp.int32),
+            hist=(
+                jnp.zeros((self.batch, self.max_seq), dtype=jnp.int32)
+                if self.spec_lookahead > 0
+                else None
+            ),
         )
         self.sessions[nonce] = sess
         return sess

@@ -349,9 +349,8 @@ def solve_topology(
         layers = [a for r in per_dev_rounds[i] for a in r]
         window = 0 if n[i] >= w[i] else max(n[i] // 2, 1)
         # multi-chip hosts serve their window tensor-parallel over the local
-        # slice (parallel/shard_mesh.py) — unless the solve streams weights
-        # on this node, which the mesh shard does not compose with: fall
-        # back to a single-chip shard there rather than failing at load
+        # slice (parallel/shard_mesh.py); a streaming window composes — each
+        # layer streams in tp/sp-sharded (see the r5 note below).
         # chip_count is already clamped to a KV-head-divisible tp above;
         # chips the clamp left over become a SEQUENCE-parallel axis (KV
         # shards over them) instead of idling — e.g. a 4-chip host serving
@@ -367,19 +366,10 @@ def solve_topology(
                 mesh_sp = s
                 break
         residency = 0 if n[i] >= w[i] else n[i]
-        if window > 0 and (mesh_tp > 1 or mesh_sp > 1):
-            # streaming does not compose with the mesh shard: fall back to
-            # one chip AND re-derive residency against single-chip HBM —
-            # the solve sized n[i] with the pooled multi-chip capacity
-            log.warning(
-                "%s: weight streaming assigned to a %d-chip host; mesh "
-                "sharding disabled for this node (streams on one chip)",
-                d.instance, orig_chips.get(d.instance, mesh_tp),
-            )
-            mesh_tp, mesh_sp = 1, 1
-            n1 = min(w[i], hbm_layer_capacity(_dc_replace(d, chip_count=1), m))
-            window = 0 if n1 >= w[i] else max(n1 // 2, 1)
-            residency = 0 if n1 >= w[i] else n1
+        # streaming COMPOSES with the mesh shard (r5): each window layer
+        # streams host->mesh as tp/sp-sharded device_puts, so the window
+        # lives in the slice's POOLED HBM — exactly the capacity n[i] was
+        # sized against.  No single-chip fallback, no re-derivation.
         assignments.append(
             LayerAssignment(
                 instance=d.instance,

@@ -58,14 +58,9 @@ class ShardCompute:
         mesh_tp = max(mesh_tp, 1)
         if mesh_tp * mesh_sp > 1:
             # mesh-backed shard (VERDICT r3 next #1): this ring node's layer
-            # window runs SPMD over the host's local chips
-            if window_size or residency_size:
-                raise NotImplementedError(
-                    "weight streaming (window_size/residency_size) does not "
-                    "compose with a mesh-backed shard: streamed windows are "
-                    "host-resident per layer while the mesh shards resident "
-                    "params over chips — drop mesh_tp/mesh_sp or the window"
-                )
+            # window runs SPMD over the host's local chips; a window/
+            # residency plan streams each layer as tp/sp-sharded device_puts
+            # (VERDICT r4 next #2 — BASELINE config 3 on the mesh topology)
             from dnet_tpu.parallel.shard_mesh import MeshShardEngine
 
             self.engine = MeshShardEngine(
@@ -80,6 +75,9 @@ class ShardCompute:
                 kv_ttl_s=kv_ttl_s,
                 kv_quant_bits=kv_quant_bits,
                 weight_quant_bits=weight_quant_bits,
+                window_size=window_size,
+                residency_size=residency_size,
+                repack_dir=repack_dir,
             )
         else:
             self.engine = LocalEngine(

@@ -239,6 +239,18 @@ def test_mesh_backed_shards_chat(cluster):
     h1 = httpx.get(f"http://127.0.0.1:{ports['s1_http']}/health", timeout=5).json()
     assert h0["mesh_tp"] == 2 and h1["mesh_tp"] == 2
     assert meshed == plain
+    # streaming x mesh (VERDICT r4 next #2): the same mesh topology with a
+    # window/residency plan — each shard streams its layers host->mesh as
+    # tp-sharded device_puts; served bytes must not change
+    streamed = serve_once(
+        [
+            {"instance": "s0", "layers": [0, 1], "mesh_tp": 2,
+             "window_size": 1, "residency_size": 1},
+            {"instance": "s1", "layers": [2, 3], "mesh_tp": 2,
+             "window_size": 1, "residency_size": 1},
+        ]
+    )
+    assert streamed == plain
     httpx.post(f"{base}/v1/unload_model", timeout=60.0)
 
 

@@ -120,14 +120,70 @@ def test_mesh_shard_kround_schedule(tiny_llama_dir, eight_devices):
     assert toks == _ref_tokens(tiny_llama_dir, ids, 4)
 
 
-def test_mesh_rejects_weight_streaming(tiny_llama_dir, eight_devices):
+def test_mesh_shard_streams_weights(tiny_llama_dir, eight_devices):
+    """Streaming x mesh (VERDICT r4 next #2): a tp=2 shard with a
+    window/residency plan streams each layer host->mesh as tp-sharded
+    device_puts; the ring stream must equal the resident reference."""
     from dnet_tpu.shard.compute import ShardCompute
 
-    with pytest.raises(NotImplementedError, match="streaming"):
-        ShardCompute(
-            tiny_llama_dir, [0, 1], max_seq=32, mesh_tp=2,
-            mesh_devices=eight_devices[0:2], window_size=1,
-        )
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=2, mesh_devices=eight_devices[0:2],
+        window_size=1, residency_size=1,
+    )
+    assert lo.engine.plan.streams_weights
+    assert lo.engine.tp == 2
+    hi = ShardCompute(
+        tiny_llama_dir, [2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=2, mesh_devices=eight_devices[2:4],
+    )
+    ids = [256, 72, 101, 108, 108, 111]
+    assert _drive_ring([lo, hi], ids, 6) == _ref_tokens(tiny_llama_dir, ids, 6)
+
+
+def test_mesh_shard_streams_with_sp(tiny_llama_dir, eight_devices):
+    """Streaming composes with the sp axis too: per-layer KV caches shard
+    their sequence axis over sp while the window streams."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", mesh_tp=1, mesh_sp=2,
+        mesh_devices=eight_devices[0:2], window_size=1, residency_size=1,
+    )
+    assert lo.engine.plan.streams_weights and lo.engine.sp == 2
+    hi = ShardCompute(
+        tiny_llama_dir, [2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32",
+    )
+    ids = [256, 84, 104, 101]
+    assert _drive_ring([lo, hi], ids, 5) == _ref_tokens(tiny_llama_dir, ids, 5)
+
+
+def test_mesh_shard_streams_quantized(tiny_llama_dir, eight_devices):
+    """int8 weight-only quantized layers stream host->mesh with their
+    scale trees sharded alongside; stream equals the resident quantized
+    single-device reference."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+
+    ref = LocalEngine(
+        tiny_llama_dir, max_seq=64, param_dtype="float32",
+        weight_quant_bits=8, weight_quant_group=16,
+    )
+    ids = [256, 72, 101, 108]
+    dec = DecodingParams(temperature=0.0)
+    want = [r.token_id for r in ref.generate(ids, dec, max_tokens=5)]
+    ref.close()
+    eng = MeshShardEngine(
+        tiny_llama_dir, layers=range(4), tp=2, devices=eight_devices[0:2],
+        max_seq=64, param_dtype="float32", window_size=2, residency_size=2,
+        weight_quant_bits=8, weight_quant_group=16,
+    )
+    assert eng.plan.streams_weights
+    got = [r.token_id for r in eng.generate(ids, dec, max_tokens=5)]
+    eng.close()
+    assert got == want
 
 
 def test_mesh_tp_auto_all_devices(tiny_llama_dir, eight_devices):
@@ -209,3 +265,74 @@ def test_mesh_shard_ring_speculation(tiny_llama_dir, eight_devices):
     hi.engine.close()
     assert got[:n] == want
     assert laps < n  # multiple tokens per lap: speculation actually fired
+
+
+def test_mesh_shard_engine_level_spec(tiny_llama_dir, eight_devices):
+    """Engine-level speculation over the mesh (VERDICT r4 next #5): a tp=2
+    MeshShardEngine with spec_lookahead drives the (L+1)-wide verify
+    forward through shard_map; the greedy stream equals LocalEngine's and
+    speculation actually fires (fewer blocks than tokens)."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+
+    ids = [7, 3, 11, 7, 3, 11, 7, 3]  # repetitive: prompt-lookup hits
+    dec = DecodingParams(temperature=0.0)
+    ref = LocalEngine(tiny_llama_dir, max_seq=128, param_dtype="float32")
+    want = [r.token_id for r in ref.generate(ids, dec, max_tokens=10)]
+    ref.close()
+    eng = MeshShardEngine(
+        tiny_llama_dir, layers=range(4), tp=2, devices=eight_devices[0:2],
+        max_seq=128, param_dtype="float32", spec_lookahead=4,
+    )
+    assert eng.spec_eligible(dec)
+    got = [r.token_id for r in eng.generate(ids, dec, max_tokens=10)]
+    eng.close()
+    assert got == want
+
+
+def test_mesh_shard_spec_with_sp(tiny_llama_dir, eight_devices):
+    """Spec composes with the sp axis: KV sequence sharded over sp=2 while
+    the verify block writes L+1 positions per lap."""
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+
+    ids = [7, 3, 11, 7, 3, 11]
+    dec = DecodingParams(temperature=0.0)
+    ref = LocalEngine(tiny_llama_dir, max_seq=128, param_dtype="float32")
+    want = [r.token_id for r in ref.generate(ids, dec, max_tokens=8)]
+    ref.close()
+    eng = MeshShardEngine(
+        tiny_llama_dir, layers=range(4), tp=1, sp=2,
+        devices=eight_devices[0:2], max_seq=128, param_dtype="float32",
+        spec_lookahead=4,
+    )
+    assert eng.spec_eligible(dec)
+    got = [r.token_id for r in eng.generate(ids, dec, max_tokens=8)]
+    eng.close()
+    assert got == want
+
+
+def test_mesh_shard_streams_two_segment_model(tmp_path, eight_devices):
+    """Two-segment models (deepseek) stream through the mesh shard: each
+    layer arrives as {"dense": ...} OR {"moe": ...}, and the structure-keyed
+    shard_map dispatch builds one program per segment layout."""
+    from tests.fakes.checkpoints import make_tiny_deepseek_v2
+
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+
+    d = tmp_path / "ds"
+    make_tiny_deepseek_v2(d)
+    dec = DecodingParams(temperature=0.0)
+    ids = [1, 7, 3, 11]
+    local = LocalEngine(d, max_seq=64, param_dtype="float32")
+    n_layers = local.config.num_hidden_layers
+    want = [r.token_id for r in local.generate(ids, dec, max_tokens=6)]
+    local.close()
+    eng = MeshShardEngine(
+        d, layers=range(n_layers), tp=2, devices=eight_devices[:2],
+        max_seq=64, param_dtype="float32", window_size=1, residency_size=1,
+    )
+    got = [r.token_id for r in eng.generate(ids, dec, max_tokens=6)]
+    eng.close()
+    assert got == want

@@ -241,3 +241,29 @@ def test_sp_picks_largest_divisor():
     topo = solve_topology(devs, m)
     a = topo.assignments[0]
     assert a.mesh_tp == 2 and a.mesh_sp == 2
+
+
+def test_streaming_composes_with_mesh():
+    """A multi-chip host whose assignment exceeds pooled HBM keeps BOTH its
+    mesh axes and its streaming window (r5): layers stream as tp-sharded
+    device_puts into the slice's pooled capacity — no single-chip fallback."""
+    from dnet_tpu.core.types import DeviceInfo
+    from dnet_tpu.parallel.solver import ModelProfile, solve_topology
+
+    devs = [
+        DeviceInfo(
+            instance="s0", host="h0", http_port=1, grpc_port=2,
+            chip_count=4, flops_bf16=1e12, hbm_bw=1e11, host_to_hbm_bw=1e10,
+            # pooled HBM fits only a few of the 8 one-GiB layers
+            hbm_bytes=1 << 30, host_ram_bytes=64 << 30,
+        )
+    ]
+    m = ModelProfile(
+        model_id="m", num_layers=8, layer_bytes=1 << 30,
+        layer_flops_per_token=1e8, kv_bytes_per_token_per_layer=1024,
+        seq_len=4096, tp_heads=2,
+    )
+    topo = solve_topology(devs, m)
+    a = topo.assignments[0]
+    assert a.window_size > 0 and a.residency_size > 0, "must stream"
+    assert a.mesh_tp == 2 and a.mesh_sp == 2, "mesh axes must survive streaming"
