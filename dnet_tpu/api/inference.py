@@ -86,8 +86,18 @@ class InferenceManager:
         self.tokenizer = None  # set by ModelManager on load
         self.model_id: Optional[str] = None
         self.request_timeout_s = request_timeout_s
+        self._max_concurrent = max_concurrent
         self._semaphore = asyncio.Semaphore(max_concurrent)
         self.failure_monitor = None  # RingFailureMonitor in ring mode
+
+    def set_concurrency_limit(self, n: Optional[int]) -> None:
+        """Re-cap request admission (ring lanes: the shard lane pools hold
+        exactly `lanes` KV rows, so admitting more mid-decode requests than
+        lanes would hard-fail the overflow instead of queueing it).  None
+        restores the configured default.  Requests already inside the old
+        semaphore finish under it; new arrivals use the new cap."""
+        cap = self._max_concurrent if n is None else min(n, self._max_concurrent)
+        self._semaphore = asyncio.Semaphore(max(cap, 1))
 
     @property
     def ready(self) -> bool:

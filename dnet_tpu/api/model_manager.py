@@ -147,9 +147,11 @@ class LocalModelManager:
                             _cfg.model_type,
                         )
                         use_pipelined = False
-                    elif getattr(_inst, "no_pp_mesh", False) and _pp > 1:
-                        # interleaved mixed layouts can't pp-shard; the
-                        # sequential mesh (which forces pp=1) still serves
+                    elif getattr(_inst, "no_pipelined", False) and _pp > 1:
+                        # interleaved mixed layouts pp-shard on the
+                        # sequential mesh (chunk-aligned stacks, r5) but the
+                        # staggered-microbatch pipeline can't slice their
+                        # dict stacks per stage yet
                         log.warning(
                             "%s interleaved dense/moe layout cannot fill a "
                             "pp=%d pipeline; serving sequential mesh",

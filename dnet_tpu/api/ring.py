@@ -36,6 +36,7 @@ class RingApiAdapter(ApiAdapterBase):
         max_seq_len: Optional[int] = None,
         stream_idle_s: float = 300.0,
         auto_steps: int = 0,
+        lanes: int = 1,
     ) -> None:
         from dnet_tpu.transport.grpc_transport import RingClient
 
@@ -56,9 +57,20 @@ class RingApiAdapter(ApiAdapterBase):
         # into the ring, so those steps cost no API round trip.  Tokens for
         # granted steps can arrive BEFORE the driver awaits them — they
         # stash in _early until send_tokens registers the future.
-        self._auto_steps = max(int(auto_steps), 0)
+        # batched lanes (r5): with lanes > 1, concurrent requests' decode
+        # steps COALESCE into multi-lane frames — the ring serves N nonces
+        # per pass instead of N passes.  Grants are per-nonce self-pacing
+        # and would pull members out of the shared cadence: lanes win.
+        self._lanes = max(int(lanes), 1)
+        self._auto_steps = 0 if self._lanes > 1 else max(int(auto_steps), 0)
         self._granted: Dict[str, int] = {}  # nonce -> highest granted step
         self._early: Dict[tuple, TokenResult] = {}
+        self._pending: List[dict] = []  # lane entries awaiting a flush
+        self._flush_task: Optional[asyncio.Task] = None
+        self._batch_seq = 0
+        # nonces mid-generation (first send -> reset): the flusher holds a
+        # batch open only while MORE active streams could still join it
+        self._active: Dict[str, bool] = {}
 
     async def start(self) -> None:
         self._head_client = self._make_client(self.head_addr)
@@ -95,6 +107,9 @@ class RingApiAdapter(ApiAdapterBase):
         self._futures.cancel_nonce(nonce)
         self._pos_state.pop(nonce, None)
         self._granted.pop(nonce, None)
+        self._active.pop(nonce, None)
+        if self._pending:
+            self._pending = [e for e in self._pending if e["nonce"] != nonce]
         for key in [k for k in self._early if k[0] == nonce]:
             self._early.pop(key, None)
         if self._streams is not None:
@@ -128,6 +143,26 @@ class RingApiAdapter(ApiAdapterBase):
             if early is not None:
                 self._futures.resolve(early)
             return
+        if self._lanes > 1 and step > 0:
+            # mid-DECODE streams only: prefilling requests must not count
+            # toward the coalesce target (a long prefill would stall every
+            # flush for the full convergence window)
+            self._active[nonce] = True
+            # coalesce: enqueue this decode step and let the flusher build
+            # a multi-lane frame from every same-tick sender (concurrent
+            # drivers resolve together, so their next steps arrive together)
+            self._pending.append(
+                {
+                    "nonce": nonce,
+                    "seq": step,
+                    "pos": self._pos_for(nonce, step, len(token_ids)),
+                    "decoding": asdict(decoding),
+                    "token": int(token_ids[0]),
+                }
+            )
+            if self._flush_task is None or self._flush_task.done():
+                self._flush_task = asyncio.ensure_future(self._flush_lanes())
+            return
         auto = 0
         if self._auto_steps > 0 and budget is not None and budget > 1:
             auto = min(self._auto_steps, budget - 1)
@@ -150,6 +185,65 @@ class RingApiAdapter(ApiAdapterBase):
         if auto:
             self._granted[nonce] = step + auto
         await self._streams.send(nonce, frame)
+
+    LANES_NONCE = "__lanes__"  # carrier stream for coalesced decode frames
+    # how long a partially-filled batch may hold open for more mid-decode
+    # streams to join.  This is a CONVERGENCE cost, not a per-token cost:
+    # members of one batch resolve together and re-send together, so once
+    # streams merge they stay merged and the wait collapses to ~0.  A solo
+    # stream (one active nonce) never waits at all.
+    LANE_CONVERGE_S = 0.05
+
+    async def _flush_lanes(self) -> None:
+        """Drain pending lane entries into multi-lane frames.  A batch
+        holds open (bounded by LANE_CONVERGE_S) while more mid-decode
+        streams could still join; per-nonce ordering is the driver's (it
+        never sends step k+1 before step k resolved)."""
+        await asyncio.sleep(0)
+        loop = asyncio.get_running_loop()
+        while self._pending:
+            target = min(self._lanes, len(self._active))
+            if len(self._pending) < target:
+                deadline = loop.time() + self.LANE_CONVERGE_S
+                while len(self._pending) < target and loop.time() < deadline:
+                    await asyncio.sleep(0.0005)
+            batch = self._pending[: self._lanes]
+            self._pending = self._pending[len(batch):]
+            tokens = np.asarray([[e["token"]] for e in batch], dtype=np.int32)
+            payload, _dtype, shape = tensor_to_bytes(tokens)
+            frame = ActivationFrame(
+                nonce=self.LANES_NONCE,
+                seq=self._batch_seq,
+                layer_id=-1,
+                pos=0,
+                dtype="tokens",
+                shape=shape,
+                payload=payload,
+                callback_url=self.callback_url,
+                decoding={},
+                t_sent=time.time(),
+                lanes=[
+                    {k: e[k] for k in ("nonce", "seq", "pos", "decoding")}
+                    for e in batch
+                ],
+            )
+            self._batch_seq += 1
+            log.info(
+                "[PROFILE] lane flush: %d member(s), %d active, %d still pending",
+                len(batch), len(self._active), len(self._pending),
+            )
+            try:
+                await self._streams.send(self.LANES_NONCE, frame)
+            except Exception as exc:
+                # fail every member alone and fast; their drivers surface
+                # the error instead of blocking the full request timeout
+                for e in batch:
+                    self.resolve_token(
+                        TokenResult(
+                            nonce=e["nonce"], token_id=-1, step=e["seq"],
+                            error=f"batch frame send failed: {exc}",
+                        )
+                    )
 
     def _pos_for(self, nonce: str, step: int, n_tokens: int) -> int:
         """Step 0 injects the whole prompt at pos 0; every later step

@@ -121,7 +121,8 @@ class RingModelManager:
         t0 = time.perf_counter()
         by_instance = {d.instance: d for d in topo.devices}
         max_seq = max_seq or self.max_seq
-        spec = self._spec_lookahead_for(topo, model_dir, max_seq)
+        lanes = self._lanes_for(topo)
+        spec = 0 if lanes > 1 else self._spec_lookahead_for(topo, model_dir, max_seq)
 
         async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
             for a in topo.assignments:
@@ -154,6 +155,9 @@ class RingModelManager:
                     # (0 when the topology/model can't rewind — see
                     # _spec_lookahead_for)
                     "spec_lookahead": spec,
+                    # batched lanes: every shard allocates the same pooled
+                    # lane count so coalesced frames serve end to end
+                    "lanes": lanes,
                 }
                 url = f"http://{dev.host}:{dev.http_port}/load_model"
                 r = await client.post(url, json=body)
@@ -179,9 +183,13 @@ class RingModelManager:
             ],
             max_seq_len=max_seq,
             auto_steps=get_settings().api.ring_auto_steps,
+            lanes=max(lanes, 1),
         )
         await adapter.start()
         self.inference.adapter = adapter
+        # lane pools hold exactly `lanes` KV rows per shard: admission must
+        # queue (not hard-fail) requests beyond that
+        self.inference.set_concurrency_limit(lanes if lanes > 1 else None)
         self.inference.tokenizer = tokenizer
         self.inference.model_id = model_id
         if old is not None:
@@ -189,6 +197,26 @@ class RingModelManager:
         dt = time.perf_counter() - t0
         log.info("ring model %s loaded across %d shard(s) in %.1fs", model_id, len(topo.assignments), dt)
         return dt
+
+    def _lanes_for(self, topo) -> int:
+        """Batched-lane preconditions the API can check up front: a
+        configured lane count, a single-round topology with no streaming
+        windows and no mesh-backed shards.  Shards re-check at load."""
+        from dnet_tpu.config import get_settings
+
+        lanes = get_settings().api.ring_lanes
+        if lanes <= 1:
+            return 0
+        if any(
+            len(_contiguous_runs(a.layers)) > 1
+            or a.window_size > 0
+            or a.mesh_tp > 1
+            or a.mesh_sp > 1
+            for a in topo.assignments
+        ):
+            log.info("ring lanes off: k-round, streaming, or mesh topology")
+            return 0
+        return lanes
 
     def _spec_lookahead_for(self, topo, model_dir, max_seq: int) -> int:
         """Ring speculation preconditions the API can check up front: a

@@ -244,6 +244,11 @@ class ApiSettings(_EnvGroup):
     # halts on EOS / cache capacity; overshoot past a stop SEQUENCE is
     # discarded like local decode chunks.  0 disables.
     ring_auto_steps: int = 16
+    # batched lanes over the ring: >1 coalesces that many concurrent
+    # requests' decode steps into ONE multi-lane ring pass (shard/lanes.py).
+    # Needs a single-round non-mesh topology; grants and ring speculation
+    # are per-nonce self-pacing and turn off when lanes are on.  0/1 = off.
+    ring_lanes: int = 0
 
 
 @dataclass

@@ -377,32 +377,18 @@ class LocalEngine:
 
         L = self.spec_lookahead
         if L > 0:
-            from dnet_tpu.core.spec import accept_drafts, commit_history, ngram_draft
+            # one speculative verify step: draft L tokens from history, run
+            # ONE forward over [tok, d_1..d_L], greedily accept the agreeing
+            # prefix.  KV for all L+1 positions is written; the host-side
+            # caller rewinds pos to the accepted count (core/spec.py)
+            from dnet_tpu.core.spec import make_spec_step
 
-            def spec_step_fn(window_params, edge_params, tok, hist, kv, pos):
-                """One speculative verify step: draft L tokens from history,
-                run ONE forward over [tok, d_1..d_L], greedily accept the
-                agreeing prefix.  KV for all L+1 positions is written; the
-                host-side caller rewinds pos to the accepted count (stale
-                rows are overwritten by the next block — core/spec.py)."""
-                hist = commit_history(hist, pos, tok, jnp.int32(1))
-                drafts = ngram_draft(hist, pos + 1, L)  # [B, L]
-                hist = commit_history(hist, pos + 1, drafts, jnp.int32(L))
-                block = jnp.concatenate([tok, drafts], axis=1)  # [B, L+1]
-                x = model.embed(edge_params, block)
-                x, kv = model.apply_window(
-                    window_params, x, kv, pos, t_real=L + 1
-                )
-                x = model.normalize(edge_params, x)
-                logits = model.lm_project(edge_params, x)  # [B, L+1, V]
-                preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                # n_accept is recoverable host-side from out's -1 sentinel
-                # (preds are argmaxes, always >= 0), so only `out` crosses
-                # device->host — one transfer per block
-                _, out = accept_drafts(preds, drafts)
-                return out, hist, kv
+            def window_pass(wp, x, kv, pos, t_real):
+                return model.apply_window(wp, x, kv, pos, t_real=t_real)
 
-            self._spec_step = jax.jit(spec_step_fn, donate_argnums=(3, 4))
+            self._spec_step = jax.jit(
+                make_spec_step(model, window_pass, L), donate_argnums=(3, 4)
+            )
 
     # ---- offload execution --------------------------------------------
     def run_layers(self, sess: "Session", x: jnp.ndarray, pos: int, t_real=None) -> jnp.ndarray:

@@ -139,3 +139,30 @@ def commit_history(
         return jax.lax.dynamic_update_slice_in_dim(h, t, pos, axis=0)
 
     return jax.vmap(put)(hist, safe)
+
+
+def make_spec_step(model, window_pass, L: int):
+    """Shared speculative verify-block body (LocalEngine and the mesh-shard
+    engine differ ONLY in how the window pass executes): commit the fed
+    token, draft L tokens by prompt-lookup, verify in one (L+1)-wide
+    forward through `window_pass(window_params, x, kv, pos, t_real)`, and
+    return accept_drafts' sentinel-packed output.  One owner of the
+    commit/draft/verify contract — engines jit the returned fn with their
+    own donation choices."""
+
+    def spec_step_fn(window_params, edge_params, tok, hist, kv, pos):
+        hist = commit_history(hist, pos, tok, jnp.int32(1))
+        drafts = ngram_draft(hist, pos + 1, L)  # [B, L]
+        hist = commit_history(hist, pos + 1, drafts, jnp.int32(L))
+        block = jnp.concatenate([tok, drafts], axis=1)  # [B, L+1]
+        x = model.embed(edge_params, block)
+        x, kv = window_pass(window_params, x, kv, pos, L + 1)
+        x = model.normalize(edge_params, x)
+        logits = model.lm_project(edge_params, x)  # [B, L+1, V]
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # n_accept is recoverable host-side from out's -1 sentinel (preds
+        # are argmaxes, always >= 0), so only `out` crosses device->host
+        _, out = accept_drafts(preds, drafts)
+        return out, hist, kv
+
+    return spec_step_fn

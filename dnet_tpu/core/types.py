@@ -84,6 +84,14 @@ class ActivationMessage:
     drafts: list = field(default_factory=list)
     committed: list = field(default_factory=list)
     extra_finals: Optional[list] = None
+    # batched lanes (r5): a COALESCED decode frame serving several nonces in
+    # one ring pass.  `lanes` rides every hop — one {"nonce","seq","pos",
+    # "decoding"} entry per member, payload rows stacked in the same order;
+    # the tail's final message answers with `lane_finals` (one TokenResult-
+    # shaped dict per member) which the adapter fans out as per-nonce
+    # SendToken callbacks.
+    lanes: list = field(default_factory=list)
+    lane_finals: Optional[list] = None
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0

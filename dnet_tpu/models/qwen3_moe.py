@@ -15,9 +15,12 @@ supported with two stacking strategies (VERDICT r3 next #6):
   - INTERLEAVED layouts (decoder_sparse_step striding) run an
     order-preserving mixed scan: per-kind stacks plus index vectors, each
     step lax.cond-dispatching on the layer's kind — exact layer order with
-    two compiled branch bodies.  pp>1 mesh rings are refused (a multi-lap
-    schedule cannot reproduce an interleaved order); everything else
-    (Local/shard/tp/sp engines, streaming) works.
+    two compiled branch bodies.  pp>1 mesh rings work via CHUNK-ALIGNED
+    stacking (r5, pad_mesh_segments): each rank holds its contiguous slice
+    of the global order and runs the mixed scan over it, scheduled by the
+    pp-sharded layer_kinds slots — a single lap reproduces the exact
+    order.  Only the staggered-microbatch pipeline is refused
+    (no_pipelined: its per-stage stack slicing predates dict stacks).
 """
 
 from __future__ import annotations
@@ -71,7 +74,15 @@ class Qwen3MoeRingModel(TwoSegmentStackMixin, MixtralRingModel, Qwen3RingModel):
             if self.prefix_mixed:
                 self.ring_phases = 2  # deepseek-style multi-lap pp rings
             else:
-                self.no_pp_mesh = True  # interleaved order has no lap form
+                # interleaved orders pp-shard via CHUNK-ALIGNED stacks (r5):
+                # pad_mesh_segments reorders each kind's rows so uniform pp
+                # sharding hands every rank exactly its contiguous slice of
+                # the GLOBAL order, and a single lap's mixed lax.cond scan
+                # (scheduled by the pp-sharded layer_kinds slots) reproduces
+                # the exact layer order.  The staggered-microbatch pipeline
+                # still can't slice these dict stacks per stage.
+                self.pp_pad_chunks = True
+                self.no_pipelined = True
 
     # ---- stacking -----------------------------------------------------
     def stack_layers(self, per_layer: List[Dict[str, np.ndarray]]):
@@ -101,7 +112,64 @@ class Qwen3MoeRingModel(TwoSegmentStackMixin, MixtralRingModel, Qwen3RingModel):
             return RingModel.wrap_offload_layer(self, mapped)
         return TwoSegmentStackMixin.wrap_offload_layer(self, mapped)
 
-    # pad_mesh_segments (prefix-mixed pp rings) comes from the mixin
+    # ---- pp chunk alignment (interleaved layouts) ----------------------
+    def pad_mesh_segments(self, stacked: dict, pp: int):
+        """Prefix layouts: the mixin's per-segment padding (2-lap rings).
+        Interleaved layouts: chunk-aligned stacking — the global layer
+        order splits into pp contiguous chunks (one per pipeline rank);
+        each kind's stack is laid out rank-major (a chunk's dense rows are
+        already contiguous in the dense-only ordering) and padded to the
+        max per-rank count with zero layers (exact residual no-ops), so
+        uniform pp sharding hands every rank its own chunk.  Sets
+        `self.layer_kinds` to the rank-major slot-kind schedule the mixed
+        scan reads (pp-sharded operand, parallel/ring.py), and returns
+        (padded_stacked, n_kv_layers = pp * slots_per_rank)."""
+        if self.prefix_mixed:
+            return TwoSegmentStackMixin.pad_mesh_segments(self, stacked, pp)
+        L = self.config.num_hidden_layers
+        C0 = -(-L // pp)
+        kinds = [1 if self.is_moe_layer(a) else 0 for a in range(L)]
+        kinds += [0] * (C0 * pp - L)  # virtual trailing dense no-op slots
+        chunks = [kinds[r * C0 : (r + 1) * C0] for r in range(pp)]
+        # real rows per rank (virtual slots own no checkpoint rows)
+        real_k = [kinds[: L][r * C0 : (r + 1) * C0] for r in range(pp)]
+        real_d = [c.count(0) for c in real_k]
+        real_m = [c.count(1) for c in real_k]
+        d_slots = [c.count(0) for c in chunks]
+        m_slots = [c.count(1) for c in chunks]
+        Dmax, Mmax = max(d_slots), max(m_slots)
+
+        def chunk_pad(tree, counts, target):
+            """Rank-major reorder + zero-pad one kind's stack."""
+            offs = np.concatenate([[0], np.cumsum(counts)])
+
+            def pad(a):
+                rows = []
+                for r in range(pp):
+                    block = a[offs[r] : offs[r + 1]]
+                    n = target - block.shape[0]
+                    if n:
+                        block = np.concatenate(
+                            [block, np.zeros((n, *a.shape[1:]), a.dtype)]
+                        )
+                    rows.append(block)
+                return np.concatenate(rows, axis=0)
+
+            return jax.tree.map(pad, tree)
+
+        out = {
+            "dense": chunk_pad(stacked["dense"], real_d, Dmax),
+            "moe": chunk_pad(stacked["moe"], real_m, Mmax),
+        }
+        slot_kinds = []
+        for r in range(pp):
+            slot_kinds += (
+                chunks[r]
+                + [0] * (Dmax - d_slots[r])
+                + [1] * (Mmax - m_slots[r])
+            )
+        self.layer_kinds = jnp.asarray(slot_kinds, jnp.int32)
+        return out, pp * (Dmax + Mmax)
 
     # ---- mixed-layout execution ---------------------------------------
     def _mlp_block(self, p: dict, x: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
@@ -143,25 +211,31 @@ class Qwen3MoeRingModel(TwoSegmentStackMixin, MixtralRingModel, Qwen3RingModel):
             )
 
         # interleaved: order-preserving mixed scan over the window's layers
-        if phase is not None:
-            raise NotImplementedError(
-                "interleaved qwen3_moe layouts (decoder_sparse_step) cannot "
-                "run multi-lap pp rings; use tp/sp axes or the gRPC shard ring"
+        if layer_kinds is not None:
+            # pp mesh (chunk-aligned stacks, pad_mesh_segments): this rank's
+            # slot schedule arrives as the pp-sharded kinds operand; the
+            # per-kind row indices are its exclusive cumsums.  Slot j's KV
+            # row is j (the chunk IS the rank's kv block).
+            kinds = layer_kinds.astype(jnp.int32)
+            L = kinds.shape[0]
+            d_pos = jnp.cumsum(1 - kinds) - (1 - kinds)
+            m_pos = jnp.cumsum(kinds) - kinds
+            xs = (jnp.arange(L, dtype=jnp.int32), kinds, d_pos, m_pos)
+        else:
+            L = len(self.moe_mask)
+            kinds = jnp.asarray([1 if m else 0 for m in self.moe_mask], jnp.int32)
+            d_pos, m_pos, dc, mc = [], [], 0, 0
+            for m in self.moe_mask:
+                d_pos.append(dc)
+                m_pos.append(mc)
+                if m:
+                    mc += 1
+                else:
+                    dc += 1
+            xs = (
+                jnp.arange(L, dtype=jnp.int32), kinds,
+                jnp.asarray(d_pos, jnp.int32), jnp.asarray(m_pos, jnp.int32),
             )
-        L = len(self.moe_mask)
-        kinds = jnp.asarray([1 if m else 0 for m in self.moe_mask], jnp.int32)
-        d_pos, m_pos, dc, mc = [], [], 0, 0
-        for m in self.moe_mask:
-            d_pos.append(dc)
-            m_pos.append(mc)
-            if m:
-                mc += 1
-            else:
-                dc += 1
-        xs = (
-            jnp.arange(L, dtype=jnp.int32), kinds,
-            jnp.asarray(d_pos, jnp.int32), jnp.asarray(m_pos, jnp.int32),
-        )
 
         def body(carry, per):
             x, kv = carry

@@ -97,19 +97,14 @@ class MeshEngine:
         model_cls = get_ring_model_cls(self.config.model_type)
         self.model = model_cls(self.config, range(self.config.num_hidden_layers))
         L = self.config.num_hidden_layers
-        # segmented models (ring_phases > 1) zero-pad each segment to pp
-        # divisibility, so L need not divide evenly
-        segmented = getattr(self.model, "ring_phases", 1) > 1
-        if getattr(self.model, "no_pp_mesh", False):
-            # interleaved mixed layouts (qwen3_moe decoder_sparse_step) have
-            # no multi-lap form: the pipeline cannot reproduce layer order
-            if pp > 1:
-                raise NotImplementedError(
-                    f"{self.config.model_type} with an interleaved dense/moe "
-                    f"layout cannot shard layers over pp={pp}; use tp/sp "
-                    f"axes or the gRPC shard ring"
-                )
-            pp = 1
+        # segmented models zero-pad their stacks to pp divisibility — per
+        # segment for multi-lap rings (ring_phases > 1), chunk-aligned for
+        # interleaved layouts (pp_pad_chunks, models/qwen3_moe.py r5) — so
+        # L need not divide evenly
+        segmented = (
+            getattr(self.model, "ring_phases", 1) > 1
+            or getattr(self.model, "pp_pad_chunks", False)
+        )
         if pp <= 0:  # 0 = infer: use every remaining device for pipeline stages
             n_dev = len(list(devices) if devices is not None else jax.devices())
             pp = max(n_dev // (tp * dp * sp), 1)
@@ -189,15 +184,10 @@ class MeshEngine:
         model_cls = get_ring_model_cls(config.model_type)
         self.model = model_cls(config, range(config.num_hidden_layers))
         L = config.num_hidden_layers
-        segmented = getattr(self.model, "ring_phases", 1) > 1
-        if getattr(self.model, "no_pp_mesh", False):
-            if pp > 1:
-                raise NotImplementedError(
-                    f"{config.model_type} with an interleaved dense/moe "
-                    f"layout cannot shard layers over pp={pp}; use tp/sp "
-                    f"axes or the gRPC shard ring"
-                )
-            pp = 1
+        segmented = (
+            getattr(self.model, "ring_phases", 1) > 1
+            or getattr(self.model, "pp_pad_chunks", False)
+        )
         if pp <= 0:
             n_dev = len(list(devices) if devices is not None else jax.devices())
             pp = max(n_dev // (tp * dp * sp), 1)
@@ -299,7 +289,10 @@ class MeshEngine:
         # multiple (exact residual no-ops); the KV cache then holds the
         # padded layer count, laid out per-rank (dense rows then moe rows)
         self._n_kv_layers = len(m.layers)
-        if getattr(m, "ring_phases", 1) > 1:
+        if (
+            getattr(m, "ring_phases", 1) > 1
+            or getattr(m, "pp_pad_chunks", False)
+        ):
             stacked, self._n_kv_layers = m.pad_mesh_segments(stacked, self.pp)
         self._host_window = jax.tree.map(cast, stacked)
         edge_raw = m.map_edge(self.ckpt.load_edge_raw())

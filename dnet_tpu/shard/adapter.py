@@ -148,10 +148,42 @@ class RingAdapter:
             t_sent=time.time(),
             auto_steps=msg.auto_steps,
             drafts=list(msg.drafts),
+            lanes=list(msg.lanes),
         )
         await streams.send(msg.nonce, frame)
 
     async def _send_token(self, msg: ActivationMessage) -> None:
+        if msg.lane_finals:
+            # batched lanes: one callback per member nonce (the batch frame
+            # itself has no token of its own)
+            addr = parse_callback(msg.callback_url)
+            if not addr:
+                log.error("lane finals for %s have no callback", msg.nonce)
+                return
+            client = self._cb_clients.get(addr)
+            if client is None:
+                client = self._make_cb_client(addr)
+                self._cb_clients[addr] = client
+            # members are independent nonces (each appears once per batch):
+            # fan the callbacks out concurrently instead of paying
+            # (N-1) x RTT on every batched step
+            await asyncio.gather(
+                *(
+                    client.send_token(
+                        TokenPayload(
+                            nonce=f["nonce"],
+                            step=int(f["step"]),
+                            token_id=int(f["token_id"]),
+                            logprob=f.get("logprob"),
+                            top_ids=list(f.get("top_ids") or []),
+                            top_logprobs=list(f.get("top_logprobs") or []),
+                            error=f.get("error", ""),
+                        )
+                    )
+                    for f in msg.lane_finals
+                )
+            )
+            return
         if msg.cont is not None:
             # decode grant: feed the sampled token straight back into the
             # ring BEFORE the API callback — the next step's compute starts

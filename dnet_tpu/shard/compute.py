@@ -47,6 +47,7 @@ class ShardCompute:
         mesh_sp: int = 1,
         mesh_devices: Optional[Sequence] = None,
         spec_lookahead: int = 0,
+        lanes: int = 0,
     ) -> None:
         from dnet_tpu.core.kvcache import resolve_kv_bits
 
@@ -131,6 +132,25 @@ class ShardCompute:
             and self.engine.model.kv_rewindable(self.engine.max_seq)
         )
         self._hist: dict[str, np.ndarray] = {}  # head-side draft history
+        # batched lanes (r5): N concurrent nonces share ONE ring pass; the
+        # API coalesces their decode steps into multi-lane frames and this
+        # pool serves them with one batched step (shard/lanes.py).  Needs a
+        # single-round, non-mesh, resident-weight shard — fail at LOAD.
+        self.lane_pool = None
+        if lanes > 1:
+            if len(self.rounds) > 1:
+                raise NotImplementedError(
+                    "batched lanes need a single-round (contiguous) "
+                    "assignment; k-round schedules serve batch=1"
+                )
+            if mesh_tp * mesh_sp > 1:
+                raise NotImplementedError(
+                    "batched lanes on a mesh-backed shard are not wired; "
+                    "drop lanes or the mesh axes"
+                )
+            from dnet_tpu.shard.lanes import LanePool
+
+            self.lane_pool = LanePool(self.engine, lanes)
 
     @property
     def max_layer(self) -> int:
@@ -144,9 +164,13 @@ class ShardCompute:
         if nonce:
             self.engine.end_session(nonce)
             self._hist.pop(nonce, None)
+            if self.lane_pool is not None:
+                self.lane_pool.release(nonce)
         else:
             self.engine.reset()
             self._hist.clear()
+            if self.lane_pool is not None:
+                self.lane_pool.reset()
 
     def _decode_payload(self, msg: ActivationMessage, pos: int):
         """Incoming hidden frame -> padded device array + real length.
@@ -208,6 +232,8 @@ class ShardCompute:
     def process(self, msg: ActivationMessage) -> ActivationMessage:
         """Run this shard's window; returns the outgoing message
         (hidden-state hop or final sampled token)."""
+        if msg.lanes:
+            return self._process_lane_frame(msg)
         eng = self.engine
         nonce = msg.nonce
         pos = msg.pos
@@ -282,6 +308,109 @@ class ShardCompute:
         sess.pos = pos + T
         sess.last_used = time.time()
         return self._emit(msg, sess, x, T, pos, self.is_last, self.max_layer)
+
+    # ---- batched lanes -------------------------------------------------
+    def _process_lane_frame(self, msg: ActivationMessage) -> ActivationMessage:
+        """One coalesced decode step for every member nonce (shard/lanes.py).
+        Members prefilled on this shard's B=1 programs are adopted into pool
+        lanes on their first batched frame."""
+        if self.lane_pool is None:
+            raise ValueError(
+                "batch frame arrived but lanes are not enabled on this shard"
+            )
+        pool = self.lane_pool
+        n = len(msg.lanes)
+        if msg.is_tokens:
+            if not self.is_first:
+                raise ValueError("token batch frame arrived at a non-first shard")
+            tokens = msg.tokens().reshape(n, 1).astype(np.int32)
+            out = pool.step_entry(msg, tokens, self.is_last)
+        else:
+            from dnet_tpu.compression import (
+                decompress_tensor_device,
+                is_compressed_dtype,
+            )
+
+            if is_compressed_dtype(msg.dtype):
+                hidden = decompress_tensor_device(msg.data, msg.dtype, msg.shape)
+            else:
+                hidden = bytes_to_device(msg.data, msg.dtype, msg.shape)
+            if hidden.shape[0] != n or hidden.shape[1] != 1:
+                raise ValueError(
+                    f"batch frame payload {hidden.shape} does not match "
+                    f"{n} single-token lanes"
+                )
+            out = pool.step_hidden(msg, hidden, self.is_last)
+        if self.is_last:
+            return self._lane_finals_message(msg, out)
+        return self._emit_lanes(msg, out)
+
+    def _emit_lanes(self, msg: ActivationMessage, x) -> ActivationMessage:
+        """Hidden hop of a batch frame: member rows stacked [n, 1, H]."""
+        out = np.asarray(x)
+        if self.compress_frac > 0:
+            from dnet_tpu.compression import compress_tensor
+
+            payload, dtype, shape = compress_tensor(
+                out, self.compress_frac, wire_dtype=self.wire_dtype,
+                quant_bits=self.compress_quant_bits,
+            )
+        else:
+            payload, dtype, shape = tensor_to_bytes(out, wire_dtype=self.wire_dtype)
+        return ActivationMessage(
+            nonce=msg.nonce,
+            layer_id=self.max_layer,
+            seq=msg.seq,
+            dtype=dtype,
+            shape=shape,
+            data=payload,
+            pos=msg.pos,
+            callback_url=msg.callback_url,
+            decoding=msg.decoding,
+            lanes=list(msg.lanes),
+        )
+
+    def _lane_finals_message(self, msg: ActivationMessage, results) -> ActivationMessage:
+        """Tail of a batch frame: one TokenResult-shaped dict per member,
+        fanned out as per-nonce SendToken callbacks by the adapter."""
+        finals = []
+        for lane, res in zip(msg.lanes, results):
+            if res is None:  # faulted member: fail it alone
+                finals.append(
+                    {
+                        "nonce": lane["nonce"],
+                        "step": int(lane["seq"]),
+                        "token_id": -1,
+                        "error": lane.get("error") or "lane failed",
+                    }
+                )
+                continue
+            dec = DecodingParams(**(lane.get("decoding") or {}))
+            tr = LocalEngine.token_result(
+                lane["nonce"], res, step=int(lane["seq"]), decoding=dec
+            )
+            finals.append(
+                {
+                    "nonce": tr.nonce,
+                    "step": tr.step,
+                    "token_id": tr.token_id,
+                    "logprob": tr.logprob,
+                    "top_ids": [t for t, _ in (tr.top_logprobs or [])],
+                    "top_logprobs": [lp for _, lp in (tr.top_logprobs or [])],
+                }
+            )
+        return ActivationMessage(
+            nonce=msg.nonce,
+            layer_id=self.max_layer,
+            seq=msg.seq,
+            dtype="token",
+            shape=(len(finals),),
+            pos=msg.pos,
+            callback_url=msg.callback_url,
+            decoding=msg.decoding,
+            is_final=True,
+            lane_finals=finals,
+        )
 
     # ---- ring speculation (head widen / tail verify) -------------------
     def _spec_widen(self, msg: ActivationMessage) -> ActivationMessage:
@@ -456,6 +585,8 @@ class ShardCompute:
 
     def sweep_sessions(self) -> int:
         n = self.engine.sweep_sessions()
+        if self.lane_pool is not None:
+            n += self.lane_pool.sweep(self.engine.kv_ttl_s)
         if self._hist:
             # prune draft histories whose session died (TTL sweep, failed
             # reset RPC): each entry pins a max_seq int64 array

@@ -46,6 +46,9 @@ class ShardLoadModelRequest(BaseModel):
     # ring speculation (head drafts / tail verifies, shard/compute.py);
     # the API only sets this on single-round rewind-safe rings
     spec_lookahead: int = 0
+    # batched lanes (shard/lanes.py): >1 allocates a pooled KV cache so the
+    # API may coalesce that many concurrent nonces into one ring pass
+    lanes: int = 0
 
 
 class MeasureLatencyRequest(BaseModel):

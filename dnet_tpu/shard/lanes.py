@@ -1,0 +1,378 @@
+"""Batched lanes for the gRPC ring: N concurrent nonces per ring pass.
+
+VERDICT r4 next #4 — the ring is the multi-host serving path, and until now
+it decoded batch=1 per nonce: concurrent chats merely interleaved full ring
+passes.  On TPU, decode is weight-bound — lanes 2..N of a batched matmul
+are nearly free — so the API adapter now COALESCES concurrent decode steps
+into one multi-lane frame (api/ring.py), and each shard serves all members
+with ONE batched step over a pooled KV cache.
+
+This module owns the shard-side pool: a fixed set of `slots` KV rows (the
+continuous-batching layout of core/batch.py applied to the ring), vmapped
+head/mid/tail step programs with per-lane `kv_commit` gating, and the
+session->lane adoption that keeps every lane's sampling state (RNG key,
+repetition counts, position) byte-identical to a solo run.  Prefill stays
+on the engine's B=1 bucket programs; the finished session's KV row moves
+into the pool on the nonce's first batched frame (same discipline as
+BatchedEngine._move_to_slot).
+
+Reference contrast: the reference serves ONE in-flight sequence per nonce
+(src/dnet/api/inference.py:135 — a single driver loop per request, no
+cross-request batching anywhere); this is the throughput inversion the
+repo's own north star needed most.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnet_tpu.core.sampler import (
+    MAX_LOGIT_BIAS,
+    SampleParams,
+    SampleResult,
+    encode_logit_bias,
+    sample,
+)
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class LanePool:
+    """Pooled per-lane KV + sampling state and the batched step programs."""
+
+    def __init__(self, engine, slots: int) -> None:
+        if slots < 2:
+            raise ValueError(f"lanes need >= 2 slots, got {slots}")
+        if engine.plan.streams_weights:
+            raise NotImplementedError(
+                "batched lanes need resident weights (fit policy)"
+            )
+        if not engine.model.supports_kv_commit:
+            raise NotImplementedError(
+                f"batched lanes not supported for "
+                f"{engine.config.model_type} (no gated KV writes)"
+            )
+        self.eng = engine
+        self.model = engine.model
+        self.slots = slots
+        self.max_seq = engine.max_seq
+        m = self.model
+        self.kv = m.init_kv(
+            len(m.layers), slots, self.max_seq, engine.kv_dtype,
+            quant_bits=engine.kv_quant_bits,
+        )
+        V = engine.config.vocab_size
+        self.counts = jnp.zeros((slots, V), dtype=jnp.int32)
+        self.keys = jax.random.split(
+            jax.random.key(int.from_bytes(__import__("os").urandom(4), "little")),
+            slots,
+        )
+        self.pos = np.zeros(slots, dtype=np.int64)
+        self.last_used = np.zeros(slots, dtype=np.float64)
+        self.slot_of: Dict[str, int] = {}
+        self._free: List[int] = list(range(slots))
+        self._build()
+
+    # ---- programs -----------------------------------------------------
+    def _build(self) -> None:
+        model = self.model
+        kv_axes = jax.tree.map(lambda _: 1, self.kv)
+        sp_axes = SampleParams(0, 0, 0, 0, 0, 0, 0, 0)
+
+        def window_one(wp, x, kv, pos, active):
+            """Shared body: one lane's window pass (B=1 re-added)."""
+            kv = jax.tree.map(lambda a: a[:, None], kv)
+            x, kv = model.apply_window(wp, x, kv, pos, kv_commit=active)
+            return x, jax.tree.map(lambda a: a[:, 0], kv)
+
+        def one_head(wp, ep, token, kv, pos, active):
+            """First shard: token in, hidden out."""
+            x = model.embed(ep, token[None, :])  # [1, 1, D]
+            x, kv = window_one(wp, x, kv, pos, active)
+            return x[0], kv
+
+        def one_mid(wp, x_row, kv, pos, active):
+            """Interior shard: hidden in, hidden out."""
+            x, kv = window_one(wp, x_row[None], kv, pos, active)
+            return x[0], kv
+
+        def sample_one(ep, x, kv, pos, active, sp, key, counts):
+            """Shared tail: head projection + per-lane sample (the exact
+            RNG/counts discipline of BatchedEngine.one — inactive lanes
+            advance nothing)."""
+            x = model.normalize(ep, x[:, -1:])
+            logits = model.lm_project(ep, x)[:, 0]  # [1, V]
+            new_key, step_key = jax.random.split(key)
+            res = sample(logits, sp, step_key, token_counts=counts[None])
+            counts = counts.at[res.token[0]].add(jnp.where(active, 1, 0))
+            key = jax.random.wrap_key_data(
+                jnp.where(
+                    active,
+                    jax.random.key_data(new_key),
+                    jax.random.key_data(key),
+                )
+            )
+            return res, kv, counts, key
+
+        def one_tail(wp, ep, x_row, kv, pos, active, sp, key, counts):
+            """Last shard: hidden in, sampled token out."""
+            x, kv = window_one(wp, x_row[None], kv, pos, active)
+            return sample_one(ep, x, kv, pos, active, sp, key, counts)
+
+        def one_full(wp, ep, token, kv, pos, active, sp, key, counts):
+            """Single-shard ring: token in, sampled token out."""
+            x = model.embed(ep, token[None, :])
+            x, kv = window_one(wp, x, kv, pos, active)
+            return sample_one(ep, x, kv, pos, active, sp, key, counts)
+
+        self._head = jax.jit(
+            jax.vmap(
+                one_head,
+                in_axes=(None, None, 0, kv_axes, 0, 0),
+                out_axes=(0, kv_axes),
+            ),
+            donate_argnums=(3,),
+        )
+        self._mid = jax.jit(
+            jax.vmap(
+                one_mid,
+                in_axes=(None, 0, kv_axes, 0, 0),
+                out_axes=(0, kv_axes),
+            ),
+            donate_argnums=(2,),
+        )
+        self._tail = jax.jit(
+            jax.vmap(
+                one_tail,
+                in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
+                out_axes=(0, kv_axes, 0, 0),
+            ),
+            donate_argnums=(3, 8),
+        )
+        self._full = jax.jit(
+            jax.vmap(
+                one_full,
+                in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
+                out_axes=(0, kv_axes, 0, 0),
+            ),
+            donate_argnums=(3, 8),
+        )
+
+    # ---- lane lifecycle ----------------------------------------------
+    def adopt(self, nonce: str) -> int:
+        """Move the nonce's prefilled B=1 session into a pool lane: KV row,
+        RNG key, repetition counts, position.  The continued stream is
+        byte-identical to the solo session's."""
+        slot = self.slot_of.get(nonce)
+        if slot is not None:
+            return slot
+        sess = self.eng.sessions.get(nonce)
+        if sess is None:
+            raise ValueError(f"no prefilled session for {nonce!r} to adopt")
+        if not self._free:
+            raise RuntimeError(f"no free lanes (capacity {self.slots})")
+        slot = self._free.pop(0)
+        self.slot_of[nonce] = slot
+        self.kv = jax.tree.map(
+            lambda big, one: big.at[:, slot : slot + 1].set(one.astype(big.dtype)),
+            self.kv,
+            sess.kv,
+        )
+        self.counts = self.counts.at[slot].set(sess.counts[0])
+        self.keys = self.keys.at[slot].set(sess.key)
+        self.pos[slot] = sess.pos
+        self.last_used[slot] = time.time()
+        self.eng.end_session(nonce)  # the B=1 cache row is now dead weight
+        return slot
+
+    def release(self, nonce: str) -> None:
+        """Host-side bookkeeping ONLY.  Reset RPCs arrive on the servicer
+        thread while a donating batched step may be in flight on the
+        compute thread — touching self.counts/kv here would race the
+        donated buffers ("Buffer has been deleted or donated").  Device
+        rows need no cleanup: adopt() fully overwrites the lane's KV row,
+        counts row, and RNG key for the next owner."""
+        slot = self.slot_of.pop(nonce, None)
+        if slot is not None:
+            self.pos[slot] = 0
+            self._free.append(slot)
+
+    def reset(self) -> None:
+        for nonce in list(self.slot_of):
+            self.release(nonce)
+
+    def sweep(self, ttl_s: float) -> int:
+        now = time.time()
+        dead = [
+            n for n, s in self.slot_of.items() if now - self.last_used[s] > ttl_s
+        ]
+        for n in dead:
+            self.release(n)
+        return len(dead)
+
+    # ---- batched step -------------------------------------------------
+    def _scatter(self, msg) -> tuple:
+        """Full-width (slots) arrays from a batch frame's member rows.
+
+        Per-member fault isolation: a bad lane (reset race -> no session to
+        adopt, stale pos, capacity) is FLAGGED on its lane dict (the flag
+        rides the remaining hops) and skipped — one cancelled request must
+        never error-fail its batchmates.  `order` maps member index to
+        slot, None for faulted members."""
+        active = np.zeros(self.slots, dtype=bool)
+        pos = np.zeros(self.slots, dtype=np.int32)
+        order: List = []
+        used: set = set()
+        for lane in msg.lanes:
+            if lane.get("error"):  # faulted on an earlier shard
+                order.append(None)
+                continue
+            nonce = lane["nonce"]
+            try:
+                slot = self.slot_of.get(nonce)
+                if slot is None:
+                    slot = self.adopt(nonce)
+                lpos = int(lane["pos"])
+                if lpos != self.pos[slot]:
+                    raise ValueError(
+                        f"frame pos {lpos} != lane pos {int(self.pos[slot])} "
+                        f"(stale or out-of-order frame)"
+                    )
+                if lpos >= self.max_seq:
+                    raise ValueError(
+                        f"sequence length {lpos} reached max_seq {self.max_seq}"
+                    )
+                if slot in used:
+                    raise ValueError("duplicate nonce in a batch frame")
+            except Exception as exc:
+                log.warning("lane %s faulted: %s", nonce, exc)
+                lane["error"] = str(exc)
+                order.append(None)
+                continue
+            used.add(slot)
+            active[slot] = True
+            pos[slot] = lpos
+            order.append(slot)
+        return active, pos, order
+
+    def _sample_params(self, msg, order) -> SampleParams:
+        from dnet_tpu.core.types import DecodingParams
+
+        S = self.slots
+        temp = np.zeros(S, dtype=np.float32)
+        top_p = np.ones(S, dtype=np.float32)
+        top_k = np.zeros(S, dtype=np.int32)
+        min_p = np.zeros(S, dtype=np.float32)
+        rep = np.ones(S, dtype=np.float32)
+        mtk = np.ones(S, dtype=np.int32)
+        b_ids = np.full((S, MAX_LOGIT_BIAS), -1, dtype=np.int32)
+        b_vals = np.zeros((S, MAX_LOGIT_BIAS), dtype=np.float32)
+        for lane, slot in zip(msg.lanes, order):
+            if slot is None:
+                continue
+            dec = DecodingParams(**lane.get("decoding") or {})
+            temp[slot] = dec.temperature
+            top_p[slot] = dec.top_p
+            top_k[slot] = dec.top_k
+            min_p[slot] = dec.min_p
+            rep[slot] = dec.repetition_penalty
+            mtk[slot] = dec.min_tokens_to_keep
+            b_ids[slot], b_vals[slot] = encode_logit_bias(dec.logit_bias)
+        return SampleParams(
+            temperature=jnp.asarray(temp),
+            top_p=jnp.asarray(top_p),
+            top_k=jnp.asarray(top_k),
+            min_p=jnp.asarray(min_p),
+            repetition_penalty=jnp.asarray(rep),
+            min_tokens_to_keep=jnp.asarray(mtk),
+            bias_ids=jnp.asarray(b_ids),
+            bias_vals=jnp.asarray(b_vals),
+        )
+
+    def step_entry(self, msg, tokens: np.ndarray, is_last: bool):
+        """Head-shard batched step.  tokens [n, 1] int32 in member order.
+        Returns hidden [n, 1, D] (ring continues) or per-member
+        SampleResults (single-shard ring)."""
+        active, pos, order = self._scatter(msg)
+        token_full = np.zeros((self.slots, 1), dtype=np.int32)
+        for (slot, row) in zip(order, tokens):
+            if slot is not None:
+                token_full[slot] = row
+        eng = self.eng
+        if is_last:
+            sp = self._sample_params(msg, order)
+            res, self.kv, self.counts, self.keys = self._full(
+                eng.window_params, eng.edge_params, jnp.asarray(token_full),
+                self.kv, jnp.asarray(pos), jnp.asarray(active), sp,
+                self.keys, self.counts,
+            )
+            return self._advance_and_slice(res, order)
+        x, self.kv = self._head(
+            eng.window_params, eng.edge_params, jnp.asarray(token_full),
+            self.kv, jnp.asarray(pos), jnp.asarray(active),
+        )
+        self._advance(order)
+        return x[self._gather_idx(order)]
+
+    def step_hidden(self, msg, hidden, is_last: bool):
+        """Mid/tail-shard batched step.  hidden [n, 1, D] in member order."""
+        active, pos, order = self._scatter(msg)
+        D = hidden.shape[-1]
+        x_full = jnp.zeros((self.slots, 1, D), dtype=self.eng.param_dtype)
+        good = [i for i, o in enumerate(order) if o is not None]
+        x_full = x_full.at[np.asarray([order[i] for i in good])].set(
+            jnp.asarray(hidden)[np.asarray(good)].astype(self.eng.param_dtype)
+        )
+        eng = self.eng
+        if is_last:
+            sp = self._sample_params(msg, order)
+            res, self.kv, self.counts, self.keys = self._tail(
+                eng.window_params, eng.edge_params, x_full, self.kv,
+                jnp.asarray(pos), jnp.asarray(active), sp,
+                self.keys, self.counts,
+            )
+            return self._advance_and_slice(res, order)
+        x, self.kv = self._mid(
+            eng.window_params, x_full, self.kv, jnp.asarray(pos),
+            jnp.asarray(active),
+        )
+        self._advance(order)
+        return x[self._gather_idx(order)]
+
+    @staticmethod
+    def _gather_idx(order) -> np.ndarray:
+        """Member-order gather indices; faulted members (slot None) reuse
+        row 0 — an inert garbage row their flagged lane metadata marks."""
+        return np.asarray([o if o is not None else 0 for o in order])
+
+    def _advance(self, order) -> None:
+        now = time.time()
+        for slot in order:
+            if slot is None:
+                continue
+            self.pos[slot] += 1
+            self.last_used[slot] = now
+
+    def _advance_and_slice(self, res, order) -> List[Optional[SampleResult]]:
+        """Per-member B=1 SampleResult views (host-side) from the vmapped
+        full-width outputs — each slice drops into LocalEngine.token_result
+        unchanged.  Faulted members yield None (error finals upstream)."""
+        self._advance(order)
+        res = jax.tree.map(np.asarray, res)
+        return [
+            None
+            if slot is None
+            else SampleResult(
+                token=res.token[slot],
+                logprob=res.logprob[slot],
+                top_tokens=res.top_tokens[slot],
+                top_logprobs=res.top_logprobs[slot],
+            )
+            for slot in order
+        ]

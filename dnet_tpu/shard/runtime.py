@@ -71,6 +71,7 @@ class ShardRuntime:
         mesh_tp: int = 1,
         mesh_sp: int = 1,
         spec_lookahead: int = 0,
+        lanes: int = 0,
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
@@ -93,6 +94,7 @@ class ShardRuntime:
                 mesh_tp=mesh_tp,
                 mesh_sp=mesh_sp,
                 spec_lookahead=spec_lookahead,
+                lanes=lanes,
             )
             self.model_path = str(model_dir)
             log.info(
@@ -152,6 +154,32 @@ class ShardRuntime:
                 self._emit(out)
             except Exception as exc:
                 log.exception("compute failed for nonce %s", msg.nonce)
+                if msg.lanes:
+                    # a batch frame's carrier nonce has no future API-side:
+                    # fail every MEMBER so their drivers surface the error
+                    # instead of blocking the full request timeout
+                    self._emit(
+                        ActivationMessage(
+                            nonce=msg.nonce,
+                            layer_id=msg.layer_id,
+                            seq=msg.seq,
+                            dtype="error",
+                            shape=(),
+                            pos=msg.pos,
+                            callback_url=msg.callback_url,
+                            is_final=True,
+                            lane_finals=[
+                                {
+                                    "nonce": lane["nonce"],
+                                    "step": int(lane["seq"]),
+                                    "token_id": -1,
+                                    "error": str(exc),
+                                }
+                                for lane in msg.lanes
+                            ],
+                        )
+                    )
+                    continue
                 self._emit(
                     ActivationMessage(
                         nonce=msg.nonce,

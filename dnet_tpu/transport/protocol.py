@@ -69,6 +69,9 @@ class ActivationFrame:
     # continuation (tail -> head, committed to the head's draft history)
     drafts: List[int] = field(default_factory=list)
     committed: List[int] = field(default_factory=list)
+    # batched lanes: per-member {"nonce","seq","pos","decoding"} metadata of
+    # a coalesced decode frame (payload rows stacked in the same order)
+    lanes: List[dict] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         d = asdict(self)
@@ -96,6 +99,7 @@ class ActivationFrame:
             auto_steps=self.auto_steps,
             drafts=list(self.drafts),
             committed=list(self.committed),
+            lanes=list(self.lanes),
         )
 
 

@@ -1,0 +1,205 @@
+"""Batched lanes over a REAL two-process ring (VERDICT r4 next #4).
+
+DNET_API_RING_LANES=4: the API coalesces concurrent chats' decode steps
+into multi-lane gRPC frames; each shard serves all members in one batched
+step.  Asserted here end to end: per-request outputs byte-identical to
+solo runs, and 4 concurrent chats complete >= 2x faster than the same 4
+run serially (the reference's single-sequence driver —
+src/dnet/api/inference.py:135 — is the baseline being beaten).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import httpx
+import pytest
+
+pytestmark = pytest.mark.integration
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_health(url: str, timeout: float = 60.0) -> dict:
+    t0 = time.monotonic()
+    last = None
+    while time.monotonic() - t0 < timeout:
+        try:
+            r = httpx.get(url, timeout=2.0)
+            if r.status_code == 200:
+                return r.json()
+        except httpx.HTTPError as exc:
+            last = exc
+        time.sleep(0.5)
+    raise TimeoutError(f"{url} not healthy after {timeout}s: {last}")
+
+
+@pytest.fixture(scope="module")
+def lanes_cluster(tiny_llama_dir, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("lanes_cluster")
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+        "DNET_API_PARAM_DTYPE": "float32",
+        "DNET_API_RING_LANES": "4",
+        "DNET_LOG_TO_FILE": "0",
+    }
+    ports = {
+        "s0_http": free_port(), "s0_grpc": free_port(),
+        "s1_http": free_port(), "s1_grpc": free_port(),
+        "api_http": free_port(), "api_grpc": free_port(),
+    }
+    hostfile = tmp / "hostfile"
+    hostfile.write_text(
+        f"s0 127.0.0.1 {ports['s0_http']} {ports['s0_grpc']}\n"
+        f"s1 127.0.0.1 {ports['s1_http']} {ports['s1_grpc']}\n"
+    )
+    procs = []
+    logs = []
+
+    def spawn(name, *argv):
+        lf = open(tmp / f"{name}.log", "w")
+        logs.append((name, tmp / f"{name}.log"))
+        p = subprocess.Popen(
+            [sys.executable, "-m", *argv],
+            env=env, stdout=lf, stderr=subprocess.STDOUT, cwd=str(tmp),
+        )
+        procs.append(p)
+        return p
+
+    spawn(
+        "s0", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+        "--http-port", str(ports["s0_http"]), "--grpc-port", str(ports["s0_grpc"]),
+        "--shard-name", "s0",
+    )
+    spawn(
+        "s1", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+        "--http-port", str(ports["s1_http"]), "--grpc-port", str(ports["s1_grpc"]),
+        "--shard-name", "s1",
+    )
+    spawn(
+        "api", "dnet_tpu.cli.api", "--host", "127.0.0.1",
+        "--http-port", str(ports["api_http"]), "--grpc-port", str(ports["api_grpc"]),
+        "--hostfile", str(hostfile),
+    )
+    try:
+        wait_health(f"http://127.0.0.1:{ports['s0_http']}/health")
+        wait_health(f"http://127.0.0.1:{ports['s1_http']}/health")
+        wait_health(f"http://127.0.0.1:{ports['api_http']}/health")
+        yield ports, tiny_llama_dir
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for name, path in logs:
+            tail = path.read_text()[-2000:]
+            print(f"\n===== {name} log tail =====\n{tail}")
+
+
+PROMPTS = [
+    "Say hi",
+    "Count to three",
+    "Name a color",
+    "What is water?",
+]
+
+
+def _chat(base: str, prompt: str, max_tokens: int = 48) -> str:
+    r = httpx.post(
+        f"{base}/v1/chat/completions",
+        json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": max_tokens,
+            "temperature": 0,
+        },
+        timeout=300.0,
+    )
+    assert r.status_code == 200, r.text
+    return r.json()["choices"][0]["message"]["content"]
+
+
+def test_concurrent_chats_batch_and_match(lanes_cluster):
+    ports, model_dir = lanes_cluster
+    base = f"http://127.0.0.1:{ports['api_http']}"
+
+    r = httpx.post(
+        f"{base}/v1/prepare_topology_manual",
+        json={
+            "model": str(model_dir),
+            "assignments": [
+                {"instance": "s0", "layers": [0, 1]},
+                {"instance": "s1", "layers": [2, 3]},
+            ],
+        },
+        timeout=30.0,
+    )
+    assert r.status_code == 200, r.text
+    r = httpx.post(
+        f"{base}/v1/load_model", json={"model": str(model_dir)}, timeout=300.0
+    )
+    assert r.status_code == 200, r.text
+
+    # warmup: compile the lane programs + the solo path before timing
+    with ThreadPoolExecutor(4) as ex:
+        list(ex.map(lambda p: _chat(base, p, 8), PROMPTS))
+    _chat(base, PROMPTS[0], 8)
+
+    # serial baseline: the reference's serving shape (one in-flight request)
+    t0 = time.perf_counter()
+    solo = [_chat(base, p) for p in PROMPTS]
+    t_serial = time.perf_counter() - t0
+
+    # concurrent: the adapter coalesces the four decode streams into
+    # multi-lane frames (4 nonces per ring pass)
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(4) as ex:
+        conc = list(ex.map(lambda p: _chat(base, p), PROMPTS))
+    t_conc = time.perf_counter() - t0
+
+    # correctness first: batching must not change any stream (greedy)
+    assert conc == solo
+    speedup = t_serial / t_conc
+    print(f"lanes speedup: serial {t_serial:.2f}s / concurrent {t_conc:.2f}s = {speedup:.2f}x")
+    assert speedup >= 2.0, (
+        f"expected >= 2x aggregate speedup from batched lanes, got "
+        f"{speedup:.2f}x (serial {t_serial:.2f}s, concurrent {t_conc:.2f}s)"
+    )
+
+
+def test_lanes_survive_request_churn(lanes_cluster):
+    """Requests joining/leaving mid-flight (different lengths) keep every
+    stream correct — lane release on EOS, re-allocation for new nonces."""
+    ports, model_dir = lanes_cluster
+    base = f"http://127.0.0.1:{ports['api_http']}"
+
+    lens = [6, 12, 18, 24]
+    solo = [_chat(base, p, n) for p, n in zip(PROMPTS, lens)]
+    with ThreadPoolExecutor(4) as ex:
+        conc = list(
+            ex.map(lambda pn: _chat(base, pn[0], pn[1]), zip(PROMPTS, lens))
+        )
+    assert conc == solo
+    # second wave reuses freed lanes
+    with ThreadPoolExecutor(4) as ex:
+        again = list(
+            ex.map(lambda pn: _chat(base, pn[0], pn[1]), zip(PROMPTS, lens))
+        )
+    assert again == solo
