@@ -16,6 +16,7 @@ import time
 from pathlib import Path
 from typing import Optional
 
+from dnet_tpu.config import get_settings
 from dnet_tpu.utils.logger import get_logger
 from dnet_tpu.utils.tokenizer import load_tokenizer
 
@@ -219,7 +220,8 @@ class LocalModelManager:
                 # the mesh chunk programs (K-step full-ring scans) are the
                 # most expensive compiles in the codebase: do them now, not
                 # mid-stream on the first request's ramp
-                engine.warm_chunks()
+                if get_settings().api.warm_on_load:
+                    engine.warm_chunks()
             elif self.batch_slots > 1:
                 from dnet_tpu.core.batch import BatchedEngine
 
@@ -239,7 +241,8 @@ class LocalModelManager:
                 )
                 # compile the batched step + fused-chunk widths now, not on
                 # the first request while every lane shares one executor
-                engine.warm_chunks()
+                if get_settings().api.warm_on_load:
+                    engine.warm_chunks()
             else:
                 from dnet_tpu.core.engine import LocalEngine
 
@@ -256,7 +259,8 @@ class LocalModelManager:
                 )
                 # compile the chunked decode widths now, not mid-stream on
                 # the first request's ramp
-                engine.warm_chunks()
+                if get_settings().api.warm_on_load:
+                    engine.warm_chunks()
             return engine, load_tokenizer(model_dir)
 
         engine, tokenizer = await loop.run_in_executor(None, _build)

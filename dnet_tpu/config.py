@@ -244,6 +244,11 @@ class ApiSettings(_EnvGroup):
     # halts on EOS / cache capacity; overshoot past a stop SEQUENCE is
     # discarded like local decode chunks.  0 disables.
     ring_auto_steps: int = 16
+    # compile the decode-chunk program matrix at LOAD time (no first-request
+    # ramp stall).  0 defers every compile to first use — faster model
+    # hot-swaps where startup latency matters more than first-token latency
+    # (CI model-matrix loops, A/B harnesses).
+    warm_on_load: bool = True
     # batched lanes over the ring: >1 coalesces that many concurrent
     # requests' decode steps into ONE multi-lane ring pass (shard/lanes.py).
     # Needs a single-round non-mesh topology; grants and ring speculation
