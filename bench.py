@@ -52,10 +52,18 @@ def _measure_fused(model, window, edge, kv, batch: int, n_steps: int = 64) -> fl
     token = jnp.ones((batch, 1), dtype=jnp.int32)
     toks, kv = step(window, edge, token, kv, jnp.int32(0))  # warmup/compile
     toks.block_until_ready()
-    t0 = time.perf_counter()
-    toks, kv = step(window, edge, token, kv, jnp.int32(n_steps))
-    toks.block_until_ready()
-    return batch * n_steps / (time.perf_counter() - t0)
+    # best-of-2 timed windows: the ceiling is the denominator of
+    # serve_vs_fused, and a one-shot window swings +/-6% under shared-CPU
+    # scheduling (r4's apparent 0.93 -> 0.86 "regression" was exactly this)
+    best = 0.0
+    pos = n_steps
+    for _ in range(2):
+        t0 = time.perf_counter()
+        toks, kv = step(window, edge, token, kv, jnp.int32(pos))
+        toks.block_until_ready()
+        best = max(best, batch * n_steps / (time.perf_counter() - t0))
+        pos += n_steps
+    return best
 
 
 def _measure_fused_chunks(engine, batch: int, n_steps: int = 256) -> float:
