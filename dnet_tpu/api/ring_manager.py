@@ -200,21 +200,19 @@ class RingModelManager:
 
     def _lanes_for(self, topo) -> int:
         """Batched-lane preconditions the API can check up front: a
-        configured lane count, a single-round topology with no streaming
-        windows and no mesh-backed shards.  Shards re-check at load."""
+        configured lane count and a single-round topology with no
+        streaming windows.  Mesh-backed shards COMPOSE with lanes (r5:
+        shard_map(vmap) lane programs).  Shards re-check at load."""
         from dnet_tpu.config import get_settings
 
         lanes = get_settings().api.ring_lanes
         if lanes <= 1:
             return 0
         if any(
-            len(_contiguous_runs(a.layers)) > 1
-            or a.window_size > 0
-            or a.mesh_tp > 1
-            or a.mesh_sp > 1
+            len(_contiguous_runs(a.layers)) > 1 or a.window_size > 0
             for a in topo.assignments
         ):
-            log.info("ring lanes off: k-round, streaming, or mesh topology")
+            log.info("ring lanes off: k-round or streaming topology")
             return 0
         return lanes
 

@@ -367,6 +367,84 @@ class MeshShardEngine(LocalEngine):
                 make_spec_step(model, window_pass, L), donate_argnums=(3, 4)
             )
 
+    # ---- batched lanes over the mesh (r5) ------------------------------
+    def place_lane_kv(self, kv):
+        """Lane-pool cache placement: [L, slots, S, KVH, Hd] with the same
+        axis meanings as the B=1 cache — slots ride the (size-1) dp axis,
+        heads shard over tp, sequence over sp."""
+        _, _, kv = place_ring_state({}, {}, kv, self.mesh)
+        return kv
+
+    def build_lane_programs(self, kv_template) -> dict:
+        """shard_map(vmap(...)) lane step programs: the per-lane window
+        pass (per-lane pos + kv_commit gating) vmaps INSIDE the mesh
+        program, so the tp psum seams batch over lanes; head projection +
+        per-lane sampling run on the replicated output outside shard_map.
+        Signatures match LanePool._build_local exactly — ShardCompute's
+        batch-frame hot loop cannot tell the substrates apart."""
+        from dnet_tpu.core.sampler import SampleParams
+        from dnet_tpu.shard.lanes import lane_sampler
+
+        model, mesh = self.model, self.mesh
+        sp_axis = AXIS_SP if self.sp > 1 else None
+        has_kinds = getattr(model, "layer_kinds", None) is not None
+        kinds_arr = model.layer_kinds if has_kinds else jnp.zeros((), jnp.int32)
+        kvs = kv_spec(sp_axis is not None)
+        kv_axes = jax.tree.map(lambda _: 1, kv_template)
+        sample_one = lane_sampler(model)
+        sp_axes = SampleParams(0, 0, 0, 0, 0, 0, 0, 0)
+
+        def window_lanes(wp, x, kv, pos, active, kinds):
+            def one(x_row, kv_row, p, a):
+                kv1 = jax.tree.map(lambda t: t[:, None], kv_row)
+                xo = jax.lax.pcast(x_row[None], ("pp", "dp"), to="varying")
+                xo, kv1 = model.apply_window(
+                    wp, xo, kv1, p,
+                    layer_kinds=kinds if has_kinds else None,
+                    tp_axis=AXIS_TP, sp_axis=sp_axis, kv_commit=a,
+                )
+                xo = jax.lax.psum(xo, ("pp", "dp"))
+                return xo[0], jax.tree.map(lambda t: t[:, 0], kv1)
+
+            return jax.vmap(
+                one, in_axes=(0, kv_axes, 0, 0), out_axes=(0, kv_axes)
+            )(x, kv, pos, active)
+
+        core = jax.shard_map(
+            window_lanes, mesh=mesh,
+            in_specs=(self._window_specs, P(), kvs, P(), P(), P()),
+            out_specs=(P(), kvs),
+        )
+
+        def head(wp, ep, token, kv, pos, active):
+            x = model.embed(ep, token)  # [slots, 1, D]
+            return core(wp, x, kv, pos, active, kinds_arr)
+
+        def mid(wp, x, kv, pos, active):
+            return core(wp, x, kv, pos, active, kinds_arr)
+
+        def tail(wp, ep, x, kv, pos, active, sp, keys, counts):
+            x, kv = core(wp, x, kv, pos, active, kinds_arr)
+            res, counts, keys = jax.vmap(
+                sample_one, in_axes=(None, 0, 0, sp_axes, 0, 0)
+            )(ep, x[:, None], active, sp, keys, counts)
+            return res, kv, counts, keys
+
+        def full(wp, ep, token, kv, pos, active, sp, keys, counts):
+            x = model.embed(ep, token)
+            x, kv = core(wp, x, kv, pos, active, kinds_arr)
+            res, counts, keys = jax.vmap(
+                sample_one, in_axes=(None, 0, 0, sp_axes, 0, 0)
+            )(ep, x[:, None], active, sp, keys, counts)
+            return res, kv, counts, keys
+
+        return {
+            "head": jax.jit(head, donate_argnums=(3,)),
+            "mid": jax.jit(mid, donate_argnums=(2,)),
+            "tail": jax.jit(tail, donate_argnums=(3, 8)),
+            "full": jax.jit(full, donate_argnums=(3, 8)),
+        }
+
     # ---- sessions -----------------------------------------------------
     def new_session(
         self, nonce: str, seed: Optional[int] = None, kv=None, pos: int = 0

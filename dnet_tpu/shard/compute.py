@@ -135,7 +135,9 @@ class ShardCompute:
         # batched lanes (r5): N concurrent nonces share ONE ring pass; the
         # API coalesces their decode steps into multi-lane frames and this
         # pool serves them with one batched step (shard/lanes.py).  Needs a
-        # single-round, non-mesh, resident-weight shard — fail at LOAD.
+        # single-round assignment with resident weights (LanePool refuses
+        # streaming plans at construction — load-time, not first-frame);
+        # mesh-backed shards compose (shard_map(vmap) lane programs).
         self.lane_pool = None
         if lanes > 1:
             if len(self.rounds) > 1:
@@ -143,11 +145,9 @@ class ShardCompute:
                     "batched lanes need a single-round (contiguous) "
                     "assignment; k-round schedules serve batch=1"
                 )
-            if mesh_tp * mesh_sp > 1:
-                raise NotImplementedError(
-                    "batched lanes on a mesh-backed shard are not wired; "
-                    "drop lanes or the mesh axes"
-                )
+            # composes with mesh-backed shards too (r5): MeshShardEngine
+            # supplies shard_map(vmap(...)) lane programs — N nonces per
+            # ring pass, each pass SPMD over the host's local chips
             from dnet_tpu.shard.lanes import LanePool
 
             self.lane_pool = LanePool(self.engine, lanes)

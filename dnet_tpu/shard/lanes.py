@@ -43,6 +43,30 @@ from dnet_tpu.utils.logger import get_logger
 log = get_logger()
 
 
+def lane_sampler(model):
+    """Per-lane head projection + sample — the exact RNG/counts discipline
+    of BatchedEngine.one (inactive lanes advance nothing).  Shared by the
+    plain vmapped programs below and the mesh-shard lane programs
+    (parallel/shard_mesh.py), which differ only in the window pass."""
+
+    def sample_one(ep, x, active, sp, key, counts):
+        x = model.normalize(ep, x[:, -1:])
+        logits = model.lm_project(ep, x)[:, 0]  # [1, V]
+        new_key, step_key = jax.random.split(key)
+        res = sample(logits, sp, step_key, token_counts=counts[None])
+        counts = counts.at[res.token[0]].add(jnp.where(active, 1, 0))
+        key = jax.random.wrap_key_data(
+            jnp.where(
+                active,
+                jax.random.key_data(new_key),
+                jax.random.key_data(key),
+            )
+        )
+        return res, counts, key
+
+    return sample_one
+
+
 class LanePool:
     """Pooled per-lane KV + sampling state and the batched step programs."""
 
@@ -63,10 +87,18 @@ class LanePool:
         self.slots = slots
         self.max_seq = engine.max_seq
         m = self.model
-        self.kv = m.init_kv(
+        kv = m.init_kv(
             len(m.layers), slots, self.max_seq, engine.kv_dtype,
             quant_bits=engine.kv_quant_bits,
+            # sp shards the sequence axis — a rotating SWA ring buffer
+            # would alias it (same rule as MeshShardEngine.new_session)
+            rotating=(getattr(engine, "sp", 1) == 1),
         )
+        # mesh-backed shards place the pool with their kv sharding (slots
+        # ride the size-1 dp axis, heads/sequence shard over tp/sp)
+        if hasattr(engine, "place_lane_kv"):
+            kv = engine.place_lane_kv(kv)
+        self.kv = kv
         V = engine.config.vocab_size
         self.counts = jnp.zeros((slots, V), dtype=jnp.int32)
         self.keys = jax.random.split(
@@ -77,13 +109,23 @@ class LanePool:
         self.last_used = np.zeros(slots, dtype=np.float64)
         self.slot_of: Dict[str, int] = {}
         self._free: List[int] = list(range(slots))
-        self._build()
+        if hasattr(engine, "build_lane_programs"):
+            # mesh-backed shard: shard_map(vmap(...)) programs from the
+            # engine (parallel/shard_mesh.py)
+            progs = engine.build_lane_programs(self.kv)
+        else:
+            progs = self._build_local()
+        self._head = progs["head"]
+        self._mid = progs["mid"]
+        self._tail = progs["tail"]
+        self._full = progs["full"]
 
     # ---- programs -----------------------------------------------------
-    def _build(self) -> None:
+    def _build_local(self) -> dict:
         model = self.model
         kv_axes = jax.tree.map(lambda _: 1, self.kv)
         sp_axes = SampleParams(0, 0, 0, 0, 0, 0, 0, 0)
+        sample_one = lane_sampler(model)
 
         def window_one(wp, x, kv, pos, active):
             """Shared body: one lane's window pass (B=1 re-added)."""
@@ -102,67 +144,53 @@ class LanePool:
             x, kv = window_one(wp, x_row[None], kv, pos, active)
             return x[0], kv
 
-        def sample_one(ep, x, kv, pos, active, sp, key, counts):
-            """Shared tail: head projection + per-lane sample (the exact
-            RNG/counts discipline of BatchedEngine.one — inactive lanes
-            advance nothing)."""
-            x = model.normalize(ep, x[:, -1:])
-            logits = model.lm_project(ep, x)[:, 0]  # [1, V]
-            new_key, step_key = jax.random.split(key)
-            res = sample(logits, sp, step_key, token_counts=counts[None])
-            counts = counts.at[res.token[0]].add(jnp.where(active, 1, 0))
-            key = jax.random.wrap_key_data(
-                jnp.where(
-                    active,
-                    jax.random.key_data(new_key),
-                    jax.random.key_data(key),
-                )
-            )
-            return res, kv, counts, key
-
         def one_tail(wp, ep, x_row, kv, pos, active, sp, key, counts):
             """Last shard: hidden in, sampled token out."""
             x, kv = window_one(wp, x_row[None], kv, pos, active)
-            return sample_one(ep, x, kv, pos, active, sp, key, counts)
+            res, counts, key = sample_one(ep, x, active, sp, key, counts)
+            return res, kv, counts, key
 
         def one_full(wp, ep, token, kv, pos, active, sp, key, counts):
             """Single-shard ring: token in, sampled token out."""
             x = model.embed(ep, token[None, :])
             x, kv = window_one(wp, x, kv, pos, active)
-            return sample_one(ep, x, kv, pos, active, sp, key, counts)
+            res, counts, key = sample_one(ep, x, active, sp, key, counts)
+            return res, kv, counts, key
 
-        self._head = jax.jit(
-            jax.vmap(
-                one_head,
-                in_axes=(None, None, 0, kv_axes, 0, 0),
-                out_axes=(0, kv_axes),
+        return {
+            "head": jax.jit(
+                jax.vmap(
+                    one_head,
+                    in_axes=(None, None, 0, kv_axes, 0, 0),
+                    out_axes=(0, kv_axes),
+                ),
+                donate_argnums=(3,),
             ),
-            donate_argnums=(3,),
-        )
-        self._mid = jax.jit(
-            jax.vmap(
-                one_mid,
-                in_axes=(None, 0, kv_axes, 0, 0),
-                out_axes=(0, kv_axes),
+            "mid": jax.jit(
+                jax.vmap(
+                    one_mid,
+                    in_axes=(None, 0, kv_axes, 0, 0),
+                    out_axes=(0, kv_axes),
+                ),
+                donate_argnums=(2,),
             ),
-            donate_argnums=(2,),
-        )
-        self._tail = jax.jit(
-            jax.vmap(
-                one_tail,
-                in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
-                out_axes=(0, kv_axes, 0, 0),
+            "tail": jax.jit(
+                jax.vmap(
+                    one_tail,
+                    in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
+                    out_axes=(0, kv_axes, 0, 0),
+                ),
+                donate_argnums=(3, 8),
             ),
-            donate_argnums=(3, 8),
-        )
-        self._full = jax.jit(
-            jax.vmap(
-                one_full,
-                in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
-                out_axes=(0, kv_axes, 0, 0),
+            "full": jax.jit(
+                jax.vmap(
+                    one_full,
+                    in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
+                    out_axes=(0, kv_axes, 0, 0),
+                ),
+                donate_argnums=(3, 8),
             ),
-            donate_argnums=(3, 8),
-        )
+        }
 
     # ---- lane lifecycle ----------------------------------------------
     def adopt(self, nonce: str) -> int:

@@ -58,15 +58,10 @@ class Shard:
                 kv_bits=req.kv_bits,
                 weight_quant_bits=req.weight_quant_bits,
                 # 0 = the shard's own deployment default (each host knows
-                # its chip count better than the API node does) — unless
-                # the API planned batched LANES, an explicit plan the
-                # implicit mesh default must not veto at load time (the
-                # API cannot see this host's DNET_SHARD_MESH_*; a planned
-                # mesh_tp > 1 suppresses lanes API-side instead)
-                mesh_tp=req.mesh_tp
-                or (1 if req.lanes > 1 else get_settings().shard.mesh_tp),
-                mesh_sp=req.mesh_sp
-                or (1 if req.lanes > 1 else get_settings().shard.mesh_sp),
+                # its chip count better than the API node does); lanes
+                # compose with either resolution (r5)
+                mesh_tp=req.mesh_tp or get_settings().shard.mesh_tp,
+                mesh_sp=req.mesh_sp or get_settings().shard.mesh_sp,
                 spec_lookahead=req.spec_lookahead,
                 lanes=req.lanes,
                 # engine ignores it unless plan_policy chose a streaming

@@ -237,3 +237,101 @@ def test_lane_frame_wire_roundtrip():
     assert g.lanes == f.lanes
     m = g.to_message()
     assert m.lanes == f.lanes
+
+
+def _drive_lane_batches(shards, prompts, decs, n_tok):
+    """Prefill each nonce solo, then decode via coalesced batch frames."""
+    got = {n: [_prefill(shards, n, prompts[n], decs[n])] for n in prompts}
+    pos = {n: len(prompts[n]) for n in prompts}
+    for step in range(1, n_tok):
+        members = [(n, got[n][-1], pos[n], decs[n]) for n in prompts]
+        msg = _batch_frame(members, step)
+        for sc in shards:
+            msg = sc.process(msg)
+        by_nonce = {f["nonce"]: f for f in msg.lane_finals}
+        for n in prompts:
+            got[n].append(int(by_nonce[n]["token_id"]))
+            pos[n] += 1
+    for sc in shards:
+        sc.engine.close()
+    return got
+
+
+def test_lanes_compose_with_mesh_shards(tiny_llama_dir, eight_devices):
+    """Lanes x mesh-backed shards (the full north-star composition): each
+    ring pass serves N nonces AND runs SPMD over the host's chips —
+    shard_map(vmap) lane programs, per-lane pos/kv_commit inside the mesh
+    program.  Streams equal solo."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    n_tok = 5
+    prompts = {"a": [256, 72, 101], "b": [7, 3, 11, 7]}
+    dec = DecodingParams(temperature=0.0)
+    decs = {n: dec for n in prompts}
+    want = {
+        n: _solo_stream(tiny_llama_dir, prompts[n], dec, n_tok)
+        for n in prompts
+    }
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", lanes=2, mesh_tp=2,
+        mesh_devices=eight_devices[0:2],
+    )
+    hi = ShardCompute(
+        tiny_llama_dir, [2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", lanes=2, mesh_tp=2,
+        mesh_devices=eight_devices[2:4],
+    )
+    assert lo.lane_pool is not None and lo.engine.tp == 2
+    got = _drive_lane_batches([lo, hi], prompts, decs, n_tok)
+    assert got == want
+
+
+def test_lanes_compose_with_sp_mesh_shard(tiny_llama_dir, eight_devices):
+    """Lanes over an sp=2 mesh shard: per-lane KV shards its sequence axis
+    while lanes batch the ring pass."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    n_tok = 5
+    prompts = {"a": [256, 72, 101], "b": [11, 3, 7, 1]}
+    dec = DecodingParams(temperature=0.0)
+    decs = {n: dec for n in prompts}
+    want = {
+        n: _solo_stream(tiny_llama_dir, prompts[n], dec, n_tok)
+        for n in prompts
+    }
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", lanes=2, mesh_tp=1, mesh_sp=2,
+        mesh_devices=eight_devices[0:2],
+    )
+    hi = ShardCompute(
+        tiny_llama_dir, [2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", lanes=2,
+    )
+    got = _drive_lane_batches([lo, hi], prompts, decs, n_tok)
+    assert got == want
+
+
+def test_lanes_mesh_seeded_sampling_parity(tiny_llama_dir, eight_devices):
+    """Seeded SAMPLED lanes over a mesh shard: RNG/counts adoption keeps
+    every stream byte-identical to its solo run."""
+    from dnet_tpu.shard.compute import ShardCompute
+
+    n_tok = 5
+    prompts = {"a": [256, 72, 101], "b": [7, 3, 11]}
+    decs = {
+        "a": DecodingParams(temperature=0.8, top_p=0.9, seed=11),
+        "b": DecodingParams(temperature=0.6, seed=12),
+    }
+    want = {
+        n: _solo_stream(tiny_llama_dir, prompts[n], decs[n], n_tok)
+        for n in prompts
+    }
+    lo = ShardCompute(
+        tiny_llama_dir, [0, 1, 2, 3], max_seq=64, param_dtype="float32",
+        wire_dtype="float32", lanes=2, mesh_tp=2,
+        mesh_devices=eight_devices[0:2],
+    )
+    got = _drive_lane_batches([lo], prompts, decs, n_tok)
+    assert got == want
