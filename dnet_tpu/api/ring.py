@@ -7,6 +7,7 @@ ShardApi gRPC servicer (src/dnet/api/grpc_servicer/servicer.py:19-37).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import time
 from dataclasses import asdict
 from typing import Callable, Dict, List, Optional
@@ -37,6 +38,7 @@ class RingApiAdapter(ApiAdapterBase):
         stream_idle_s: float = 300.0,
         auto_steps: int = 0,
         lanes: int = 1,
+        prefix_cache: int = 0,
     ) -> None:
         from dnet_tpu.transport.grpc_transport import RingClient
 
@@ -71,6 +73,19 @@ class RingApiAdapter(ApiAdapterBase):
         # nonces mid-generation (first send -> reset): the flusher holds a
         # batch open only while MORE active streams could still join it
         self._active: Dict[str, bool] = {}
+        # ring prefix caching (r5): the API alone sees token ids, so IT
+        # matches prefixes and keys every shard-side snapshot through the
+        # prompt frames.  The index (shared PrefixIndex matcher, values =
+        # snapshot keys) mirrors the shards' SnapshotStore LRUs (same
+        # capacity, same put/get sequence); a shard-side miss (e.g. a
+        # restarted shard) error-fails that request with `prefix-miss:<key>`
+        # and invalidates the entry here, so the next request re-stores.
+        from dnet_tpu.core.prefix_cache import PrefixIndex
+
+        self._prefix_cap = max(int(prefix_cache), 0)
+        self._prefix_index = PrefixIndex(
+            max(self._prefix_cap, 1), self.PREFIX_MIN_TOKENS
+        )
 
     async def start(self) -> None:
         self._head_client = self._make_client(self.head_addr)
@@ -166,14 +181,25 @@ class RingApiAdapter(ApiAdapterBase):
         auto = 0
         if self._auto_steps > 0 and budget is not None and budget > 1:
             auto = min(self._auto_steps, budget - 1)
+        pos = self._pos_for(nonce, step, len(token_ids))
+        send_ids = token_ids
+        prefix_hit = prefix_store = ""
+        if step == 0 and self._prefix_cap > 0:
+            ids = tuple(token_ids)
+            hit = self._prefix_lookup(ids)
+            if hit is not None:
+                pos, prefix_hit = hit
+                send_ids = token_ids[pos:]  # prefill only the new suffix
+            if len(ids) >= self.PREFIX_MIN_TOKENS:
+                prefix_store = self._prefix_put(ids)
         payload, dtype, shape = tensor_to_bytes(
-            np.asarray([token_ids], dtype=np.int32)
+            np.asarray([send_ids], dtype=np.int32)
         )
         frame = ActivationFrame(
             nonce=nonce,
             seq=step,
             layer_id=-1,
-            pos=self._pos_for(nonce, step, len(token_ids)),
+            pos=pos,
             dtype="tokens",
             shape=shape,
             payload=payload,
@@ -181,6 +207,8 @@ class RingApiAdapter(ApiAdapterBase):
             decoding=asdict(decoding),
             t_sent=time.time(),
             auto_steps=auto,
+            prefix_hit=prefix_hit,
+            prefix_store=prefix_store,
         )
         if auto:
             self._granted[nonce] = step + auto
@@ -245,6 +273,24 @@ class RingApiAdapter(ApiAdapterBase):
                         )
                     )
 
+    PREFIX_MIN_TOKENS = 16  # tiny prompts aren't worth a snapshot
+
+    def _prefix_lookup(self, ids: tuple):
+        """Longest indexed strict-proper-prefix of `ids` (matching rules
+        owned by core.prefix_cache.PrefixIndex).  (n_tokens, key) or None."""
+        return self._prefix_index.lookup(ids)
+
+    def _prefix_put(self, ids: tuple) -> str:
+        """Index the full prompt and return its store key (shards snapshot
+        under it as the prompt frame passes)."""
+        key = self._prefix_index.get_exact(ids)
+        if key is None:
+            key = hashlib.sha1(
+                np.asarray(ids, dtype=np.int64).tobytes()
+            ).hexdigest()[:16]
+            self._prefix_index.put(ids, key)
+        return key
+
     def _pos_for(self, nonce: str, step: int, n_tokens: int) -> int:
         """Step 0 injects the whole prompt at pos 0; every later step
         appends exactly ONE token, so pos is DERIVED (prompt_len + step - 1)
@@ -262,6 +308,10 @@ class RingApiAdapter(ApiAdapterBase):
         return await self._futures.wait(nonce, step, timeout)
 
     def resolve_token(self, result: TokenResult) -> None:
+        if result.error and result.error.startswith("prefix-miss:"):
+            # a shard lost (or never had) this snapshot: drop the index
+            # entry so the NEXT request re-prefills in full and re-stores
+            self._prefix_index.drop_value(result.error.split(":", 2)[1])
         if not self._futures.resolve(result):
             if result.step <= self._granted.get(result.nonce, -1):
                 # a granted step raced ahead of the driver's await: hold it

@@ -123,6 +123,7 @@ class RingModelManager:
         max_seq = max_seq or self.max_seq
         lanes = self._lanes_for(topo)
         spec = 0 if lanes > 1 else self._spec_lookahead_for(topo, model_dir, max_seq)
+        prefix = self._prefix_for(topo)
 
         async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
             for a in topo.assignments:
@@ -158,6 +159,9 @@ class RingModelManager:
                     # batched lanes: every shard allocates the same pooled
                     # lane count so coalesced frames serve end to end
                     "lanes": lanes,
+                    # ring prefix caching: same snapshot capacity on every
+                    # shard (the API index mirrors their LRU sequence)
+                    "prefix_cache": prefix,
                 }
                 url = f"http://{dev.host}:{dev.http_port}/load_model"
                 r = await client.post(url, json=body)
@@ -184,6 +188,7 @@ class RingModelManager:
             max_seq_len=max_seq,
             auto_steps=get_settings().api.ring_auto_steps,
             lanes=max(lanes, 1),
+            prefix_cache=prefix,
         )
         await adapter.start()
         self.inference.adapter = adapter
@@ -198,23 +203,45 @@ class RingModelManager:
         log.info("ring model %s loaded across %d shard(s) in %.1fs", model_id, len(topo.assignments), dt)
         return dt
 
+    @staticmethod
+    def _single_round_resident(topo) -> bool:
+        """The shared topology precondition for lanes / prefix caching /
+        ring speculation: every assignment is one contiguous run (the
+        prompt visits each shard once) with no streaming window (resident
+        KV/weights)."""
+        return not any(
+            len(_contiguous_runs(a.layers)) > 1 or a.window_size > 0
+            for a in topo.assignments
+        )
+
     def _lanes_for(self, topo) -> int:
         """Batched-lane preconditions the API can check up front: a
-        configured lane count and a single-round topology with no
-        streaming windows.  Mesh-backed shards COMPOSE with lanes (r5:
-        shard_map(vmap) lane programs).  Shards re-check at load."""
+        configured lane count and a single-round resident topology.
+        Mesh-backed shards COMPOSE with lanes (r5: shard_map(vmap) lane
+        programs).  Shards re-check at load."""
         from dnet_tpu.config import get_settings
 
         lanes = get_settings().api.ring_lanes
         if lanes <= 1:
             return 0
-        if any(
-            len(_contiguous_runs(a.layers)) > 1 or a.window_size > 0
-            for a in topo.assignments
-        ):
+        if not self._single_round_resident(topo):
             log.info("ring lanes off: k-round or streaming topology")
             return 0
         return lanes
+
+    def _prefix_for(self, topo) -> int:
+        """Ring prefix-cache preconditions: a configured capacity and a
+        single-round resident topology (a streamed shard keeps per-layer
+        kv lists; a k-round prompt visits shards twice)."""
+        from dnet_tpu.config import get_settings
+
+        cap = get_settings().api.prefix_cache
+        if cap <= 0:
+            return 0
+        if not self._single_round_resident(topo):
+            log.info("ring prefix cache off: k-round or streaming topology")
+            return 0
+        return cap
 
     def _spec_lookahead_for(self, topo, model_dir, max_seq: int) -> int:
         """Ring speculation preconditions the API can check up front: a
@@ -226,10 +253,7 @@ class RingModelManager:
         L = get_settings().api.spec_lookahead
         if L <= 0:
             return 0
-        if any(
-            len(_contiguous_runs(a.layers)) > 1 or a.window_size > 0
-            for a in topo.assignments
-        ):
+        if not self._single_round_resident(topo):
             log.info("ring speculation off: k-round or streaming topology")
             return 0
         try:

@@ -35,19 +35,24 @@ def _copy_tree(tree):
     return jax.tree.map(lambda a: a.copy(), tree)
 
 
-class PrefixCache:
+class PrefixIndex:
+    """Longest-strict-proper-prefix matcher + LRU over token-id tuples —
+    the ONE owner of the matching invariants (>= 1 token must remain to
+    prefill, so the forward pass can produce the last position's logits;
+    move-to-end on hit; evict-oldest at capacity; tiny prompts skipped).
+    PrefixCache stores KV snapshots in it; the ring API adapter
+    (api/ring.py) stores snapshot KEYS — both sides of ring prefix
+    caching thus share one matching implementation."""
+
     def __init__(self, capacity: int, min_tokens: int = 16) -> None:
         self.capacity = capacity
-        self.min_tokens = min_tokens  # tiny prompts aren't worth a snapshot
+        self.min_tokens = min_tokens
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[Tuple[int, ...], dict]" = OrderedDict()
-        # prompt ids -> kv snapshot (repetition counts are zero at prefill
-        # end — they track generated tokens only — so KV is the whole state)
-        self.stats = {"hits": 0, "misses": 0, "stores": 0}
+        self._entries: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
 
-    def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, dict]]:
-        """Longest cached prefix covering at most len(prompt)-1 tokens.
-        Returns (n_tokens, kv copy) or None."""
+    def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, object]]:
+        """Longest entry covering at most len(prompt)-1 tokens; bumps LRU.
+        Returns (n_tokens, value) or None."""
         ids = tuple(prompt_ids)
         with self._lock:
             best = None
@@ -59,22 +64,107 @@ class PrefixCache:
                     if best is None or len(key) > len(best):
                         best = key
             if best is None:
-                self.stats["misses"] += 1
                 return None
-            kv = self._entries[best]
             self._entries.move_to_end(best)
-            self.stats["hits"] += 1
-        return len(best), _copy_tree(kv)
+            return len(best), self._entries[best]
 
-    def store(self, prompt_ids: Sequence[int], kv: dict) -> None:
+    def get_exact(self, prompt_ids: Sequence[int]):
+        """Exact-match value (LRU-bumped) or None."""
+        ids = tuple(prompt_ids)
+        with self._lock:
+            if ids not in self._entries:
+                return None
+            self._entries.move_to_end(ids)
+            return self._entries[ids]
+
+    def put(self, prompt_ids: Sequence[int], value) -> bool:
+        """Insert if absent and long enough; True iff newly stored."""
         ids = tuple(prompt_ids)
         if len(ids) < self.min_tokens:
-            return
+            return False
         with self._lock:
             if ids in self._entries:
                 self._entries.move_to_end(ids)
+                return False
+            self._entries[ids] = value
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+            return True
+
+    def drop_value(self, value) -> None:
+        """Remove every entry holding `value` (ring prefix-miss recovery)."""
+        with self._lock:
+            for key in [k for k, v in self._entries.items() if v == value]:
+                del self._entries[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+class PrefixCache:
+    def __init__(self, capacity: int, min_tokens: int = 16) -> None:
+        # prompt ids -> kv snapshot (repetition counts are zero at prefill
+        # end — they track generated tokens only — so KV is the whole state)
+        self._index = PrefixIndex(capacity, min_tokens)
+        self.min_tokens = min_tokens
+        self.stats = {"hits": 0, "misses": 0, "stores": 0}
+
+    def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, dict]]:
+        """Longest cached prefix covering at most len(prompt)-1 tokens.
+        Returns (n_tokens, kv copy) or None."""
+        hit = self._index.lookup(prompt_ids)
+        if hit is None:
+            self.stats["misses"] += 1
+            return None
+        self.stats["hits"] += 1
+        n, kv = hit
+        return n, _copy_tree(kv)
+
+    def store(self, prompt_ids: Sequence[int], kv: dict) -> None:
+        if len(prompt_ids) < self.min_tokens:
+            return
+        if self._index.get_exact(prompt_ids) is not None:
+            return
+        if self._index.put(prompt_ids, _copy_tree(kv)):
+            self.stats["stores"] += 1
+
+    def clear(self) -> None:
+        self._index.clear()
+
+
+class SnapshotStore:
+    """String-keyed KV snapshot LRU — the SHARD half of ring prefix caching.
+
+    The API node owns prefix MATCHING (it alone sees token ids; mid shards
+    see only hidden states) and drives every store/hit by key through the
+    activation frames; each shard keeps its own window's KV snapshot under
+    that key.  Same defensive-copy rules as PrefixCache: engine step
+    functions donate KV, so snapshots copy in AND out."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, Tuple[int, dict]]" = OrderedDict()
+        self.stats = {"hits": 0, "misses": 0, "stores": 0}
+
+    def get(self, key: str) -> Optional[Tuple[int, dict]]:
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self.stats["misses"] += 1
+                return None
+            self._entries.move_to_end(key)
+            self.stats["hits"] += 1
+            n, kv = hit
+        return n, _copy_tree(kv)
+
+    def put(self, key: str, pos: int, kv: dict) -> None:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
                 return
-            self._entries[ids] = _copy_tree(kv)
+            self._entries[key] = (pos, _copy_tree(kv))
             self.stats["stores"] += 1
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)

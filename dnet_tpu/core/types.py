@@ -92,6 +92,13 @@ class ActivationMessage:
     # SendToken callbacks.
     lanes: list = field(default_factory=list)
     lane_finals: Optional[list] = None
+    # ring prefix caching (r5): the API (which alone sees token ids) keys
+    # every store/hit.  A prompt frame with `prefix_store` asks each shard
+    # to snapshot its post-prefill KV under that key; one with `prefix_hit`
+    # seeds the session from the shard's snapshot (the frame then carries
+    # only the SUFFIX tokens at pos = the snapshot length).
+    prefix_store: str = ""
+    prefix_hit: str = ""
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0

@@ -149,6 +149,8 @@ class RingAdapter:
             auto_steps=msg.auto_steps,
             drafts=list(msg.drafts),
             lanes=list(msg.lanes),
+            prefix_store=msg.prefix_store,
+            prefix_hit=msg.prefix_hit,
         )
         await streams.send(msg.nonce, frame)
 

@@ -48,6 +48,7 @@ class ShardCompute:
         mesh_devices: Optional[Sequence] = None,
         spec_lookahead: int = 0,
         lanes: int = 0,
+        prefix_cache: int = 0,
     ) -> None:
         from dnet_tpu.core.kvcache import resolve_kv_bits
 
@@ -151,6 +152,20 @@ class ShardCompute:
             from dnet_tpu.shard.lanes import LanePool
 
             self.lane_pool = LanePool(self.engine, lanes)
+        # ring prefix caching (r5): the API keys every store/hit through the
+        # frames (it alone sees token ids — mid shards see hidden states);
+        # this shard keeps ITS window's KV snapshots under those keys.
+        # Needs resident weights (kv is a list under streaming) and a
+        # single-round assignment (the prompt visits k times otherwise).
+        self.prefix_snaps = None
+        if (
+            prefix_cache > 0
+            and len(self.rounds) == 1
+            and not self.engine.plan.streams_weights
+        ):
+            from dnet_tpu.core.prefix_cache import SnapshotStore
+
+            self.prefix_snaps = SnapshotStore(prefix_cache)
 
     @property
     def max_layer(self) -> int:
@@ -171,6 +186,8 @@ class ShardCompute:
             self._hist.clear()
             if self.lane_pool is not None:
                 self.lane_pool.reset()
+            if self.prefix_snaps is not None:
+                self.prefix_snaps.clear()
 
     def _decode_payload(self, msg: ActivationMessage, pos: int):
         """Incoming hidden frame -> padded device array + real length.
@@ -239,7 +256,12 @@ class ShardCompute:
         pos = msg.pos
         sess = eng.sessions.get(nonce)
         if sess is None:
-            if pos > 0:
+            if msg.prefix_hit:
+                # prompt frame continuing a cached prefix: seed this
+                # shard's session from its snapshot (frame pos = prefix
+                # length, payload = the suffix only)
+                sess = self._seed_prefix_session(msg)
+            elif pos > 0:
                 # a mid-stream frame with no session is STALE — a decode
                 # grant still circulating after the driver's stop-sequence
                 # reset, or a TTL-swept request.  Recreating the session
@@ -250,7 +272,8 @@ class ShardCompute:
                     f"no session for {nonce!r} at pos {pos} "
                     f"(reset or expired); dropping frame"
                 )
-            sess = eng.new_session(nonce, msg.decoding.seed)
+            else:
+                sess = eng.new_session(nonce, msg.decoding.seed)
 
         if msg.is_tokens and self.is_first and self._spec_ok:
             # HEAD: record entries in the draft history; widen eligible
@@ -299,6 +322,7 @@ class ShardCompute:
                 )
                 sess.pos = pos + T
                 sess.last_used = time.time()
+                self._maybe_snapshot(msg, sess)
                 return self._final_message(msg, res, sess)
             else:
                 x, sess.kv = eng._hidden(
@@ -307,7 +331,37 @@ class ShardCompute:
 
         sess.pos = pos + T
         sess.last_used = time.time()
+        self._maybe_snapshot(msg, sess)
         return self._emit(msg, sess, x, T, pos, self.is_last, self.max_layer)
+
+    # ---- ring prefix caching -------------------------------------------
+    def _seed_prefix_session(self, msg: ActivationMessage):
+        """Create the nonce's session from this shard's prefix snapshot.
+        A missing/mismatched snapshot fails with a parseable `prefix-miss:`
+        error — the API invalidates its index entry so the NEXT request
+        re-prefills and re-stores (shards never half-serve a prefix)."""
+        if self.prefix_snaps is None:
+            raise ValueError(
+                f"prefix-miss:{msg.prefix_hit}: prefix caching disabled on "
+                f"this shard (streaming, k-round, or capacity 0)"
+            )
+        hit = self.prefix_snaps.get(msg.prefix_hit)
+        if hit is None:
+            raise ValueError(
+                f"prefix-miss:{msg.prefix_hit}: no snapshot on this shard"
+            )
+        n, kv = hit
+        if n != msg.pos:
+            raise ValueError(
+                f"prefix-miss:{msg.prefix_hit}: snapshot covers {n} tokens "
+                f"but the frame resumes at pos {msg.pos}"
+            )
+        return self.engine.new_session(msg.nonce, msg.decoding.seed, kv=kv, pos=n)
+
+    def _maybe_snapshot(self, msg: ActivationMessage, sess) -> None:
+        """Store this shard's post-prompt KV under the API-chosen key."""
+        if msg.prefix_store and self.prefix_snaps is not None:
+            self.prefix_snaps.put(msg.prefix_store, sess.pos, sess.kv)
 
     # ---- batched lanes -------------------------------------------------
     def _process_lane_frame(self, msg: ActivationMessage) -> ActivationMessage:
@@ -544,9 +598,12 @@ class ShardCompute:
             callback_url=msg.callback_url,
             decoding=msg.decoding,
             # the decode grant (and any verify drafts) must reach the TAIL:
-            # they ride every hop
+            # they ride every hop — as do the prefix store/hit keys (every
+            # shard snapshots/seeds its own window)
             auto_steps=msg.auto_steps,
             drafts=list(msg.drafts),
+            prefix_store=msg.prefix_store,
+            prefix_hit=msg.prefix_hit,
         )
 
     def _final_message(self, msg: ActivationMessage, res, sess) -> ActivationMessage:
@@ -596,10 +653,13 @@ class ShardCompute:
         return n
 
     def health(self) -> dict:
-        return {
+        out = {
             "layers": list(self.layers),
             "sessions": len(self.engine.sessions),
         }
+        if self.prefix_snaps is not None:
+            out["prefix_cache"] = dict(self.prefix_snaps.stats)
+        return out
 
     def probe_stage_time(self, steps: int = 3) -> float:
         """Measured seconds/token for THIS stage: run the real process()

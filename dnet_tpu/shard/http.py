@@ -49,6 +49,9 @@ class ShardLoadModelRequest(BaseModel):
     # batched lanes (shard/lanes.py): >1 allocates a pooled KV cache so the
     # API may coalesce that many concurrent nonces into one ring pass
     lanes: int = 0
+    # ring prefix caching (shard/compute.py): per-shard KV snapshot count;
+    # the API keys every store/hit through the prompt frames
+    prefix_cache: int = 0
 
 
 class MeasureLatencyRequest(BaseModel):
@@ -90,6 +93,8 @@ class ShardHTTPServer:
         if compute is not None:
             eng = compute.engine
             mesh = {"mesh_tp": getattr(eng, "tp", 1), "mesh_sp": getattr(eng, "sp", 1)}
+            if compute.prefix_snaps is not None:
+                mesh["prefix_cache"] = dict(compute.prefix_snaps.stats)
         return web.json_response(
             {
                 "status": "ok",

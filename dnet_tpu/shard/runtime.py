@@ -72,6 +72,7 @@ class ShardRuntime:
         mesh_sp: int = 1,
         spec_lookahead: int = 0,
         lanes: int = 0,
+        prefix_cache: int = 0,
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
@@ -95,6 +96,7 @@ class ShardRuntime:
                 mesh_sp=mesh_sp,
                 spec_lookahead=spec_lookahead,
                 lanes=lanes,
+                prefix_cache=prefix_cache,
             )
             self.model_path = str(model_dir)
             log.info(

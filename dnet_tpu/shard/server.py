@@ -64,6 +64,7 @@ class Shard:
                 mesh_sp=req.mesh_sp or get_settings().shard.mesh_sp,
                 spec_lookahead=req.spec_lookahead,
                 lanes=req.lanes,
+                prefix_cache=req.prefix_cache,
                 # engine ignores it unless plan_policy chose a streaming
                 # policy — no second copy of that decision here
                 repack_dir=get_settings().shard.repack_dir,

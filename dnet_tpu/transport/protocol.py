@@ -72,6 +72,9 @@ class ActivationFrame:
     # batched lanes: per-member {"nonce","seq","pos","decoding"} metadata of
     # a coalesced decode frame (payload rows stacked in the same order)
     lanes: List[dict] = field(default_factory=list)
+    # ring prefix caching: store/seed keys on prompt frames (core/types.py)
+    prefix_store: str = ""
+    prefix_hit: str = ""
 
     def to_bytes(self) -> bytes:
         d = asdict(self)
@@ -100,6 +103,8 @@ class ActivationFrame:
             drafts=list(self.drafts),
             committed=list(self.committed),
             lanes=list(self.lanes),
+            prefix_store=self.prefix_store,
+            prefix_hit=self.prefix_hit,
         )
 
 

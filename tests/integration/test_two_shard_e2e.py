@@ -59,6 +59,10 @@ def cluster(tiny_llama_dir, tmp_path_factory):
         # in this module: the determinism/equality assertions below verify
         # the composed path end to end over real gRPC
         "DNET_API_SPEC_LOOKAHEAD": "4",
+        # ring prefix caching rides the same requests: repeated multi-turn
+        # prompts hit per-shard snapshots (suffix-only prefill) while the
+        # equality assertions pin unchanged outputs
+        "DNET_API_PREFIX_CACHE": "4",
         "DNET_LOG_TO_FILE": "0",
     }
     # shards resolve the model path directly (absolute), no models_dir needed
@@ -190,6 +194,70 @@ def test_two_shard_chat(cluster):
     assert r.status_code == 200
     h0 = httpx.get(f"http://127.0.0.1:{ports['s0_http']}/health", timeout=5).json()
     assert h0["model"] is None and h0["layers"] == []
+
+
+def test_prefix_cache_multiturn(cluster):
+    """Ring prefix caching over the real wire: a multi-turn request whose
+    history was served before prefills only the new turn (per-shard KV
+    snapshots), and its answer is byte-identical to the full-prefill run
+    of the same bytes."""
+    ports, model_dir = cluster
+    base = f"http://127.0.0.1:{ports['api_http']}"
+    r = httpx.post(
+        f"{base}/v1/prepare_topology_manual",
+        json={
+            "model": str(model_dir),
+            "assignments": [
+                {"instance": "s0", "layers": [0, 1]},
+                {"instance": "s1", "layers": [2, 3]},
+            ],
+        },
+        timeout=30.0,
+    )
+    assert r.status_code == 200, r.text
+    r = httpx.post(
+        f"{base}/v1/load_model", json={"model": str(model_dir)}, timeout=300.0
+    )
+    assert r.status_code == 200, r.text
+
+    turn1 = {"role": "user", "content": "Tell me a long story about the sea"}
+    # synthetic assistant turn: the multi-turn prompt must exist BEFORE
+    # turn1 is ever served, so its first run is genuinely uncached
+    multi = [
+        turn1,
+        {"role": "assistant", "content": "Once upon a tide"},
+        {"role": "user", "content": "Now continue it"},
+    ]
+
+    def chat(messages):
+        r = httpx.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "model": str(model_dir), "messages": messages,
+                "max_tokens": 6, "temperature": 0,
+            },
+            timeout=120.0,
+        )
+        assert r.status_code == 200, r.text
+        return r.json()["choices"][0]["message"]["content"]
+
+    # 1) full prefill: NOTHING indexed matches this prompt yet (turn1 has
+    #    not been served; earlier tests used different conversations)
+    a_nocache = chat(multi)
+    # 2) serve turn 1 — its rendered prompt (a strict prefix of multi's)
+    #    snapshots on every shard
+    chat([turn1])
+    # 3) the SAME grown prompt now hits turn 1's snapshot (suffix-only
+    #    prefill) — the answer must equal the full-prefill run
+    a_cached = chat(multi)
+    assert a_cached == a_nocache
+    # the hit actually happened on both shards (not a silent full prefill)
+    for s in ("s0", "s1"):
+        h = httpx.get(
+            f"http://127.0.0.1:{ports[f'{s}_http']}/health", timeout=5
+        ).json()
+        assert h["prefix_cache"]["hits"] >= 1, h
+    httpx.post(f"{base}/v1/unload_model", timeout=60.0)
 
 
 def test_mesh_backed_shards_chat(cluster):

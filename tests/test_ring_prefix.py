@@ -1,0 +1,149 @@
+"""Ring prefix caching (r5): per-shard KV snapshots keyed by the API.
+
+The API alone sees token ids, so it matches prefixes and drives every
+store/hit through the prompt frames; each shard (head, mid, tail — and
+mesh-backed shards) snapshots/seeds its OWN window's KV.  A hit prefills
+only the new suffix; streams must equal full-prefill references exactly.
+"""
+
+import numpy as np
+import pytest
+
+from dnet_tpu.core.types import ActivationMessage, DecodingParams
+
+pytestmark = [pytest.mark.shard]
+
+PROMPT = [256, 72, 101, 108, 108, 111, 7, 3, 11, 7, 3, 11, 256, 84, 104, 101]
+
+
+def _mk_chain(tiny_llama_dir, prefix_cache, mesh=None):
+    from dnet_tpu.shard.compute import ShardCompute
+
+    kw = dict(
+        max_seq=64, param_dtype="float32", wire_dtype="float32",
+        prefix_cache=prefix_cache,
+    )
+    if mesh:
+        lo = ShardCompute(
+            tiny_llama_dir, [0, 1], mesh_tp=2, mesh_devices=mesh[:2], **kw
+        )
+        hi = ShardCompute(
+            tiny_llama_dir, [2, 3], mesh_tp=2, mesh_devices=mesh[2:4], **kw
+        )
+    else:
+        lo = ShardCompute(tiny_llama_dir, [0, 1], **kw)
+        hi = ShardCompute(tiny_llama_dir, [2, 3], **kw)
+    return lo, hi
+
+
+def _drive(shards, nonce, ids, n, pos0=0, store="", hit=""):
+    """Prompt frame (optionally a suffix continuing a cached prefix), then
+    greedy token-by-token decode."""
+    dec = DecodingParams(temperature=0.0)
+    toks = []
+    arr = np.asarray([ids], dtype=np.int32)
+    pos = pos0
+    for step in range(n):
+        msg = ActivationMessage(
+            nonce=nonce, layer_id=-1, seq=step, dtype="tokens",
+            shape=arr.shape, data=arr.tobytes(), pos=pos, decoding=dec,
+            prefix_store=store if step == 0 else "",
+            prefix_hit=hit if step == 0 else "",
+        )
+        for sc in shards:
+            msg = sc.process(msg)
+        assert msg.is_final, f"step {step} did not finish"
+        if msg.error:
+            raise RuntimeError(msg.error)
+        pos += arr.shape[1]
+        toks.append(msg.token_id)
+        arr = np.asarray([[msg.token_id]], dtype=np.int32)
+    return toks
+
+
+@pytest.mark.parametrize("meshy", [False, True])
+def test_prefix_hit_matches_full_prefill(tiny_llama_dir, eight_devices, meshy):
+    """Store on request 1, hit on request 2 (same grown prompt + a new
+    turn): the suffix-only prefill must produce the exact full-prefill
+    stream — on plain AND mesh-backed shards."""
+    mesh = eight_devices if meshy else None
+    shards = _mk_chain(tiny_llama_dir, prefix_cache=4, mesh=mesh)
+    n = 4
+    key = "k1"
+    # request 1: full prompt, snapshot stored on every shard
+    first = _drive(shards, "r1", PROMPT, n, store=key)
+    # request 2: the grown multi-turn prompt = PROMPT + last answer + more
+    suffix = [first[-1], 256, 110]
+    full = PROMPT + suffix
+    # reference: a FRESH chain prefills the whole grown prompt
+    ref_shards = _mk_chain(tiny_llama_dir, prefix_cache=0, mesh=mesh)
+    want = _drive(ref_shards, "ref", full, n)
+    for sc in ref_shards:
+        sc.engine.close()
+    # hit: only the suffix prefills, at pos = len(PROMPT)
+    got = _drive(
+        shards, "r2", suffix, n, pos0=len(PROMPT), hit=key
+    )
+    for sc in shards:
+        sc.engine.close()
+    assert got == want
+
+
+def test_prefix_miss_fails_with_parseable_error(tiny_llama_dir):
+    shards = _mk_chain(tiny_llama_dir, prefix_cache=4)
+    with pytest.raises(ValueError, match=r"prefix-miss:ghost"):
+        _drive(shards, "r", [1, 2, 3], 1, pos0=8, hit="ghost")
+    for sc in shards:
+        sc.engine.close()
+
+
+def test_prefix_snapshot_isolated_from_decode(tiny_llama_dir):
+    """The stored snapshot must be a COPY: request 1 keeps decoding (and
+    donating its KV) after the store; a later hit still reproduces the
+    reference stream."""
+    shards = _mk_chain(tiny_llama_dir, prefix_cache=4)
+    first = _drive(shards, "r1", PROMPT, 8, store="k")  # long decode after store
+    suffix = [first[0]]
+    ref_shards = _mk_chain(tiny_llama_dir, prefix_cache=0)
+    want = _drive(ref_shards, "ref", PROMPT + suffix, 3)
+    for sc in ref_shards:
+        sc.engine.close()
+    got = _drive(shards, "r2", suffix, 3, pos0=len(PROMPT), hit="k")
+    for sc in shards:
+        sc.engine.close()
+    assert got == want
+
+
+def test_adapter_prefix_index_roundtrip():
+    """API-side matching: store on first prompt, longest-prefix hit on the
+    grown prompt, invalidation on a prefix-miss error token."""
+    from dnet_tpu.api.ring import RingApiAdapter
+    from dnet_tpu.core.prefix_cache import PrefixIndex
+    from dnet_tpu.core.types import TokenResult
+
+    a = RingApiAdapter.__new__(RingApiAdapter)
+    a._prefix_cap = 2
+    a._prefix_index = PrefixIndex(2, RingApiAdapter.PREFIX_MIN_TOKENS)
+    ids1 = tuple(range(20))
+    key1 = a._prefix_put(ids1)
+    assert a._prefix_put(ids1) == key1  # idempotent
+    grown = ids1 + (99, 98)
+    hit = a._prefix_lookup(grown)
+    assert hit == (20, key1)
+    # exact-equal prompt must NOT hit (>= 1 token left to prefill)
+    assert a._prefix_lookup(ids1) is None
+    # a too-short prompt is never indexed
+    assert not a._prefix_index.put(tuple(range(5)), "short")
+    # LRU eviction at capacity: two newer entries push ids1 out
+    a._prefix_put(tuple(range(100, 120)))
+    a._prefix_put(tuple(range(200, 220)))
+    assert a._prefix_lookup(grown) is None  # ids1 evicted
+    assert a._prefix_lookup(tuple(range(100, 121))) is not None  # survivor
+    # miss invalidation drops the entry
+    a._prefix_index.put(ids1, key1)
+    a.resolve_token = RingApiAdapter.resolve_token.__get__(a)
+    a._futures = type("F", (), {"resolve": staticmethod(lambda r: True)})()
+    a.resolve_token(
+        TokenResult(nonce="x", token_id=-1, step=0, error=f"prefix-miss:{key1}: gone")
+    )
+    assert a._prefix_lookup(grown) is None
