@@ -107,8 +107,15 @@ class PrefixCache:
         # prompt ids -> kv snapshot (repetition counts are zero at prefill
         # end — they track generated tokens only — so KV is the whole state)
         self._index = PrefixIndex(capacity, min_tokens)
-        self.min_tokens = min_tokens
         self.stats = {"hits": 0, "misses": 0, "stores": 0}
+
+    @property
+    def min_tokens(self) -> int:
+        return self._index.min_tokens
+
+    @min_tokens.setter
+    def min_tokens(self, v: int) -> None:  # tests tune it for tiny prompts
+        self._index.min_tokens = v
 
     def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, dict]]:
         """Longest cached prefix covering at most len(prompt)-1 tokens.
