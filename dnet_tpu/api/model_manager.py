@@ -148,17 +148,6 @@ class LocalModelManager:
                             _cfg.model_type,
                         )
                         use_pipelined = False
-                    elif getattr(_inst, "no_pipelined", False) and _pp > 1:
-                        # interleaved mixed layouts pp-shard on the
-                        # sequential mesh (chunk-aligned stacks, r5) but the
-                        # staggered-microbatch pipeline can't slice their
-                        # dict stacks per stage yet
-                        log.warning(
-                            "%s interleaved dense/moe layout cannot fill a "
-                            "pp=%d pipeline; serving sequential mesh",
-                            _cfg.model_type, _pp,
-                        )
-                        use_pipelined = False
                     elif self.batch_slots // dp < _pp:
                         log.warning(
                             "batch_slots=%d gives %d slots per dp lane, < "

@@ -19,8 +19,8 @@ supported with two stacking strategies (VERDICT r3 next #6):
     stacking (r5, pad_mesh_segments): each rank holds its contiguous slice
     of the global order and runs the mixed scan over it, scheduled by the
     pp-sharded layer_kinds slots — a single lap reproduces the exact
-    order.  Only the staggered-microbatch pipeline is refused
-    (no_pipelined: its per-stage stack slicing predates dict stacks).
+    order — through the sequential mesh ring AND the staggered-microbatch
+    pipelined rotation (both thread the pp-sharded kinds operand).
 """
 
 from __future__ import annotations
@@ -79,10 +79,10 @@ class Qwen3MoeRingModel(TwoSegmentStackMixin, MixtralRingModel, Qwen3RingModel):
                 # sharding hands every rank exactly its contiguous slice of
                 # the GLOBAL order, and a single lap's mixed lax.cond scan
                 # (scheduled by the pp-sharded layer_kinds slots) reproduces
-                # the exact layer order.  The staggered-microbatch pipeline
-                # still can't slice these dict stacks per stage.
+                # the exact layer order — in the sequential mesh ring AND
+                # the staggered-microbatch rotation alike (both thread the
+                # kinds operand at P(AXIS_PP)).
                 self.pp_pad_chunks = True
-                self.no_pipelined = True
 
     # ---- stacking -----------------------------------------------------
     def stack_layers(self, per_layer: List[Dict[str, np.ndarray]]):

@@ -314,3 +314,24 @@ def test_all_dense_degenerate_is_flat(tmp_path_factory):
         np.asarray(logits[0], np.float32), ref[-1], atol=2e-3, rtol=2e-3
     )
     eng.close()
+
+
+def test_interleaved_pipelined_rotation_matches_local(tmp_path_factory, eight_devices):
+    """Interleaved layout through the staggered-microbatch PIPELINED
+    engine: the rotation program threads the same pp-sharded slot schedule
+    as the sequential ring — stream matches local."""
+    from tests.fakes.checkpoints import make_tiny_qwen3_moe
+
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
+
+    d = tmp_path_factory.mktemp("q3moe_interleave_pipe")
+    make_tiny_qwen3_moe(d, config={"decoder_sparse_step": 2})
+    local = LocalEngine(d, max_seq=64, param_dtype="float32")
+    ids = [256, 72, 101, 108]
+    dec = DecodingParams(temperature=0.0)
+    want = [r.token_id for r in local.generate(ids, dec, max_tokens=8)]
+    local.close()
+    pipe = PipelinedMeshEngine(d, pp=2, slots=2, max_seq=64, param_dtype="float32")
+    got = [r.token_id for r in pipe.generate(ids, dec, max_tokens=8)]
+    assert got == want
