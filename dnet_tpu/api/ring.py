@@ -70,6 +70,10 @@ class RingApiAdapter(ApiAdapterBase):
         self._pending: List[dict] = []  # lane entries awaiting a flush
         self._flush_task: Optional[asyncio.Task] = None
         self._batch_seq = 0
+        # observed send->resolve latency EMA (seconds): sizes the lane
+        # convergence window to ~1.5 ring passes
+        self._step_ema = 0.0
+        self._sent_at: Dict[tuple, float] = {}
         # nonces mid-generation (first send -> reset): the flusher holds a
         # batch open only while MORE active streams could still join it
         self._active: Dict[str, bool] = {}
@@ -125,6 +129,8 @@ class RingApiAdapter(ApiAdapterBase):
         self._active.pop(nonce, None)
         if self._pending:
             self._pending = [e for e in self._pending if e["nonce"] != nonce]
+        for key in [k for k in self._sent_at if k[0] == nonce]:
+            self._sent_at.pop(key, None)
         for key in [k for k in self._early if k[0] == nonce]:
             self._early.pop(key, None)
         if self._streams is not None:
@@ -175,6 +181,7 @@ class RingApiAdapter(ApiAdapterBase):
                     "token": int(token_ids[0]),
                 }
             )
+            self._sent_at[(nonce, step)] = time.monotonic()
             if self._flush_task is None or self._flush_task.done():
                 self._flush_task = asyncio.ensure_future(self._flush_lanes())
             return
@@ -215,24 +222,35 @@ class RingApiAdapter(ApiAdapterBase):
         await self._streams.send(nonce, frame)
 
     LANES_NONCE = "__lanes__"  # carrier stream for coalesced decode frames
-    # how long a partially-filled batch may hold open for more mid-decode
-    # streams to join.  This is a CONVERGENCE cost, not a per-token cost:
-    # members of one batch resolve together and re-send together, so once
-    # streams merge they stay merged and the wait collapses to ~0.  A solo
+    # convergence window bounds: how long a partially-filled batch may hold
+    # open for more mid-decode streams to join.  This is a CONVERGENCE
+    # cost, not a per-token cost — members of one batch resolve together
+    # and re-send together, so once streams merge they stay merged and the
+    # wait collapses to ~0.  The window ADAPTS to the observed step time
+    # (a multi-host ring pass can exceed any fixed constant; streams offset
+    # by up to ~1.5 steps must still merge on the first wait).  A solo
     # stream (one active nonce) never waits at all.
-    LANE_CONVERGE_S = 0.05
+    LANE_CONVERGE_MIN_S = 0.05
+    LANE_CONVERGE_MAX_S = 1.0
+
+    def _converge_window(self) -> float:
+        ema = self._step_ema
+        if ema <= 0:
+            return self.LANE_CONVERGE_MIN_S
+        return min(max(1.5 * ema, self.LANE_CONVERGE_MIN_S),
+                   self.LANE_CONVERGE_MAX_S)
 
     async def _flush_lanes(self) -> None:
         """Drain pending lane entries into multi-lane frames.  A batch
-        holds open (bounded by LANE_CONVERGE_S) while more mid-decode
-        streams could still join; per-nonce ordering is the driver's (it
-        never sends step k+1 before step k resolved)."""
+        holds open (bounded by the adaptive convergence window) while more
+        mid-decode streams could still join; per-nonce ordering is the
+        driver's (it never sends step k+1 before step k resolved)."""
         await asyncio.sleep(0)
         loop = asyncio.get_running_loop()
         while self._pending:
             target = min(self._lanes, len(self._active))
             if len(self._pending) < target:
-                deadline = loop.time() + self.LANE_CONVERGE_S
+                deadline = loop.time() + self._converge_window()
                 while len(self._pending) < target and loop.time() < deadline:
                     await asyncio.sleep(0.0005)
             batch = self._pending[: self._lanes]
@@ -308,10 +326,19 @@ class RingApiAdapter(ApiAdapterBase):
         return await self._futures.wait(nonce, step, timeout)
 
     def resolve_token(self, result: TokenResult) -> None:
+        sent = self._sent_at.pop((result.nonce, result.step), None)
+        if sent is not None:
+            dt = time.monotonic() - sent
+            self._step_ema = dt if self._step_ema <= 0 else (
+                0.8 * self._step_ema + 0.2 * dt
+            )
         if result.error and result.error.startswith("prefix-miss:"):
-            # a shard lost (or never had) this snapshot: drop the index
-            # entry so the NEXT request re-prefills in full and re-stores
-            self._prefix_index.drop_value(result.error.split(":", 2)[1])
+            # a shard lost this snapshot — which means it restarted (or
+            # diverged) and lost ALL of them, and the failed request itself
+            # indexed a key no shard ever stored.  Clearing the whole index
+            # self-heals in ONE failure: the next request full-prefills and
+            # re-stores, instead of walking a chain of stale/phantom keys.
+            self._prefix_index.clear()
         if not self._futures.resolve(result):
             if result.step <= self._granted.get(result.nonce, -1):
                 # a granted step raced ahead of the driver's await: hold it

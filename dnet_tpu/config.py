@@ -251,8 +251,9 @@ class ApiSettings(_EnvGroup):
     warm_on_load: bool = True
     # batched lanes over the ring: >1 coalesces that many concurrent
     # requests' decode steps into ONE multi-lane ring pass (shard/lanes.py).
-    # Needs a single-round non-mesh topology; grants and ring speculation
-    # are per-nonce self-pacing and turn off when lanes are on.  0/1 = off.
+    # Needs a single-round resident-weight topology; composes with
+    # mesh-backed shards.  Grants and ring speculation are per-nonce
+    # self-pacing and turn off when lanes are on.  0/1 = off.
     ring_lanes: int = 0
 
 

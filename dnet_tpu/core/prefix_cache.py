@@ -91,12 +91,6 @@ class PrefixIndex:
                 self._entries.popitem(last=False)
             return True
 
-    def drop_value(self, value) -> None:
-        """Remove every entry holding `value` (ring prefix-miss recovery)."""
-        with self._lock:
-            for key in [k for k, v in self._entries.items() if v == value]:
-                del self._entries[key]
-
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

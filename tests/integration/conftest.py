@@ -1,0 +1,107 @@
+"""Shared integration-tier harness: the real two-shard + api cluster.
+
+One parameterized spawn path (ports, hostfile, readiness, log-tail
+teardown) for every module that drives the multi-process ring — modules
+differ only in the env they hand the servers.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+import httpx
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_health(url: str, timeout: float = 60.0) -> dict:
+    t0 = time.monotonic()
+    last = None
+    while time.monotonic() - t0 < timeout:
+        try:
+            r = httpx.get(url, timeout=2.0)
+            if r.status_code == 200:
+                return r.json()
+        except httpx.HTTPError as exc:
+            last = exc
+        time.sleep(0.5)
+    raise TimeoutError(f"{url} not healthy after {timeout}s: {last}")
+
+
+@contextmanager
+def spawn_two_shard_cluster(tmp: Path, extra_env: dict):
+    """Spawn s0 + s1 + api processes; yields the port map once all three
+    are healthy.  Log tails print at teardown for post-mortems."""
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "JAX_PLATFORMS": "cpu",
+        "DNET_API_PARAM_DTYPE": "float32",
+        "DNET_LOG_TO_FILE": "0",
+        **extra_env,
+    }
+    ports = {
+        "s0_http": free_port(), "s0_grpc": free_port(),
+        "s1_http": free_port(), "s1_grpc": free_port(),
+        "api_http": free_port(), "api_grpc": free_port(),
+    }
+    hostfile = tmp / "hostfile"
+    hostfile.write_text(
+        f"s0 127.0.0.1 {ports['s0_http']} {ports['s0_grpc']}\n"
+        f"s1 127.0.0.1 {ports['s1_http']} {ports['s1_grpc']}\n"
+    )
+    procs = []
+    logs = []
+
+    def spawn(name, *argv):
+        lf = open(tmp / f"{name}.log", "w")
+        logs.append((name, tmp / f"{name}.log"))
+        p = subprocess.Popen(
+            [sys.executable, "-m", *argv],
+            env=env, stdout=lf, stderr=subprocess.STDOUT, cwd=str(tmp),
+        )
+        procs.append(p)
+        return p
+
+    spawn(
+        "s0", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+        "--http-port", str(ports["s0_http"]), "--grpc-port", str(ports["s0_grpc"]),
+        "--shard-name", "s0",
+    )
+    spawn(
+        "s1", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
+        "--http-port", str(ports["s1_http"]), "--grpc-port", str(ports["s1_grpc"]),
+        "--shard-name", "s1",
+    )
+    spawn(
+        "api", "dnet_tpu.cli.api", "--host", "127.0.0.1",
+        "--http-port", str(ports["api_http"]), "--grpc-port", str(ports["api_grpc"]),
+        "--hostfile", str(hostfile),
+    )
+    try:
+        wait_health(f"http://127.0.0.1:{ports['s0_http']}/health")
+        wait_health(f"http://127.0.0.1:{ports['s1_http']}/health")
+        wait_health(f"http://127.0.0.1:{ports['api_http']}/health")
+        yield ports
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for name, path in logs:
+            tail = path.read_text()[-2000:]
+            print(f"\n===== {name} log tail =====\n{tail}")

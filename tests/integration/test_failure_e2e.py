@@ -9,7 +9,7 @@ import time
 import httpx
 import pytest
 
-from tests.integration.test_two_shard_e2e import REPO, free_port, wait_health
+from tests.integration.conftest import REPO, free_port, wait_health
 
 pytestmark = pytest.mark.integration
 

@@ -7,54 +7,23 @@ split [0,1]/[2,3], chat completion asserted non-empty and deterministic.
 """
 
 import json
-import os
-import signal
-import socket
-import subprocess
-import sys
-import time
-from pathlib import Path
 
 import httpx
 import pytest
 
+from tests.integration.conftest import spawn_two_shard_cluster
+
 pytestmark = pytest.mark.integration
-
-REPO = Path(__file__).resolve().parents[2]
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def wait_health(url: str, timeout: float = 60.0) -> dict:
-    t0 = time.monotonic()
-    last = None
-    while time.monotonic() - t0 < timeout:
-        try:
-            r = httpx.get(url, timeout=2.0)
-            if r.status_code == 200:
-                return r.json()
-        except httpx.HTTPError as exc:
-            last = exc
-        time.sleep(0.5)
-    raise TimeoutError(f"{url} not healthy after {timeout}s: {last}")
 
 
 @pytest.fixture(scope="module")
 def cluster(tiny_llama_dir, tmp_path_factory):
     tmp = tmp_path_factory.mktemp("cluster")
     env = {
-        **os.environ,
-        "PYTHONPATH": str(REPO),
-        "JAX_PLATFORMS": "cpu",
         # 2 virtual devices per process: shards can serve mesh-backed
         # windows (parallel/shard_mesh.py) — the CPU proxy for one host
         # driving its local ICI slice
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "DNET_API_PARAM_DTYPE": "float32",
         # ring speculation rides the decode grants on every greedy request
         # in this module: the determinism/equality assertions below verify
         # the composed path end to end over real gRPC
@@ -63,63 +32,9 @@ def cluster(tiny_llama_dir, tmp_path_factory):
         # prompts hit per-shard snapshots (suffix-only prefill) while the
         # equality assertions pin unchanged outputs
         "DNET_API_PREFIX_CACHE": "4",
-        "DNET_LOG_TO_FILE": "0",
     }
-    # shards resolve the model path directly (absolute), no models_dir needed
-    ports = {
-        "s0_http": free_port(), "s0_grpc": free_port(),
-        "s1_http": free_port(), "s1_grpc": free_port(),
-        "api_http": free_port(), "api_grpc": free_port(),
-    }
-    hostfile = tmp / "hostfile"
-    hostfile.write_text(
-        f"s0 127.0.0.1 {ports['s0_http']} {ports['s0_grpc']}\n"
-        f"s1 127.0.0.1 {ports['s1_http']} {ports['s1_grpc']}\n"
-    )
-    procs = []
-    logs = []
-
-    def spawn(name, *argv):
-        lf = open(tmp / f"{name}.log", "w")
-        logs.append((name, tmp / f"{name}.log"))
-        p = subprocess.Popen(
-            [sys.executable, "-m", *argv],
-            env=env, stdout=lf, stderr=subprocess.STDOUT, cwd=str(tmp),
-        )
-        procs.append(p)
-        return p
-
-    spawn(
-        "s0", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
-        "--http-port", str(ports["s0_http"]), "--grpc-port", str(ports["s0_grpc"]),
-        "--shard-name", "s0",
-    )
-    spawn(
-        "s1", "dnet_tpu.cli.shard", "--host", "127.0.0.1",
-        "--http-port", str(ports["s1_http"]), "--grpc-port", str(ports["s1_grpc"]),
-        "--shard-name", "s1",
-    )
-    spawn(
-        "api", "dnet_tpu.cli.api", "--host", "127.0.0.1",
-        "--http-port", str(ports["api_http"]), "--grpc-port", str(ports["api_grpc"]),
-        "--hostfile", str(hostfile),
-    )
-    try:
-        wait_health(f"http://127.0.0.1:{ports['s0_http']}/health")
-        wait_health(f"http://127.0.0.1:{ports['s1_http']}/health")
-        wait_health(f"http://127.0.0.1:{ports['api_http']}/health")
+    with spawn_two_shard_cluster(tmp, env) as ports:
         yield ports, tiny_llama_dir
-    finally:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        for name, path in logs:
-            tail = path.read_text()[-2000:]
-            print(f"\n===== {name} log tail =====\n{tail}")
 
 
 def test_two_shard_chat(cluster):

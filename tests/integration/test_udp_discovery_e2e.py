@@ -10,7 +10,7 @@ from pathlib import Path
 import httpx
 import pytest
 
-from tests.integration.test_two_shard_e2e import REPO, free_port, wait_health
+from tests.integration.conftest import REPO, free_port, wait_health
 from tests.test_p2p_discovery import free_udp_port
 
 pytestmark = pytest.mark.integration
