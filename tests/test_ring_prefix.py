@@ -124,6 +124,8 @@ def test_adapter_prefix_index_roundtrip():
     a = RingApiAdapter.__new__(RingApiAdapter)
     a._prefix_cap = 2
     a._prefix_index = PrefixIndex(2, RingApiAdapter.PREFIX_MIN_TOKENS)
+    a._sent_at = {}
+    a._step_ema = 0.0
     ids1 = tuple(range(20))
     key1 = a._prefix_put(ids1)
     assert a._prefix_put(ids1) == key1  # idempotent
