@@ -235,6 +235,17 @@ class LocalModelManager:
             else:
                 from dnet_tpu.core.engine import LocalEngine
 
+                # draft-MODEL speculation: local-engine single-sequence
+                # serving only (batched/mesh engines draft by prompt-lookup)
+                draft_dir = None
+                draft_id = get_settings().api.draft_model
+                if draft_id and self.spec_lookahead > 0:
+                    draft_dir = resolve_model_dir(draft_id, self.models_dir)
+                    if draft_dir is None:
+                        log.warning(
+                            "DNET_API_DRAFT_MODEL=%s not found; drafting by "
+                            "prompt-lookup instead", draft_id,
+                        )
                 engine = LocalEngine(
                     model_dir,
                     max_seq=max_seq or self.max_seq,
@@ -245,6 +256,7 @@ class LocalModelManager:
                     weight_quant_group=wq_group,
                     prefix_cache_size=self.prefix_cache,
                     spec_lookahead=self.spec_lookahead,
+                    draft_dir=draft_dir,
                 )
                 # compile the chunked decode widths now, not mid-stream on
                 # the first request's ramp

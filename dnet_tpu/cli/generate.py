@@ -48,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="speculative decoding lookahead (greedy only; 0 = off) — "
         "works on the local engine and on --mesh engines alike",
     )
+    p.add_argument(
+        "--draft", default="", metavar="MODEL",
+        help="draft MODEL for speculation (checkpoint path or catalog id; "
+        "needs --spec; local engine only — without it drafts come from "
+        "prompt-lookup)",
+    )
     return p
 
 
@@ -84,8 +90,24 @@ def main(argv=None) -> int:
         print(f"model {args.model!r} not found", file=sys.stderr)
         return 2
 
+    draft_dir = None
+    if args.draft:
+        draft_dir = resolve_model_dir(args.draft, s.api.models_dir)
+        if draft_dir is None:
+            print(f"draft model {args.draft!r} not found", file=sys.stderr)
+            return 2
+        if args.spec <= 0:
+            print("--draft needs --spec L", file=sys.stderr)
+            return 2
+
     mesh_kw = parse_mesh(args.mesh)
     if mesh_kw:
+        if draft_dir is not None:
+            print(
+                "--draft is local-engine only; mesh engines draft by "
+                "prompt-lookup", file=sys.stderr,
+            )
+            return 2
         from dnet_tpu.parallel.engine import MeshEngine
 
         engine = MeshEngine(
@@ -100,7 +122,7 @@ def main(argv=None) -> int:
 
         engine = LocalEngine(
             model_dir, max_seq=args.max_seq, param_dtype=args.param_dtype,
-            spec_lookahead=args.spec,
+            spec_lookahead=args.spec, draft_dir=draft_dir,
         )
     tokenizer = load_tokenizer(model_dir)
     dec = DecodingParams(

@@ -238,6 +238,11 @@ class ApiSettings(_EnvGroup):
     # verify forward (core/spec.py).  Greedy-exact; eligible requests emit
     # 1..L+1 tokens per weight read.  Local and mesh engines (batch 1).
     spec_lookahead: int = 0
+    # draft-MODEL speculation (single-process serving, LocalEngine only):
+    # a smaller same-vocab checkpoint drafts SPEC_LOOKAHEAD tokens per
+    # verify block instead of prompt-lookup — better acceptance on
+    # non-repetitive text.  Checkpoint path or models_dir id; "" = off.
+    draft_model: str = ""
     # ring decode grants: a token frame may authorize the TAIL shard to
     # feed up to this many sampled tokens straight back into the ring
     # (tail -> head hop), removing the per-token API round trip.  The tail

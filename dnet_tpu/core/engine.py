@@ -63,6 +63,9 @@ class Session:
     # at position i (whose KV landed in slot i).  None unless the engine
     # was built with spec_lookahead > 0.
     hist: jax.Array = None  # [B, max_seq] int32
+    # draft-MODEL speculation: the small model's own KV cache (None unless
+    # the engine was built with draft_dir)
+    dkv: dict = None
     # acceptance accounting: blocks run / tokens emitted, feeding the
     # adaptive spec-vs-chunk gate (spec_worthwhile)
     spec_blocks: int = 0
@@ -98,6 +101,7 @@ class LocalEngine:
         weight_quant_group: int = 0,
         prefix_cache_size: int = 0,
         spec_lookahead: int = 0,
+        draft_dir: Optional[str | Path] = None,
     ):
         self.ckpt = Checkpoint(model_dir)
         self.config = ModelConfig.from_hf(self.ckpt.config)
@@ -153,8 +157,51 @@ class LocalEngine:
         self._sync_per_layer = obs.sync_per_layer
         self._sync_every_n = obs.sync_every_n
 
+        # draft-MODEL speculation (r5, beyond both the reference and the
+        # prompt-lookup drafts): a second, much smaller checkpoint drafts
+        # spec_lookahead tokens autoregressively; the target verifies the
+        # block in ONE forward.  Greedy-exactness is independent of draft
+        # quality (only acceptance varies), so any same-vocab model works.
+        self.draft = None
+        if draft_dir is not None:
+            if spec_lookahead <= 0:
+                raise ValueError(
+                    "draft_dir needs spec_lookahead > 0 (the draft model "
+                    "exists only to draft verify blocks)"
+                )
+            self._load_draft(draft_dir)
+
         self._load_params()
         self._build_fns()
+
+    def _load_draft(self, draft_dir: str | Path) -> None:
+        ckpt = Checkpoint(draft_dir)
+        cfg = ModelConfig.from_hf(ckpt.config)
+        if cfg.vocab_size != self.config.vocab_size:
+            raise ValueError(
+                f"draft model vocab {cfg.vocab_size} != target vocab "
+                f"{self.config.vocab_size}; speculation needs a shared "
+                f"token space"
+            )
+        model_cls = get_ring_model_cls(cfg.model_type)
+        dmodel = model_cls(cfg, list(range(cfg.num_hidden_layers)))
+        if not dmodel.kv_rewindable(self.max_seq):
+            raise ValueError(
+                f"draft model {cfg.model_type} uses rotating SWA caches, "
+                f"which cannot rewind after partial acceptance"
+            )
+        per_layer = [dmodel.map_layer(ckpt.load_layer_raw(a)) for a in dmodel.layers]
+        window = self._cast(dmodel.stack_layers(per_layer))
+        edge = self._cast(dmodel.map_edge(ckpt.load_edge_raw()))
+        from types import SimpleNamespace
+
+        self.draft = SimpleNamespace(
+            model=dmodel, config=cfg, window=window, edge=edge
+        )
+        log.info(
+            "draft model loaded: %s (%d layers) drafting for %s",
+            cfg.model_type, cfg.num_hidden_layers, self.config.model_type,
+        )
 
     @classmethod
     def from_params(
@@ -197,6 +244,7 @@ class LocalEngine:
         self.weight_cache = None
         self._windows = []
         self.prefix_cache = None
+        self.draft = None
         self.window_params = jax.tree.map(jnp.asarray, window_params)
         self.edge_params = jax.tree.map(jnp.asarray, edge_params)
         self._sync_per_layer = False
@@ -390,6 +438,54 @@ class LocalEngine:
                 make_spec_step(model, window_pass, L), donate_argnums=(3, 4)
             )
 
+        if L > 0 and self.draft is not None:
+            # draft-MODEL verify block: L sequential small-model steps draft
+            # the block on-device (the draft's own KV rides the session),
+            # then the target verifies in one (L+1)-wide forward.  Rewind
+            # discipline matches the ngram path: all drafted positions
+            # write both caches; stale rows are never attended (causal
+            # masks at the rewound pos) and are overwritten on reuse.
+            from dnet_tpu.core.spec import accept_drafts
+
+            dmodel = self.draft.model
+
+            def draft_forward(dwp, dep, tok, dkv, p):
+                x = dmodel.embed(dep, tok)
+                x, dkv = dmodel.apply_window(dwp, x, dkv, p, t_real=1)
+                x = dmodel.normalize(dep, x)
+                return dmodel.lm_project(dep, x)[:, 0], dkv
+
+            def spec_step_draft(wp, ep, dwp, dep, tok, kv, dkv, pos):
+                def body(carry, _):
+                    t, dkv, p = carry
+                    logits, dkv = draft_forward(dwp, dep, t, dkv, p)
+                    nt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nt[:, None], dkv, p + 1), nt
+
+                (_, dkv, _), drafts = jax.lax.scan(
+                    body, (tok, dkv, pos), None, length=L
+                )
+                drafts = jnp.moveaxis(drafts, 0, 1)  # [B, L]
+                block = jnp.concatenate([tok, drafts], axis=1)  # [B, L+1]
+                x = model.embed(ep, block)
+                x, kv = model.apply_window(wp, x, kv, pos, t_real=L + 1)
+                x = model.normalize(ep, x)
+                logits = model.lm_project(ep, x)
+                preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                _, out = accept_drafts(preds, drafts)
+                return out, kv, dkv
+
+            self._spec_step_draft = jax.jit(
+                spec_step_draft, donate_argnums=(5, 6)
+            )
+
+            def draft_prefill(dwp, dep, tokens, dkv, pos, t_real):
+                x = dmodel.embed(dep, tokens)
+                _, dkv = dmodel.apply_window(dwp, x, dkv, pos, t_real=t_real)
+                return dkv
+
+            self._draft_prefill = jax.jit(draft_prefill, donate_argnums=(3,))
+
     # ---- offload execution --------------------------------------------
     def run_layers(self, sess: "Session", x: jnp.ndarray, pos: int, t_real=None) -> jnp.ndarray:
         """Apply this engine's layers to x under the active policy.
@@ -526,6 +622,14 @@ class LocalEngine:
                 if self.spec_lookahead > 0
                 else None
             ),
+            dkv=(
+                self.draft.model.init_kv(
+                    self.draft.config.num_hidden_layers, self.batch,
+                    self.max_seq, self.kv_dtype,
+                )
+                if self.draft is not None
+                else None
+            ),
         )
         self.sessions[nonce] = sess
         return sess
@@ -606,6 +710,13 @@ class LocalEngine:
                 self.window_params, self.edge_params, jnp.asarray(tokens), sess.kv,
                 jnp.int32(sess.pos), jnp.int32(T - 1),
             )
+        if self.draft is not None:
+            if fresh and len(prompt_ids) != len(full_ids):
+                # prefix-cache hit seeded only the TARGET's kv; the draft
+                # (tiny) simply re-reads the whole prompt from position 0
+                self._advance_draft(sess, full_ids, 0)
+            else:
+                self._advance_draft(sess, prompt_ids, sess.pos)
         # repetition penalty counts GENERATED tokens only (prompt tokens are
         # not seeded): the ring's sampling shard never sees prompt ids, so
         # both serving paths must share this definition to stay equivalent.
@@ -644,7 +755,25 @@ class LocalEngine:
                 np.broadcast_to(np.asarray(full_ids[:n], dtype=np.int32), (self.batch, n))
             )
             sess.hist = jax.lax.dynamic_update_slice_in_dim(sess.hist, ids, 0, axis=1)
+        # the draft's context for the cached prefix (its kv is not in the
+        # prefix cache; re-reading the prefix through the tiny model is
+        # cheaper than caching a second kv family)
+        self._advance_draft(sess, list(full_ids[:n]), 0)
         return n
+
+    def _advance_draft(self, sess: "Session", ids: Sequence[int], pos0: int) -> None:
+        """Run the draft model over `ids` at absolute position pos0 so its
+        cache tracks the committed context (draft-model speculation)."""
+        if self.draft is None or sess.dkv is None or not ids:
+            return
+        T = len(ids)
+        Tpad = min(bucket_length(T), self.max_seq - pos0)
+        tokens = np.zeros((self.batch, Tpad), dtype=np.int32)
+        tokens[:, :T] = np.asarray(ids, dtype=np.int32)
+        sess.dkv = self._draft_prefill(
+            self.draft.window, self.draft.edge, jnp.asarray(tokens),
+            sess.dkv, jnp.int32(pos0), jnp.int32(T),
+        )
 
     def store_prefix(self, nonce: str, full_ids: Sequence[int]) -> None:
         """Snapshot a fully-prefilled session's KV under the full prompt
@@ -812,10 +941,17 @@ class LocalEngine:
             tok = sess.last_token
         else:
             tok = jnp.full((self.batch, 1), token_id, dtype=jnp.int32)
-        out, sess.hist, sess.kv = self._spec_step(
-            self.window_params, self.edge_params, tok, sess.hist, sess.kv,
-            jnp.int32(sess.pos),
-        )
+        if self.draft is not None:
+            out, sess.kv, sess.dkv = self._spec_step_draft(
+                self.window_params, self.edge_params,
+                self.draft.window, self.draft.edge,
+                tok, sess.kv, sess.dkv, jnp.int32(sess.pos),
+            )
+        else:
+            out, sess.hist, sess.kv = self._spec_step(
+                self.window_params, self.edge_params, tok, sess.hist, sess.kv,
+                jnp.int32(sess.pos),
+            )
         out_h = np.asarray(out)  # [B, L+1]; blocks until the block finishes
         emitted = min(int((out_h[0] >= 0).sum()), budget)
         sess.pos += emitted

@@ -1,0 +1,127 @@
+"""Draft-MODEL speculation (r5): a second, smaller checkpoint drafts the
+verify block instead of prompt-lookup.
+
+Greedy-exactness is independent of draft quality — every emitted token is
+an argmax of the same logits plain decode would compute — so streams must
+equal plain decode for ANY same-vocab draft.  Acceptance quality is pinned
+with the degenerate draft == target (every draft must be accepted).
+"""
+
+import numpy as np
+import pytest
+
+from dnet_tpu.core.types import DecodingParams
+
+pytestmark = pytest.mark.core
+
+
+@pytest.fixture(scope="module")
+def target_dir(tmp_path_factory):
+    from tests.fakes.checkpoints import make_tiny_llama
+
+    d = tmp_path_factory.mktemp("draft_target")
+    make_tiny_llama(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def small_draft_dir(tmp_path_factory):
+    """Same vocab, different (smaller + differently-seeded) weights."""
+    from tests.fakes.checkpoints import make_tiny_llama
+
+    d = tmp_path_factory.mktemp("draft_small")
+    make_tiny_llama(d, config={"num_hidden_layers": 2}, seed=7)
+    return d
+
+
+def _stream(engine, ids, n):
+    return [
+        r.token_id
+        for r in engine.generate(ids, DecodingParams(temperature=0.0), max_tokens=n)
+    ]
+
+
+def test_draft_stream_matches_plain_decode(target_dir, small_draft_dir):
+    """ANY draft keeps the stream greedy-exact."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    ids = [256, 72, 101, 108, 108, 111]
+    plain = LocalEngine(target_dir, max_seq=128, param_dtype="float32")
+    want = _stream(plain, ids, 10)
+    plain.close()
+    eng = LocalEngine(
+        target_dir, max_seq=128, param_dtype="float32", spec_lookahead=4,
+        draft_dir=small_draft_dir,
+    )
+    assert eng.draft is not None
+    got = _stream(eng, ids, 10)
+    eng.close()
+    assert got == want
+
+
+def test_self_draft_accepts_everything(target_dir):
+    """draft == target: every drafted token matches the verify argmax, so
+    each block emits L+1 tokens (modulo the trailing budget)."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    ids = [256, 72, 101, 108]
+    eng = LocalEngine(
+        target_dir, max_seq=128, param_dtype="float32", spec_lookahead=4,
+        draft_dir=target_dir,
+    )
+    dec = DecodingParams(temperature=0.0)
+    res = eng.prefill_and_sample("s", ids, dec)
+    tok = int(res.token[0])
+    out = eng.decode_spec("s", tok, dec, 16)
+    assert len(out) == 5  # L+1: full acceptance
+    plain = LocalEngine(target_dir, max_seq=128, param_dtype="float32")
+    want = _stream(plain, ids, 10)
+    plain.close()
+    got = _stream(eng, ids, 10)
+    eng.close()
+    assert got == want
+
+
+def test_draft_with_prefix_cache_hit(target_dir, small_draft_dir):
+    """A prefix-cache hit seeds only the target's KV; the draft re-reads
+    the full prompt — the follow-up stream stays exact."""
+    from dnet_tpu.core.engine import LocalEngine
+
+    base = [256, 72, 101, 108, 108, 111, 7, 3, 11, 7, 3, 11, 256, 84, 104, 101]
+    eng = LocalEngine(
+        target_dir, max_seq=128, param_dtype="float32", spec_lookahead=4,
+        draft_dir=small_draft_dir, prefix_cache_size=2,
+    )
+    first = _stream(eng, base, 4)
+    grown = base + first[:1] + [256, 110]
+    plain = LocalEngine(target_dir, max_seq=128, param_dtype="float32")
+    want = _stream(plain, grown, 6)
+    plain.close()
+    got = _stream(eng, grown, 6)  # hits the cached `base`-stream prefix
+    assert eng.prefix_cache.stats["hits"] >= 1
+    eng.close()
+    assert got == want
+
+
+def test_draft_vocab_mismatch_rejected(target_dir, tmp_path):
+    from tests.fakes.checkpoints import make_tiny_llama
+
+    from dnet_tpu.core.engine import LocalEngine
+
+    d = tmp_path / "badvocab"
+    make_tiny_llama(d, config={"vocab_size": 300})
+    with pytest.raises(ValueError, match="vocab"):
+        LocalEngine(
+            target_dir, max_seq=64, param_dtype="float32", spec_lookahead=4,
+            draft_dir=d,
+        )
+
+
+def test_draft_without_spec_rejected(target_dir, small_draft_dir):
+    from dnet_tpu.core.engine import LocalEngine
+
+    with pytest.raises(ValueError, match="spec_lookahead"):
+        LocalEngine(
+            target_dir, max_seq=64, param_dtype="float32",
+            draft_dir=small_draft_dir,
+        )
