@@ -127,6 +127,13 @@ def _measure_served(engine, batch: int) -> dict:
         prompt_tokens = 0
         for i in range(4):  # request 0 is the compile warmup
             r = await manager.generate(req)
+            if i == 0:
+                # drop the warmup's compile-inflated observations so the
+                # registry percentiles (_obs_snapshot) cover exactly the
+                # timed requests, matching the medians computed below
+                from dnet_tpu.obs import reset_obs
+
+                reset_obs()
             if i > 0:
                 assert r.usage.completion_tokens == max_tokens, (
                     f"expected {max_tokens} tokens, got {r.usage.completion_tokens}"
@@ -142,6 +149,29 @@ def _measure_served(engine, batch: int) -> dict:
         }
 
     return asyncio.run(run())
+
+
+def _obs_snapshot() -> dict:
+    """Histogram percentiles from the obs registry, merged into the emitted
+    JSON line.  The served measurement runs through the real InferenceManager
+    stack, so the registry's dnet_decode_step_ms / dnet_ttft_ms series
+    already hold every step of the timed section — the artifact gains
+    distribution shape (p50/p95) on top of the medians for free."""
+    from dnet_tpu.obs import get_registry
+
+    out: dict = {}
+    for name, key in (
+        ("dnet_decode_step_ms", "decode_step"),
+        ("dnet_ttft_ms", "ttft"),
+        ("dnet_prefill_ms", "prefill"),
+    ):
+        h = get_registry().get(name)
+        if h is None or h.count == 0:
+            continue
+        out[f"{key}_p50_ms"] = round(h.percentile(0.5), 3)
+        out[f"{key}_p95_ms"] = round(h.percentile(0.95), 3)
+        out[f"{key}_n"] = int(h.count)
+    return out
 
 
 def _emit(out: dict, diagnostics: Optional[dict] = None) -> None:
@@ -505,6 +535,7 @@ def main() -> None:
             cfg, window, edge, batch=batch, max_seq=max_seq
         )
         served = _measure_served(engine, batch)
+    obs_stats = _obs_snapshot()  # registry state right after the timed section
     tok_s = batch * served["tok_s"]  # tps_decoding is per-lane; lanes decode together
 
     # single-chip HBM roofline for decode: read all weights per token
@@ -580,6 +611,7 @@ def main() -> None:
         "mfu_basis": mfu_basis,
     }
     out.update(flash_dec)
+    out.update(obs_stats)
     if "--smoke" in sys.argv:
         out.update(_compress_microbench())
         if mesh_cfg is None:

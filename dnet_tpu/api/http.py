@@ -8,6 +8,8 @@ Routes (reference: src/dnet/api/http_api.py:75-93):
   GET  /v1/topology            — current topology (ring mode)
   GET  /v1/devices             — discovered devices
   GET  /health
+  GET  /metrics                — Prometheus text exposition (dnet_tpu.obs)
+  GET  /v1/debug/timeline/{rid} — one request's flight-recorder spans
 FastAPI is not available in this image; aiohttp's request handling + a thin
 pydantic validation shim cover the same surface.
 """
@@ -70,6 +72,10 @@ class ApiHTTPServer:
         self.app.router.add_post("/v1/calibrate", self.calibrate)
         self.app.router.add_get("/v1/devices", self.get_devices)
         self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/metrics", self.metrics)
+        self.app.router.add_get(
+            "/v1/debug/timeline/{rid}", self.debug_timeline
+        )
         self._runner: Optional[web.AppRunner] = None
 
     # ---- lifecycle ----------------------------------------------------
@@ -483,3 +489,23 @@ class ApiHTTPServer:
             if monitor.degraded:
                 body["status"] = "degraded"
         return web.json_response(body)
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of the process-global registry."""
+        from dnet_tpu.obs.http import metrics_response
+
+        return await metrics_response(request)
+
+    async def debug_timeline(self, request: web.Request) -> web.Response:
+        """One completed (or in-flight) request's flight-recorder spans —
+        rid is the response id (`chatcmpl-...` or the completions-endpoint
+        `cmpl-...` form); the recorder keeps the most recent requests, so
+        recent rids resolve and ancient ones 404."""
+        from dnet_tpu.obs.http import find_timeline
+
+        rid = request.match_info["rid"]
+        timeline = find_timeline(rid)
+        if timeline is None:
+            return _json_error(404, f"no recorded timeline for {rid!r}",
+                               "not_found")
+        return web.json_response(timeline)

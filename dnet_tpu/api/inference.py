@@ -28,10 +28,16 @@ from dnet_tpu.api.schemas import (
 )
 from dnet_tpu.api.strategies import ApiAdapterBase
 from dnet_tpu.core.types import DecodingParams
+from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.utils.logger import get_logger
 from dnet_tpu.utils.tokenizer import Detokenizer
 
 log = get_logger()
+
+_TTFT_MS = metric("dnet_ttft_ms")
+_REQUESTS = metric("dnet_requests_total")
+_REQUEST_ERRORS = metric("dnet_request_errors_total")
+_TOKENS_TOTAL = metric("dnet_tokens_generated_total")
 
 
 class InferenceError(Exception):
@@ -176,6 +182,9 @@ class InferenceManager:
         t_first: Optional[float] = None
         generated = 0
         finish_reason = "length"
+        recorder = get_recorder()
+        recorder.begin(rid)  # flight-recorder timeline (rid == nonce)
+        _REQUESTS.inc()
         pending = ""  # emitted-text buffer held back for stop-seq matching
         held_entries: list = []  # logprob entries for held-back tokens
         emitted_ahead = 0  # emitted chars owned by the oldest held entry
@@ -194,6 +203,7 @@ class InferenceManager:
                         f"ring degraded: shard(s) "
                         f"{self.failure_monitor.down_shards()} down"
                     )
+                t_step = time.perf_counter()
                 await self.adapter.send_tokens(
                     nonce, send_ids, decoding, step, budget=max_new - step
                 )
@@ -202,9 +212,21 @@ class InferenceManager:
                 )
                 if result.error:
                     raise InferenceError(result.error)
+                # one span per emitted token: send -> token resolved (grant /
+                # chunk-buffered steps resolve in ~0ms, visibly so)
+                recorder.span(
+                    rid, "decode_step",
+                    (time.perf_counter() - t_step) * 1000, step=step,
+                )
                 if t_first is None:
                     t_first = time.perf_counter()
+                    ttft_ms = (t_first - t_start) * 1000
+                    _TTFT_MS.observe(ttft_ms)
+                    # force: summary spans must survive the per-request
+                    # span cap on generations long enough to out-span it
+                    recorder.span(rid, "ttft", ttft_ms, t_ms=0.0, force=True)
                 generated += 1
+                _TOKENS_TOTAL.inc()
 
                 if result.token_id in eos:
                     finish_reason = "stop"
@@ -312,19 +334,17 @@ class InferenceManager:
                 completion_tokens=generated,
                 total_tokens=len(prompt_ids) + generated,
             )
+            # the request span closes the timeline; RequestMetrics is a VIEW
+            # over the recorded spans (ttft + per-step + this), not a second
+            # hand-maintained set of stopwatch fields
+            recorder.span(
+                rid, "request", (t_end - t_start) * 1000, t_ms=0.0,
+                tokens=generated, prompt_tokens=len(prompt_ids),
+                finish_reason=finish_reason, force=True,
+            )
             metrics = None
             if req.profile:
-                total_ms = (t_end - t_start) * 1000
-                ttfb_ms = ((t_first or t_end) - t_start) * 1000
-                gen_ms = max(total_ms - ttfb_ms, 1e-9)
-                metrics = RequestMetrics(
-                    total_ms=total_ms,
-                    ttfb_ms=ttfb_ms,
-                    token_gen_ms=gen_ms,
-                    tokens_generated=generated,
-                    tps_overall=generated / max(total_ms / 1000, 1e-9),
-                    tps_decoding=max(generated - 1, 0) / (gen_ms / 1000),
-                )
+                metrics = RequestMetrics.from_timeline(recorder.timeline(rid))
             yield ChatCompletionChunk(
                 id=rid,
                 model=req.model,
@@ -342,6 +362,11 @@ class InferenceManager:
                 usage=usage,
                 metrics=metrics,
             )
+        except Exception:
+            # client disconnects / task cancels (BaseException) are not
+            # server errors; InferenceError and friends are
+            _REQUEST_ERRORS.inc()
+            raise
         finally:
             await self.adapter.reset_cache(nonce)
 

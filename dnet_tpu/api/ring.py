@@ -16,12 +16,17 @@ import numpy as np
 
 from dnet_tpu.api.strategies import ApiAdapterBase, _TokenFutures
 from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.transport.protocol import ActivationFrame, Empty, TokenPayload
 from dnet_tpu.transport.stream_manager import StreamManager
 from dnet_tpu.utils.logger import get_logger
 from dnet_tpu.utils.serialization import tensor_to_bytes
 
 log = get_logger()
+
+_HOP_RTT_MS = metric("dnet_ring_hop_rtt_ms")
+_LANE_DEPTH = metric("dnet_lane_flush_depth")
+_LANE_WAIT_MS = metric("dnet_lane_queue_wait_ms")
 
 
 class RingApiAdapter(ApiAdapterBase):
@@ -179,6 +184,7 @@ class RingApiAdapter(ApiAdapterBase):
                     "pos": self._pos_for(nonce, step, len(token_ids)),
                     "decoding": asdict(decoding),
                     "token": int(token_ids[0]),
+                    "t_enq": time.monotonic(),  # lane queue-wait origin
                 }
             )
             self._sent_at[(nonce, step)] = time.monotonic()
@@ -196,6 +202,7 @@ class RingApiAdapter(ApiAdapterBase):
             hit = self._prefix_lookup(ids)
             if hit is not None:
                 pos, prefix_hit = hit
+                get_recorder().span(nonce, "prefix_cache_hit", 0.0, tokens=pos)
                 send_ids = token_ids[pos:]  # prefill only the new suffix
             if len(ids) >= self.PREFIX_MIN_TOKENS:
                 prefix_store = self._prefix_put(ids)
@@ -255,6 +262,14 @@ class RingApiAdapter(ApiAdapterBase):
                     await asyncio.sleep(0.0005)
             batch = self._pending[: self._lanes]
             self._pending = self._pending[len(batch):]
+            _LANE_DEPTH.observe(len(batch))
+            now = time.monotonic()
+            for e in batch:
+                wait_ms = (now - e["t_enq"]) * 1000
+                _LANE_WAIT_MS.observe(wait_ms)
+                get_recorder().span(
+                    e["nonce"], "lane_queue_wait", wait_ms, step=e["seq"]
+                )
             tokens = np.asarray([[e["token"]] for e in batch], dtype=np.int32)
             payload, _dtype, shape = tensor_to_bytes(tokens)
             frame = ActivationFrame(
@@ -294,8 +309,9 @@ class RingApiAdapter(ApiAdapterBase):
     PREFIX_MIN_TOKENS = 16  # tiny prompts aren't worth a snapshot
 
     def _prefix_lookup(self, ids: tuple):
-        """Longest indexed strict-proper-prefix of `ids` (matching rules
-        owned by core.prefix_cache.PrefixIndex).  (n_tokens, key) or None."""
+        """Longest indexed strict-proper-prefix of `ids` (matching rules —
+        and the hit/miss counters — owned by core.prefix_cache.PrefixIndex).
+        (n_tokens, key) or None."""
         return self._prefix_index.lookup(ids)
 
     def _prefix_put(self, ids: tuple) -> str:
@@ -306,7 +322,7 @@ class RingApiAdapter(ApiAdapterBase):
             key = hashlib.sha1(
                 np.asarray(ids, dtype=np.int64).tobytes()
             ).hexdigest()[:16]
-            self._prefix_index.put(ids, key)
+            self._prefix_index.put(ids, key)  # PrefixIndex counts the store
         return key
 
     def _pos_for(self, nonce: str, step: int, n_tokens: int) -> int:
@@ -329,6 +345,7 @@ class RingApiAdapter(ApiAdapterBase):
         sent = self._sent_at.pop((result.nonce, result.step), None)
         if sent is not None:
             dt = time.monotonic() - sent
+            _HOP_RTT_MS.observe(dt * 1000)
             self._step_ema = dt if self._step_ema <= 0 else (
                 0.8 * self._step_ema + 0.2 * dt
             )

@@ -223,7 +223,12 @@ class Usage(BaseModel):
 class RequestMetrics(BaseModel):
     """dnet extension returned when profile=true.
 
-    Reference: src/dnet/api/inference.py:216-233.
+    Reference: src/dnet/api/inference.py:216-233.  Since the obs subsystem,
+    this is a VIEW over the request's flight-recorder timeline
+    (dnet_tpu.obs.FlightRecorder): the driver records `ttft`, per-step
+    `decode_step`, and a closing `request` span, and `from_timeline`
+    derives every field from those — one measurement, two consumers
+    (`/v1/debug/timeline/{rid}` dumps the same spans raw).
     """
 
     total_ms: float = 0.0
@@ -232,6 +237,50 @@ class RequestMetrics(BaseModel):
     tokens_generated: int = 0
     tps_overall: float = 0.0
     tps_decoding: float = 0.0
+
+    @classmethod
+    def from_timeline(cls, timeline: Optional[dict]) -> "RequestMetrics":
+        """Derive the profile fields from recorded spans.  Tolerates a
+        missing timeline (recorder ring evicted the rid under extreme
+        concurrency) by returning zeros rather than inventing numbers."""
+        spans = (timeline or {}).get("spans", [])
+
+        def last(name: str) -> Optional[dict]:
+            return next(
+                (s for s in reversed(spans) if s["name"] == name), None
+            )
+
+        req = last("request")
+        if req is None:
+            return cls()
+        total_ms = float(req["dur_ms"])
+        meta = req.get("meta") or {}
+        tokens = int(
+            meta.get(
+                "tokens",
+                sum(1 for s in spans if s["name"] == "decode_step"),
+            )
+        )
+        ttft = last("ttft")
+        if ttft is not None:
+            ttfb_ms = float(ttft["dur_ms"])
+        elif tokens:
+            # ttft span lost (timeline evicted and auto-reopened
+            # mid-request): attribute the whole duration to decoding
+            # rather than clamping gen_ms to ~0 and reporting an
+            # astronomical tps_decoding
+            ttfb_ms = 0.0
+        else:
+            ttfb_ms = total_ms
+        gen_ms = max(total_ms - ttfb_ms, 1e-9)
+        return cls(
+            total_ms=total_ms,
+            ttfb_ms=ttfb_ms,
+            token_gen_ms=gen_ms,
+            tokens_generated=tokens,
+            tps_overall=tokens / max(total_ms / 1000, 1e-9),
+            tps_decoding=max(tokens - 1, 0) / (gen_ms / 1000),
+        )
 
 
 class TopLogprob(BaseModel):

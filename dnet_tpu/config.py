@@ -148,6 +148,13 @@ class ObsSettings(_EnvGroup):
     sync_per_layer: bool = False
     sync_every_n: int = 0
 
+    def sync_stride(self) -> int:
+        """Normalized decode-step sync cadence: 0 = never fence, N >= 1 =
+        fence every N steps (1 = every step).  THE place owning the 0-vs-1
+        semantics — call sites must use this, not the raw field (negative
+        values clamp to never)."""
+        return max(int(self.sync_every_n), 0)
+
 
 @dataclass
 class KVSettings(_EnvGroup):

@@ -31,10 +31,16 @@ from dnet_tpu.core.sampler import (
 )
 from dnet_tpu.core.types import DecodingParams, TokenResult
 from dnet_tpu.models import ModelConfig, get_ring_model_cls
+from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.utils.checkpoint import Checkpoint
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
+
+_DECODE_STEP_MS = metric("dnet_decode_step_ms")
+_PREFILL_MS = metric("dnet_prefill_ms")
+_LAYER_MS = metric("dnet_layer_compute_ms")
+_SESS_EVICTED = metric("dnet_kv_sessions_evicted_total")
 
 
 def bucket_length(n: int, min_bucket: int = 16) -> int:
@@ -48,6 +54,7 @@ def bucket_length(n: int, min_bucket: int = 16) -> int:
 class Session:
     """Per-nonce decode state."""
 
+    nonce: str = ""  # owning request id (flight-recorder span key)
     kv: dict = None  # stacked [L, ...] cache (fit policy)
     kv_list: list = None  # per-layer [1, ...] caches (offload policies)
     pos: int = 0
@@ -155,7 +162,7 @@ class LocalEngine:
 
         obs = get_settings().obs
         self._sync_per_layer = obs.sync_per_layer
-        self._sync_every_n = obs.sync_every_n
+        self._sync_every_n = obs.sync_stride()  # 0 = never, N >= 1 = every N
 
         # draft-MODEL speculation (r5, beyond both the reference and the
         # prompt-lookup drafts): a second, much smaller checkpoint drafts
@@ -532,10 +539,12 @@ class LocalEngine:
                 )
                 if self._sync_per_layer:
                     x.block_until_ready()
-                    log.info(
-                        "[PROFILE] layer %d: %.2fms",
-                        layer, (time.perf_counter() - t0) * 1000,
+                    dt_ms = (time.perf_counter() - t0) * 1000
+                    _LAYER_MS.observe(dt_ms)
+                    get_recorder().span(
+                        sess.nonce, "layer_compute", dt_ms, layer=layer
                     )
+                    log.info("[PROFILE] layer %d: %.2fms", layer, dt_ms)
                 # unpin immediately so the residency budget can evict behind
                 # us; sliding_fit (residency < window) delta-swaps eagerly
                 self.weight_cache.release([layer])
@@ -612,6 +621,7 @@ class LocalEngine:
                     self.kv_dtype, quant_bits=self.kv_quant_bits,
                 )
         sess = Session(
+            nonce=nonce,
             kv=kv,
             kv_list=kv_list,
             pos=pos,
@@ -642,6 +652,8 @@ class LocalEngine:
         dead = [n for n, s in self.sessions.items() if now - s.last_used > self.kv_ttl_s]
         for n in dead:
             del self.sessions[n]
+        if dead:
+            _SESS_EVICTED.inc(len(dead))
         return len(dead)
 
     def reset(self) -> None:
@@ -669,6 +681,7 @@ class LocalEngine:
         full_ids = list(prompt_ids)
         if not full_ids:
             raise ValueError("empty prompt")
+        t_pf = time.perf_counter()
         sess = self.sessions.get(nonce)
         fresh = sess is None
         # validate against the FULL prompt before any session mutation: a
@@ -687,6 +700,7 @@ class LocalEngine:
             if hit is not None:
                 n, kv_copy = hit
                 sess = self.new_session(nonce, seed, kv=kv_copy, pos=n)
+                get_recorder().span(nonce, "prefix_cache_hit", 0.0, tokens=n)
                 prompt_ids = full_ids[n:]  # >= 1 token left by construction
             else:
                 sess = self.new_session(nonce, seed)
@@ -731,6 +745,11 @@ class LocalEngine:
             # snapshot the full-prompt KV (copied: step fns donate their kv;
             # the cache itself skips prompts below its min_tokens threshold)
             self.prefix_cache.store(full_ids, sess.kv)
+        # dispatch wall time (logits are still async); a synced number needs
+        # the DNET_OBS_SYNC_* fences, same as the [PROFILE] lines always did
+        dt_ms = (time.perf_counter() - t_pf) * 1000
+        _PREFILL_MS.observe(dt_ms)
+        get_recorder().span(nonce, "prefill", dt_ms, tokens=T)
         return logits
 
     def seed_from_prefix(
@@ -821,6 +840,7 @@ class LocalEngine:
             raise ValueError(
                 f"sequence length {sess.pos} reached max_seq {self.max_seq}"
             )
+        t_step = time.perf_counter()
         sess.key, step_key = jax.random.split(sess.key)
         sp = SampleParams.from_decoding(decoding)
         plan = SamplePlan.from_decoding(decoding)
@@ -840,10 +860,15 @@ class LocalEngine:
         if self._sync_every_n and sess.pos % self._sync_every_n == 0:
             t0 = time.perf_counter()
             res.token.block_until_ready()
+            drain_ms = (time.perf_counter() - t0) * 1000
+            get_recorder().span(nonce, "decode_sync_drain", drain_ms,
+                                step=sess.pos)
             log.info(
                 "[PROFILE] decode step %d sync: %.2fms drain",
-                sess.pos, (time.perf_counter() - t0) * 1000,
+                sess.pos, drain_ms,
             )
+        # dispatch wall (synced only when the fence above ran this step)
+        _DECODE_STEP_MS.observe((time.perf_counter() - t_step) * 1000)
         sess.pos += 1
         sess.last_used = time.time()
         return res
@@ -935,6 +960,7 @@ class LocalEngine:
                 else int(np.asarray(sess.last_token)[0, 0])
             )
             return [self.decode_step(nonce, tid, decoding)]
+        t_blk = time.perf_counter()
         if token_id is None:
             if sess.last_token is None:
                 raise RuntimeError("no device-resident token to chain from")
@@ -954,6 +980,12 @@ class LocalEngine:
             )
         out_h = np.asarray(out)  # [B, L+1]; blocks until the block finishes
         emitted = min(int((out_h[0] >= 0).sum()), budget)
+        # the verify block amortizes one forward over `emitted` tokens:
+        # record the per-token share so the histogram's count stays equal
+        # to tokens served across the plain / chunked / speculative paths
+        per_tok_ms = (time.perf_counter() - t_blk) * 1000 / max(emitted, 1)
+        for _ in range(emitted):
+            _DECODE_STEP_MS.observe(per_tok_ms)
         sess.pos += emitted
         sess.spec_blocks += 1
         sess.spec_emitted += emitted
@@ -1034,7 +1066,13 @@ class LocalEngine:
         the packed [K, B, W] result block, split host-side."""
         sess = self.sessions[nonce]
         K, packed, plan = sess.pending.popleft()
+        t0 = time.perf_counter()
         arr = np.asarray(packed)  # blocks until the chunk's program finishes
+        # the blocking read amortizes the chunk: record the per-token share
+        # (K observations keep the histogram's count == tokens served)
+        per_tok_ms = (time.perf_counter() - t0) * 1000 / K
+        for _ in range(K):
+            _DECODE_STEP_MS.observe(per_tok_ms)
         toks = arr[..., 0].astype(np.int32)  # [K, B]
         if plan.logprobs:
             M = MAX_TOP_LOGPROBS

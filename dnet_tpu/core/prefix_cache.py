@@ -30,6 +30,16 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 
+from dnet_tpu.obs import metric
+
+# one labeled family set covers both halves of ring prefix caching: the
+# API-side prompt index + LocalEngine PrefixCache (cache="prefix") and the
+# shard-side SnapshotStore (cache="snapshot")
+_HITS = metric("dnet_kv_cache_hits_total")
+_MISSES = metric("dnet_kv_cache_misses_total")
+_EVICTIONS = metric("dnet_kv_cache_evictions_total")
+_STORES = metric("dnet_kv_cache_stores_total")
+
 
 def _copy_tree(tree):
     return jax.tree.map(lambda a: a.copy(), tree)
@@ -44,15 +54,19 @@ class PrefixIndex:
     (api/ring.py) stores snapshot KEYS — both sides of ring prefix
     caching thus share one matching implementation."""
 
-    def __init__(self, capacity: int, min_tokens: int = 16) -> None:
+    def __init__(
+        self, capacity: int, min_tokens: int = 16, kind: str = "prefix"
+    ) -> None:
         self.capacity = capacity
         self.min_tokens = min_tokens
+        self.kind = kind  # `cache` label on the hit/miss/store/evict counters
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
 
     def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, object]]:
         """Longest entry covering at most len(prompt)-1 tokens; bumps LRU.
-        Returns (n_tokens, value) or None."""
+        Returns (n_tokens, value) or None.  Counts the hit/miss here — the
+        one matcher — so no wrapper can forget to."""
         ids = tuple(prompt_ids)
         with self._lock:
             best = None
@@ -64,8 +78,10 @@ class PrefixIndex:
                     if best is None or len(key) > len(best):
                         best = key
             if best is None:
+                _MISSES.labels(cache=self.kind).inc()
                 return None
             self._entries.move_to_end(best)
+            _HITS.labels(cache=self.kind).inc()
             return len(best), self._entries[best]
 
     def get_exact(self, prompt_ids: Sequence[int]):
@@ -87,8 +103,10 @@ class PrefixIndex:
                 self._entries.move_to_end(ids)
                 return False
             self._entries[ids] = value
+            _STORES.labels(cache=self.kind).inc()
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
+                _EVICTIONS.labels(cache=self.kind).inc()
             return True
 
     def clear(self) -> None:
@@ -114,7 +132,7 @@ class PrefixCache:
     def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, dict]]:
         """Longest cached prefix covering at most len(prompt)-1 tokens.
         Returns (n_tokens, kv copy) or None."""
-        hit = self._index.lookup(prompt_ids)
+        hit = self._index.lookup(prompt_ids)  # PrefixIndex counts hit/miss
         if hit is None:
             self.stats["misses"] += 1
             return None
@@ -127,7 +145,7 @@ class PrefixCache:
             return
         if self._index.get_exact(prompt_ids) is not None:
             return
-        if self._index.put(prompt_ids, _copy_tree(kv)):
+        if self._index.put(prompt_ids, _copy_tree(kv)):  # counts the store
             self.stats["stores"] += 1
 
     def clear(self) -> None:
@@ -154,9 +172,11 @@ class SnapshotStore:
             hit = self._entries.get(key)
             if hit is None:
                 self.stats["misses"] += 1
+                _MISSES.labels(cache="snapshot").inc()
                 return None
             self._entries.move_to_end(key)
             self.stats["hits"] += 1
+            _HITS.labels(cache="snapshot").inc()
             n, kv = hit
         return n, _copy_tree(kv)
 
@@ -167,8 +187,10 @@ class SnapshotStore:
                 return
             self._entries[key] = (pos, _copy_tree(kv))
             self.stats["stores"] += 1
+            _STORES.labels(cache="snapshot").inc()
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
+                _EVICTIONS.labels(cache="snapshot").inc()
 
     def clear(self) -> None:
         with self._lock:

@@ -1,0 +1,180 @@
+"""Unified observability: metrics registry + per-request flight recorder.
+
+One process-global `MetricsRegistry` (Prometheus text exposition at
+`GET /metrics` on both the API and shard HTTP servers) and one
+`FlightRecorder` (span timelines at `GET /v1/debug/timeline/{rid}`).
+Instrumented modules fetch family handles by name via `metric()`; the
+canonical family set below is registered on first access so `/metrics`
+exposes every series — zero-valued — from process start, and so a typo'd
+name fails loudly at import instead of silently creating a parallel series.
+
+`obs_enabled()` is the ONE truth for profile gating: the `[PROFILE]` log
+filter (utils/logger.py) and any sampling decisions both consult it, so the
+legacy `DNET_PROFILE` env and `DNET_OBS_ENABLED` (config.ObsSettings) can
+never disagree.  The registry and recorder themselves are always on —
+counters are near-free and the recorder is bounded — gating covers only the
+log-line firehose and the device-sync fences.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from dnet_tpu.obs.metrics import (
+    CONTENT_TYPE_LATEST,
+    DEFAULT_MS_BUCKETS,
+    METRIC_NAME_RE,
+    MetricFamily,
+    MetricsRegistry,
+)
+from dnet_tpu.obs.recorder import FlightRecorder
+
+__all__ = [
+    "CONTENT_TYPE_LATEST",
+    "DEFAULT_MS_BUCKETS",
+    "METRIC_NAME_RE",
+    "FlightRecorder",
+    "MetricFamily",
+    "MetricsRegistry",
+    "get_recorder",
+    "get_registry",
+    "metric",
+    "obs_enabled",
+    "reset_obs",
+]
+
+_registry = MetricsRegistry()
+_recorder = FlightRecorder()
+_core_once = threading.Lock()
+_core_done = False
+
+# lane-depth / small-count histograms use power-of-two buckets, not ms
+COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+_CACHE_KINDS = ("prefix", "snapshot")
+
+
+def _register_core(reg: MetricsRegistry) -> None:
+    """The canonical family set, pre-registered (and labeled children
+    pre-touched) so exposition carries them at zero before first use."""
+    reg.histogram(
+        "dnet_decode_step_ms",
+        "Per-token decode step wall time on the serving path (ms)",
+    )
+    reg.histogram(
+        "dnet_prefill_ms", "Prompt prefill wall time per request (ms)"
+    )
+    reg.histogram(
+        "dnet_ttft_ms", "Time to first token per request (ms)"
+    )
+    reg.histogram(
+        "dnet_layer_compute_ms",
+        "Per-layer compute wall time under DNET_OBS_SYNC_PER_LAYER (ms)",
+    )
+    reg.histogram(
+        "dnet_token_rpc_ms",
+        "Shard-to-API token callback RPC latency (ms)",
+    )
+    reg.histogram(
+        "dnet_ring_hop_rtt_ms",
+        "API-observed token frame send-to-resolve round trip (ms)",
+    )
+    reg.histogram(
+        "dnet_lane_queue_wait_ms",
+        "Decode-step wait in the lane coalescing queue (ms)",
+    )
+    reg.histogram(
+        "dnet_lane_flush_depth",
+        "Members per flushed multi-lane ring frame",
+        buckets=COUNT_BUCKETS,
+    )
+    reg.counter(
+        "dnet_transport_tx_bytes_total",
+        "Activation/token frame payload bytes written to outbound streams",
+    )
+    reg.counter(
+        "dnet_transport_rx_bytes_total",
+        "Activation/token frame payload bytes admitted at ingress",
+    )
+    reg.counter(
+        "dnet_transport_tx_frames_total",
+        "Frames written to outbound streams",
+    )
+    reg.counter(
+        "dnet_transport_backpressure_total",
+        "Backpressure ACKs that paused an outbound stream",
+    )
+    for name, help_text in (
+        ("dnet_kv_cache_hits_total", "KV snapshot cache hits"),
+        ("dnet_kv_cache_misses_total", "KV snapshot cache misses"),
+        ("dnet_kv_cache_evictions_total", "KV snapshot cache LRU evictions"),
+        ("dnet_kv_cache_stores_total", "KV snapshots stored"),
+    ):
+        fam = reg.counter(name, help_text, labelnames=("cache",))
+        for kind in _CACHE_KINDS:
+            fam.labels(cache=kind)  # pre-touch: expose at 0 from the start
+    reg.counter(
+        "dnet_kv_sessions_evicted_total",
+        "Per-nonce KV sessions dropped by the TTL sweep",
+    )
+    reg.counter("dnet_requests_total", "Decode requests started")
+    reg.counter(
+        "dnet_request_errors_total", "Decode requests failed with an error"
+    )
+    reg.counter(
+        "dnet_tokens_generated_total", "Tokens emitted across all requests"
+    )
+
+
+def _ensure_core() -> None:
+    global _core_done
+    if _core_done:
+        return
+    with _core_once:
+        if not _core_done:
+            _register_core(_registry)
+            _core_done = True
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry (core families registered)."""
+    _ensure_core()
+    return _registry
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def metric(name: str) -> MetricFamily:
+    """Fetch a registered family by name; unknown names raise (catching
+    typos at import time beats a silently separate series)."""
+    _ensure_core()
+    fam = _registry.get(name)
+    if fam is None:
+        raise KeyError(f"metric {name!r} is not registered; add it to "
+                       f"dnet_tpu.obs._register_core")
+    return fam
+
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def obs_enabled() -> bool:
+    """Single profile-gating truth: DNET_OBS_ENABLED (ObsSettings) or the
+    legacy DNET_PROFILE env, whichever is set."""
+    from dnet_tpu.config import get_settings
+
+    if get_settings().obs.enabled:
+        return True
+    return os.environ.get("DNET_PROFILE", "").strip().lower() in _TRUTHY
+
+
+def reset_obs() -> None:
+    """Zero metrics in place and drop recorded timelines (for tests).
+    Family/child objects survive, so handles held by instrumented modules
+    stay valid."""
+    _ensure_core()
+    _registry.reset()
+    _recorder.clear()

@@ -1,0 +1,37 @@
+"""Shared aiohttp glue for the obs HTTP surface.
+
+Both server roles (api/http.py, shard/http.py) expose `GET /metrics` and
+`GET /v1/debug/timeline/{rid}`; the exposition body and the timeline lookup
+live here so the two cannot drift.  Error-shape wrapping stays with each
+server (the API wraps 404s as `{"error": {...}}`, the shard as
+`{"status": "error", ...}` — each matching its own route convention).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+from dnet_tpu.obs import CONTENT_TYPE_LATEST, get_recorder, get_registry
+
+
+async def metrics_response(request: web.Request) -> web.Response:
+    """Prometheus text exposition of this process's registry."""
+    return web.Response(
+        body=get_registry().expose().encode("utf-8"),
+        headers={"Content-Type": CONTENT_TYPE_LATEST},
+    )
+
+
+def find_timeline(rid: str) -> Optional[dict]:
+    """Timeline lookup by public response id.  The recorder keys timelines
+    by the internal `chatcmpl-...` nonce; /v1/completions clients hold the
+    rewritten `cmpl-...` form (api/inference.py), so that alias is tried
+    too — the documented workflow is "rid = the response id", whichever
+    endpoint produced it."""
+    rec = get_recorder()
+    timeline = rec.timeline(rid)
+    if timeline is None and rid.startswith("cmpl-"):
+        timeline = rec.timeline("chat" + rid)
+    return timeline

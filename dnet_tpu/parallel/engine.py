@@ -329,6 +329,7 @@ class MeshEngine:
             )
             _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
         sess = Session(
+            nonce=nonce,
             kv=kv,
             pos=pos,
             key=jax.random.key(seed),

@@ -480,6 +480,7 @@ class MeshShardEngine(LocalEngine):
                 )
                 _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
         sess = Session(
+            nonce=nonce,
             kv=kv,
             kv_list=kv_list,
             pos=pos,

@@ -16,11 +16,15 @@ import time
 from typing import Callable, Dict, Optional
 
 from dnet_tpu.core.types import ActivationMessage, TokenResult
+from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.transport.protocol import ActivationFrame, TokenPayload
 from dnet_tpu.transport.stream_manager import StreamManager
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
+
+_RX_BYTES = metric("dnet_transport_rx_bytes_total")
+_TOKEN_RPC_MS = metric("dnet_token_rpc_ms")
 
 
 def parse_callback(url: str) -> str:
@@ -103,6 +107,9 @@ class RingAdapter:
     async def ingress_frame(self, frame: ActivationFrame) -> tuple[bool, str]:
         """Admit a frame: local compute if the next layer is ours, else relay.
         Returns (ok, message) for the ACK."""
+        n_bytes = len(getattr(frame, "payload", b"") or b"")
+        _RX_BYTES.inc(n_bytes)
+        get_recorder().span(frame.nonce, "transport_recv", 0.0, bytes=n_bytes)
         compute = self.runtime.compute
         if compute is not None and compute.wants(frame.layer_id):
             msg = frame.to_message()
@@ -229,12 +236,17 @@ class RingAdapter:
             await client.send_token(
                 TokenPayload(nonce=msg.nonce, step=step, token_id=int(token_id))
             )
+        # record first, then log the RECORDED value (the [PROFILE] line is
+        # now a view over the same measurement the registry aggregates)
+        rpc_ms = (time.perf_counter() - t0) * 1e3
+        _TOKEN_RPC_MS.observe(rpc_ms)
+        get_recorder().span(msg.nonce, "token_rpc", rpc_ms, step=msg.seq)
         log.info(
             "[PROFILE] token step=%d nonce=%s n=%d rpc=%.2fms",
             msg.seq,
             msg.nonce,
             1 + len(msg.extra_finals or ()),
-            (time.perf_counter() - t0) * 1e3,
+            rpc_ms,
         )
 
     async def _send_error_token(

@@ -2,7 +2,10 @@
 
 Reference: src/dnet/shard/http_api.py:222-336 — /health, /load_model,
 /unload_model, /measure_latency (gRPC probes to peers per payload size),
-/profile (device microbench).
+/profile (device microbench).  Plus the obs surface: `GET /metrics` (this
+process's Prometheus exposition — transport rx bytes, token RPC latency,
+snapshot-cache counters live HERE, not on the API node) and
+`GET /v1/debug/timeline/{rid}` (this shard's recorded spans for a nonce).
 """
 
 from __future__ import annotations
@@ -65,6 +68,10 @@ class ShardHTTPServer:
         self.shard = shard  # Shard facade (runtime + adapter)
         self.app = web.Application(client_max_size=16 * 1024 * 1024)
         self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/metrics", self.metrics)
+        self.app.router.add_get(
+            "/v1/debug/timeline/{rid}", self.debug_timeline
+        )
         self.app.router.add_post("/load_model", self.load_model)
         self.app.router.add_post("/unload_model", self.unload_model)
         self.app.router.add_post("/measure_latency", self.measure_latency)
@@ -86,6 +93,29 @@ class ShardHTTPServer:
             self._runner = None
 
     # ---- handlers -----------------------------------------------------
+    async def metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of this shard process's registry."""
+        from dnet_tpu.obs.http import metrics_response
+
+        return await metrics_response(request)
+
+    async def debug_timeline(self, request: web.Request) -> web.Response:
+        """This shard's recorded spans for one request nonce — the
+        shard-side half (transport_recv, token_rpc, layer_compute, ...) of
+        the timeline the API server exposes under the same path.  The 404
+        shape follows this server's `{"status": "error"}` convention."""
+        from dnet_tpu.obs.http import find_timeline
+
+        rid = request.match_info["rid"]
+        timeline = find_timeline(rid)
+        if timeline is None:
+            return web.json_response(
+                {"status": "error",
+                 "message": f"no recorded timeline for {rid!r}"},
+                status=404,
+            )
+        return web.json_response(timeline)
+
     async def health(self, request: web.Request) -> web.Response:
         rt = self.shard.runtime
         compute = rt.compute

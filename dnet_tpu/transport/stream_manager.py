@@ -14,10 +14,15 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
+from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.transport.protocol import ActivationFrame, StreamAck
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
+
+_TX_BYTES = metric("dnet_transport_tx_bytes_total")
+_TX_FRAMES = metric("dnet_transport_tx_frames_total")
+_BACKPRESSURE = metric("dnet_transport_backpressure_total")
 
 
 @dataclass
@@ -72,6 +77,7 @@ class StreamManager:
         while ctx.disabled:
             await asyncio.sleep(max(ctx.disabled_until - time.monotonic(), 0.01))
         ctx.seq += 1
+        t0 = time.perf_counter()
         try:
             await ctx.call.write(frame)
         except Exception:
@@ -80,6 +86,13 @@ class StreamManager:
             await self.end_stream(nonce)
             raise
         ctx.last_used = time.monotonic()
+        n_bytes = len(getattr(frame, "payload", b"") or b"")
+        _TX_BYTES.inc(n_bytes)
+        _TX_FRAMES.inc()
+        get_recorder().span(
+            nonce, "transport_send", (time.perf_counter() - t0) * 1000,
+            bytes=n_bytes,
+        )
 
     async def _ack_reader(self, ctx: StreamContext) -> None:
         """Consume ACKs; a backpressure ACK pauses the stream briefly
@@ -93,6 +106,10 @@ class StreamManager:
                     ack = StreamAck.from_bytes(bytes(ack))
                 if ack.backpressure:
                     ctx.disabled_until = time.monotonic() + self._backoff_s
+                    _BACKPRESSURE.inc()
+                    get_recorder().span(
+                        ctx.nonce, "backpressure_pause", self._backoff_s * 1000
+                    )
                     log.warning(
                         "[PROFILE] stream %s backpressure, pausing %.2fs",
                         ctx.nonce,

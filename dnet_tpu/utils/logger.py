@@ -52,12 +52,15 @@ def setup_logger(
         logger.removeHandler(h)
 
     from dnet_tpu.config import get_settings
+    from dnet_tpu.obs import obs_enabled
 
     s = get_settings()
     level = level or s.log.level
     log_dir = log_dir or s.log.dir
     to_file = s.log.to_file if to_file is None else to_file
-    profile_on = s.obs.enabled or os.environ.get("DNET_PROFILE", "") in {"1", "true"}
+    # one gating truth shared with the metrics/recorder layer (dnet_tpu.obs):
+    # the [PROFILE] filter and the registry can never disagree
+    profile_on = obs_enabled()
 
     logger.setLevel(level.upper())
     logger.propagate = False

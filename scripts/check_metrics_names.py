@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Metric-name lint: every dnet metric matches `dnet_[a-z0-9_]+` and has a
+help string.
+
+Two passes, so drift cannot hide either way:
+
+1. **Live registry** — import `dnet_tpu.obs` (which registers the canonical
+   family set) and validate every registered family's name and help.
+2. **Source scan** — regex over the tree for `counter(` / `gauge(` /
+   `histogram(` calls whose first argument is a string literal, catching
+   series that a future PR registers lazily (never hit by pass 1) or with
+   an empty/missing help string.
+
+Invoked from the tier-1 suite (tests/test_metrics_lint.py) so a bad name
+fails CI, not a 3am dashboard.  Exit 0 = clean, 1 = violations (printed).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # runnable as `python scripts/check_...py`
+    sys.path.insert(0, str(REPO))
+
+# metric-registration calls with a literal name; help must be the next
+# argument and a non-empty string literal
+_CALL_RE = re.compile(
+    r"""\.\s*(counter|gauge|histogram)\(\s*
+        (?P<q>['"])(?P<name>[^'"]+)(?P=q)\s*,\s*
+        (?P<rest>.{0,120})""",
+    re.VERBOSE | re.DOTALL,
+)
+_HELP_RE = re.compile(r"""^(?P<q>['"])(?P<help>[^'"]*)""")
+
+_SCAN_DIRS = ("dnet_tpu", "scripts")
+_SCAN_FILES = ("bench.py",)
+
+
+def _check_name(name: str, where: str, errors: list) -> None:
+    from dnet_tpu.obs import METRIC_NAME_RE
+
+    if not METRIC_NAME_RE.match(name):
+        errors.append(
+            f"{where}: metric name {name!r} does not match "
+            f"{METRIC_NAME_RE.pattern}"
+        )
+
+
+def check_registry(errors: list) -> int:
+    from dnet_tpu.obs import get_registry
+
+    fams = get_registry().families()
+    for name, fam in fams.items():
+        _check_name(name, "registry", errors)
+        if not fam.help.strip():
+            errors.append(f"registry: metric {name} has an empty help string")
+    return len(fams)
+
+
+def check_sources(errors: list) -> int:
+    n = 0
+    files = [REPO / f for f in _SCAN_FILES]
+    for d in _SCAN_DIRS:
+        files.extend(sorted((REPO / d).rglob("*.py")))
+    for path in files:
+        if not path.is_file():
+            continue
+        text = path.read_text()
+        for m in _CALL_RE.finditer(text):
+            name = m.group("name")
+            if not name.startswith("dnet_"):
+                continue  # not one of ours (e.g. a generic helper call)
+            n += 1
+            where = f"{path.relative_to(REPO)}"
+            _check_name(name, where, errors)
+            hm = _HELP_RE.match(m.group("rest").lstrip())
+            if hm is None or not hm.group("help").strip():
+                errors.append(
+                    f"{where}: metric {name} registered without a literal "
+                    f"non-empty help string"
+                )
+    return n
+
+
+def main() -> int:
+    errors: list[str] = []
+    n_reg = check_registry(errors)
+    n_src = check_sources(errors)
+    if errors:
+        for e in errors:
+            print(f"FAIL {e}")
+        return 1
+    print(f"ok: {n_reg} registered families, {n_src} source-literal "
+          f"registrations, all conform")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

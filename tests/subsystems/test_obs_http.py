@@ -1,0 +1,193 @@
+"""Obs HTTP surface: `GET /metrics` on both server roles and the
+per-request flight-recorder dump at `GET /v1/debug/timeline/{rid}`.
+
+The acceptance contract for the obs subsystem: the API exposition carries
+the canonical series (dnet_decode_step_ms, dnet_transport_tx_bytes_total,
+dnet_kv_cache_hits_total) in parseable Prometheus v0.0.4 text, the shard
+server exposes the same registry, and a completed request's timeline dump
+contains its ttft span plus at least one per-step span.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dnet_tpu.api.http import ApiHTTPServer
+from dnet_tpu.api.inference import InferenceManager
+from dnet_tpu.api.model_manager import LocalModelManager
+from dnet_tpu.shard.http import ShardHTTPServer
+
+pytestmark = [pytest.mark.api, pytest.mark.http]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_stack():
+    inference = InferenceManager(adapter=None, request_timeout_s=30.0)
+    manager = LocalModelManager(inference, max_seq=64, param_dtype="float32")
+    server = ApiHTTPServer(inference, manager)
+    return inference, manager, server
+
+
+async def client_for(app) -> TestClient:
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal v0.0.4 parser: sample name+labels -> float value.  Raises on
+    malformed lines, so the test doubles as a format check."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, value = line.rsplit(" ", 1)
+        samples[key] = float(value)
+    return samples
+
+
+def test_api_metrics_route():
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server.app)
+        r = await client.get("/metrics")
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = await r.text()
+        samples = _parse_exposition(text)
+        assert samples, "empty exposition"
+        # the acceptance-criteria series, typed correctly
+        assert "# TYPE dnet_decode_step_ms histogram" in text
+        assert "# TYPE dnet_transport_tx_bytes_total counter" in text
+        assert "# TYPE dnet_kv_cache_hits_total counter" in text
+        assert any(k.startswith("dnet_decode_step_ms_bucket") for k in samples)
+        assert "dnet_transport_tx_bytes_total" in samples
+        assert 'dnet_kv_cache_hits_total{cache="prefix"}' in samples
+        await client.close()
+
+    run(go())
+
+
+def test_shard_metrics_route():
+    async def go():
+        # /metrics never touches the shard facade, so a bare object serves
+        server = ShardHTTPServer(shard=object())
+        client = await client_for(server.app)
+        r = await client.get("/metrics")
+        assert r.status == 200
+        text = await r.text()
+        samples = _parse_exposition(text)
+        # shard-side series present (same process-global registry)
+        assert "dnet_transport_rx_bytes_total" in samples
+        assert any(k.startswith("dnet_token_rpc_ms_bucket") for k in samples)
+        await client.close()
+
+    run(go())
+
+
+def test_shard_timeline_route():
+    """Shard-recorded spans (transport_recv, token_rpc, ...) are readable
+    through the shard's own /v1/debug/timeline/{rid}."""
+
+    async def go():
+        from dnet_tpu.obs import get_recorder
+
+        get_recorder().span("nonce-shard-tl", "token_rpc", 2.5, step=1)
+        server = ShardHTTPServer(shard=object())
+        client = await client_for(server.app)
+        r = await client.get("/v1/debug/timeline/nonce-shard-tl")
+        assert r.status == 200
+        tl = await r.json()
+        assert tl["spans"][0]["name"] == "token_rpc"
+        r = await client.get("/v1/debug/timeline/never-seen")
+        assert r.status == 404
+        await client.close()
+
+    run(go())
+
+
+def test_timeline_unknown_rid_404():
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server.app)
+        r = await client.get("/v1/debug/timeline/chatcmpl-nope")
+        assert r.status == 404
+        body = await r.json()
+        assert "no recorded timeline" in body["error"]["message"]
+        await client.close()
+
+    run(go())
+
+
+def test_timeline_of_completed_request(tiny_llama_dir):
+    """End-to-end acceptance: serve one request, then dump its timeline —
+    it must contain the ttft span and >= 1 per-step (decode_step) span,
+    plus the closing request span RequestMetrics derives from."""
+
+    async def go():
+        _, _, server = make_stack()
+        client = await client_for(server.app)
+        r = await client.post(
+            "/v1/load_model", json={"model": str(tiny_llama_dir)}
+        )
+        assert r.status == 200, await r.text()
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "temperature": 0,
+                "profile": True,
+            },
+        )
+        assert r.status == 200, await r.text()
+        out = await r.json()
+        rid = out["id"]
+
+        r = await client.get(f"/v1/debug/timeline/{rid}")
+        assert r.status == 200, await r.text()
+        tl = await r.json()
+        assert tl["rid"] == rid
+        names = [s["name"] for s in tl["spans"]]
+        assert "ttft" in names
+        steps = [s for s in tl["spans"] if s["name"] == "decode_step"]
+        assert len(steps) >= 1
+        assert all(s["dur_ms"] >= 0 for s in tl["spans"])
+        # the profile metrics returned inline are a view over these spans
+        req = next(s for s in tl["spans"] if s["name"] == "request")
+        assert out["metrics"]["total_ms"] == pytest.approx(req["dur_ms"])
+        assert out["metrics"]["tokens_generated"] == req["meta"]["tokens"]
+        # and the registry aggregated the same steps
+        r = await client.get("/metrics")
+        samples = _parse_exposition(await r.text())
+        assert samples["dnet_ttft_ms_count"] >= 1
+        assert samples["dnet_decode_step_ms_count"] >= len(steps)
+        await client.close()
+
+    run(go())
+
+def test_timeline_cmpl_alias_resolves():
+    """/v1/completions clients hold the rewritten `cmpl-...` response id;
+    the timeline lookup must resolve it to the internal `chatcmpl-...` key
+    (dnet_tpu.obs.http.find_timeline) instead of 404ing."""
+
+    async def go():
+        from dnet_tpu.obs import get_recorder
+
+        get_recorder().span(
+            "chatcmpl-alias-test", "request", 10.0, t_ms=0.0, force=True
+        )
+        _, _, server = make_stack()
+        client = await client_for(server.app)
+        r = await client.get("/v1/debug/timeline/cmpl-alias-test")
+        assert r.status == 200
+        tl = await r.json()
+        assert tl["rid"] == "chatcmpl-alias-test"
+        await client.close()
+
+    run(go())

@@ -55,3 +55,16 @@ def test_reset_cache():
     from dnet_tpu.config import get_settings
 
     assert get_settings() is get_settings()
+
+
+def test_obs_sync_stride_normalized(monkeypatch):
+    """One place owns the 0-vs-1 semantics: 0 = never fence, N >= 1 =
+    fence every N steps; negatives clamp to never."""
+    from dnet_tpu.config import ObsSettings
+
+    assert ObsSettings(sync_every_n=0).sync_stride() == 0
+    assert ObsSettings(sync_every_n=1).sync_stride() == 1
+    assert ObsSettings(sync_every_n=8).sync_stride() == 8
+    assert ObsSettings(sync_every_n=-3).sync_stride() == 0
+    monkeypatch.setenv("DNET_OBS_SYNC_EVERY_N", "-5")
+    assert ObsSettings.from_env().sync_stride() == 0

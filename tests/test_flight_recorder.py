@@ -1,0 +1,165 @@
+"""Flight-recorder unit tests: span recording, ring-buffer eviction of the
+oldest request timelines, per-request span caps, and the timed helper."""
+
+import pytest
+
+from dnet_tpu.obs.recorder import FlightRecorder
+
+pytestmark = pytest.mark.core
+
+
+def test_span_recording_and_timeline_shape():
+    rec = FlightRecorder(max_requests=8)
+    rec.begin("r1")
+    rec.span("r1", "ttft", 12.5, t_ms=0.0)
+    rec.span("r1", "decode_step", 1.25, step=3)
+    tl = rec.timeline("r1")
+    assert tl["rid"] == "r1"
+    assert tl["dropped"] == 0
+    names = [s["name"] for s in tl["spans"]]
+    assert names == ["ttft", "decode_step"]
+    assert tl["spans"][0]["dur_ms"] == 12.5
+    assert tl["spans"][0]["t_ms"] == 0.0
+    assert tl["spans"][1]["meta"] == {"step": 3}
+    # derived start offset: now - dur, so never negative for sane clocks
+    assert tl["spans"][1]["t_ms"] >= 0.0 or tl["spans"][1]["t_ms"] > -2.0
+
+
+def test_timeline_returns_copies():
+    rec = FlightRecorder()
+    rec.span("r1", "a", 1.0)
+    tl = rec.timeline("r1")
+    tl["spans"][0]["name"] = "mutated"
+    assert rec.timeline("r1")["spans"][0]["name"] == "a"
+
+
+def test_ring_buffer_evicts_oldest_requests():
+    rec = FlightRecorder(max_requests=4)
+    for i in range(6):
+        rec.begin(f"r{i}")
+        rec.span(f"r{i}", "x", 1.0)
+    assert rec.request_ids() == ["r2", "r3", "r4", "r5"]
+    assert rec.timeline("r0") is None
+    assert rec.timeline("r1") is None
+    assert rec.timeline("r5") is not None
+
+
+def test_re_begin_moves_to_back_of_ring():
+    rec = FlightRecorder(max_requests=2)
+    rec.begin("a")
+    rec.begin("b")
+    rec.begin("a")  # refresh: "a" is now newest
+    rec.begin("c")  # evicts "b", not "a"
+    assert rec.timeline("a") is not None
+    assert rec.timeline("b") is None
+
+
+def test_span_cap_counts_dropped():
+    rec = FlightRecorder(max_spans=3)
+    for i in range(5):
+        rec.span("r", "s", float(i))
+    tl = rec.timeline("r")
+    assert len(tl["spans"]) == 3
+    assert tl["dropped"] == 2
+
+
+def test_auto_begin_on_unknown_rid():
+    """Shard/transport-side spans arrive keyed by nonce with no driver
+    begin(); they must still land in a timeline."""
+    rec = FlightRecorder()
+    rec.span("never-begun", "transport_recv", 0.0, bytes=128)
+    tl = rec.timeline("never-begun")
+    assert tl is not None and tl["spans"][0]["meta"]["bytes"] == 128
+
+
+def test_timed_contextmanager_records_duration():
+    import time
+
+    rec = FlightRecorder()
+    with rec.timed("r", "work", tag="x"):
+        time.sleep(0.01)
+    span = rec.timeline("r")["spans"][0]
+    assert span["name"] == "work"
+    assert span["dur_ms"] >= 5.0
+    assert span["meta"] == {"tag": "x"}
+
+
+def test_clear_and_bounds_validation():
+    rec = FlightRecorder()
+    rec.span("r", "s", 1.0)
+    rec.clear()
+    assert rec.timeline("r") is None
+    with pytest.raises(ValueError):
+        FlightRecorder(max_requests=0)
+    with pytest.raises(ValueError):
+        FlightRecorder(max_spans=0)
+
+
+def test_force_span_bypasses_cap():
+    """Summary spans (ttft, the closing request span) must survive the
+    per-request cap so RequestMetrics.from_timeline still resolves them on
+    generations long enough to out-span it."""
+    rec = FlightRecorder(max_requests=4, max_spans=4)
+    for i in range(10):
+        rec.span("r1", "decode_step", 1.0, step=i)
+    rec.span("r1", "ttft", 5.0, t_ms=0.0, force=True)
+    rec.span("r1", "request", 100.0, t_ms=0.0, tokens=10, force=True)
+    tl = rec.timeline("r1")
+    names = [s["name"] for s in tl["spans"]]
+    assert "ttft" in names and "request" in names
+    assert tl["dropped"] == 6  # the capped decode steps, not the summaries
+
+
+def test_from_timeline_summary_spans_survive_cap():
+    from dnet_tpu.api.schemas import RequestMetrics
+
+    rec = FlightRecorder(max_spans=2)
+    for i in range(8):
+        rec.span("r1", "decode_step", 1.0, step=i)
+    rec.span("r1", "ttft", 20.0, t_ms=0.0, force=True)
+    rec.span("r1", "request", 120.0, t_ms=0.0, tokens=8, force=True)
+    m = RequestMetrics.from_timeline(rec.timeline("r1"))
+    assert m.total_ms == 120.0
+    assert m.ttfb_ms == 20.0
+    assert m.tokens_generated == 8
+
+
+def test_from_timeline_missing_ttft_stays_sane():
+    """A timeline evicted and auto-reopened mid-request loses its ttft
+    span; the derived metrics must attribute the duration to decoding, not
+    clamp gen time to ~0 and report astronomical tps."""
+    from dnet_tpu.api.schemas import RequestMetrics
+
+    rec = FlightRecorder()
+    rec.span("r1", "request", 1000.0, t_ms=0.0, tokens=100, force=True)
+    m = RequestMetrics.from_timeline(rec.timeline("r1"))
+    assert m.ttfb_ms == 0.0
+    assert m.token_gen_ms == 1000.0
+    assert m.tps_decoding == pytest.approx(99.0)
+    # zero-token request: everything was time-to-(no)-first-byte
+    rec.span("r2", "request", 50.0, t_ms=0.0, tokens=0, force=True)
+    m0 = RequestMetrics.from_timeline(rec.timeline("r2"))
+    assert m0.ttfb_ms == 50.0
+    assert m0.tps_decoding == 0.0
+
+
+def test_span_refreshes_lru_position():
+    """An in-flight request writing spans must outlive idle completed
+    timelines: span() is activity, so it refreshes the ring position."""
+    rec = FlightRecorder(max_requests=3)
+    rec.begin("long")
+    rec.begin("short-1")
+    rec.begin("short-2")
+    rec.span("long", "decode_step", 1.0, step=0)  # bumps "long" to the back
+    rec.begin("short-3")  # evicts short-1 (now the oldest), not "long"
+    assert rec.timeline("long") is not None
+    assert rec.timeline("short-1") is None
+
+
+def test_auto_opened_first_span_starts_at_zero():
+    """Shard-side spans arrive with no begin(); the first span defines the
+    timeline origin, so its derived t_ms is 0, never negative."""
+    rec = FlightRecorder()
+    rec.span("nonce", "token_rpc", 5.0)
+    tl = rec.timeline("nonce")
+    assert tl["spans"][0]["t_ms"] == 0.0

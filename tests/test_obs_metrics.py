@@ -1,0 +1,206 @@
+"""Unit tests for the obs metrics registry: instrument math, bucket edges,
+label-cardinality cap, exposition golden, and the obs_enabled gate."""
+
+import pytest
+
+from dnet_tpu.obs.metrics import (
+    DEFAULT_MS_BUCKETS,
+    MetricsRegistry,
+    OVERFLOW_LABEL,
+)
+
+pytestmark = pytest.mark.core
+
+
+def test_counter_math_and_monotonicity():
+    reg = MetricsRegistry()
+    c = reg.counter("dnet_test_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("dnet_test_gauge", "help")
+    g.set(10)
+    g.inc(5)
+    g.dec(2)
+    assert g.value == 13.0
+
+
+def test_histogram_bucket_edges_inclusive():
+    reg = MetricsRegistry()
+    h = reg.histogram("dnet_test_ms", "help", buckets=(1.0, 10.0, 100.0))
+    # le is INCLUSIVE: an observation exactly at an edge lands in that bucket
+    h.observe(1.0)    # -> le=1
+    h.observe(1.0001) # -> le=10
+    h.observe(10.0)   # -> le=10
+    h.observe(100.0)  # -> le=100
+    h.observe(100.5)  # -> +Inf
+    child = h._default()
+    assert child.counts == [1, 2, 1, 1]
+    assert child.count == 5
+    assert child.sum == pytest.approx(212.5001)
+    text = reg.expose()
+    # cumulative bucket counts in exposition
+    assert 'dnet_test_ms_bucket{le="1"} 1' in text
+    assert 'dnet_test_ms_bucket{le="10"} 3' in text
+    assert 'dnet_test_ms_bucket{le="100"} 4' in text
+    assert 'dnet_test_ms_bucket{le="+Inf"} 5' in text
+    assert "dnet_test_ms_count 5" in text
+
+
+def test_histogram_percentile_interpolation():
+    reg = MetricsRegistry()
+    h = reg.histogram("dnet_test_ms", "help", buckets=(10.0, 20.0))
+    for _ in range(10):
+        h.observe(15.0)  # all in (10, 20]
+    # median interpolates to the middle of the containing bucket
+    assert h.percentile(0.5) == pytest.approx(15.0)
+    assert h.percentile(0.0) == pytest.approx(10.0)
+    assert h.percentile(1.0) == pytest.approx(20.0)
+    # +Inf observations report the last finite edge
+    h2 = reg.histogram("dnet_test2_ms", "help", buckets=(1.0,))
+    h2.observe(50.0)
+    assert h2.percentile(0.99) == 1.0
+    # empty histogram
+    h3 = reg.histogram("dnet_test3_ms", "help")
+    assert h3.percentile(0.5) == 0.0
+
+
+def test_default_ms_buckets_are_increasing():
+    assert list(DEFAULT_MS_BUCKETS) == sorted(DEFAULT_MS_BUCKETS)
+    assert len(set(DEFAULT_MS_BUCKETS)) == len(DEFAULT_MS_BUCKETS)
+
+
+def test_label_cardinality_cap_collapses_to_overflow():
+    reg = MetricsRegistry()
+    c = reg.counter("dnet_test_total", "help", labelnames=("who",))
+    cap = reg.MAX_SERIES_PER_METRIC
+    for i in range(cap + 40):
+        c.labels(who=f"w{i}").inc()
+    # bounded: cap series at most (the overflow child replaces one slot's
+    # worth of growth, never exceeds the cap)
+    assert c.series_count() <= cap + 1
+    overflow = c.labels(who="definitely-new-value")
+    assert overflow is c.labels(who="another-new-value")
+    assert overflow.value >= 40  # every post-cap inc landed here
+    assert f'who="{OVERFLOW_LABEL}"' in reg.expose()
+
+
+def test_labels_validation_and_idempotent_registration():
+    reg = MetricsRegistry()
+    c = reg.counter("dnet_test_total", "help", labelnames=("a",))
+    with pytest.raises(ValueError):
+        c.labels(b="x")  # wrong label name
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family needs .labels()
+    # same name re-registered -> same object
+    assert reg.counter("dnet_test_total", "ignored", labelnames=("a",)) is c
+    # kind mismatch -> error
+    with pytest.raises(ValueError):
+        reg.gauge("dnet_test_total", "help")
+
+
+def test_bad_names_and_empty_help_rejected():
+    reg = MetricsRegistry()
+    for bad in ("decode_ms", "dnet_UPPER", "dnet_dash-ed", "dnet_ünïcode"):
+        with pytest.raises(ValueError):
+            reg.counter(bad, "help")
+    with pytest.raises(ValueError):
+        reg.counter("dnet_ok_total", "   ")
+
+
+def test_exposition_golden():
+    """Exact v0.0.4 text for a small registry — the scrape contract."""
+    reg = MetricsRegistry()
+    c = reg.counter("dnet_frames_total", "Frames sent", labelnames=("dir",))
+    c.labels(dir="tx").inc(3)
+    c.labels(dir="rx").inc()
+    g = reg.gauge("dnet_queue_depth", "Queue depth")
+    g.set(7)
+    h = reg.histogram("dnet_step_ms", "Step time (ms)", buckets=(1.0, 5.0))
+    h.observe(0.5)
+    h.observe(4.0)
+    h.observe(9.0)
+    assert reg.expose() == (
+        "# HELP dnet_frames_total Frames sent\n"
+        "# TYPE dnet_frames_total counter\n"
+        'dnet_frames_total{dir="rx"} 1\n'
+        'dnet_frames_total{dir="tx"} 3\n'
+        "# HELP dnet_queue_depth Queue depth\n"
+        "# TYPE dnet_queue_depth gauge\n"
+        "dnet_queue_depth 7\n"
+        "# HELP dnet_step_ms Step time (ms)\n"
+        "# TYPE dnet_step_ms histogram\n"
+        'dnet_step_ms_bucket{le="1"} 1\n'
+        'dnet_step_ms_bucket{le="5"} 2\n'
+        'dnet_step_ms_bucket{le="+Inf"} 3\n'
+        "dnet_step_ms_sum 13.5\n"
+        "dnet_step_ms_count 3\n"
+    )
+
+
+def test_reset_keeps_handles_valid():
+    reg = MetricsRegistry()
+    c = reg.counter("dnet_test_total", "help")
+    h = reg.histogram("dnet_test_ms", "help")
+    c.inc(5)
+    h.observe(1.0)
+    reg.reset()
+    assert c.value == 0.0
+    assert h.count == 0
+    c.inc()  # the pre-reset handle still works
+    assert reg.get("dnet_test_total").value == 1.0
+
+
+def test_global_registry_exposes_core_series():
+    """The canonical family set is present (zero-valued) from first scrape —
+    the acceptance-criteria series in particular."""
+    from dnet_tpu.obs import get_registry
+
+    text = get_registry().expose()
+    assert "# TYPE dnet_decode_step_ms histogram" in text
+    assert "# TYPE dnet_transport_tx_bytes_total counter" in text
+    assert 'dnet_kv_cache_hits_total{cache="prefix"}' in text
+    assert 'dnet_kv_cache_hits_total{cache="snapshot"}' in text
+
+
+def test_obs_enabled_unifies_both_envs(monkeypatch):
+    from dnet_tpu.config import reset_settings_cache
+    from dnet_tpu.obs import obs_enabled
+
+    monkeypatch.delenv("DNET_OBS_ENABLED", raising=False)
+    monkeypatch.delenv("DNET_PROFILE", raising=False)
+    reset_settings_cache()
+    assert obs_enabled() is False
+    monkeypatch.setenv("DNET_PROFILE", "1")  # legacy env alone
+    assert obs_enabled() is True
+    monkeypatch.delenv("DNET_PROFILE")
+    monkeypatch.setenv("DNET_OBS_ENABLED", "true")  # settings group alone
+    reset_settings_cache()
+    assert obs_enabled() is True
+    reset_settings_cache()
+
+
+def test_wired_counters_prefix_cache():
+    """The prefix cache feeds the labeled counters (delta-based: the global
+    registry accumulates across tests)."""
+    import numpy as np
+
+    from dnet_tpu.core.prefix_cache import PrefixCache
+    from dnet_tpu.obs import metric
+
+    hits = metric("dnet_kv_cache_hits_total").labels(cache="prefix")
+    misses = metric("dnet_kv_cache_misses_total").labels(cache="prefix")
+    h0, m0 = hits.value, misses.value
+    pc = PrefixCache(capacity=2, min_tokens=4)
+    kv = {"k": np.zeros((1, 2))}
+    pc.store([1, 2, 3, 4], kv)
+    assert pc.lookup([9, 9, 9, 9, 9]) is None      # miss
+    assert pc.lookup([1, 2, 3, 4, 5]) is not None  # hit
+    assert hits.value == h0 + 1
+    assert misses.value == m0 + 1
