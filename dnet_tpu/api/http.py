@@ -7,16 +7,21 @@ Routes (reference: src/dnet/api/http_api.py:75-93):
   POST /v1/unload_model
   GET  /v1/topology            — current topology (ring mode)
   GET  /v1/devices             — discovered devices
-  GET  /health
+  GET  /health                 — + rolling SLO status (degraded when burning)
   GET  /metrics                — Prometheus text exposition (dnet_tpu.obs)
-  GET  /v1/debug/timeline/{rid} — one request's flight-recorder spans
+  GET  /v1/cluster/metrics     — every node's /metrics federated (node labels)
+  GET  /v1/debug/timeline/{rid} — one request's flight-recorder spans;
+                                  ?cluster=1 stitches every shard's spans
+                                  into one skew-corrected timeline
 FastAPI is not available in this image; aiohttp's request handling + a thin
 pydantic validation shim cover the same surface.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -73,10 +78,14 @@ class ApiHTTPServer:
         self.app.router.add_get("/v1/devices", self.get_devices)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/metrics", self.metrics)
+        self.app.router.add_get("/v1/cluster/metrics", self.cluster_metrics)
         self.app.router.add_get(
             "/v1/debug/timeline/{rid}", self.debug_timeline
         )
         self._runner: Optional[web.AppRunner] = None
+        # peers seen by earlier /v1/cluster/metrics scrapes: a peer that
+        # leaves discovery must drop to scrape_ok 0, not freeze at 1
+        self._scraped_peers: set = set()
 
     # ---- lifecycle ----------------------------------------------------
     async def start(self, host: str, port: int) -> None:
@@ -482,12 +491,20 @@ class ApiHTTPServer:
         )
 
     async def health(self, request: web.Request) -> web.Response:
+        from dnet_tpu.obs import get_slo_tracker
+
         body = HealthResponse(model=self.model_manager.current_model_id).model_dump()
         monitor = self.inference.failure_monitor
         if monitor is not None and monitor.health:
             body["shards"] = monitor.snapshot()
             if monitor.degraded:
                 body["status"] = "degraded"
+        # rolling SLO windows (obs/slo.py): a burning SLO degrades /health
+        # even while every shard is up — slow is its own kind of down
+        slo = get_slo_tracker().snapshot()
+        body["slo"] = slo
+        if slo["burning"]:
+            body["status"] = "degraded"
         return web.json_response(body)
 
     async def metrics(self, request: web.Request) -> web.Response:
@@ -496,16 +513,158 @@ class ApiHTTPServer:
 
         return await metrics_response(request)
 
+    async def _fan_out_shards(self, fetch) -> tuple[list, list]:
+        """Shared httpx fan-out over the discovered shards (cluster
+        metrics + cluster timeline): one AsyncClient with the obs scrape
+        timeout, `fetch(client, device)` per device gathered concurrently,
+        None results (unreachable / not-found / malformed) dropped.
+        Returns (devices, results)."""
+        import httpx
+
+        from dnet_tpu.config import get_settings
+
+        devices = await self.cluster_manager.scan_devices()
+        timeout = get_settings().obs.cluster_scrape_timeout_s
+        async with httpx.AsyncClient(timeout=timeout) as client:
+            results = await asyncio.gather(
+                *(fetch(client, d) for d in devices)
+            )
+        return devices, [r for r in results if r is not None]
+
+    async def cluster_metrics(self, request: web.Request) -> web.Response:
+        """Federated exposition: every healthy shard's /metrics plus this
+        process's registry, each sample re-labeled with `node="<id>"` and
+        merged into one Prometheus v0.0.4 document (obs/federation.py).
+        Unreachable shards are skipped — and visible as
+        `dnet_federation_scrape_ok{node=...} 0` in the API section."""
+        from dnet_tpu.obs import (
+            CONTENT_TYPE_LATEST,
+            get_registry,
+            get_slo_tracker,
+            metric,
+        )
+        from dnet_tpu.obs.federation import federate
+
+        sections: list[tuple[str, str]] = []
+        if self.cluster_manager is not None:
+            import httpx
+
+            scrape_ok = metric("dnet_federation_scrape_ok")
+
+            async def fetch(client, d):
+                url = f"http://{d.host}:{d.http_port}/metrics"
+                try:
+                    r = await client.get(url)
+                    r.raise_for_status()
+                except httpx.HTTPError as exc:
+                    log.warning(
+                        "cluster metrics scrape of %s failed: %s",
+                        d.instance, exc,
+                    )
+                    scrape_ok.labels(peer=d.instance).set(0.0)
+                    return None
+                scrape_ok.labels(peer=d.instance).set(1.0)
+                return (d.instance, r.text)
+
+            devices, scraped = await self._fan_out_shards(fetch)
+            # a peer that left discovery is no longer scraped at all:
+            # zero its gauge so `scrape_ok == 1` means "seen THIS scrape"
+            current = {d.instance for d in devices}
+            for gone in self._scraped_peers - current:
+                scrape_ok.labels(peer=gone).set(0.0)
+            self._scraped_peers |= current
+            sections.extend(scraped)
+        # the API section LAST-built but FIRST-emitted: exposing after the
+        # scrapes lets this very response carry their scrape_ok outcomes
+        get_slo_tracker().snapshot()
+        sections.insert(0, ("api", get_registry().expose()))
+        body, skipped = federate(sections)
+        for line in skipped:
+            log.warning("cluster metrics: dropped unparseable line %s", line)
+        return web.Response(
+            body=body.encode("utf-8"),
+            headers={"Content-Type": CONTENT_TYPE_LATEST},
+        )
+
     async def debug_timeline(self, request: web.Request) -> web.Response:
         """One completed (or in-flight) request's flight-recorder spans —
         rid is the response id (`chatcmpl-...` or the completions-endpoint
         `cmpl-...` form); the recorder keeps the most recent requests, so
-        recent rids resolve and ancient ones 404."""
+        recent rids resolve and ancient ones 404.  With `?cluster=1` the
+        response is the MERGED cluster timeline: every shard's spans for
+        the rid are fetched over their HTTP servers, skew-corrected onto
+        this node's clock, and interleaved with the API's own spans."""
         from dnet_tpu.obs.http import find_timeline
 
         rid = request.match_info["rid"]
         timeline = find_timeline(rid)
+        cluster = request.query.get("cluster", "").strip().lower()
+        if cluster in ("1", "true", "yes", "on"):
+            return await self._cluster_timeline(rid, timeline)
         if timeline is None:
             return _json_error(404, f"no recorded timeline for {rid!r}",
                                "not_found")
         return web.json_response(timeline)
+
+    async def _cluster_timeline(
+        self, rid: str, local: Optional[dict]
+    ) -> web.Response:
+        """Fetch + stitch the shard halves of one request's timeline.
+
+        Each shard fetch doubles as the clock probe correcting it: the
+        response's `t_wall` bracketed by this node's wall clock yields an
+        NTP-midpoint offset (obs/clock.py), so span times land on the API
+        clock with error bounded by half the fetch round trip."""
+        from dnet_tpu.obs.clock import offset_from_probe, stitch_timelines
+
+        # shards key spans by the internal nonce; resolve the public
+        # `cmpl-...` alias the same way the local lookup does
+        internal = (local or {}).get("rid") or (
+            "chat" + rid if rid.startswith("cmpl-") else rid
+        )
+        remotes = []
+        if self.cluster_manager is not None:
+            import httpx
+
+            async def fetch(client, d):
+                url = (
+                    f"http://{d.host}:{d.http_port}"
+                    f"/v1/debug/timeline/{internal}"
+                )
+                t0 = time.time()
+                try:
+                    r = await client.get(url)
+                    t1 = time.time()
+                    if r.status_code == 404:
+                        return None  # this shard saw no frame for rid
+                    r.raise_for_status()
+                    tl = r.json()
+                except (httpx.HTTPError, ValueError) as exc:
+                    log.warning(
+                        "cluster timeline fetch from %s failed: %s",
+                        d.instance, exc,
+                    )
+                    return None
+                try:
+                    est = offset_from_probe(t0, float(tl["t_wall"]), t1)
+                    tl["t_unix"] = float(tl["t_unix"])
+                    assert isinstance(tl["spans"], list)
+                except (KeyError, TypeError, ValueError, AssertionError):
+                    # a body we cannot place on our clock (or without
+                    # spans) must not 500 the whole merged view
+                    log.warning(
+                        "cluster timeline from %s malformed; skipping",
+                        d.instance,
+                    )
+                    return None
+                return (d.instance, tl, est)
+
+            _devices, remotes = await self._fan_out_shards(fetch)
+        if local is None and not remotes:
+            return _json_error(
+                404, f"no recorded timeline for {rid!r} on any node",
+                "not_found",
+            )
+        return web.json_response(
+            stitch_timelines(local, remotes, rid=internal)
+        )

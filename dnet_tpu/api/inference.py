@@ -28,7 +28,7 @@ from dnet_tpu.api.schemas import (
 )
 from dnet_tpu.api.strategies import ApiAdapterBase
 from dnet_tpu.core.types import DecodingParams
-from dnet_tpu.obs import get_recorder, metric
+from dnet_tpu.obs import get_recorder, get_slo_tracker, metric
 from dnet_tpu.utils.logger import get_logger
 from dnet_tpu.utils.tokenizer import Detokenizer
 
@@ -184,6 +184,7 @@ class InferenceManager:
         finish_reason = "length"
         recorder = get_recorder()
         recorder.begin(rid)  # flight-recorder timeline (rid == nonce)
+        slo = get_slo_tracker()  # rolling windows behind /health + dnet_slo_*
         _REQUESTS.inc()
         pending = ""  # emitted-text buffer held back for stop-seq matching
         held_entries: list = []  # logprob entries for held-back tokens
@@ -214,14 +215,18 @@ class InferenceManager:
                     raise InferenceError(result.error)
                 # one span per emitted token: send -> token resolved (grant /
                 # chunk-buffered steps resolve in ~0ms, visibly so)
-                recorder.span(
-                    rid, "decode_step",
-                    (time.perf_counter() - t_step) * 1000, step=step,
-                )
+                step_ms = (time.perf_counter() - t_step) * 1000
+                recorder.span(rid, "decode_step", step_ms, step=step)
+                if step > 0:
+                    # step 0 is the prefill pass — TTFT owns it; folding
+                    # it into the decode window would read a long prompt
+                    # as a decode-p95 SLO burn
+                    slo.record_decode(step_ms)
                 if t_first is None:
                     t_first = time.perf_counter()
                     ttft_ms = (t_first - t_start) * 1000
                     _TTFT_MS.observe(ttft_ms)
+                    slo.record_ttft(ttft_ms)
                     # force: summary spans must survive the per-request
                     # span cap on generations long enough to out-span it
                     recorder.span(rid, "ttft", ttft_ms, t_ms=0.0, force=True)
@@ -362,10 +367,12 @@ class InferenceManager:
                 usage=usage,
                 metrics=metrics,
             )
+            slo.record_request(ok=True)
         except Exception:
             # client disconnects / task cancels (BaseException) are not
             # server errors; InferenceError and friends are
             _REQUEST_ERRORS.inc()
+            slo.record_request(ok=False)
             raise
         finally:
             await self.adapter.reset_cache(nonce)

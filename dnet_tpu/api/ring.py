@@ -27,6 +27,7 @@ log = get_logger()
 _HOP_RTT_MS = metric("dnet_ring_hop_rtt_ms")
 _LANE_DEPTH = metric("dnet_lane_flush_depth")
 _LANE_WAIT_MS = metric("dnet_lane_queue_wait_ms")
+_PREFIX_REFILL = metric("dnet_prefix_refill_total")
 
 
 class RingApiAdapter(ApiAdapterBase):
@@ -95,6 +96,12 @@ class RingApiAdapter(ApiAdapterBase):
         self._prefix_index = PrefixIndex(
             max(self._prefix_cap, 1), self.PREFIX_MIN_TOKENS
         )
+        # transparent prefix refill: while a suffix-only prefill (prefix
+        # hit) is in flight, the FULL prompt is stashed here so a shard-side
+        # `prefix-miss:` failure re-sends a full prefill instead of
+        # surfacing an InferenceError (popped on step-0 resolution either
+        # way — one retry per request, a second miss fails loudly)
+        self._refill_state: Dict[str, dict] = {}
 
     async def start(self) -> None:
         self._head_client = self._make_client(self.head_addr)
@@ -132,6 +139,7 @@ class RingApiAdapter(ApiAdapterBase):
         self._pos_state.pop(nonce, None)
         self._granted.pop(nonce, None)
         self._active.pop(nonce, None)
+        self._refill_state.pop(nonce, None)
         if self._pending:
             self._pending = [e for e in self._pending if e["nonce"] != nonce]
         for key in [k for k in self._sent_at if k[0] == nonce]:
@@ -187,13 +195,9 @@ class RingApiAdapter(ApiAdapterBase):
                     "t_enq": time.monotonic(),  # lane queue-wait origin
                 }
             )
-            self._sent_at[(nonce, step)] = time.monotonic()
             if self._flush_task is None or self._flush_task.done():
                 self._flush_task = asyncio.ensure_future(self._flush_lanes())
             return
-        auto = 0
-        if self._auto_steps > 0 and budget is not None and budget > 1:
-            auto = min(self._auto_steps, budget - 1)
         pos = self._pos_for(nonce, step, len(token_ids))
         send_ids = token_ids
         prefix_hit = prefix_store = ""
@@ -204,9 +208,38 @@ class RingApiAdapter(ApiAdapterBase):
                 pos, prefix_hit = hit
                 get_recorder().span(nonce, "prefix_cache_hit", 0.0, tokens=pos)
                 send_ids = token_ids[pos:]  # prefill only the new suffix
+                # stash the full prompt: a shard-side prefix-miss re-sends
+                # it as a full prefill instead of failing the request
+                self._refill_state[nonce] = {
+                    "token_ids": list(token_ids),
+                    "decoding": decoding,
+                    "budget": budget,
+                }
             if len(ids) >= self.PREFIX_MIN_TOKENS:
                 prefix_store = self._prefix_put(ids)
-        payload, dtype, shape = tensor_to_bytes(
+        await self._send_token_frame(
+            nonce, send_ids, pos, decoding, step, budget,
+            prefix_hit=prefix_hit, prefix_store=prefix_store,
+        )
+
+    async def _send_token_frame(
+        self,
+        nonce: str,
+        send_ids: List[int],
+        pos: int,
+        decoding: DecodingParams,
+        step: int,
+        budget: Optional[int],
+        prefix_hit: str = "",
+        prefix_store: str = "",
+    ) -> None:
+        """Build and send one token frame, sizing (and registering) the
+        decode grant from the remaining budget — the single frame path for
+        normal sends AND the prefix-refill retry, so the two cannot drift."""
+        auto = 0
+        if self._auto_steps > 0 and budget is not None and budget > 1:
+            auto = min(self._auto_steps, budget - 1)
+        payload, _dtype, shape = tensor_to_bytes(
             np.asarray([send_ids], dtype=np.int32)
         )
         frame = ActivationFrame(
@@ -220,6 +253,7 @@ class RingApiAdapter(ApiAdapterBase):
             callback_url=self.callback_url,
             decoding=asdict(decoding),
             t_sent=time.time(),
+            t_sent_mono=time.perf_counter(),
             auto_steps=auto,
             prefix_hit=prefix_hit,
             prefix_store=prefix_store,
@@ -240,11 +274,21 @@ class RingApiAdapter(ApiAdapterBase):
     LANE_CONVERGE_MIN_S = 0.05
     LANE_CONVERGE_MAX_S = 1.0
 
+    # window = multiplier x the observed ring-pass EMA.  The EMA is stamped
+    # at the actual frame FLUSH (not the enqueue), so it measures the pure
+    # ring pass; the old enqueue-stamped EMA silently folded each batch's
+    # own convergence wait back into the window (a positive feedback the
+    # multiplier then under-stated).  With the honest, smaller EMA the
+    # multiplier carries the full jitter allowance itself: ~2.5 passes
+    # absorbs driver-coroutine scheduling offset without the feedback loop.
+    LANE_CONVERGE_EMA_MULT = 2.5
+
     def _converge_window(self) -> float:
         ema = self._step_ema
         if ema <= 0:
             return self.LANE_CONVERGE_MIN_S
-        return min(max(1.5 * ema, self.LANE_CONVERGE_MIN_S),
+        return min(max(self.LANE_CONVERGE_EMA_MULT * ema,
+                       self.LANE_CONVERGE_MIN_S),
                    self.LANE_CONVERGE_MAX_S)
 
     async def _flush_lanes(self) -> None:
@@ -270,6 +314,12 @@ class RingApiAdapter(ApiAdapterBase):
                 get_recorder().span(
                     e["nonce"], "lane_queue_wait", wait_ms, step=e["seq"]
                 )
+                # send-origin stamped at the actual flush, NOT the enqueue:
+                # the hop RTT (and the _step_ema convergence window it
+                # feeds) must measure the ring pass alone — folding the
+                # batch's own convergence wait in would inflate the EMA,
+                # which widens the window, which inflates the EMA further
+                self._sent_at[(e["nonce"], e["seq"])] = now
             tokens = np.asarray([[e["token"]] for e in batch], dtype=np.int32)
             payload, _dtype, shape = tensor_to_bytes(tokens)
             frame = ActivationFrame(
@@ -283,6 +333,7 @@ class RingApiAdapter(ApiAdapterBase):
                 callback_url=self.callback_url,
                 decoding={},
                 t_sent=time.time(),
+                t_sent_mono=time.perf_counter(),
                 lanes=[
                     {k: e[k] for k in ("nonce", "seq", "pos", "decoding")}
                     for e in batch
@@ -298,7 +349,10 @@ class RingApiAdapter(ApiAdapterBase):
             except Exception as exc:
                 # fail every member alone and fast; their drivers surface
                 # the error instead of blocking the full request timeout
+                # (drop the send stamps first: a failed send is not a hop,
+                # and a ~0ms "RTT" would poison the _step_ema)
                 for e in batch:
+                    self._sent_at.pop((e["nonce"], e["seq"]), None)
                     self.resolve_token(
                         TokenResult(
                             nonce=e["nonce"], token_id=-1, step=e["seq"],
@@ -353,9 +407,30 @@ class RingApiAdapter(ApiAdapterBase):
             # a shard lost this snapshot — which means it restarted (or
             # diverged) and lost ALL of them, and the failed request itself
             # indexed a key no shard ever stored.  Clearing the whole index
-            # self-heals in ONE failure: the next request full-prefills and
-            # re-stores, instead of walking a chain of stale/phantom keys.
+            # self-heals in ONE failure: with the full prompt stashed, THIS
+            # request re-sends a full prefill (which re-stores everywhere)
+            # instead of surfacing an InferenceError; only a second miss —
+            # no stash left — fails loudly.
             self._prefix_index.clear()
+            state = self._refill_state.pop(result.nonce, None)
+            if state is not None and result.step == 0:
+                try:
+                    asyncio.ensure_future(
+                        self._refill_prefill(result.nonce, state)
+                    )
+                except RuntimeError:
+                    # no running loop (sync caller): surface the error
+                    # instead of silently dropping the request
+                    log.warning("prefix refill skipped: no event loop")
+                else:
+                    _PREFIX_REFILL.inc()
+                    log.warning(
+                        "prefix refill for %s: %s", result.nonce, result.error
+                    )
+                    return  # the step-0 future stays pending for the refill
+        elif result.step == 0:
+            # the suffix prefill resolved: the stashed prompt is dead weight
+            self._refill_state.pop(result.nonce, None)
         if not self._futures.resolve(result):
             if result.step <= self._granted.get(result.nonce, -1):
                 # a granted step raced ahead of the driver's await: hold it
@@ -364,6 +439,42 @@ class RingApiAdapter(ApiAdapterBase):
                 self._early[(result.nonce, result.step)] = result
                 return
             log.warning("unmatched token for nonce %s step %d", result.nonce, result.step)
+
+    async def _refill_prefill(self, nonce: str, state: dict) -> None:
+        """Re-drive step 0 as a FULL prefill after a shard-side prefix
+        miss.  The stashed prompt replays through the normal frame path
+        (grant sizing included); the shards' partially-seeded sessions are
+        reset first — a healthy shard seeded its window from its snapshot
+        at the prefix pos, which a pos-0 full prefill must not extend.  A
+        send failure resolves the still-pending step-0 future with an
+        error, so the driver fails fast instead of burning its timeout."""
+        try:
+            token_ids = state["token_ids"]
+            await asyncio.gather(
+                *(c.reset_cache(nonce) for c in self._shard_clients.values()),
+                return_exceptions=True,
+            )
+            pos = self._pos_for(nonce, 0, len(token_ids))
+            prefix_store = ""
+            if self._prefix_cap > 0 and len(token_ids) >= self.PREFIX_MIN_TOKENS:
+                # re-index under a fresh key: the miss cleared the whole
+                # index, and this full prefill re-stores on every shard
+                prefix_store = self._prefix_put(tuple(token_ids))
+            get_recorder().span(
+                nonce, "prefix_refill", 0.0, tokens=len(token_ids)
+            )
+            await self._send_token_frame(
+                nonce, token_ids, pos, state["decoding"], 0, state["budget"],
+                prefix_store=prefix_store,
+            )
+        except Exception as exc:
+            log.exception("prefix refill for %s failed", nonce)
+            self._futures.resolve(
+                TokenResult(
+                    nonce=nonce, token_id=-1, step=0,
+                    error=f"prefix refill failed: {exc}",
+                )
+            )
 
     async def _idle_sweep(self) -> None:
         while True:

@@ -147,6 +147,14 @@ class ObsSettings(_EnvGroup):
     enabled: bool = False
     sync_per_layer: bool = False
     sync_every_n: int = 0
+    # SLO targets over a rolling window (obs/slo.py): 0 disables a target.
+    # Burning SLOs flip /health to "degraded" and export dnet_slo_* gauges.
+    slo_window_s: float = 300.0
+    slo_ttft_p95_ms: float = 0.0
+    slo_decode_p95_ms: float = 0.0
+    slo_availability: float = 0.0  # e.g. 0.999; fraction of requests OK
+    # /v1/cluster/metrics + cluster timeline: per-shard HTTP fetch timeout
+    cluster_scrape_timeout_s: float = 5.0
 
     def sync_stride(self) -> int:
         """Normalized decode-step sync cadence: 0 = never fence, N >= 1 =

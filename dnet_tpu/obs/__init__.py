@@ -39,6 +39,7 @@ __all__ = [
     "MetricsRegistry",
     "get_recorder",
     "get_registry",
+    "get_slo_tracker",
     "metric",
     "obs_enabled",
     "reset_obs",
@@ -125,6 +126,38 @@ def _register_core(reg: MetricsRegistry) -> None:
     reg.counter(
         "dnet_tokens_generated_total", "Tokens emitted across all requests"
     )
+    reg.counter(
+        "dnet_prefix_refill_total",
+        "Ring prefix-cache misses transparently re-sent as full prefills",
+    )
+    # labeled "peer", NOT "node": federation injects node="api" into every
+    # API-section sample, and a node label here would collide with it
+    reg.gauge(
+        "dnet_federation_scrape_ok",
+        "1 if the last /v1/cluster/metrics scrape of this peer succeeded",
+        labelnames=("peer",),
+    )
+    reg.gauge(
+        "dnet_slo_ttft_p95_ms",
+        "Rolling-window TTFT p95 against the SLO target (ms)",
+    )
+    reg.gauge(
+        "dnet_slo_decode_p95_ms",
+        "Rolling-window decode-step p95 against the SLO target (ms)",
+    )
+    reg.gauge(
+        "dnet_slo_availability",
+        "Rolling-window request availability (1 - errors/requests)",
+    )
+    burning = reg.gauge(
+        "dnet_slo_burning",
+        "1 when the named SLO is violating its target over the window",
+        labelnames=("slo",),
+    )
+    from dnet_tpu.obs.slo import SLO_KINDS
+
+    for kind in SLO_KINDS:
+        burning.labels(slo=kind)  # pre-touch: expose at 0 from the start
 
 
 def _ensure_core() -> None:
@@ -145,6 +178,31 @@ def get_registry() -> MetricsRegistry:
 
 def get_recorder() -> FlightRecorder:
     return _recorder
+
+
+_slo_tracker = None
+_slo_lock = threading.Lock()
+
+
+def get_slo_tracker():
+    """The process-global SLO tracker, built from ObsSettings targets on
+    first access (lazy so tests can mutate the env, reset the settings
+    cache, and reset_obs() to pick the new targets up)."""
+    global _slo_tracker
+    if _slo_tracker is None:
+        with _slo_lock:
+            if _slo_tracker is None:
+                from dnet_tpu.config import get_settings
+                from dnet_tpu.obs.slo import SloTracker
+
+                obs = get_settings().obs
+                _slo_tracker = SloTracker(
+                    window_s=obs.slo_window_s,
+                    ttft_p95_ms=obs.slo_ttft_p95_ms,
+                    decode_p95_ms=obs.slo_decode_p95_ms,
+                    availability=obs.slo_availability,
+                )
+    return _slo_tracker
 
 
 def metric(name: str) -> MetricFamily:
@@ -174,7 +232,12 @@ def obs_enabled() -> bool:
 def reset_obs() -> None:
     """Zero metrics in place and drop recorded timelines (for tests).
     Family/child objects survive, so handles held by instrumented modules
-    stay valid."""
+    stay valid.  The SLO tracker is DROPPED, not zeroed — the next
+    get_slo_tracker() re-reads targets from settings, so a test that
+    changed DNET_OBS_SLO_* (and reset the settings cache) sees them."""
+    global _slo_tracker
     _ensure_core()
     _registry.reset()
     _recorder.clear()
+    with _slo_lock:
+        _slo_tracker = None

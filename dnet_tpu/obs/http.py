@@ -9,15 +9,25 @@ server (the API wraps 404s as `{"error": {...}}`, the shard as
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from aiohttp import web
 
-from dnet_tpu.obs import CONTENT_TYPE_LATEST, get_recorder, get_registry
+from dnet_tpu.obs import (
+    CONTENT_TYPE_LATEST,
+    get_recorder,
+    get_registry,
+    get_slo_tracker,
+)
 
 
 async def metrics_response(request: web.Request) -> web.Response:
-    """Prometheus text exposition of this process's registry."""
+    """Prometheus text exposition of this process's registry.  SLO gauges
+    refresh lazily here: their values are windowed aggregates, so the
+    scrape instant — not the last record_*() call — is when they must be
+    current."""
+    get_slo_tracker().snapshot()
     return web.Response(
         body=get_registry().expose().encode("utf-8"),
         headers={"Content-Type": CONTENT_TYPE_LATEST},
@@ -29,9 +39,17 @@ def find_timeline(rid: str) -> Optional[dict]:
     by the internal `chatcmpl-...` nonce; /v1/completions clients hold the
     rewritten `cmpl-...` form (api/inference.py), so that alias is tried
     too — the documented workflow is "rid = the response id", whichever
-    endpoint produced it."""
+    endpoint produced it.
+
+    The snapshot carries `t_wall` (this process's wall clock at lookup)
+    so a cross-node fetch doubles as an NTP-midpoint clock probe
+    (obs/clock.py): the caller brackets the HTTP round trip with its own
+    wall clock and estimates this node's offset from the same response
+    that delivered the spans."""
     rec = get_recorder()
     timeline = rec.timeline(rid)
     if timeline is None and rid.startswith("cmpl-"):
         timeline = rec.timeline("chat" + rid)
+    if timeline is not None:
+        timeline["t_wall"] = time.time()
     return timeline

@@ -109,7 +109,13 @@ class RingAdapter:
         Returns (ok, message) for the ACK."""
         n_bytes = len(getattr(frame, "payload", b"") or b"")
         _RX_BYTES.inc(n_bytes)
-        get_recorder().span(frame.nonce, "transport_recv", 0.0, bytes=n_bytes)
+        # t_sent (the SENDER's wall clock) rides into the span so the
+        # cluster-stitched timeline can show per-hop wire time once both
+        # endpoints' clock offsets are known (obs/clock.py)
+        get_recorder().span(
+            frame.nonce, "transport_recv", 0.0,
+            bytes=n_bytes, seq=frame.seq, t_sent=frame.t_sent,
+        )
         compute = self.runtime.compute
         if compute is not None and compute.wants(frame.layer_id):
             msg = frame.to_message()
@@ -141,6 +147,7 @@ class RingAdapter:
                 log.exception("egress failed for %s", out.nonce)
 
     async def _send_activation(self, msg: ActivationMessage) -> None:
+        t0 = time.perf_counter()
         streams = self._ensure_next()
         frame = ActivationFrame(
             nonce=msg.nonce,
@@ -153,6 +160,7 @@ class RingAdapter:
             callback_url=msg.callback_url,
             decoding=_decoding_dict(msg),
             t_sent=time.time(),
+            t_sent_mono=t0,
             auto_steps=msg.auto_steps,
             drafts=list(msg.drafts),
             lanes=list(msg.lanes),
@@ -160,6 +168,11 @@ class RingAdapter:
             prefix_hit=msg.prefix_hit,
         )
         await streams.send(msg.nonce, frame)
+        # the tx leg of this hop's dequeue -> compute -> tx trace triple
+        get_recorder().span(
+            msg.nonce, "shard_tx", (time.perf_counter() - t0) * 1000.0,
+            seq=msg.seq, bytes=len(frame.payload or b""),
+        )
 
     async def _send_token(self, msg: ActivationMessage) -> None:
         if msg.lane_finals:
@@ -288,6 +301,7 @@ class RingAdapter:
             auto_steps=steps,
             committed=list(msg.committed),
             t_sent=time.time(),
+            t_sent_mono=time.perf_counter(),
         )
         streams = self._ensure_next()
         await streams.send(msg.nonce, frame)

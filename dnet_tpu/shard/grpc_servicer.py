@@ -57,5 +57,9 @@ class ShardRingServicer:
         return Empty()
 
     async def measure_latency(self, probe: LatencyProbe, context) -> LatencyProbe:
-        # echo with the same payload; caller computes RTT vs payload size
-        return LatencyProbe(t_sent=probe.t_sent, payload=probe.payload)
+        # echo with the same payload; caller computes RTT vs payload size.
+        # t_remote stamps THIS node's wall clock so the same handshake
+        # yields an NTP-midpoint clock-offset sample (obs/clock.py)
+        return LatencyProbe(
+            t_sent=probe.t_sent, payload=probe.payload, t_remote=time.time()
+        )

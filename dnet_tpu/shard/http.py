@@ -220,10 +220,12 @@ class ShardHTTPServer:
             return web.json_response(
                 {"status": "error", "message": f"invalid request: {exc}"}, status=400
             )
+        from dnet_tpu.obs.clock import ClockSync
         from dnet_tpu.transport.grpc_transport import RingClient
         from dnet_tpu.transport.protocol import LatencyProbe
 
         results = {}
+        clocks = ClockSync()  # min-RTT offset per peer from the same probes
         for peer in req.peers:
             client = RingClient(peer)
             peer_res = {}
@@ -233,11 +235,16 @@ class ShardHTTPServer:
                     payload = b"\x00" * size
                     for _ in range(req.rounds):
                         t0 = time.perf_counter()
+                        t0_wall = time.time()
                         try:
-                            await client.measure_latency(
-                                LatencyProbe(t_sent=time.time(), payload=payload)
+                            echo = await client.measure_latency(
+                                LatencyProbe(t_sent=t0_wall, payload=payload)
                             )
                             rtts.append(time.perf_counter() - t0)
+                            if getattr(echo, "t_remote", 0.0):
+                                clocks.update(
+                                    peer, t0_wall, echo.t_remote, time.time()
+                                )
                         except Exception as exc:
                             log.warning("latency probe to %s failed: %s", peer, exc)
                     if rtts:
@@ -246,7 +253,17 @@ class ShardHTTPServer:
             finally:
                 await client.close()
             results[peer] = peer_res
-        return web.json_response({"status": "ok", "latency": results})
+        offsets = {
+            peer: {
+                "offset_s": est.offset_s,
+                "rtt_s": est.rtt_s,
+            }
+            for peer in req.peers
+            if (est := clocks.estimate(peer)) is not None
+        }
+        return web.json_response(
+            {"status": "ok", "latency": results, "clock_offsets": offsets}
+        )
 
     async def probe_stage(self, request: web.Request) -> web.Response:
         """Measured seconds/token for this shard's loaded stage (solver

@@ -328,6 +328,15 @@ class LanePool:
         Returns hidden [n, 1, D] (ring continues) or per-member
         SampleResults (single-shard ring)."""
         active, pos, order = self._scatter(msg)
+        if all(o is None for o in order):
+            # same contract as step_hidden's all-faulted early return: the
+            # flagged lane dicts carry the errors, rows are inert garbage
+            if is_last:
+                return [None] * len(order)
+            return jnp.zeros(
+                (len(order), 1, self.eng.config.hidden_size),
+                dtype=self.eng.param_dtype,
+            )
         token_full = np.zeros((self.slots, 1), dtype=np.int32)
         for (slot, row) in zip(order, tokens):
             if slot is not None:
@@ -351,11 +360,22 @@ class LanePool:
     def step_hidden(self, msg, hidden, is_last: bool):
         """Mid/tail-shard batched step.  hidden [n, 1, D] in member order."""
         active, pos, order = self._scatter(msg)
+        good = [i for i, o in enumerate(order) if o is not None]
+        if not good:
+            # every member faulted (reset races, stale pos, upstream
+            # flags): nothing to compute, and np.asarray([]) would build
+            # FLOAT64 index arrays that TypeError the .at[] update — which
+            # would error-fail the whole frame instead of letting the
+            # per-lane errors ride to the tail's finals
+            if is_last:
+                return [None] * len(order)
+            return jnp.asarray(hidden).astype(self.eng.param_dtype)
         D = hidden.shape[-1]
         x_full = jnp.zeros((self.slots, 1, D), dtype=self.eng.param_dtype)
-        good = [i for i, o in enumerate(order) if o is not None]
-        x_full = x_full.at[np.asarray([order[i] for i in good])].set(
-            jnp.asarray(hidden)[np.asarray(good)].astype(self.eng.param_dtype)
+        idx = np.asarray([order[i] for i in good], dtype=np.int64)
+        x_full = x_full.at[idx].set(
+            jnp.asarray(hidden)[np.asarray(good, dtype=np.int64)]
+            .astype(self.eng.param_dtype)
         )
         eng = self.eng
         if is_last:

@@ -15,6 +15,7 @@ import time
 from typing import Optional
 
 from dnet_tpu.core.types import ActivationMessage
+from dnet_tpu.obs import get_recorder
 from dnet_tpu.shard.compute import ShardCompute
 from dnet_tpu.utils.logger import get_logger
 
@@ -151,8 +152,26 @@ class ShardRuntime:
                 log.warning("dropping frame for %s: no model loaded", msg.nonce)
                 continue
             try:
-                msg.t_enq = time.perf_counter()
+                # per-hop trace spans, keyed by the request id (== nonce):
+                # dequeue (ingress -> compute thread pickup, the queue
+                # wait) and compute (this shard's window).  tx is recorded
+                # by the adapter's egress worker — together they are the
+                # shard half of the cluster-stitched timeline
+                # (GET /v1/debug/timeline/{rid}?cluster=1).
+                t_deq = time.perf_counter()
+                msg.t_enq = t_deq
+                rec = get_recorder()
+                if msg.t_recv:
+                    rec.span(
+                        msg.nonce, "shard_dequeue",
+                        (t_deq - msg.t_recv) * 1000.0, seq=msg.seq,
+                    )
                 out = compute.process(msg)
+                rec.span(
+                    msg.nonce, "shard_compute",
+                    (time.perf_counter() - t_deq) * 1000.0,
+                    seq=msg.seq, layer_id=msg.layer_id,
+                )
                 self._emit(out)
             except Exception as exc:
                 log.exception("compute failed for nonce %s", msg.nonce)

@@ -62,6 +62,13 @@ class ActivationFrame:
     callback_url: str = ""  # grpc://host:port for the final token
     decoding: dict = field(default_factory=dict)
     t_sent: float = 0.0
+    # sender's monotonic clock at send, carried alongside t_sent so a
+    # sender-side tool (frame dump, ack-RTT probe) can correlate a frame
+    # with that process's perf_counter-based spans without trusting wall
+    # time (NTP can step t_sent mid-request).  Only meaningful to the
+    # process that stamped it — cross-NODE comparison goes through the
+    # obs/clock.py offset estimator, never this field.
+    t_sent_mono: float = 0.0
     # decode grant: tokens the tail may self-continue without an API hop
     auto_steps: int = 0
     # ring speculation: drafted token ids riding a widened verify block
@@ -197,10 +204,15 @@ class ResetCacheRequest:
 
 @dataclass
 class LatencyProbe:
-    """Echo RPC for link profiling (dnet_ring.proto MeasureLatency)."""
+    """Echo RPC for link profiling (dnet_ring.proto MeasureLatency).
+
+    The echo stamps `t_remote` (the server's wall clock while serving) so
+    every latency measurement doubles as an NTP-midpoint clock-offset
+    sample (obs/clock.py): offset = t_remote - (t_sent + t_recv)/2."""
 
     t_sent: float
     payload: bytes = b""
+    t_remote: float = 0.0
 
     def to_bytes(self) -> bytes:
         return pack(asdict(self))

@@ -2,7 +2,7 @@
 """Metric-name lint: every dnet metric matches `dnet_[a-z0-9_]+` and has a
 help string.
 
-Two passes, so drift cannot hide either way:
+Three passes, so drift cannot hide any way:
 
 1. **Live registry** — import `dnet_tpu.obs` (which registers the canonical
    family set) and validate every registered family's name and help.
@@ -10,6 +10,12 @@ Two passes, so drift cannot hide either way:
    `histogram(` calls whose first argument is a string literal, catching
    series that a future PR registers lazily (never hit by pass 1) or with
    an empty/missing help string.
+3. **Federation round trip** — relabel the live registry's exposition under
+   two node ids and merge (obs/federation.py, the `/v1/cluster/metrics`
+   path): every sample must re-parse with a valid family name and carry
+   exactly one `node` label, HELP/TYPE must emit once per family, and the
+   cluster-scope families this surface depends on (`dnet_slo_*`,
+   `dnet_prefix_refill_total`, `dnet_federation_scrape_ok`) must exist.
 
 Invoked from the tier-1 suite (tests/test_metrics_lint.py) so a bad name
 fails CI, not a 3am dashboard.  Exit 0 = clean, 1 = violations (printed).
@@ -85,16 +91,68 @@ def check_sources(errors: list) -> int:
     return n
 
 
+# families the cluster observability surface registers; their absence means
+# a refactor silently dropped a series dashboards/alerts depend on
+_REQUIRED_FAMILIES = (
+    "dnet_slo_ttft_p95_ms",
+    "dnet_slo_decode_p95_ms",
+    "dnet_slo_availability",
+    "dnet_slo_burning",
+    "dnet_prefix_refill_total",
+    "dnet_federation_scrape_ok",
+)
+
+
+def check_federation(errors: list) -> int:
+    """Pass 3: federate the live exposition with itself under two node ids
+    and re-validate the merged document sample by sample."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs.federation import _SAMPLE_RE, _family_of, federate
+
+    fams = get_registry().families()
+    for req in _REQUIRED_FAMILIES:
+        if req not in fams:
+            errors.append(f"federation: required family {req} not registered")
+    text = get_registry().expose()
+    merged, skipped = federate([("api", text), ("shard-0", text)])
+    for line in skipped:
+        errors.append(f"federation: dropped unparseable line {line!r}")
+    n = 0
+    typed: set = set()
+    for line in merged.splitlines():
+        if line.startswith("# TYPE "):
+            name = line.split()[2]
+            if name in typed:
+                errors.append(f"federation: duplicate TYPE for {name}")
+            typed.add(name)
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"federation: emitted unparseable sample {line!r}")
+            continue
+        n += 1
+        _check_name(_family_of(m.group("name")), "federation", errors)
+        if line.count('node="') != 1:
+            errors.append(
+                f"federation: sample must carry exactly one node label: "
+                f"{line!r}"
+            )
+    return n
+
+
 def main() -> int:
     errors: list[str] = []
     n_reg = check_registry(errors)
     n_src = check_sources(errors)
+    n_fed = check_federation(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
         return 1
     print(f"ok: {n_reg} registered families, {n_src} source-literal "
-          f"registrations, all conform")
+          f"registrations, {n_fed} federated samples, all conform")
     return 0
 
 

@@ -1,0 +1,339 @@
+"""Cluster-scope observability: `/v1/cluster/metrics` federation and the
+`?cluster=1` stitched timeline (the PR's acceptance surface).
+
+Two real aiohttp "shard" servers run in-process on loopback ports; the API
+server scrapes/fetches them over genuine HTTP (httpx), so the tests cover
+the full transport path.  The timeline test injects large, opposite clock
+skews (+30s / -45s) into the two shard responses — far beyond any loopback
+RTT — and asserts the merged view lands every span within the request's
+real duration with causally sane hop ordering.
+"""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from dnet_tpu.api.http import ApiHTTPServer
+from dnet_tpu.api.inference import InferenceManager
+from dnet_tpu.api.model_manager import LocalModelManager
+from dnet_tpu.core.types import DeviceInfo
+
+pytestmark = [pytest.mark.api, pytest.mark.http]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class FakeClusterManager:
+    def __init__(self, devices):
+        self._devices = devices
+        self.current_topology = None
+
+    async def scan_devices(self):
+        return self._devices
+
+
+def make_api(cluster_manager=None):
+    inference = InferenceManager(adapter=None, request_timeout_s=30.0)
+    manager = LocalModelManager(inference, max_seq=64, param_dtype="float32")
+    return ApiHTTPServer(inference, manager, cluster_manager)
+
+
+async def client_for(app) -> TestClient:
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _parse_exposition(text: str) -> dict:
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, value = line.rsplit(" ", 1)
+        samples[key] = float(value)
+    return samples
+
+
+def _device(instance, port):
+    return DeviceInfo(
+        instance=instance, host="127.0.0.1", http_port=port, grpc_port=0
+    )
+
+
+def test_cluster_metrics_federates_nodes():
+    """/v1/cluster/metrics merges the API registry with every shard's
+    /metrics under node labels, in parseable v0.0.4 text."""
+
+    async def go():
+        from dnet_tpu.shard.http import ShardHTTPServer
+
+        s0 = TestServer(ShardHTTPServer(shard=object()).app)
+        s1 = TestServer(ShardHTTPServer(shard=object()).app)
+        await s0.start_server()
+        await s1.start_server()
+        api = make_api(
+            FakeClusterManager([_device("s0", s0.port), _device("s1", s1.port)])
+        )
+        client = await client_for(api.app)
+        r = await client.get("/v1/cluster/metrics")
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = await r.text()
+        samples = _parse_exposition(text)
+        for node in ("api", "s0", "s1"):
+            assert f'dnet_transport_rx_bytes_total{{node="{node}"}}' in samples
+            assert any(
+                k.startswith(f'dnet_token_rpc_ms_bucket{{node="{node}"')
+                for k in samples
+            ), f"histogram series missing for {node}"
+        # HELP/TYPE once per family even with three nodes contributing
+        assert text.count("# TYPE dnet_requests_total counter") == 1
+        assert text.count("# TYPE dnet_token_rpc_ms histogram") == 1
+        # the scrape outcomes ride the API section of the same response
+        assert samples['dnet_federation_scrape_ok{node="api",peer="s0"}'] == 1
+        assert samples['dnet_federation_scrape_ok{node="api",peer="s1"}'] == 1
+        await client.close()
+        await s0.close()
+        await s1.close()
+
+    run(go())
+
+
+def test_cluster_metrics_skips_unreachable_shard():
+    async def go():
+        from dnet_tpu.shard.http import ShardHTTPServer
+
+        s0 = TestServer(ShardHTTPServer(shard=object()).app)
+        await s0.start_server()
+        with __import__("socket").socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            dead_port = sock.getsockname()[1]  # bound, never listening
+        api = make_api(
+            FakeClusterManager(
+                [_device("s0", s0.port), _device("dead", dead_port)]
+            )
+        )
+        client = await client_for(api.app)
+        r = await client.get("/v1/cluster/metrics")
+        assert r.status == 200
+        samples = _parse_exposition(await r.text())
+        assert f'dnet_transport_rx_bytes_total{{node="s0"}}' in samples
+        assert not any('node="dead"' in k for k in samples)
+        assert samples['dnet_federation_scrape_ok{node="api",peer="dead"}'] == 0
+        assert samples['dnet_federation_scrape_ok{node="api",peer="s0"}'] == 1
+        await client.close()
+        await s0.close()
+
+    run(go())
+
+
+def test_cluster_metrics_departed_peer_drops_to_zero():
+    """A peer that leaves discovery must not freeze at scrape_ok 1: the
+    next scrape zeroes it, so `== 1` always means "seen THIS scrape"."""
+
+    async def go():
+        from dnet_tpu.shard.http import ShardHTTPServer
+
+        s0 = TestServer(ShardHTTPServer(shard=object()).app)
+        await s0.start_server()
+        cm = FakeClusterManager([_device("s0", s0.port)])
+        api = make_api(cm)
+        client = await client_for(api.app)
+        r = await client.get("/v1/cluster/metrics")
+        samples = _parse_exposition(await r.text())
+        assert samples['dnet_federation_scrape_ok{node="api",peer="s0"}'] == 1
+        cm._devices = []  # s0 leaves discovery
+        r = await client.get("/v1/cluster/metrics")
+        samples = _parse_exposition(await r.text())
+        assert samples['dnet_federation_scrape_ok{node="api",peer="s0"}'] == 0
+        await client.close()
+        await s0.close()
+
+    run(go())
+
+
+def test_cluster_metrics_without_cluster_manager_is_api_only():
+    async def go():
+        api = make_api(cluster_manager=None)
+        client = await client_for(api.app)
+        r = await client.get("/v1/cluster/metrics")
+        assert r.status == 200
+        samples = _parse_exposition(await r.text())
+        assert 'dnet_requests_total{node="api"}' in samples
+        assert all('node="api"' in k or "node=" not in k for k in samples)
+        await client.close()
+
+    run(go())
+
+
+def test_federation_relabel_units():
+    """The relabeler/merger at the line level: label injection (labeled and
+    bare samples, escaping), one HELP/TYPE per family, unparseable lines
+    dropped with a receipt instead of re-emitted mangled."""
+    from dnet_tpu.obs.federation import add_node_label, federate
+
+    assert add_node_label("dnet_x 5", "n") == 'dnet_x{node="n"} 5'
+    assert (
+        add_node_label('dnet_x{cache="prefix"} 1.5', "n")
+        == 'dnet_x{node="n",cache="prefix"} 1.5'
+    )
+    assert 'node="a\\"b"' in add_node_label("dnet_x 1", 'a"b')
+    exposition = (
+        "# HELP dnet_x help text\n# TYPE dnet_x counter\ndnet_x 1\n"
+    )
+    merged, skipped = federate(
+        [("a", exposition + "this is not a sample !\n"), ("b", exposition)]
+    )
+    assert skipped == ["a: this is not a sample !"]
+    assert merged.count("# TYPE dnet_x counter") == 1
+    assert 'dnet_x{node="a"} 1' in merged and 'dnet_x{node="b"} 1' in merged
+    # histogram sample kinds group under the base family: no per-suffix
+    # HELP/TYPE blocks appear
+    hist = (
+        "# HELP dnet_h h\n# TYPE dnet_h histogram\n"
+        'dnet_h_bucket{le="1"} 0\ndnet_h_bucket{le="+Inf"} 0\n'
+        "dnet_h_sum 0\ndnet_h_count 0\n"
+    )
+    merged, skipped = federate([("a", hist)])
+    assert not skipped
+    assert 'dnet_h_bucket{node="a",le="+Inf"} 0' in merged
+    assert "# TYPE dnet_h_bucket" not in merged
+
+
+def _skewed_shard_app(rid: str, timeline: dict, skew_s: float) -> web.Application:
+    """A fake shard HTTP server whose clock runs `skew_s` ahead of ours:
+    both the timeline origin (t_unix, set by the caller) and the t_wall
+    stamp the fetch-probe reads are shifted by the same amount, exactly as
+    a real shard with a skewed wall clock would report them."""
+
+    async def handler(request):
+        if request.match_info["rid"] != rid:
+            return web.json_response(
+                {"status": "error", "message": "no recorded timeline"},
+                status=404,
+            )
+        body = dict(timeline)
+        body["t_wall"] = time.time() + skew_s
+        return web.json_response(body)
+
+    app = web.Application()
+    app.router.add_get("/v1/debug/timeline/{rid}", handler)
+    return app
+
+
+def test_cluster_timeline_merges_and_corrects_skew():
+    """Acceptance: `GET /v1/debug/timeline/{rid}?cluster=1` returns ONE
+    merged timeline with spans from >= 2 remote nodes, skew-corrected onto
+    the API clock with monotonically sane hop ordering — under injected
+    skews of +30s and -45s."""
+
+    async def go():
+        from dnet_tpu.obs import get_recorder, reset_obs
+
+        reset_obs()
+        rid = "chatcmpl-cluster-accept"
+        rec = get_recorder()
+        rec.begin(rid)
+        rec.span(rid, "decode_step", 40.0, t_ms=0.0)  # API drives 0..40ms
+
+        t0_api = rec.timeline(rid)["t_unix"]
+        # hop separations (200ms / 400ms) are far above the offset
+        # estimator's loopback error (bounded by half the fetch RTT), so
+        # the corrected ORDER is deterministic even on a slow CI box —
+        # while the injected skews stay 2 orders of magnitude larger still
+        # shard 0 (clock +30s): hop work 200ms after the API step started
+        skew0 = 30.0
+        tl0 = {
+            "rid": rid, "t_unix": t0_api + skew0 + 0.200, "dropped": 0,
+            "spans": [
+                {"name": "shard_dequeue", "t_ms": 0.0, "dur_ms": 1.0},
+                {"name": "shard_compute", "t_ms": 1.0, "dur_ms": 10.0},
+                {"name": "shard_tx", "t_ms": 11.0, "dur_ms": 2.0},
+            ],
+        }
+        # shard 1 (clock -45s): its hop starts 400ms in
+        skew1 = -45.0
+        tl1 = {
+            "rid": rid, "t_unix": t0_api + skew1 + 0.400, "dropped": 0,
+            "spans": [{"name": "shard_compute", "t_ms": 0.0, "dur_ms": 12.0}],
+        }
+        s0 = TestServer(_skewed_shard_app(rid, tl0, skew0))
+        s1 = TestServer(_skewed_shard_app(rid, tl1, skew1))
+        await s0.start_server()
+        await s1.start_server()
+        api = make_api(
+            FakeClusterManager([_device("s0", s0.port), _device("s1", s1.port)])
+        )
+        client = await client_for(api.app)
+        r = await client.get(f"/v1/debug/timeline/{rid}?cluster=1")
+        assert r.status == 200, await r.text()
+        tl = await r.json()
+        assert tl["rid"] == rid and tl["cluster"] is True
+        nodes = {s["node"] for s in tl["spans"]}
+        assert {"api", "s0", "s1"} <= nodes  # spans from >= 2 remote nodes
+        # skew-corrected: every span lands inside the request's real
+        # few-ms envelope (loopback probe error), not +-30/45 SECONDS off
+        for s in tl["spans"]:
+            assert -1000.0 < s["t_ms"] < 1000.0, s
+        # monotonically sane hop ordering on the corrected axis
+        times = [s["t_ms"] for s in tl["spans"]]
+        assert times == sorted(times)
+        order = [s["node"] for s in tl["spans"]]
+        assert order.index("api") < order.index("s0") < order.index("s1")
+        by_node = {n["node"]: n for n in tl["nodes"]}
+        assert by_node["s0"]["offset_ms"] == pytest.approx(30000.0, abs=500.0)
+        assert by_node["s1"]["offset_ms"] == pytest.approx(-45000.0, abs=500.0)
+        # the plain (single-node) view is unchanged by the cluster fetch
+        r = await client.get(f"/v1/debug/timeline/{rid}")
+        plain = await r.json()
+        assert all("node" not in s for s in plain["spans"])
+        await client.close()
+        await s0.close()
+        await s1.close()
+
+    run(go())
+
+
+def test_cluster_timeline_404_when_no_node_recorded_it():
+    async def go():
+        from dnet_tpu.obs import reset_obs
+
+        reset_obs()
+        s0 = TestServer(_skewed_shard_app("other-rid", {"rid": "other-rid"}, 0))
+        await s0.start_server()
+        api = make_api(FakeClusterManager([_device("s0", s0.port)]))
+        client = await client_for(api.app)
+        r = await client.get("/v1/debug/timeline/chatcmpl-nowhere?cluster=1")
+        assert r.status == 404
+        body = await r.json()
+        assert "any node" in body["error"]["message"]
+        await client.close()
+        await s0.close()
+
+    run(go())
+
+
+def test_cluster_timeline_local_only_without_cluster_manager():
+    """cluster=1 on a single-process deployment degrades gracefully to a
+    merged view with only the api node."""
+
+    async def go():
+        from dnet_tpu.obs import get_recorder, reset_obs
+
+        reset_obs()
+        get_recorder().span("chatcmpl-solo", "request", 5.0, t_ms=0.0)
+        api = make_api(cluster_manager=None)
+        client = await client_for(api.app)
+        r = await client.get("/v1/debug/timeline/chatcmpl-solo?cluster=1")
+        assert r.status == 200
+        tl = await r.json()
+        assert tl["cluster"] is True
+        assert [s["node"] for s in tl["spans"]] == ["api"]
+        await client.close()
+
+    run(go())

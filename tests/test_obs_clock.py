@@ -1,0 +1,120 @@
+"""Clock-offset estimation + cross-node timeline stitching (obs/clock.py).
+
+The NTP-midpoint math is the part of cluster trace stitching that must be
+exactly right: a wrong sign or a half-RTT slip reorders hops in the merged
+timeline.  These tests pin the estimator (exactness under symmetric delay,
+the rtt/2 error bound under full asymmetry, min-RTT sample selection) and
+the stitcher (injected skew corrected, spans node-annotated and sorted).
+"""
+
+import pytest
+
+from dnet_tpu.obs.clock import (
+    ClockSync,
+    offset_from_probe,
+    stitch_timelines,
+)
+
+pytestmark = [pytest.mark.core]
+
+
+def test_offset_exact_under_symmetric_delay():
+    # local sends at t0=100, one-way delay 0.1s each way, remote clock
+    # +5s ahead: the server stamps (local) 100.1 as 105.1
+    est = offset_from_probe(100.0, 105.1, 100.2)
+    assert est.offset_s == pytest.approx(5.0)
+    assert est.rtt_s == pytest.approx(0.2)
+    assert est.error_bound_s == pytest.approx(0.1)
+
+
+def test_offset_negative_skew():
+    # remote clock BEHIND by 2s
+    est = offset_from_probe(10.0, 8.05, 10.1)
+    assert est.offset_s == pytest.approx(-2.0)
+
+
+def test_offset_error_bounded_by_half_rtt_under_full_asymmetry():
+    # worst case: the entire delay on one leg.  The midpoint estimate is
+    # then off by exactly rtt/2 — never more.
+    t0, t1, skew = 10.0, 10.4, -2.0
+    for t_serve in (t0, t1):  # served instantly after send / just before recv
+        est = offset_from_probe(t0, t_serve + skew, t1)
+        assert abs(est.offset_s - skew) <= est.error_bound_s + 1e-9
+
+
+def test_probe_rejects_negative_rtt():
+    with pytest.raises(ValueError):
+        offset_from_probe(2.0, 5.0, 1.0)
+
+
+def test_clock_sync_keeps_min_rtt_sample():
+    cs = ClockSync()
+    cs.update("s0", 0.0, 5.25, 0.5)  # rtt 0.5
+    cs.update("s0", 0.0, 5.1, 0.2)  # tighter: replaces
+    assert cs.estimate("s0").rtt_s == pytest.approx(0.2)
+    assert cs.offset_s("s0") == pytest.approx(5.0)
+    # a congested (wider) probe must NOT degrade the stored estimate
+    cs.update("s0", 0.0, 9.0, 2.0)
+    assert cs.estimate("s0").rtt_s == pytest.approx(0.2)
+    assert cs.offset_s("s0") == pytest.approx(5.0)
+    # unknown nodes read as offset 0 (no correction, never a crash)
+    assert cs.offset_s("never-probed") == 0.0
+    assert cs.estimate("never-probed") is None
+
+
+def test_stitch_corrects_injected_skew_and_orders_hops():
+    """A shard whose clock runs 30s ahead records its compute span 10ms
+    after the API's step start; stitching must place it at ~+10ms, not
+    +30010ms, and sort the merged spans causally."""
+    local = {
+        "rid": "chatcmpl-x", "t_unix": 1000.0, "dropped": 0,
+        "spans": [
+            {"name": "decode_step", "t_ms": 0.0, "dur_ms": 50.0},
+            {"name": "ttft", "t_ms": 0.0, "dur_ms": 55.0},
+        ],
+    }
+    shard = {
+        "rid": "chatcmpl-x", "t_unix": 1030.010, "dropped": 2,
+        "spans": [{"name": "shard_compute", "t_ms": 5.0, "dur_ms": 20.0}],
+    }
+    est = offset_from_probe(1000.0, 1030.0, 1000.0)  # offset exactly +30s
+    merged = stitch_timelines(local, [("s0", shard, est)])
+    assert merged["rid"] == "chatcmpl-x"
+    assert merged["t_unix"] == 1000.0
+    assert merged["cluster"] is True
+    nodes = {s["node"] for s in merged["spans"]}
+    assert nodes == {"api", "s0"}
+    sc = next(s for s in merged["spans"] if s["name"] == "shard_compute")
+    # shard origin 1030.010 corrected to 1000.010 -> +10ms; span at +5ms
+    assert sc["t_ms"] == pytest.approx(15.0, abs=1e-6)
+    times = [s["t_ms"] for s in merged["spans"]]
+    assert times == sorted(times)
+    assert merged["dropped"] == 2
+    by_node = {n["node"]: n for n in merged["nodes"]}
+    assert by_node["s0"]["offset_ms"] == pytest.approx(30000.0)
+    assert by_node["api"]["offset_ms"] == 0.0
+
+
+def test_stitch_without_local_rebases_on_earliest_remote():
+    s0 = {"rid": "r", "t_unix": 500.0, "dropped": 0,
+          "spans": [{"name": "shard_compute", "t_ms": 3.0, "dur_ms": 1.0}]}
+    s1 = {"rid": "r", "t_unix": 507.0, "dropped": 0,
+          "spans": [{"name": "shard_compute", "t_ms": 0.0, "dur_ms": 1.0}]}
+    est0 = offset_from_probe(0.0, 0.0, 0.0)  # no skew
+    est1 = offset_from_probe(0.0, 7.0, 0.0)  # s1's clock +7s ahead
+    merged = stitch_timelines(None, [("s0", s0, est0), ("s1", s1, est1)],
+                              rid="r")
+    assert merged["rid"] == "r"
+    assert merged["t_unix"] == pytest.approx(500.0)
+    # s1 origin 507 - 7 = 500: both spans land on one comparable axis
+    t = {s["node"]: s["t_ms"] for s in merged["spans"]}
+    assert t["s0"] == pytest.approx(3.0)
+    assert t["s1"] == pytest.approx(0.0)
+
+
+def test_stitch_empty_remote_list_is_single_node_view():
+    local = {"rid": "r", "t_unix": 1.0, "dropped": 0,
+             "spans": [{"name": "request", "t_ms": 0.0, "dur_ms": 9.0}]}
+    merged = stitch_timelines(local, [])
+    assert [s["node"] for s in merged["spans"]] == ["api"]
+    assert merged["nodes"][0]["node"] == "api"

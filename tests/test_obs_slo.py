@@ -1,0 +1,134 @@
+"""SLO rolling windows (obs/slo.py): edges that decide pages.
+
+The boundary semantics matter more than the happy path: an empty window
+must never burn (no evidence is not bad evidence), a value exactly AT its
+target is meeting it, and observations must expire with the window rather
+than haunt the p95 forever.
+"""
+
+import pytest
+
+from dnet_tpu.obs import get_slo_tracker, metric, reset_obs
+from dnet_tpu.obs.slo import (
+    SLO_AVAILABILITY,
+    SLO_DECODE,
+    SLO_TTFT,
+    RollingWindow,
+    SloTracker,
+)
+
+pytestmark = [pytest.mark.core]
+
+
+def _by_name(tracker, now=None):
+    return {s.name: s for s in tracker.statuses(now)}
+
+
+def test_empty_window_never_burns():
+    t = SloTracker(window_s=300.0, ttft_p95_ms=100.0, decode_p95_ms=50.0,
+                   availability=0.999)
+    st = _by_name(t)
+    assert not any(s.burning for s in st.values())
+    assert st[SLO_AVAILABILITY].value == 1.0  # vacuous availability
+    assert st[SLO_TTFT].value == 0.0 and st[SLO_TTFT].samples == 0
+
+
+def test_exact_target_boundary_is_meeting_the_slo():
+    t = SloTracker(window_s=300.0, ttft_p95_ms=100.0)
+    t.record_ttft(100.0, now=1.0)
+    assert not _by_name(t, now=1.0)[SLO_TTFT].burning  # == target: fine
+    t.record_ttft(100.1, now=2.0)  # p95 now above
+    st = _by_name(t, now=2.0)[SLO_TTFT]
+    assert st.burning and st.value > 100.0
+
+
+def test_zero_window_disables_instead_of_crashing():
+    """DNET_OBS_SLO_WINDOW_S=0 must follow the same "0 disables" rule as
+    the target knobs — a config crash here would take /health, /metrics
+    and every decode request down with it."""
+    t = SloTracker(window_s=0.0, ttft_p95_ms=5.0, availability=0.999)
+    t.record_ttft(1e9, now=0.0)
+    t.record_request(False, now=0.0)
+    assert t.targets == {SLO_TTFT: 0.0, SLO_DECODE: 0.0, SLO_AVAILABILITY: 0.0}
+    assert t.burning(now=0.0) == []
+
+
+def test_disabled_target_never_burns():
+    t = SloTracker(window_s=300.0)  # all targets 0 = disabled
+    t.record_ttft(1e9, now=0.0)
+    t.record_decode(1e9, now=0.0)
+    t.record_request(False, now=0.0)
+    assert t.burning(now=0.0) == []
+
+
+def test_window_expiry_forgives_old_pain():
+    t = SloTracker(window_s=10.0, decode_p95_ms=50.0)
+    t.record_decode(500.0, now=0.0)
+    assert _by_name(t, now=5.0)[SLO_DECODE].burning
+    # the bad observation ages out; an empty window is not burning
+    st = _by_name(t, now=11.0)[SLO_DECODE]
+    assert not st.burning and st.samples == 0
+
+
+def test_availability_boundary_and_burn():
+    t = SloTracker(window_s=300.0, availability=0.99)
+    for _ in range(99):
+        t.record_request(True, now=1.0)
+    t.record_request(False, now=1.0)
+    st = _by_name(t, now=1.0)[SLO_AVAILABILITY]
+    assert st.value == pytest.approx(0.99)
+    assert not st.burning  # exactly at target
+    t.record_request(False, now=1.0)
+    assert _by_name(t, now=1.0)[SLO_AVAILABILITY].burning
+
+
+def test_rolling_window_percentile_nearest_rank():
+    w = RollingWindow(window_s=100.0)
+    for v in range(1, 101):
+        w.observe(float(v), now=0.0)
+    assert w.percentile(0.95, now=0.0) == 95.0
+    assert w.percentile(0.5, now=0.0) == 50.0
+    assert w.percentile(1.0, now=0.0) == 100.0
+    assert w.percentile(0.0, now=0.0) == 1.0  # lowest observation
+    assert w.percentile(0.95, now=200.0) == 0.0  # all expired
+
+
+def test_rolling_window_bounds_memory():
+    w = RollingWindow(window_s=1e9, max_events=8)
+    for v in range(100):
+        w.observe(float(v), now=float(v))
+    assert w.count(now=100.0) == 8  # oldest fell off early, present kept
+    assert w.percentile(1.0, now=100.0) == 99.0
+
+
+def test_snapshot_updates_gauges():
+    t = SloTracker(window_s=300.0, ttft_p95_ms=10.0)
+    t.record_ttft(25.0, now=1.0)
+    snap = t.snapshot(now=1.0)
+    assert snap["burning"] == [SLO_TTFT]
+    assert metric("dnet_slo_ttft_p95_ms").value == pytest.approx(25.0)
+    assert metric("dnet_slo_burning").labels(slo=SLO_TTFT).value == 1.0
+    assert metric("dnet_slo_burning").labels(slo=SLO_DECODE).value == 0.0
+    # recovery clears the burn flag on the next snapshot
+    t2 = SloTracker(window_s=300.0, ttft_p95_ms=10.0)
+    assert t2.snapshot(now=1.0)["burning"] == []
+    assert metric("dnet_slo_burning").labels(slo=SLO_TTFT).value == 0.0
+
+
+def test_tracker_singleton_rebuilds_from_settings(monkeypatch):
+    from dnet_tpu.config import reset_settings_cache
+
+    monkeypatch.setenv("DNET_OBS_SLO_TTFT_P95_MS", "42.5")
+    monkeypatch.setenv("DNET_OBS_SLO_WINDOW_S", "60")
+    reset_settings_cache()
+    reset_obs()  # drops the singleton so targets re-read
+    try:
+        t = get_slo_tracker()
+        assert t.targets[SLO_TTFT] == 42.5
+        assert t.window_s == 60.0
+        assert get_slo_tracker() is t  # stable until the next reset
+    finally:
+        monkeypatch.delenv("DNET_OBS_SLO_TTFT_P95_MS")
+        monkeypatch.delenv("DNET_OBS_SLO_WINDOW_S")
+        reset_settings_cache()
+        reset_obs()

@@ -126,6 +126,7 @@ def test_adapter_prefix_index_roundtrip():
     a._prefix_index = PrefixIndex(2, RingApiAdapter.PREFIX_MIN_TOKENS)
     a._sent_at = {}
     a._step_ema = 0.0
+    a._refill_state = {}
     ids1 = tuple(range(20))
     key1 = a._prefix_put(ids1)
     assert a._prefix_put(ids1) == key1  # idempotent
@@ -149,3 +150,93 @@ def test_adapter_prefix_index_roundtrip():
         TokenResult(nonce="x", token_id=-1, step=0, error=f"prefix-miss:{key1}: gone")
     )
     assert a._prefix_lookup(grown) is None
+
+
+def test_prefix_miss_transparent_refill():
+    """A shard-side prefix-miss must NOT surface an InferenceError: the
+    adapter resets the nonce shard-side, re-sends the stashed FULL prompt
+    as a fresh prefill (counted in dnet_prefix_refill_total), and the
+    step-0 future resolves from the refilled pass.  Exactly one retry per
+    request: a second miss — stash consumed — fails loudly."""
+    import asyncio
+
+    from dnet_tpu.api.ring import RingApiAdapter
+    from dnet_tpu.core.types import TokenResult
+    from dnet_tpu.obs import metric
+    from tests.fakes.transport import FakeRingClient
+
+    async def go():
+        frames = []
+        clients = {}
+
+        def factory(addr):
+            c = FakeRingClient(addr, on_frame=lambda f: frames.append(f))
+            clients[addr] = c
+            return c
+
+        api = RingApiAdapter(
+            head_addr="s0:1",
+            callback_url="grpc://api:1",
+            shard_grpc_addrs=["s0:1", "s1:1"],
+            ring_client_factory=factory,
+            max_seq_len=128,
+            prefix_cache=4,
+        )
+        await api.start()
+        dec = DecodingParams(temperature=0.0)
+        prompt = list(range(100, 120))  # 20 >= PREFIX_MIN_TOKENS
+        # request 1 indexes the prompt (prefix_store rides the frame)
+        await api.send_tokens("r1", prompt, dec, 0)
+        assert frames[-1].prefix_store and not frames[-1].prefix_hit
+        api.resolve_token(TokenResult(nonce="r1", token_id=5, step=0))
+        await api.await_token("r1", 0, timeout=5.0)
+        # request 2 extends it -> suffix-only prefill keyed by the hit
+        grown = prompt + [5, 7]
+        await api.send_tokens("r2", grown, dec, 0)
+        hit_frame = frames[-1]
+        assert hit_frame.prefix_hit and hit_frame.pos == len(prompt)
+        assert hit_frame.shape[1] == 2  # only the suffix rode the wire
+        refills = metric("dnet_prefix_refill_total")
+        before = refills.value
+        # the shard lost the snapshot: a prefix-miss arrives for step 0
+        api.resolve_token(
+            TokenResult(
+                nonce="r2", token_id=-1, step=0,
+                error=f"prefix-miss:{hit_frame.prefix_hit}: no snapshot",
+            )
+        )
+        for _ in range(200):  # the refill is scheduled, not inline
+            await asyncio.sleep(0.005)
+            if frames[-1] is not hit_frame:
+                break
+        refill = frames[-1]
+        assert refill.nonce == "r2" and refill.seq == 0
+        assert refill.pos == 0 and not refill.prefix_hit
+        assert refill.shape[1] == len(grown)  # the whole prompt this time
+        assert refill.prefix_store  # re-stores on every shard
+        assert refills.value == before + 1
+        # the nonce was reset shard-side before the full prefill landed
+        assert "r2" in clients["s0:1"].resets
+        assert "r2" in clients["s1:1"].resets
+        # the driver's await stayed pending; the refilled pass resolves it
+        api.resolve_token(TokenResult(nonce="r2", token_id=9, step=0))
+        res = await api.await_token("r2", 0, timeout=5.0)
+        assert not res.error and res.token_id == 9
+        # second miss on a fresh request: the first consumed its stash, so
+        # another miss surfaces as an error instead of looping forever
+        longer = grown + [9, 4]
+        await api.send_tokens("r3", longer, dec, 0)
+        api.resolve_token(
+            TokenResult(nonce="r3", token_id=-1, step=0,
+                        error="prefix-miss:zz: gone")
+        )
+        await asyncio.sleep(0.05)  # first miss refills transparently
+        api.resolve_token(
+            TokenResult(nonce="r3", token_id=-1, step=0,
+                        error="prefix-miss:zz: still gone")
+        )
+        res = await api.await_token("r3", 0, timeout=5.0)
+        assert res.error.startswith("prefix-miss:")
+        await api.shutdown()
+
+    asyncio.run(go())

@@ -221,6 +221,33 @@ def test_unknown_nonce_lane_fails_alone(tiny_llama_dir):
         sc.engine.close()
 
 
+def test_all_faulted_batch_frame_yields_per_lane_errors(tiny_llama_dir):
+    """A batch frame whose EVERY member faulted (mass reset race: no
+    session to adopt on any lane) must still come back as per-member error
+    finals.  The empty `good` list used to build float64 index arrays
+    (`np.asarray([])`) that TypeError'd the whole frame on the mid shard —
+    hiding the real per-lane errors behind a frame-level crash."""
+    dec = DecodingParams(temperature=0.0)
+    shards = _mk_shards(tiny_llama_dir, lanes=2)
+    # prime the pools so adoption paths are live, then use never-prefilled
+    # nonces: both members fault at adoption on the head shard
+    _prefill(shards, "warm", [256, 72], dec)
+    msg = _batch_frame([("g1", 5, 3, dec), ("g2", 6, 4, dec)], 1)
+    for sc in shards:
+        msg = sc.process(msg)
+    assert msg.is_final
+    assert len(msg.lane_finals) == 2
+    for f in msg.lane_finals:
+        assert f["token_id"] == -1 and f["error"], f
+    # the pool is undamaged: a healthy member still decodes afterwards
+    msg = _batch_frame([("warm", 7, 2, dec)], 1)
+    for sc in shards:
+        msg = sc.process(msg)
+    assert msg.lane_finals[0]["token_id"] >= 0
+    for sc in shards:
+        sc.engine.close()
+
+
 def test_lane_frame_wire_roundtrip():
     """The lanes metadata survives the msgpack frame encoding."""
     from dnet_tpu.transport.protocol import ActivationFrame
