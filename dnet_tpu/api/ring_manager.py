@@ -121,7 +121,7 @@ class RingModelManager:
         t0 = time.perf_counter()
         by_instance = {d.instance: d for d in topo.devices}
         max_seq = max_seq or self.max_seq
-        lanes = self._lanes_for(topo)
+        lanes = self._lanes_for(topo, model_dir)
         spec = 0 if lanes > 1 else self._spec_lookahead_for(topo, model_dir, max_seq)
         prefix = self._prefix_for(topo)
 
@@ -214,9 +214,27 @@ class RingModelManager:
             for a in topo.assignments
         )
 
-    def _lanes_for(self, topo) -> int:
+    @staticmethod
+    def _probe_model(model_dir):
+        """(ModelConfig, ring model class) from a local checkpoint dir —
+        THE config.json probe shared by every API-side model-capability
+        gate (lanes, speculation)."""
+        import json
+        from pathlib import Path
+
+        from dnet_tpu.models import ModelConfig, get_ring_model_cls
+
+        cfg = ModelConfig.from_hf(
+            json.loads((Path(model_dir) / "config.json").read_text())
+        )
+        return cfg, get_ring_model_cls(cfg.model_type)
+
+    def _lanes_for(self, topo, model_dir) -> int:
         """Batched-lane preconditions the API can check up front: a
-        configured lane count and a single-round resident topology.
+        configured lane count, a single-round resident topology, and a
+        model with gated KV writes (LanePool hard-fails on
+        supports_kv_commit=False — degrading to lanes=1 HERE keeps
+        /load_model serving instead of bubbling that NotImplementedError).
         Mesh-backed shards COMPOSE with lanes (r5: shard_map(vmap) lane
         programs).  Shards re-check at load."""
         from dnet_tpu.config import get_settings
@@ -226,6 +244,22 @@ class RingModelManager:
             return 0
         if not self._single_round_resident(topo):
             log.info("ring lanes off: k-round or streaming topology")
+            return 0
+        try:
+            cfg, model_cls = self._probe_model(model_dir)
+            if not model_cls.supports_kv_commit:
+                log.warning(
+                    "ring_lanes=%d requested but %s has no gated KV writes; "
+                    "degrading to lanes=1",
+                    lanes, cfg.model_type,
+                )
+                return 0
+        except Exception as exc:
+            # an unprobeable model must not wedge /load_model either way:
+            # serve single-lane and say why
+            log.warning(
+                "ring lanes off (model probe failed: %s); serving lanes=1", exc
+            )
             return 0
         return lanes
 
@@ -257,17 +291,8 @@ class RingModelManager:
             log.info("ring speculation off: k-round or streaming topology")
             return 0
         try:
-            import json
-            from pathlib import Path
-
-            from dnet_tpu.models import ModelConfig, get_ring_model_cls
-
-            cfg = ModelConfig.from_hf(
-                json.loads((Path(model_dir) / "config.json").read_text())
-            )
-            model = get_ring_model_cls(cfg.model_type)(
-                cfg, range(cfg.num_hidden_layers)
-            )
+            cfg, model_cls = self._probe_model(model_dir)
+            model = model_cls(cfg, range(cfg.num_hidden_layers))
             if not model.kv_rewindable(max_seq):
                 log.info(
                     "ring speculation off: %s cache cannot rewind",

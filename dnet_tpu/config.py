@@ -173,6 +173,16 @@ class KVSettings(_EnvGroup):
     group_size: int = 64
     max_seq_len: int = 4096
     ttl_seconds: float = 600.0
+    # paged KV (dnet_tpu/kv/): block-granular allocation with per-sequence
+    # page tables, refcounted copy-on-write prefix sharing, and free-block
+    # admission instead of slots x max_seq dense pinning.  Local/Batched
+    # engines; the dense path stays the default.
+    paged: bool = False
+    # tokens per KV block (the allocation granule); must divide max_seq
+    block_tokens: int = 16
+    # total pool capacity in blocks; 0 = auto-size to the engine's dense
+    # equivalent (slots x max_seq / block_tokens)
+    pool_blocks: int = 0
 
 
 @dataclass

@@ -43,6 +43,16 @@ from dnet_tpu.core.sampler import (
     sample,
 )
 from dnet_tpu.core.types import DecodingParams
+from dnet_tpu.kv import (
+    BlockPool,
+    BlockStore,
+    KVPoolExhausted,
+    PagedKVConfig,
+    PagedPrefixCache,
+    PageTable,
+    paged_enabled,
+)
+from dnet_tpu.obs import get_recorder
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
@@ -56,8 +66,9 @@ class BatchedEngine:
     def __init__(self, model_dir: str | Path, slots: int = 8, **engine_kwargs):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        paged, prefix_size, engine_kwargs = self._split_paged_kwargs(engine_kwargs)
         self.eng = LocalEngine(model_dir, **engine_kwargs)
-        self._init_state(slots)
+        self._init_state(slots, paged=paged, prefix_size=prefix_size)
 
     @classmethod
     def from_params(
@@ -68,11 +79,34 @@ class BatchedEngine:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self = cls.__new__(cls)
+        paged, prefix_size, kw = cls._split_paged_kwargs(kw)
         self.eng = LocalEngine.from_params(config, window_params, edge_params, **kw)
-        self._init_state(slots)
+        self._init_state(slots, paged=paged, prefix_size=prefix_size)
         return self
 
-    def _init_state(self, slots: int) -> None:
+    @staticmethod
+    def _split_paged_kwargs(kw: Dict[str, Any]):
+        """Resolve the paged-KV flag and claim the prefix-cache capacity.
+
+        Under DNET_KV_PAGED=1 the BATCHED engine owns the pool, the
+        per-slot page tables, and prefix sharing — the inner B=1 engine is
+        pure prefill staging and must not run its own ledger or snapshot
+        cache (double admission / double memory)."""
+        kw = dict(kw)
+        paged = kw.pop("kv_paged", None)
+        paged = paged_enabled() if paged is None else bool(paged)
+        prefix_size = int(kw.pop("prefix_cache_size", 0) or 0)
+        # ALWAYS pin the inner engine dense: left to read DNET_KV_PAGED
+        # itself it would build a phantom ledger that spuriously rejects
+        # staging prefills and publishes gauges for a pool nobody serves
+        kw["kv_paged"] = False
+        if not paged and prefix_size:
+            kw["prefix_cache_size"] = prefix_size
+        return paged, prefix_size, kw
+
+    def _init_state(
+        self, slots: int, paged: bool = False, prefix_size: int = 0
+    ) -> None:
         if self.eng.plan.streams_weights:
             raise NotImplementedError(
                 "continuous batching needs resident weights (fit policy); "
@@ -100,9 +134,64 @@ class BatchedEngine:
             )
             self.spec_lookahead = 0
         m = self.eng.model
-        self.kv = m.init_kv(
-            len(m.layers), slots, self.max_seq, self.eng.kv_dtype,
-            quant_bits=self.eng.kv_quant_bits,
+        # paged KV (kv/): per-slot page tables over a shared block pool
+        # replace the dense [L, slots, S] residency; the dense view exists
+        # only transiently per step (gather -> step -> block scatter)
+        self.kv_pool: Optional[BlockPool] = None
+        self.kv_store: Optional[BlockStore] = None
+        self.paged_prefix: Optional[PagedPrefixCache] = None
+        self._kv_cfg: Optional[PagedKVConfig] = None
+        self._tables: List[Optional[PageTable]] = [None] * slots
+        self._adopt: Dict[str, Tuple[int, List[int], int]] = {}
+        if paged:
+            try:
+                cfg = PagedKVConfig.from_settings(
+                    self.max_seq, slots=slots + prefix_size
+                )
+                store = BlockStore(
+                    m, len(m.layers), cfg, self.eng.kv_dtype,
+                    quant_bits=self.eng.kv_quant_bits,
+                    session_tokens=self.max_seq,
+                )
+            except (ValueError, NotImplementedError) as exc:
+                log.warning(
+                    "paged KV disabled for batched engine (%s); "
+                    "serving dense slots", exc,
+                )
+                paged = False
+                if prefix_size > 0:
+                    # the kwargs split claimed the prefix capacity for the
+                    # (now unavailable) paged cache: give the inner engine
+                    # its dense snapshot cache back
+                    self.eng.prefix_cache = self.eng._build_prefix_cache(
+                        prefix_size
+                    )
+            else:
+                self._kv_cfg = cfg
+                self.kv_pool = BlockPool(cfg)
+                self.kv_store = store
+                if prefix_size > 0:
+                    self.paged_prefix = PagedPrefixCache(
+                        self.kv_pool, store, prefix_size,
+                        row_tokens=self.max_seq,
+                    )
+                if self.spec_lookahead > 0:
+                    log.warning(
+                        "per-lane speculation disabled under paged KV "
+                        "(verify blocks bypass the block scatter path)"
+                    )
+                    self.spec_lookahead = 0
+                log.info(
+                    "paged KV on: %d blocks x %d tokens serving %d slots",
+                    cfg.pool_blocks, cfg.block_tokens, slots,
+                )
+        self.kv = (
+            None
+            if paged
+            else m.init_kv(
+                len(m.layers), slots, self.max_seq, self.eng.kv_dtype,
+                quant_bits=self.eng.kv_quant_bits,
+            )
         )
         V = self.config.vocab_size
         self.counts = jnp.zeros((slots, V), dtype=jnp.int32)
@@ -151,7 +240,11 @@ class BatchedEngine:
             )
             return res, kv, counts, key
 
-        kv_axes = jax.tree.map(lambda _: 1, self.kv)
+        # paged mode has no persistent dense cache; the pool tree has the
+        # same leaf STRUCTURE, which is all the axis spec needs
+        kv_axes = jax.tree.map(
+            lambda _: 1, self.kv if self.kv is not None else self.kv_store.kv
+        )
         sp_axes = SampleParams(0, 0, 0, 0, 0, 0, 0, 0)
         self._vmapped = jax.vmap(
             one,
@@ -248,8 +341,18 @@ class BatchedEngine:
     def free_slot(self, nonce: str) -> None:
         self._buffer.pop(nonce, None)
         self._spec_stats.pop(nonce, None)
+        stash = self._adopt.pop(nonce, None)
+        if stash is not None and self.kv_pool is not None:
+            # adopted-but-never-committed prefix references (cancel race)
+            self.kv_pool.free_blocks(stash[1])
         slot = self.slot_of.pop(nonce, None)
         if slot is not None:
+            if self.kv_pool is not None:
+                # block-table release: the whole point of paging — a
+                # finished request's blocks return to the free list (or
+                # drop a refcount on shared prefix blocks)
+                tbl, self._tables[slot] = self._tables[slot], None
+                self.kv_pool.release_table(tbl)
             self.counts = self.counts.at[slot].set(0)
             if self.hist is not None:
                 self.hist = self.hist.at[slot].set(0)
@@ -285,10 +388,48 @@ class BatchedEngine:
 
     # ---- inference ----------------------------------------------------
     def seed_from_prefix(self, nonce, full_ids, seed=None) -> int:
-        return self.eng.seed_from_prefix(nonce, full_ids, seed)
+        """Paged mode: a PrefixIndex hit resolves to SHARED refcounted
+        blocks — the full blocks alias straight into this request's future
+        page table (no copy); only the staging dense row for the inner
+        B=1 prefill is gathered.  Dense mode defers to the inner engine's
+        snapshot cache."""
+        if self.kv_pool is None:
+            return self.eng.seed_from_prefix(nonce, full_ids, seed)
+        if self.paged_prefix is None or nonce in self.eng.sessions:
+            return 0
+        full = list(full_ids)
+        hit = self.paged_prefix.lookup_blocks(full)
+        if hit is None:
+            return 0
+        n, blocks, n_full = hit
+        kv_row = self.kv_store.gather_row(blocks, self.max_seq)
+        self.eng._restore_session(nonce, full, n, kv_row, seed)
+        self._adopt[nonce] = (n, blocks, n_full)
+        get_recorder().span(nonce, "prefix_cache_hit", 0.0, tokens=n)
+        return n
 
     def store_prefix(self, nonce, full_ids) -> None:
-        self.eng.store_prefix(nonce, full_ids)
+        if self.kv_pool is None:
+            return self.eng.store_prefix(nonce, full_ids)
+        if self.paged_prefix is None:
+            return
+        full = list(full_ids)
+        slot = self.slot_of.get(nonce)
+        if (
+            slot is not None
+            and self._tables[slot] is not None
+            and int(self.pos[slot]) == len(full)
+        ):
+            # adopted slot: snapshot by ALIASING the live table (zero copy)
+            self.paged_prefix.store_blocks(
+                full, len(full), self._tables[slot].blocks
+            )
+            return
+        sess = self.eng.sessions.get(nonce)
+        if sess is not None and sess.kv is not None and sess.pos == len(full):
+            # still staging on the inner engine (chunked prefill): commit
+            # tail blocks, dedup the parent prefix block-level
+            self.paged_prefix.store(full, sess.kv)
 
     def reserve_slot(self, nonce) -> None:
         """Claim a batch slot BEFORE chunked prefill burns any compute
@@ -302,6 +443,18 @@ class BatchedEngine:
         stalls active lanes for its whole prefill.  allow_store=False keeps
         partial-prompt snapshots out of the prefix cache (store_prefix
         snapshots the full prompt at the end)."""
+        if self.kv_pool is not None:
+            # admission per chunk: the slot-commit at adopt time is the
+            # authoritative (all-or-nothing) alloc; this pre-check stops a
+            # doomed long prompt from burning its remaining chunks
+            sess = self.eng.sessions.get(nonce)
+            pos = 0 if sess is None else int(sess.pos)
+            # only the FULL aliased blocks survive into the commit's table;
+            # a shared partial tail is COW-copied from fresh blocks, so it
+            # must not be counted as already-held capacity
+            n_full = self._adopt.get(nonce, (0, [], 0))[2]
+            need = self._kv_cfg.blocks_for(min(pos + len(ids), self.max_seq))
+            self.kv_pool.require(max(need - n_full, 0))
         return self.eng.prefill(nonce, list(ids), seed, allow_store=False)
 
     def abandon_prefill(self, nonce) -> None:
@@ -317,13 +470,91 @@ class BatchedEngine:
         self._move_to_slot(nonce, sess)
         return res
 
+    def _commit_paged_slot(self, nonce: str, slot: int, sess) -> None:
+        """Turn a staged B=1 prefill into this slot's page table: aliased
+        prefix blocks stay in place, everything from the first non-shared
+        block commits out of the staged dense row (which already merged
+        shared-partial content with the new tokens — the COW copy)."""
+        cfg = self._kv_cfg
+        n = int(sess.pos)
+        nb = cfg.blocks_for(n)
+        stash = self._adopt.pop(nonce, None)
+        n_sh, blocks, n_full = stash if stash is not None else (0, [], 0)
+        try:
+            own = self.kv_pool.alloc(nb - n_full)
+        except KVPoolExhausted:
+            if stash is not None:
+                self._adopt[nonce] = stash  # abandon_prefill releases it
+            raise
+        self.kv_store.commit_row(sess.kv, list(range(n_full, nb)), own)
+        if stash is not None:
+            if n_sh % cfg.block_tokens:
+                # the request diverged mid-block: the shared tail block was
+                # copied (via the staged row) instead of mutated in place
+                self.kv_pool.count_cow()
+            self.kv_pool.free_blocks(blocks[n_full:])  # transient refs
+        # a re-prefilled nonce keeps its slot: drop the superseded table
+        self.kv_pool.release_table(self._tables[slot])
+        self._tables[slot] = PageTable(
+            blocks=list(blocks[:n_full]) + own, shared_upto=n_full
+        )
+
+    def _paged_extend(self, order, errors, active, R: int) -> int:
+        """Extend every stepping lane's page table to cover R more tokens.
+        If the pool cannot cover the full chunk width, the WHOLE dispatch
+        shrinks to single steps (keeping one program) and only lanes that
+        cannot get even one block fail — alone, with the typed
+        backpressure message."""
+        while True:
+            appended: Dict[int, List[int]] = {}
+            for nonce, slot in list(order.items()):
+                try:
+                    appended[slot] = self.kv_pool.ensure(
+                        self._tables[slot], int(self.pos[slot]) + R
+                    )
+                except KVPoolExhausted as exc:
+                    if R > 1:
+                        break  # shrink the chunk and re-try every lane
+                    errors[nonce] = str(exc)
+                    active[slot] = False
+                    del order[nonce]
+            else:
+                return R
+            # roll the failed wide pass back before retrying at R=1: a
+            # lane's unused hoard (blocks past its next single step) must
+            # not starve the lanes that come after it in the retry
+            for slot, fresh in appended.items():
+                tbl = self._tables[slot]
+                keep = max(
+                    len(tbl.blocks) - len(fresh),
+                    self._kv_cfg.blocks_for(int(self.pos[slot]) + 1),
+                )
+                if keep < len(tbl.blocks):
+                    self.kv_pool.free_blocks(tbl.blocks[keep:])
+                    del tbl.blocks[keep:]
+            R = 1
+
+    def _table_ids(self) -> np.ndarray:
+        """[slots, max_seq/bt] physical block ids (0-padded past each
+        table; padded rows sit beyond every live pos, where the causal
+        mask zeroes them exactly)."""
+        nb = self.max_seq // self._kv_cfg.block_tokens
+        ids = np.zeros((self.slots, nb), dtype=np.int32)
+        for slot, tbl in enumerate(self._tables):
+            if tbl is not None and tbl.blocks:
+                ids[slot, : len(tbl.blocks)] = tbl.blocks
+        return ids
+
     def _move_to_slot(self, nonce: str, sess) -> None:
         slot = self.alloc_slot(nonce)
-        self.kv = jax.tree.map(
-            lambda big, one: big.at[:, slot : slot + 1].set(one.astype(big.dtype)),
-            self.kv,
-            sess.kv,
-        )
+        if self.kv_pool is not None:
+            self._commit_paged_slot(nonce, slot, sess)
+        else:
+            self.kv = jax.tree.map(
+                lambda big, one: big.at[:, slot : slot + 1].set(one.astype(big.dtype)),
+                self.kv,
+                sess.kv,
+            )
         self.counts = self.counts.at[slot].set(sess.counts[0])
         self.keys = self.keys.at[slot].set(sess.key)
         if self.hist is not None and sess.hist is not None:
@@ -340,8 +571,33 @@ class BatchedEngine:
         """Prefill on the B=1 bucket program, then move the session's KV row
         and sampling state into this request's batch slot."""
         self.alloc_slot(nonce)  # fail on a full pool BEFORE burning prefill
-        res = self.eng.prefill_and_sample(nonce, prompt_ids, decoding)
-        self._move_to_slot(nonce, self.eng.sessions[nonce])
+        if self.kv_pool is None:
+            res = self.eng.prefill_and_sample(nonce, prompt_ids, decoding)
+            self._move_to_slot(nonce, self.eng.sessions[nonce])
+            return res
+        full = list(prompt_ids)
+        try:
+            n = self.seed_from_prefix(nonce, full, decoding.seed)
+            # admission: the POOL must cover the non-shared remainder
+            # before any prefill compute burns (same fail-fast invariant
+            # as the slot claim above) — a shortfall surfaces as the typed
+            # backpressure error, never a mid-prefill crash.  Only FULL
+            # aliased blocks count as held: the commit COW-copies a shared
+            # partial tail from a fresh block.
+            n_full = self._adopt.get(nonce, (0, [], 0))[2]
+            need = self._kv_cfg.blocks_for(min(len(full), self.max_seq))
+            self.kv_pool.require(max(need - n_full, 0))
+            logits = self.eng.prefill(
+                nonce, full[n:], decoding.seed, allow_store=False
+            )
+            res = self.eng._sample_with_counts(
+                self.eng.sessions[nonce], logits, decoding
+            )
+            self._move_to_slot(nonce, self.eng.sessions[nonce])
+        except Exception:
+            self.abandon_prefill(nonce)
+            raise
+        self.store_prefix(nonce, full)
         return res
 
     def decode_batch(
@@ -462,11 +718,19 @@ class BatchedEngine:
             cap = min((budgets.get(n) or 1) for n in order)
             cap = min(cap, *(int(self.max_seq - self.pos[s]) for s in order.values()))
             R = next((r for r in self.CHUNK_BUCKETS if r <= cap), 1)
+        if self.kv_pool is not None:
+            # block-table extension is admission: a lane the pool cannot
+            # cover fails ALONE with the typed backpressure message
+            R = self._paged_extend(order, errors, active, R)
+            if not order:
+                return out_buf, errors
+        paged = self.kv_pool is not None
+        kv_in = self.kv if not paged else self.kv_store.gather(self._table_ids())
         args = (
             self.eng.window_params,
             self.eng.edge_params,
             jnp.asarray(token),
-            self.kv,
+            kv_in,
             jnp.asarray(pos),
             jnp.asarray(active),
             sp,
@@ -474,9 +738,24 @@ class BatchedEngine:
             self.counts,
         )
         if R > 1:
-            stacked, self.kv, self.counts, self.keys = self._chunk_fn(R)(*args)
+            stacked, kv_out, self.counts, self.keys = self._chunk_fn(R)(*args)
         else:
-            res, self.kv, self.counts, self.keys = self._step(*args)
+            res, kv_out, self.counts, self.keys = self._step(*args)
+        if paged:
+            # persist ONLY the blocks this step wrote (block-append write);
+            # the contiguous view kv_out is scratch and dies here
+            bt = self._kv_cfg.block_tokens
+            triples = []
+            for _nonce, slot in order.items():
+                p0 = int(self.pos[slot])
+                tbl = self._tables[slot]
+                triples.extend(
+                    (slot, b, tbl.blocks[b])
+                    for b in range(p0 // bt, (p0 + R - 1) // bt + 1)
+                )
+            self.kv_store.scatter(kv_out, triples)
+        else:
+            self.kv = kv_out
         now = time.time()
         out: Dict[str, SampleResult] = dict(out_buf)
         if R > 1:

@@ -55,13 +55,32 @@ class PrefixIndex:
     caching thus share one matching implementation."""
 
     def __init__(
-        self, capacity: int, min_tokens: int = 16, kind: str = "prefix"
+        self,
+        capacity: int,
+        min_tokens: int = 16,
+        kind: str = "prefix",
+        on_evict=None,
     ) -> None:
         self.capacity = capacity
         self.min_tokens = min_tokens
         self.kind = kind  # `cache` label on the hit/miss/store/evict counters
+        # called with each evicted VALUE after the lock drops (the paged
+        # prefix cache releases its block references here)
+        self.on_evict = on_evict
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
+
+    def _match(self, ids: Tuple[int, ...], max_len: int):
+        """Longest entry of length <= max_len that prefixes `ids` (caller
+        holds the lock)."""
+        best = None
+        for key in self._entries:
+            if len(key) < (best and len(best) or 1):
+                continue
+            if len(key) <= max_len and ids[: len(key)] == key:
+                if best is None or len(key) > len(best):
+                    best = key
+        return best
 
     def lookup(self, prompt_ids: Sequence[int]) -> Optional[Tuple[int, object]]:
         """Longest entry covering at most len(prompt)-1 tokens; bumps LRU.
@@ -69,19 +88,26 @@ class PrefixIndex:
         one matcher — so no wrapper can forget to."""
         ids = tuple(prompt_ids)
         with self._lock:
-            best = None
-            for key in self._entries:
-                if len(key) < (best and len(best) or 1):
-                    continue
-                # proper prefix with at least one token left to prefill
-                if len(key) <= len(ids) - 1 and ids[: len(key)] == key:
-                    if best is None or len(key) > len(best):
-                        best = key
+            # proper prefix with at least one token left to prefill
+            best = self._match(ids, len(ids) - 1)
             if best is None:
                 _MISSES.labels(cache=self.kind).inc()
                 return None
             self._entries.move_to_end(best)
             _HITS.labels(cache=self.kind).inc()
+            return len(best), self._entries[best]
+
+    def match_quiet(
+        self, prompt_ids: Sequence[int], allow_equal: bool = True
+    ) -> Optional[Tuple[int, object]]:
+        """Longest-prefix match WITHOUT touching the hit/miss counters or
+        the LRU order — the store-side dedup probe (a snapshot store that
+        aliases its parent's blocks is not a request-path hit)."""
+        ids = tuple(prompt_ids)
+        with self._lock:
+            best = self._match(ids, len(ids) if allow_equal else len(ids) - 1)
+            if best is None:
+                return None
             return len(best), self._entries[best]
 
     def get_exact(self, prompt_ids: Sequence[int]):
@@ -98,6 +124,7 @@ class PrefixIndex:
         ids = tuple(prompt_ids)
         if len(ids) < self.min_tokens:
             return False
+        evicted = []
         with self._lock:
             if ids in self._entries:
                 self._entries.move_to_end(ids)
@@ -105,13 +132,20 @@ class PrefixIndex:
             self._entries[ids] = value
             _STORES.labels(cache=self.kind).inc()
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                evicted.append(self._entries.popitem(last=False)[1])
                 _EVICTIONS.labels(cache=self.kind).inc()
-            return True
+        if self.on_evict is not None:
+            for v in evicted:
+                self.on_evict(v)
+        return True
 
     def clear(self) -> None:
         with self._lock:
+            dropped = list(self._entries.values())
             self._entries.clear()
+        if self.on_evict is not None:
+            for v in dropped:
+                self.on_evict(v)
 
 
 class PrefixCache:

@@ -119,6 +119,32 @@ def _register_core(reg: MetricsRegistry) -> None:
         "dnet_kv_sessions_evicted_total",
         "Per-nonce KV sessions dropped by the TTL sweep",
     )
+    # paged KV pool (dnet_tpu/kv/paged.py): used + free == pool size at all
+    # times (shared blocks count once in used; BlockPool.check_conservation)
+    reg.gauge(
+        "dnet_kv_blocks_used",
+        "Paged KV pool blocks currently allocated (refcount >= 1)",
+    )
+    reg.gauge(
+        "dnet_kv_blocks_free",
+        "Paged KV pool blocks on the free list",
+    )
+    reg.gauge(
+        "dnet_kv_pool_blocks",
+        "Paged KV pool total capacity in blocks",
+    )
+    reg.counter(
+        "dnet_kv_cow_copies_total",
+        "Paged KV copy-on-write block copies (shared block diverged)",
+    )
+    reg.counter(
+        "dnet_kv_prefix_shared_blocks_total",
+        "Paged KV blocks shared by refcount aliasing instead of copying",
+    )
+    reg.counter(
+        "dnet_kv_admission_rejected_total",
+        "Paged KV admissions/extensions refused for lack of free blocks",
+    )
     reg.counter("dnet_requests_total", "Decode requests started")
     reg.counter(
         "dnet_request_errors_total", "Decode requests failed with an error"

@@ -31,6 +31,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from dnet_tpu.utils.jax_compat import SDS_HAS_VMA, pcast_varying
+
 NEG_INF = -1e30
 
 
@@ -114,7 +116,7 @@ def _flash_pallas(q, k, v, pos, sinks, *, G: int, scale: float, bq: int,
     n_s = S // bk
     # inside shard_map the output is device-varying over the inputs' mesh
     # axes; check_vma requires the declaration (vma=() outside shard_map)
-    kw = {"vma": frozenset(vma)} if vma else {}
+    kw = {"vma": frozenset(vma)} if (vma and SDS_HAS_VMA) else {}
 
     # grid (batch, head, q-tile, kv-tile); kv-tile LAST so the scratch
     # accumulator carries across its (sequential) iterations
@@ -193,7 +195,7 @@ def _flash_emulate(q, k, v, pos, sinks, *, scale: float, bk: int):
     axes = _vma_union(q, k, v, pos) or frozenset()
     if axes:
         init = tuple(
-            lax.pcast(x, tuple(sorted(axes)), to="varying") for x in init
+            pcast_varying(x, tuple(sorted(axes))) for x in init
         )
     (m, l, acc), _ = lax.scan(fold, init, jnp.arange(n_s))
     sink = sinks.astype(jnp.float32).reshape(KVH, G)[None, :, :, None, None]
@@ -233,24 +235,44 @@ def _under_manual_mesh():
     global _PROBE_WARNED
     try:
         return bool(jax.sharding.get_abstract_mesh().manual_axes)
-    except Exception as exc:
-        if not _PROBE_WARNED:
-            _PROBE_WARNED = True
-            import logging
+    except AttributeError:
+        # jax 0.4.x: no abstract-mesh API; inside shard_map the axis env
+        # is non-empty (and empty under plain jit/eager), which is the
+        # same True/False this probe needs
+        try:
+            from jax.core import nonempty_axis_env_DO_NOT_USE
 
-            logging.getLogger("dnet").warning(
-                "manual-mesh probe failed (%s: %s); flash kernels disabled "
-                "— dense attention serves everywhere", type(exc).__name__, exc
-            )
-        return None
+            return bool(nonempty_axis_env_DO_NOT_USE())
+        except Exception as exc:
+            return _probe_failed(exc)
+    except Exception as exc:
+        return _probe_failed(exc)
+
+
+def _probe_failed(exc) -> None:
+    global _PROBE_WARNED
+    if not _PROBE_WARNED:
+        _PROBE_WARNED = True
+        import logging
+
+        logging.getLogger("dnet").warning(
+            "manual-mesh probe failed (%s: %s); flash kernels disabled "
+            "— dense attention serves everywhere", type(exc).__name__, exc
+        )
+    return None
 
 
 def _vma_union(*xs):
     """Union of the inputs' varying mesh axes (shard_map vma) — what a
-    pallas_call's outputs must declare under check_vma.  None if the probe
-    API is unavailable (callers fall back to dense)."""
+    pallas_call's outputs must declare under check_vma.  On jax without
+    the vma type system, falls back to ALL manual axes of the current
+    trace (conservative but exact for shard_map bodies, where every value
+    is per-device); None only if the probe API itself is unavailable
+    (callers fall back to dense)."""
     if not hasattr(jax, "typeof"):
-        return None
+        from dnet_tpu.utils.jax_compat import manual_axis_names
+
+        return manual_axis_names()
     out = frozenset()
     try:
         for x in xs:

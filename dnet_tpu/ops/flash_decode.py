@@ -42,6 +42,7 @@ from dnet_tpu.ops.flash_attention import (
     _under_manual_mesh,
     _vma_union,
 )
+from dnet_tpu.utils.jax_compat import SDS_HAS_VMA, pcast_varying
 
 NEG_INF = -1e30
 
@@ -206,7 +207,7 @@ def _decode_pallas(q, k, v, scalars, sinks, *, G: int, scale: float, bk: int,
     )
     # inside shard_map the partials are device-varying over the sp axis;
     # check_vma demands the output declare it (vma=() outside shard_map)
-    kw = {"vma": frozenset(vma)} if vma else {}
+    kw = {"vma": frozenset(vma)} if (vma and SDS_HAS_VMA) else {}
     out_specs = pl.BlockSpec((1, 1, G, Vd), lambda b, kh, s, scal: (b, 0, kh, 0))
     out_shape = jax.ShapeDtypeStruct((B, T, H, Vd), q.dtype, **kw)
     if with_lse:
@@ -344,7 +345,7 @@ def _decode_emulate(q, k, v, scalars, sinks, *, G: int, scale: float,
     axes = _vma_union(q, k, v, scalars) or frozenset()
     if axes:
         init = tuple(
-            lax.pcast(x, tuple(sorted(axes)), to="varying") for x in init
+            pcast_varying(x, tuple(sorted(axes))) for x in init
         )
     (m, l, acc), _ = lax.scan(fold, init, jnp.arange(n_s))
     if with_lse:

@@ -50,6 +50,11 @@ class MeshEngine:
     end_session = LocalEngine.end_session
     sweep_sessions = LocalEngine.sweep_sessions
     reset = LocalEngine.reset
+    # paged KV is a Local/Batched engine feature (mesh caches are sharded);
+    # the borrowed session/decode drivers consult these and no-op
+    kv_pool = None
+    _paged_ensure = LocalEngine._paged_ensure
+    _paged_release = LocalEngine._paged_release
     # chunked-scan decode: the ring chunk program (make_ring_chunk_fn) keeps
     # LocalEngine's (packed, last_token, kv, key, counts) contract, so the
     # dispatch/read/pipelining machinery is borrowed verbatim — one
@@ -130,6 +135,10 @@ class MeshEngine:
         self.kv_ttl_s = kv_ttl_s
         self.sessions: Dict[str, Session] = {}
         self.plan = type("plan", (), {"streams_weights": False, "name": "fit"})()
+        # the borrowed decode_spec driver branches on self.draft (draft-MODEL
+        # speculation is LocalEngine-only); without the attribute the first
+        # verify block dies on AttributeError mid-stream
+        self.draft = None
         self.prefix_cache = None
         if prefix_cache_size > 0:
             # snapshots stay mesh-sharded: restore is a copy with the same
@@ -215,6 +224,7 @@ class MeshEngine:
         self.kv_ttl_s = kv_ttl_s
         self.sessions = {}
         self.plan = type("plan", (), {"streams_weights": False, "name": "fit"})()
+        self.draft = None  # mesh spec drafts by prompt-lookup only
         self.prefix_cache = None
         if isinstance(window_params, dict):
             self._check_quant_sharding(window_params)

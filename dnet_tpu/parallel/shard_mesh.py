@@ -44,6 +44,7 @@ from dnet_tpu.parallel.mesh import (
     window_param_specs,
 )
 from dnet_tpu.parallel.ring import place_ring_state
+from dnet_tpu.utils.jax_compat import pcast_varying, shard_map
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
@@ -218,7 +219,7 @@ class MeshShardEngine(LocalEngine):
             # x becomes device-varying over pp/dp once the pp-sharded params
             # and dp-sharded kv touch it (both axes are size 1 here); mark it
             # up front so the layer scan's carry types line up.
-            x = jax.lax.pcast(x, ("pp", "dp"), to="varying")
+            x = pcast_varying(x, ("pp", "dp"))
             x, kv = model.apply_window(
                 wp, x, kv, pos,
                 layer_kinds=kinds if has_kinds else None,
@@ -229,7 +230,7 @@ class MeshShardEngine(LocalEngine):
             x = jax.lax.psum(x, ("pp", "dp"))
             return x, kv
 
-        core = jax.shard_map(
+        core = shard_map(
             window_core, mesh=mesh, in_specs=in_specs, out_specs=out_specs
         )
 
@@ -253,7 +254,7 @@ class MeshShardEngine(LocalEngine):
                 key = jax.tree.structure(window_params)
                 fn = progs.get(key)
                 if fn is None:
-                    seg_core = jax.shard_map(
+                    seg_core = shard_map(
                         window_core, mesh=mesh,
                         in_specs=(
                             window_param_specs(window_params),
@@ -397,7 +398,7 @@ class MeshShardEngine(LocalEngine):
         def window_lanes(wp, x, kv, pos, active, kinds):
             def one(x_row, kv_row, p, a):
                 kv1 = jax.tree.map(lambda t: t[:, None], kv_row)
-                xo = jax.lax.pcast(x_row[None], ("pp", "dp"), to="varying")
+                xo = pcast_varying(x_row[None], ("pp", "dp"))
                 xo, kv1 = model.apply_window(
                     wp, xo, kv1, p,
                     layer_kinds=kinds if has_kinds else None,
@@ -410,7 +411,7 @@ class MeshShardEngine(LocalEngine):
                 one, in_axes=(0, kv_axes, 0, 0), out_axes=(0, kv_axes)
             )(x, kv, pos, active)
 
-        core = jax.shard_map(
+        core = shard_map(
             window_lanes, mesh=mesh,
             in_specs=(self._window_specs, P(), kvs, P(), P(), P()),
             out_specs=(P(), kvs),

@@ -1,0 +1,76 @@
+"""Version compatibility shims for jax APIs the codebase leans on.
+
+The serving code targets the stable `jax.shard_map` entry point and the
+varying-mesh-axes type system (`lax.pcast(..., to="varying")`, checked by
+shard_map's check_vma).  Older jax releases (<= 0.4.x) ship shard_map as
+`jax.experimental.shard_map.shard_map` with the legacy `check_rep`
+replication checker and no `pcast`.  Resolving the callables HERE — once,
+at import — keeps every mesh program builder (parallel/ring.py,
+parallel/pipelined.py, parallel/shard_mesh.py, ops/ring_attention.py) free
+of per-call version probes.
+
+On old jax the shim disables `check_rep` (the legacy checker rejects the
+collectives the ring programs use to describe per-stage-varying values)
+and `pcast_varying` becomes the identity — the annotation has no runtime
+semantics, it only informs the checker being disabled.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+try:  # jax >= 0.5: stable top-level entry point with check_vma
+    shard_map = jax.shard_map
+    _HAS_PCAST = hasattr(lax, "pcast")
+except AttributeError:  # older jax: experimental module + check_rep
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    shard_map = partial(_exp_shard_map, check_rep=False)
+    _HAS_PCAST = False
+
+
+# whether ShapeDtypeStruct carries a vma declaration (jax >= 0.6 pallas
+# under check_vma); without it there is no checker to satisfy, so callers
+# simply drop the kwarg
+try:
+    jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    SDS_HAS_VMA = True
+except TypeError:
+    SDS_HAS_VMA = False
+
+
+def manual_axis_names() -> frozenset:
+    """Names of the manual mesh axes of the CURRENT trace (empty outside
+    shard_map).  On old jax every value inside shard_map is device-varying
+    over every manual axis, so this is the conservative vma for all of
+    them; on current jax prefer per-array `jax.typeof(x).vma`."""
+    import jax.core as jcore
+
+    try:
+        return frozenset(jcore.unsafe_get_axis_names_DO_NOT_USE())
+    except Exception:
+        return frozenset()
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mesh axis from inside shard_map (`lax.axis_size`
+    on current jax; `jax.core.axis_frame` returns the same int on 0.4.x)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    import jax.core as jcore
+
+    return jcore.axis_frame(axis_name)
+
+
+def pcast_varying(x, axis_name):
+    """Mark a replicated value as varying over `axis_name` (tuple ok) for
+    shard_map's vma checker; identity on jax without the vma type system."""
+    if _HAS_PCAST:
+        return lax.pcast(x, axis_name, to="varying")
+    return x
+
+
+__all__ = ["axis_size", "pcast_varying", "shard_map"]

@@ -100,6 +100,14 @@ _REQUIRED_FAMILIES = (
     "dnet_slo_burning",
     "dnet_prefix_refill_total",
     "dnet_federation_scrape_ok",
+    # paged KV pool (dnet_tpu/kv/) — capacity dashboards and the
+    # backpressure alert depend on these
+    "dnet_kv_blocks_used",
+    "dnet_kv_blocks_free",
+    "dnet_kv_pool_blocks",
+    "dnet_kv_cow_copies_total",
+    "dnet_kv_prefix_shared_blocks_total",
+    "dnet_kv_admission_rejected_total",
 )
 
 
@@ -142,17 +150,76 @@ def check_federation(errors: list) -> int:
     return n
 
 
+def check_paged_conservation(errors: list) -> int:
+    """Pass 4: exercise the paged KV pool through an alloc / share / COW /
+    table-release / prefix-eviction script and assert the books balance at
+    every step — used + free == pool (shared blocks counted once), the
+    free list stays duplicate-free and disjoint, refcounts match holders,
+    and the gauges report exactly what the pool says."""
+    from dnet_tpu.kv import BlockPool, KVPoolExhausted, PagedKVConfig, PageTable
+    from dnet_tpu.obs import metric
+
+    pool = BlockPool(PagedKVConfig(block_tokens=8, pool_blocks=12))
+    steps = 0
+
+    def audit(holders):
+        nonlocal steps
+        steps += 1
+        try:
+            pool.check_conservation(holders)
+        except AssertionError as exc:
+            errors.append(f"paged-conservation step {steps}: {exc}")
+            return
+        used = metric("dnet_kv_blocks_used").value
+        free = metric("dnet_kv_blocks_free").value
+        if (used, free) != (pool.used, pool.free):
+            errors.append(
+                f"paged-conservation step {steps}: gauges ({used}, {free}) "
+                f"!= pool ({pool.used}, {pool.free})"
+            )
+
+    t1, t2 = PageTable(), PageTable()
+    entry = pool.alloc(2)  # a prefix entry's blocks
+    audit([entry])
+    pool.ensure(t1, 20)  # 3 blocks
+    audit([entry, t1.blocks])
+    t2.blocks.extend(pool.share(entry))  # adoption aliases the entry
+    pool.ensure(t2, 30)  # grows past the shared run
+    audit([entry, t1.blocks, entry, t2.blocks[2:]])
+    old = t2.blocks[1]
+    t2.blocks[1] = pool.cow(old)  # diverge mid-run
+    audit([entry, t1.blocks, [entry[0]], t2.blocks[1:]])
+    try:
+        pool.alloc(pool.free + 1)
+        errors.append("paged-conservation: overdraw did not raise")
+    except KVPoolExhausted:
+        pass
+    audit([entry, t1.blocks, [entry[0]], t2.blocks[1:]])
+    pool.release_table(t1)
+    pool.release_table(t2)
+    pool.free_blocks(entry)  # prefix eviction
+    audit([])
+    if pool.used != 0 or pool.free != pool.total:
+        errors.append(
+            f"paged-conservation: end state leaks ({pool.used} used, "
+            f"{pool.free}/{pool.total} free)"
+        )
+    return steps
+
+
 def main() -> int:
     errors: list[str] = []
     n_reg = check_registry(errors)
     n_src = check_sources(errors)
     n_fed = check_federation(errors)
+    n_pool = check_paged_conservation(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
         return 1
     print(f"ok: {n_reg} registered families, {n_src} source-literal "
-          f"registrations, {n_fed} federated samples, all conform")
+          f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
+          f"audits, all conform")
     return 0
 
 

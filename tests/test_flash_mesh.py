@@ -16,6 +16,8 @@ Two levels of evidence, neither needing TPU hardware:
 import numpy as np
 import pytest
 
+from dnet_tpu.utils.jax_compat import shard_map
+
 pytestmark = [pytest.mark.core, pytest.mark.parallel]
 
 
@@ -58,7 +60,7 @@ def test_tp_sharded_flash_decode_matches_dense(rng, eight_devices, pos):
         assert flash_decode_eligible(q, k), "kernel must be eligible in-mesh"
         return flash_decode_attend(q, k, v, jnp.int32(pos))
 
-    got = jax.shard_map(
+    got = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, None, "tp"), P(None, None, "tp"), P(None, None, "tp")),
         out_specs=P(None, None, "tp"),
@@ -85,7 +87,7 @@ def test_tp_sharded_rotating_swa_matches_dense(rng, eight_devices):
             q, k, v, jnp.int32(pos), window=window, rotating=True
         )
 
-    got = jax.shard_map(
+    got = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, None, "tp"), P(None, None, "tp"), P(None, None, "tp")),
         out_specs=P(None, None, "tp"),
@@ -118,7 +120,7 @@ def test_sp_flash_compose_executes_in_shard_map(rng, eight_devices, pos):
         assert sp_flash_eligible(q, k), "sp composition must be eligible"
         return sp_flash_decode_attend(q, k, v, jnp.int32(pos), "tp")
 
-    got = jax.shard_map(
+    got = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(None, "tp"), P(None, "tp")),
         out_specs=P(),
@@ -144,7 +146,7 @@ def test_sp_flash_with_sinks_matches_dense(rng, eight_devices):
     def body(q, k, v):
         return sp_flash_decode_attend(q, k, v, jnp.int32(45), "tp", sinks=sinks)
 
-    got = jax.shard_map(
+    got = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(None, "tp"), P(None, "tp")),
         out_specs=P(),
@@ -172,7 +174,7 @@ def test_sp_rank_entirely_past_pos(rng, eight_devices):
     def body(q, k, v):
         return sp_flash_decode_attend(q, k, v, jnp.int32(pos), "tp")
 
-    got = jax.shard_map(
+    got = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(None, "tp"), P(None, "tp")),
         out_specs=P(),
@@ -201,7 +203,7 @@ def test_tp_sharded_flash_prefill_matches_dense(rng, eight_devices):
         assert flash_eligible(q, k, v), "prefill kernel must be eligible in-mesh"
         return flash_attend_causal(q, k, v, pos)
 
-    got = jax.shard_map(
+    got = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, None, "tp"), P(None, None, "tp"), P(None, None, "tp")),
         out_specs=P(None, None, "tp"),
@@ -238,7 +240,7 @@ def test_real_kernel_vma_trace_legal(rng, eight_devices, monkeypatch):
         )
 
     jax.make_jaxpr(
-        jax.shard_map(
+        shard_map(
             tp_decode, mesh=mesh,
             in_specs=(P(None, None, "tp"), P(None, None, "tp"), P(None, None, "tp")),
             out_specs=P(None, None, "tp"),
@@ -258,7 +260,7 @@ def test_real_kernel_vma_trace_legal(rng, eight_devices, monkeypatch):
         return tuple(jax.lax.psum(x, "tp") for x in (o, m, l))
 
     jax.make_jaxpr(
-        jax.shard_map(
+        shard_map(
             sp_decode, mesh=mesh,
             in_specs=(P(), P(None, "tp"), P(None, "tp")),
             out_specs=(P(), P(), P()),
@@ -276,7 +278,7 @@ def test_real_kernel_vma_trace_legal(rng, eight_devices, monkeypatch):
         )
 
     jax.make_jaxpr(
-        jax.shard_map(
+        shard_map(
             tp_prefill, mesh=mesh,
             in_specs=(P(None, None, "tp"), P(None, None, "tp"), P(None, None, "tp")),
             out_specs=P(None, None, "tp"),

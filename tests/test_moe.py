@@ -8,6 +8,8 @@ beyond-reference semantics.
 """
 
 import jax
+
+from dnet_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -138,7 +140,7 @@ def test_moe_sharded_matches_dense(rng, eight_devices, impl):
             )
             return lax.psum(out, "ep")
 
-        got = jax.shard_map(
+        got = shard_map(
             spmd, mesh=mesh,
             in_specs=(P(), P(), P(), P("ep"), P("ep")),
             out_specs=P(),
@@ -151,7 +153,7 @@ def test_moe_sharded_matches_dense(rng, eight_devices, impl):
             )
             return out
 
-        got = jax.shard_map(
+        got = shard_map(
             spmd, mesh=mesh,
             in_specs=(P("ep"), P("ep"), P("ep"), P("ep"), P("ep")),
             out_specs=P("ep"),

@@ -1,6 +1,8 @@
 """Ring attention / sp decode attention vs dense reference (8 CPU devices)."""
 
 import jax
+
+from dnet_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -36,7 +38,7 @@ def test_ring_attend_matches_dense_causal(sp_mesh, rng):
     def spmd(q_blk, k_blk, v_blk, qpos, kvpos):
         return ring_attend(q_blk, k_blk, v_blk, qpos, kvpos, "sp")
 
-    fn = jax.shard_map(
+    fn = shard_map(
         spmd,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P("sp"), P("sp")),
@@ -52,7 +54,7 @@ def test_ring_attend_non_causal(sp_mesh, rng):
     dense = attend(q, k, v, mask=None)
     positions = jnp.arange(S)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda qb, kb, vb, qp, kp: ring_attend(qb, kb, vb, qp, kp, "sp", causal=False),
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P("sp"), P("sp")),
@@ -79,7 +81,7 @@ def test_sp_decode_matches_dense(sp_mesh, rng):
         valid = (kvpos <= pos)[None, :]  # [1, S_local]
         return sp_decode_attend(q, kb, vb, valid, "sp")
 
-    fn = jax.shard_map(
+    fn = shard_map(
         spmd,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P("sp")),
@@ -105,7 +107,7 @@ def test_sp_decode_custom_scale_matches_dense(sp_mesh, rng):
         valid = (kvpos <= pos)[None, :]
         return sp_decode_attend(q, kb, vb, valid, "sp", scale=scale)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         spmd,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P("sp")),
@@ -121,7 +123,7 @@ def test_ring_attend_gqa_grouping(sp_mesh, rng):
     q, k, v = make_qkv(rng, S=S, H=8, KVH=2, Hd=8)
     dense = attend(q, k, v, mask=causal_mask(S, S, 0))
     positions = jnp.arange(S)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda qb, kb, vb, qp, kp: ring_attend(qb, kb, vb, qp, kp, "sp"),
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P("sp"), P("sp")),

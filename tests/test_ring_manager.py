@@ -1,0 +1,72 @@
+"""Ring-manager lane gating: `ring_lanes>1` with a model lacking gated KV
+writes must degrade to lanes=1 (with a warning) instead of making
+/load_model fail outright on LanePool's NotImplementedError (ADVICE r5)."""
+
+import pytest
+
+from dnet_tpu.api.ring_manager import RingModelManager, build_manual_topology
+from dnet_tpu.core.types import DeviceInfo
+
+pytestmark = pytest.mark.api
+
+
+def _topo(layers=((0, 1),)):
+    devs = [
+        DeviceInfo(
+            instance=f"s{i}", host="127.0.0.1", http_port=8081 + i,
+            grpc_port=58081 + i,
+        )
+        for i in range(len(layers))
+    ]
+    n = sum(len(ls) for ls in layers)
+    return build_manual_topology(
+        "m", n,
+        [{"instance": f"s{i}", "layers": list(ls)} for i, ls in enumerate(layers)],
+        devs,
+    )
+
+
+@pytest.fixture
+def mgr():
+    return RingModelManager(inference=None, cluster_manager=None)
+
+
+@pytest.fixture
+def lanes_env(monkeypatch):
+    from dnet_tpu.config import reset_settings_cache
+
+    monkeypatch.setenv("DNET_API_RING_LANES", "4")
+    reset_settings_cache()
+    yield
+    reset_settings_cache()
+
+
+def test_lanes_off_when_unconfigured(mgr, tiny_llama_dir):
+    assert mgr._lanes_for(_topo(), tiny_llama_dir) == 0
+
+
+def test_lanes_on_for_kv_commit_model(mgr, tiny_llama_dir, lanes_env):
+    assert mgr._lanes_for(_topo(), tiny_llama_dir) == 4
+
+
+def test_lanes_degrade_without_kv_commit(mgr, tiny_llama_dir, lanes_env, monkeypatch):
+    """The llama class faked commit-less: /load_model must get lanes=0
+    (single-lane serving) rather than a shard-side hard failure."""
+    from dnet_tpu.models import get_ring_model_cls
+
+    monkeypatch.setattr(
+        get_ring_model_cls("llama"), "supports_kv_commit", False
+    )
+    assert mgr._lanes_for(_topo(), tiny_llama_dir) == 0
+
+
+def test_lanes_off_on_probe_failure(mgr, tmp_path, lanes_env):
+    """An unreadable model dir must not wedge /load_model either way."""
+    assert mgr._lanes_for(_topo(), tmp_path / "missing") == 0
+
+
+def test_lanes_off_for_k_round_topology(mgr, tiny_llama_dir, lanes_env):
+    """Existing topology precondition still wins: non-contiguous layers
+    (a k-round schedule) disable lanes before the model probe runs."""
+    topo = _topo(layers=((0, 2), (1, 3)))
+    assert mgr._lanes_for(topo, tiny_llama_dir) == 0
