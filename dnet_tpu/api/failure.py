@@ -17,11 +17,13 @@ explicit gap).  This monitor closes it:
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from dnet_tpu.core.types import DeviceInfo
+from dnet_tpu.resilience import chaos
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
@@ -61,21 +63,32 @@ class RingFailureMonitor:
         self._clients: Dict[str, object] = {}  # addr -> RingClient (persistent)
         self._task: Optional[asyncio.Task] = None
         self._recovering = False
+        self._jitter = random.Random()
 
     # ---- lifecycle ------------------------------------------------------
     def start(self) -> None:
         self._task = asyncio.ensure_future(self._loop())
 
-    def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            self._task = None
+    async def stop(self) -> None:
+        """Awaited shutdown: cancel + reap the probe task and close every
+        cached channel IN this loop.  (The old fire-and-forget
+        ensure_future(close) leaked channels whenever the loop tore down
+        before the close tasks ran.)"""
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                log.exception("failure monitor task died during stop")
         clients, self._clients = self._clients, {}
         for c in clients.values():
             try:
-                asyncio.ensure_future(c.close())
-            except RuntimeError:
-                pass  # loop already gone
+                await c.close()
+            except Exception:
+                pass
 
     # ---- state ----------------------------------------------------------
     @property
@@ -104,7 +117,12 @@ class RingFailureMonitor:
                 raise
             except Exception:
                 log.exception("failure monitor tick crashed")
-            await asyncio.sleep(self.interval_s)
+            # +-10% jitter: a large ring's monitors must not probe every
+            # shard in lockstep (synchronized probe bursts alias with the
+            # decode cadence and can themselves trip timeouts under load)
+            await asyncio.sleep(
+                self.interval_s * (1.0 + self._jitter.uniform(-0.1, 0.1))
+            )
 
     async def _tick(self) -> None:
         topo = self.cluster.current_topology
@@ -126,6 +144,9 @@ class RingFailureMonitor:
             if client is None:
                 client = self._clients[addr] = self._make_client(addr)
             try:
+                # chaos point: an injected fault counts as a probe failure,
+                # driving the same DOWN/recovery transitions as a real one
+                await chaos.inject_async("health_check")
                 await client.health_check(timeout=self.timeout_s)
                 h.consecutive_failures = 0
                 h.last_ok = time.monotonic()

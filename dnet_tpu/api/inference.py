@@ -29,6 +29,8 @@ from dnet_tpu.api.schemas import (
 from dnet_tpu.api.strategies import ApiAdapterBase
 from dnet_tpu.core.types import DecodingParams
 from dnet_tpu.obs import get_recorder, get_slo_tracker, metric
+from dnet_tpu.resilience.checkpoint import ResumableDecode
+from dnet_tpu.resilience.policy import is_retryable
 from dnet_tpu.utils.logger import get_logger
 from dnet_tpu.utils.tokenizer import Detokenizer
 
@@ -193,26 +195,67 @@ class InferenceManager:
         stopped_by_seq = False
 
         await self.adapter.reset_cache(nonce)
+        # resume controller: owns the wire nonce + step mapping so a
+        # mid-decode shard failure can (behind DNET_RESILIENCE_RESUME=1)
+        # checkpoint, wait out recovery, and replay prompt+generated on the
+        # new topology without this generator — or the client — noticing.
+        # adapter is passed as a GETTER: auto-recovery swaps the instance.
+        resume = ResumableDecode(
+            lambda: self.adapter,
+            rid,
+            prompt_ids,
+            monitor=self.failure_monitor,
+            timeout_s=self.request_timeout_s,
+        )
         try:
             send_ids = list(prompt_ids)
             for step in range(max_new):
-                # re-check per step: the monitor's one-shot fail_pending only
-                # covers futures pending at the DOWN transition; a request at
-                # a step boundary would otherwise hang the full timeout
-                if self.failure_monitor is not None and self.failure_monitor.degraded:
-                    raise ServiceDegradedError(
-                        f"ring degraded: shard(s) "
-                        f"{self.failure_monitor.down_shards()} down"
-                    )
                 t_step = time.perf_counter()
-                await self.adapter.send_tokens(
-                    nonce, send_ids, decoding, step, budget=max_new - step
-                )
-                result = await self.adapter.await_token(
-                    nonce, step, self.request_timeout_s
-                )
-                if result.error:
-                    raise InferenceError(result.error)
+                try:
+                    # re-check per step: the monitor's one-shot fail_pending
+                    # only covers futures pending at the DOWN transition; a
+                    # request at a step boundary would otherwise hang the
+                    # full timeout
+                    if (
+                        self.failure_monitor is not None
+                        and self.failure_monitor.degraded
+                    ):
+                        raise ServiceDegradedError(
+                            f"ring degraded: shard(s) "
+                            f"{self.failure_monitor.down_shards()} down"
+                        )
+                    await resume.send(
+                        send_ids, decoding, step, budget=max_new - step
+                    )
+                    result = await resume.await_token(step)
+                    if result.error:
+                        raise InferenceError(result.error)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    # transparent resume: wait for auto-recovery, replay
+                    # prompt + generated under a fresh nonce, and take the
+                    # replay's sampled token as THIS step's result.
+                    # Candidates: error tokens / degraded ring / await
+                    # timeout, AND raw transport failures from the send
+                    # path (a dead stream past its re-open budget raises
+                    # ConnectionError or gRPC UNAVAILABLE here, not an
+                    # error TokenResult).  Non-transient logic errors
+                    # propagate.  None = resume disabled/exhausted —
+                    # surface the failure as before (fast 503 /
+                    # InferenceError).
+                    if not (
+                        isinstance(
+                            exc, (InferenceError, asyncio.TimeoutError)
+                        )
+                        or is_retryable(exc)
+                    ):
+                        raise
+                    result = await resume.try_resume(
+                        exc, decoding, step, budget=max_new - step
+                    )
+                    if result is None:
+                        raise
                 # one span per emitted token: send -> token resolved (grant /
                 # chunk-buffered steps resolve in ~0ms, visibly so)
                 step_ms = (time.perf_counter() - t_step) * 1000
@@ -239,6 +282,10 @@ class InferenceManager:
 
                 delta = detok.add(result.token_id)
                 send_ids = [result.token_id]
+                # checkpoint the accepted token: a later resume replays
+                # prompt + generated so far (EOS breaks above — it never
+                # extends context and never needs replaying)
+                resume.record(result.token_id)
                 # one logprob entry per generated token, carrying the
                 # token's OWN text — holdback buffering must not smear one
                 # token's logprob across text accumulated from several
@@ -375,7 +422,11 @@ class InferenceManager:
             slo.record_request(ok=False)
             raise
         finally:
-            await self.adapter.reset_cache(nonce)
+            # guarded cleanup: reset_cache can itself raise when the ring
+            # just died, which would mask the original error and crash the
+            # SSE generator — the controller logs + swallows transport
+            # errors on this path only
+            await resume.cleanup()
 
     async def embeddings(self, req) -> "EmbeddingsResponse":
         """Serve /v1/embeddings: mean-pooled final-hidden-state vectors

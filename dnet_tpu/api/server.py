@@ -201,7 +201,7 @@ async def serve_async(args) -> None:
     await stop.wait()
     log.info("shutting down")
     if inference.failure_monitor is not None:
-        inference.failure_monitor.stop()
+        await inference.failure_monitor.stop()
     if tui_task is not None:
         tui_task.cancel()
     if tui is not None:

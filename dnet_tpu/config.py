@@ -217,6 +217,49 @@ class TransportSettings(_EnvGroup):
 
 
 @dataclass
+class ResilienceSettings(_EnvGroup):
+    """Request survival: retry/backoff policy + transparent decode resume.
+
+    `resume=1` turns a mid-decode shard failure from a surfaced 503 into a
+    checkpoint -> wait-for-recovery -> replay-prefill cycle on the SAME
+    client stream (dnet_tpu/resilience/checkpoint.py).  The retry knobs
+    scale the default unary-RPC backoff policy (resilience/policy.py);
+    per-RPC-class overrides stay in code.
+    """
+
+    env_prefix = "DNET_RESILIENCE_"
+    # transparent decode resume across shard failure (InferenceManager)
+    resume: bool = False
+    # per-resume budget for the ring to become healthy again before the
+    # original error is surfaced to the client
+    resume_deadline_s: float = 30.0
+    # resume attempts per request; past this the failure surfaces
+    max_resumes: int = 2
+    # default unary-RPC retry policy (exponential backoff + full jitter)
+    retry_attempts: int = 3
+    retry_base_s: float = 0.05
+    retry_max_s: float = 2.0
+    # 0 = nondeterministic jitter; nonzero seeds the jitter RNG (tests)
+    retry_jitter_seed: int = 0
+
+
+@dataclass
+class ChaosSettings(_EnvGroup):
+    """Deterministic fault injection (dnet_tpu/resilience/chaos.py).
+
+    ``DNET_CHAOS="shard_compute:error_at:5,send_activation:error:0.1,
+    token_cb:delay:50ms"`` — comma-separated ``point:kind:param`` specs over
+    the named injection points; the schedule is a pure function of
+    ``DNET_CHAOS_SEED`` and the per-point call counters, so a failing run
+    replays exactly.
+    """
+
+    env_prefix = "DNET_"
+    chaos: str = ""
+    chaos_seed: int = 0
+
+
+@dataclass
 class GrpcSettings(_EnvGroup):
     """gRPC channel tuning (reference: src/dnet/utils/grpc_config.py:29-53)."""
 
@@ -342,6 +385,8 @@ class Settings:
     kv: KVSettings = field(default_factory=KVSettings.from_env)
     compute: ComputeSettings = field(default_factory=ComputeSettings.from_env)
     transport: TransportSettings = field(default_factory=TransportSettings.from_env)
+    resilience: ResilienceSettings = field(default_factory=ResilienceSettings.from_env)
+    chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
     grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
     api: ApiSettings = field(default_factory=ApiSettings.from_env)
     shard: ShardSettings = field(default_factory=ShardSettings.from_env)
@@ -355,6 +400,8 @@ for _cls in (
     KVSettings,
     ComputeSettings,
     TransportSettings,
+    ResilienceSettings,
+    ChaosSettings,
     GrpcSettings,
     ApiSettings,
     ShardSettings,

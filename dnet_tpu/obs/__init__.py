@@ -156,6 +156,38 @@ def _register_core(reg: MetricsRegistry) -> None:
         "dnet_prefix_refill_total",
         "Ring prefix-cache misses transparently re-sent as full prefills",
     )
+    # resilience (dnet_tpu/resilience/): retries, stream re-open, resume,
+    # and the chaos harness that exercises all of them
+    retries = reg.counter(
+        "dnet_rpc_retries_total",
+        "RPC attempts retried under the resilience backoff policy",
+        labelnames=("method",),
+    )
+    for m in ("send_activation", "send_token", "reset_cache",
+              "measure_latency"):
+        retries.labels(method=m)  # pre-touch: expose at 0 from the start
+    reg.counter(
+        "dnet_stream_reopens_total",
+        "Broken activation streams re-opened with the in-flight frame "
+        "re-sent",
+    )
+    reg.counter(
+        "dnet_request_resumed_total",
+        "Requests transparently resumed after a mid-decode failure",
+    )
+    reg.counter(
+        "dnet_resume_replay_tokens_total",
+        "Tokens (prompt + generated) replayed by request-resume prefills",
+    )
+    from dnet_tpu.resilience.chaos import INJECTION_POINTS
+
+    chaos_fam = reg.counter(
+        "dnet_chaos_injected_total",
+        "Faults injected by the deterministic chaos harness",
+        labelnames=("point",),
+    )
+    for point in INJECTION_POINTS:
+        chaos_fam.labels(point=point)  # pre-touch: the lint checks these
     # labeled "peer", NOT "node": federation injects node="api" into every
     # API-section sample, and a node label here would collide with it
     reg.gauge(

@@ -1,0 +1,18 @@
+"""Request survival: retry/backoff policy, transparent decode resume, and
+the deterministic chaos harness that tests all of it.
+
+- `policy`     — exponential-backoff + full-jitter retries for unary RPCs
+  and stream re-open (gRPC UNAVAILABLE/DEADLINE_EXCEEDED classification).
+- `checkpoint` — the resumable-request state machine `InferenceManager`
+  drives behind ``DNET_RESILIENCE_RESUME=1``.
+- `chaos`      — seeded fault injection (``DNET_CHAOS``) at named points in
+  transport send, token callback, health check, and shard compute.
+
+Import submodules directly (``from dnet_tpu.resilience import chaos``).
+This ``__init__`` stays import-free on purpose: the metrics registry's
+core registration imports ``chaos`` for the injection-point names, and an
+eager ``policy``/``checkpoint`` import here would re-enter the registry
+lock through their module-level `metric()` handles.
+"""
+
+__all__ = ["chaos", "checkpoint", "policy"]

@@ -13,10 +13,13 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 from dnet_tpu.core.types import ActivationMessage, TokenResult
 from dnet_tpu.obs import get_recorder, metric
+from dnet_tpu.resilience import chaos
+from dnet_tpu.resilience.policy import call_with_retry
 from dnet_tpu.transport.protocol import ActivationFrame, TokenPayload
 from dnet_tpu.transport.stream_manager import StreamManager
 from dnet_tpu.utils.logger import get_logger
@@ -58,6 +61,12 @@ class RingAdapter:
         self._tasks: list[asyncio.Task] = []
         self._stream_idle_s = stream_idle_s
         self._backoff_s = backoff_s
+        # ingress dedup: a sender whose stream broke re-opens and re-sends
+        # the in-flight frame; if the first copy already made it into the
+        # compute queue the duplicate must be ACKed, not re-computed.  Key
+        # includes layer_id because multi-round rings legitimately pass the
+        # same (nonce, seq) through a shard once PER ROUND.
+        self._seen: "OrderedDict[tuple, bool]" = OrderedDict()
 
     # ---- lifecycle ------------------------------------------------------
     async def start(self) -> None:
@@ -89,6 +98,7 @@ class RingAdapter:
         for client in self._cb_clients.values():
             await client.close()
         self._cb_clients.clear()
+        self._seen.clear()
         self.next_addr = ""
 
     def _ensure_next(self):
@@ -102,6 +112,8 @@ class RingAdapter:
                 idle_timeout_s=self._stream_idle_s,
             )
         return self._streams
+
+    DEDUP_CAP = 4096  # admitted-frame keys kept for duplicate detection
 
     # ---- ingress ----------------------------------------------------------
     async def ingress_frame(self, frame: ActivationFrame) -> tuple[bool, str]:
@@ -118,10 +130,20 @@ class RingAdapter:
         )
         compute = self.runtime.compute
         if compute is not None and compute.wants(frame.layer_id):
+            key = (frame.nonce, frame.seq, frame.layer_id)
+            if key in self._seen:
+                # transport retry replayed a frame this shard already
+                # admitted (stream re-open re-sends the in-flight frame):
+                # ACK idempotently instead of double-computing the step
+                log.info("duplicate frame %s seq=%d deduped", frame.nonce, frame.seq)
+                return True, "duplicate"
             msg = frame.to_message()
             msg.t_recv = time.perf_counter()
             if not self.runtime.submit(msg, timeout=0.0 if self.runtime.queue_depth else 5.0):
                 return False, "backpressure"
+            self._seen[key] = True
+            while len(self._seen) > self.DEDUP_CAP:
+                self._seen.popitem(last=False)
             return True, ""
         # relay toward the owner (reference ring.py:161-206)
         try:
@@ -174,6 +196,19 @@ class RingAdapter:
             seq=msg.seq, bytes=len(frame.payload or b""),
         )
 
+    async def _cb_send(self, client, payload: TokenPayload):
+        """Token callback under the send_token retry policy: a transient
+        API-side blip (or injected token_cb fault) must not permanently
+        lose the token and strand the request until its timeout.  The
+        chaos point sits INSIDE the retried callable so an injected error
+        is absorbed exactly like a real one."""
+
+        async def _attempt():
+            await chaos.inject_async("token_cb")
+            return await client.send_token(payload)
+
+        return await call_with_retry(_attempt, method="send_token")
+
     async def _send_token(self, msg: ActivationMessage) -> None:
         if msg.lane_finals:
             # batched lanes: one callback per member nonce (the batch frame
@@ -191,7 +226,8 @@ class RingAdapter:
             # (N-1) x RTT on every batched step
             await asyncio.gather(
                 *(
-                    client.send_token(
+                    self._cb_send(
+                        client,
                         TokenPayload(
                             nonce=f["nonce"],
                             step=int(f["step"]),
@@ -200,7 +236,7 @@ class RingAdapter:
                             top_ids=list(f.get("top_ids") or []),
                             top_logprobs=list(f.get("top_logprobs") or []),
                             error=f.get("error", ""),
-                        )
+                        ),
                     )
                     for f in msg.lane_finals
                 )
@@ -242,12 +278,13 @@ class RingAdapter:
             error=msg.error,
         )
         t0 = time.perf_counter()
-        await client.send_token(payload)
+        await self._cb_send(client, payload)
         # a verify block's additionally accepted tokens (ring speculation):
         # one callback per step, in step order behind the primary
         for step, token_id in msg.extra_finals or ():
-            await client.send_token(
-                TokenPayload(nonce=msg.nonce, step=step, token_id=int(token_id))
+            await self._cb_send(
+                client,
+                TokenPayload(nonce=msg.nonce, step=step, token_id=int(token_id)),
             )
         # record first, then log the RECORDED value (the [PROFILE] line is
         # now a view over the same measurement the registry aggregates)
@@ -272,8 +309,9 @@ class RingAdapter:
         if client is None:
             client = self._make_cb_client(addr)
             self._cb_clients[addr] = client
-        await client.send_token(
-            TokenPayload(nonce=msg.nonce, step=step, token_id=-1, error=error)
+        await self._cb_send(
+            client,
+            TokenPayload(nonce=msg.nonce, step=step, token_id=-1, error=error),
         )
 
     async def _send_continuation(self, msg: ActivationMessage) -> None:
@@ -312,6 +350,13 @@ class RingAdapter:
             self.runtime.compute.reset(nonce)
         if self._streams is not None and nonce:
             await self._streams.end_stream(nonce)
+        # dedup keys die with the nonce: a replayed request (prefix refill,
+        # resume) legitimately re-sends step 0 after a reset
+        if nonce:
+            for key in [k for k in self._seen if k[0] == nonce]:
+                del self._seen[key]
+        else:
+            self._seen.clear()
 
     async def _idle_sweeper(self) -> None:
         while True:

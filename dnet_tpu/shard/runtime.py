@@ -16,6 +16,7 @@ from typing import Optional
 
 from dnet_tpu.core.types import ActivationMessage
 from dnet_tpu.obs import get_recorder
+from dnet_tpu.resilience import chaos
 from dnet_tpu.shard.compute import ShardCompute
 from dnet_tpu.utils.logger import get_logger
 
@@ -166,6 +167,9 @@ class ShardRuntime:
                         msg.nonce, "shard_dequeue",
                         (t_deq - msg.t_recv) * 1000.0, seq=msg.seq,
                     )
+                # chaos point: an injected ChaosError here takes the exact
+                # path a real compute failure takes (error final -> driver)
+                chaos.inject("shard_compute")
                 out = compute.process(msg)
                 rec.span(
                     msg.nonce, "shard_compute",

@@ -13,6 +13,7 @@ import grpc
 import grpc.aio as aio_grpc
 
 from dnet_tpu.config import get_settings
+from dnet_tpu.resilience.policy import call_with_retry
 from dnet_tpu.transport import protocol as proto
 from dnet_tpu.utils.logger import get_logger
 
@@ -73,17 +74,35 @@ class RingClient:
     def open_stream(self):
         return self._stream_stream()
 
+    # Unary RPCs retry transient failures (gRPC UNAVAILABLE /
+    # DEADLINE_EXCEEDED) under per-class backoff policies
+    # (resilience/policy.py).  health_check's class pins ONE attempt: the
+    # failure monitor owns probe retry semantics via its fail threshold.
     async def send_activation(self, frame: proto.ActivationFrame, timeout: float = 10.0):
-        return await self._send_activation(frame, timeout=timeout)
+        return await call_with_retry(
+            lambda: self._send_activation(frame, timeout=timeout),
+            method="send_activation",
+        )
 
     async def health_check(self, timeout: float = 5.0) -> proto.HealthInfo:
-        return await self._health(proto.Empty(), timeout=timeout)
+        return await call_with_retry(
+            lambda: self._health(proto.Empty(), timeout=timeout),
+            method="health_check",
+        )
 
     async def reset_cache(self, nonce: str = "", timeout: float = 10.0):
-        return await self._reset(proto.ResetCacheRequest(nonce=nonce), timeout=timeout)
+        return await call_with_retry(
+            lambda: self._reset(
+                proto.ResetCacheRequest(nonce=nonce), timeout=timeout
+            ),
+            method="reset_cache",
+        )
 
     async def measure_latency(self, probe: proto.LatencyProbe, timeout: float = 30.0):
-        return await self._latency(probe, timeout=timeout)
+        return await call_with_retry(
+            lambda: self._latency(probe, timeout=timeout),
+            method="measure_latency",
+        )
 
     async def close(self) -> None:
         await self.channel.close()

@@ -15,6 +15,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
 from dnet_tpu.obs import get_recorder, metric
+from dnet_tpu.resilience import chaos
+from dnet_tpu.resilience.policy import call_with_retry
 from dnet_tpu.transport.protocol import ActivationFrame, StreamAck
 from dnet_tpu.utils.logger import get_logger
 
@@ -23,6 +25,7 @@ log = get_logger()
 _TX_BYTES = metric("dnet_transport_tx_bytes_total")
 _TX_FRAMES = metric("dnet_transport_tx_frames_total")
 _BACKPRESSURE = metric("dnet_transport_backpressure_total")
+_REOPENS = metric("dnet_stream_reopens_total")
 
 
 @dataclass
@@ -72,19 +75,37 @@ class StreamManager:
         (the token callback echoes it; rewriting here would desync futures
         when a stream is recreated mid-request).  ctx.seq only counts frames
         for diagnostics.
+
+        A write failure (peer restarted, channel reset) drops the context
+        and — under the send_activation retry policy — re-opens a fresh
+        stream and re-sends THIS frame with its original seq; the shard
+        side dedups on (nonce, seq, layer_id) in case the first write
+        landed before the break was observed.  Retries exhausted (or a
+        non-transient error) propagate to the caller as before.
         """
-        ctx = await self.get_or_create(nonce)
-        while ctx.disabled:
-            await asyncio.sleep(max(ctx.disabled_until - time.monotonic(), 0.01))
-        ctx.seq += 1
+        async def _attempt() -> StreamContext:
+            ctx = await self.get_or_create(nonce)
+            while ctx.disabled:
+                await asyncio.sleep(
+                    max(ctx.disabled_until - time.monotonic(), 0.01)
+                )
+            ctx.seq += 1
+            try:
+                await chaos.inject_async("send_activation")
+                await ctx.call.write(frame)
+            except Exception:
+                # dead stream: drop the context so the retry (or the next
+                # frame) opens a fresh one instead of failing forever
+                await self.end_stream(nonce)
+                raise
+            return ctx
+
         t0 = time.perf_counter()
-        try:
-            await ctx.call.write(frame)
-        except Exception:
-            # dead stream (peer restarted, channel reset): drop the context so
-            # the next frame reopens a fresh stream instead of failing forever
-            await self.end_stream(nonce)
-            raise
+        ctx = await call_with_retry(
+            _attempt,
+            method="send_activation",
+            on_retry=lambda *_: _REOPENS.inc(),
+        )
         ctx.last_used = time.monotonic()
         n_bytes = len(getattr(frame, "payload", b"") or b"")
         _TX_BYTES.inc(n_bytes)

@@ -108,6 +108,13 @@ _REQUIRED_FAMILIES = (
     "dnet_kv_cow_copies_total",
     "dnet_kv_prefix_shared_blocks_total",
     "dnet_kv_admission_rejected_total",
+    # resilience (dnet_tpu/resilience/) — the retry/resume dashboards and
+    # the chaos-coverage lint (pass 5) depend on these
+    "dnet_rpc_retries_total",
+    "dnet_stream_reopens_total",
+    "dnet_request_resumed_total",
+    "dnet_resume_replay_tokens_total",
+    "dnet_chaos_injected_total",
 )
 
 
@@ -207,19 +214,53 @@ def check_paged_conservation(errors: list) -> int:
     return steps
 
 
+def check_chaos_points(errors: list) -> int:
+    """Pass 5: every chaos injection point declared in
+    dnet_tpu/resilience/chaos.py must have a pre-touched
+    dnet_chaos_injected_total{point=} series — a new point cannot ship
+    without its observability, and a renamed point cannot strand a stale
+    label."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.resilience.chaos import INJECTION_POINTS
+
+    text = get_registry().expose()
+    n = 0
+    for point in INJECTION_POINTS:
+        n += 1
+        if f'dnet_chaos_injected_total{{point="{point}"}}' not in text:
+            errors.append(
+                f"chaos: injection point {point!r} has no "
+                f"dnet_chaos_injected_total label (pre-touch it in "
+                f"dnet_tpu.obs._register_core)"
+            )
+    # reverse direction: no exposed point label without a declaration
+    import re
+
+    for m in re.finditer(
+        r'dnet_chaos_injected_total\{point="([^"]+)"\}', text
+    ):
+        if m.group(1) not in INJECTION_POINTS:
+            errors.append(
+                f"chaos: exposed point label {m.group(1)!r} is not declared "
+                f"in chaos.INJECTION_POINTS"
+            )
+    return n
+
+
 def main() -> int:
     errors: list[str] = []
     n_reg = check_registry(errors)
     n_src = check_sources(errors)
     n_fed = check_federation(errors)
     n_pool = check_paged_conservation(errors)
+    n_chaos = check_chaos_points(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
         return 1
     print(f"ok: {n_reg} registered families, {n_src} source-literal "
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
-          f"audits, all conform")
+          f"audits, {n_chaos} chaos points, all conform")
     return 0
 
 

@@ -109,6 +109,69 @@ def test_detects_down_and_fast_fails_inflight():
     asyncio.run(go())
 
 
+def test_stop_awaits_task_and_closes_clients():
+    """stop() is an awaited shutdown: the probe task is reaped and every
+    cached channel is closed IN the running loop (the old fire-and-forget
+    ensure_future(close) leaked channels when the loop tore down first)."""
+
+    async def go():
+        FlakyClient.dead = set()
+        made = []
+
+        def factory(addr):
+            c = FlakyClient(addr)
+            made.append(c)
+            return c
+
+        adapter = StubAdapter()
+        inference = InferenceManager(adapter, request_timeout_s=5.0)
+        inference.tokenizer = ByteTokenizer()
+        inference.model_id = "m"
+        monitor = RingFailureMonitor(
+            StubCluster(), inference, interval_s=0.01,
+            fail_threshold=2, ring_client_factory=factory,
+        )
+        monitor.start()
+        await monitor._tick()  # populate the client cache
+        assert made and not any(c.closed for c in made)
+        await monitor.stop()
+        assert monitor._task is None
+        assert all(c.closed for c in made)
+        assert monitor._clients == {}
+        # idempotent: a second stop is a clean no-op
+        await monitor.stop()
+
+    asyncio.run(go())
+
+
+def test_chaos_health_check_fault_drives_down_transition():
+    """An injected health_check fault counts like a real probe failure and
+    flips the shard DOWN at the threshold."""
+    from dnet_tpu.resilience.chaos import clear_chaos, install_chaos
+
+    async def go():
+        FlakyClient.dead = set()
+        adapter = StubAdapter()
+        inference = InferenceManager(adapter, request_timeout_s=5.0)
+        inference.tokenizer = ByteTokenizer()
+        inference.model_id = "m"
+        monitor = make_monitor(inference, threshold=2)
+        install_chaos("health_check:error:1.0", seed=1)
+        try:
+            await monitor._tick()
+            assert not monitor.degraded
+            await monitor._tick()
+            assert monitor.degraded  # every probe faulted -> both DOWN
+            assert sorted(monitor.down_shards()) == ["s0", "s1"]
+        finally:
+            clear_chaos()
+        # with chaos cleared the probes succeed and the shards recover
+        await monitor._tick()
+        assert not monitor.degraded
+
+    asyncio.run(go())
+
+
 def test_auto_recover_resolves_over_healthy(monkeypatch, tiny_llama_dir):
     async def go():
         FlakyClient.dead = set()

@@ -52,14 +52,65 @@ def test_full_queue_nacks_backpressure(tiny_llama_dir):
             ),
         )
         adapter = RingAdapter(rt)
-        f = ActivationFrame(
-            nonce="n", seq=0, layer_id=-1, pos=0, dtype="tokens",
+
+        def f(seq):
+            # distinct seqs: an identical frame would hit the (nonce, seq,
+            # layer_id) dedup instead of exercising queue overflow
+            return ActivationFrame(
+                nonce="n", seq=seq, layer_id=-1, pos=0, dtype="tokens",
+                shape=(1, 1), payload=b"\x01\x00\x00\x00",
+            )
+
+        ok, msg = await adapter.ingress_frame(f(0))
+        assert ok
+        ok2, msg2 = await adapter.ingress_frame(f(1))
+        assert not ok2 and msg2 == "backpressure"
+
+    asyncio.run(go())
+
+
+def test_duplicate_frame_is_deduped_not_recomputed(tiny_llama_dir):
+    """A stream re-open re-sends the in-flight frame with its original seq;
+    if the first copy was already admitted the duplicate must ACK without
+    entering the compute queue — and reset_cache clears the dedup state so
+    a replayed request (resume, prefix refill) can re-send step 0."""
+
+    async def go():
+        rt = ShardRuntime("s", queue_size=8)  # worker NOT started: frames sit
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None,
+            lambda: rt.load_model_core(
+                str(tiny_llama_dir), [0, 1, 2, 3], max_seq=32,
+                param_dtype="float32",
+            ),
+        )
+        adapter = RingAdapter(rt)
+        frame = ActivationFrame(
+            nonce="n", seq=3, layer_id=-1, pos=0, dtype="tokens",
             shape=(1, 1), payload=b"\x01\x00\x00\x00",
         )
-        ok, msg = await adapter.ingress_frame(f)
-        assert ok
-        ok2, msg2 = await adapter.ingress_frame(f)
-        assert not ok2 and msg2 == "backpressure"
+        ok, msg = await adapter.ingress_frame(frame)
+        assert ok and msg == ""
+        assert rt.queue_depth == 1
+        ok2, msg2 = await adapter.ingress_frame(frame)
+        assert ok2 and msg2 == "duplicate"
+        assert rt.queue_depth == 1  # not recomputed
+
+        # same (nonce, seq) at a DIFFERENT layer is a new round, not a dup
+        other_round = ActivationFrame(
+            nonce="n", seq=3, layer_id=1, pos=0, dtype="float32",
+            shape=(1, 1, 64), payload=b"\x00" * 256,
+        )
+        ok3, msg3 = await adapter.ingress_frame(other_round)
+        assert ok3 and msg3 == ""
+        assert rt.queue_depth == 2
+
+        # the nonce's dedup keys die with its cache
+        await adapter.reset_cache("n")
+        ok4, msg4 = await adapter.ingress_frame(frame)
+        assert ok4 and msg4 == ""
+        assert rt.queue_depth == 3
 
     asyncio.run(go())
 

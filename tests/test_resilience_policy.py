@@ -1,0 +1,193 @@
+"""Retry/backoff policy math + classification + call_with_retry behavior."""
+
+import asyncio
+import random
+
+import pytest
+
+from dnet_tpu.obs import metric
+from dnet_tpu.resilience.chaos import ChaosError
+from dnet_tpu.resilience.policy import (
+    RetryPolicy,
+    call_with_retry,
+    is_retryable,
+    policy_for,
+)
+
+pytestmark = pytest.mark.api
+
+
+def _retries(method: str) -> float:
+    return metric("dnet_rpc_retries_total").labels(method=method).value
+
+
+# ---- backoff math ---------------------------------------------------------
+
+def test_backoff_grows_exponentially_and_caps_without_jitter():
+    p = RetryPolicy(base_delay_s=0.1, max_delay_s=0.5, multiplier=2.0,
+                    jitter="none")
+    rng = random.Random(0)
+    assert [p.delay_s(a, rng) for a in range(5)] == [
+        0.1, 0.2, 0.4, 0.5, 0.5  # capped at max_delay_s
+    ]
+
+
+def test_full_jitter_is_deterministic_under_seed_and_bounded():
+    p = RetryPolicy(base_delay_s=0.1, max_delay_s=10.0, multiplier=2.0)
+    a = [p.delay_s(i, random.Random(42)) for i in range(8)]
+    b = [p.delay_s(i, random.Random(42)) for i in range(8)]
+    assert a == b  # same seed => same schedule
+    for i, d in enumerate(a):
+        assert 0.0 <= d <= 0.1 * 2 ** i
+    # a different seed produces a different schedule
+    c = [p.delay_s(i, random.Random(7)) for i in range(8)]
+    assert a != c
+
+
+# ---- classification -------------------------------------------------------
+
+class _GrpcLikeError(Exception):
+    """Duck-types grpc.aio.AioRpcError: .code() returns an enum-like."""
+
+    class _Code:
+        def __init__(self, name):
+            self.name = name
+
+    def __init__(self, code_name):
+        self._code = self._Code(code_name)
+
+    def code(self):
+        return self._code
+
+
+def test_grpc_code_classification():
+    assert is_retryable(_GrpcLikeError("UNAVAILABLE"))
+    assert is_retryable(_GrpcLikeError("DEADLINE_EXCEEDED"))
+    assert not is_retryable(_GrpcLikeError("INVALID_ARGUMENT"))
+    assert not is_retryable(_GrpcLikeError("INTERNAL"))
+
+
+def test_builtin_error_classification():
+    assert is_retryable(ConnectionError("refused"))
+    assert is_retryable(ConnectionResetError("reset"))
+    assert is_retryable(TimeoutError("slow"))
+    assert is_retryable(OSError("broken pipe"))
+    assert is_retryable(ChaosError("injected"))  # ConnectionError subclass
+    assert not is_retryable(ValueError("bad"))
+    assert not is_retryable(RuntimeError("bug"))
+
+
+# ---- call_with_retry ------------------------------------------------------
+
+async def _no_sleep(_s):
+    return None
+
+
+def test_transient_failures_are_retried_then_succeed():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("blip")
+        return "ok"
+
+    before = _retries("send_token")
+    out = asyncio.run(call_with_retry(
+        fn, method="send_token",
+        policy=RetryPolicy(max_attempts=4, jitter="none", base_delay_s=0.0),
+        sleep=_no_sleep,
+    ))
+    assert out == "ok" and len(calls) == 3
+    assert _retries("send_token") - before == 2
+
+
+def test_non_retryable_raises_immediately():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        asyncio.run(call_with_retry(
+            fn, method="send_token",
+            policy=RetryPolicy(max_attempts=5, jitter="none"),
+            sleep=_no_sleep,
+        ))
+    assert len(calls) == 1
+
+
+def test_attempts_exhausted_raises_last_error():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise ConnectionError(f"blip {len(calls)}")
+
+    with pytest.raises(ConnectionError, match="blip 3"):
+        asyncio.run(call_with_retry(
+            fn, method="send_token",
+            policy=RetryPolicy(max_attempts=3, jitter="none", base_delay_s=0.0),
+            sleep=_no_sleep,
+        ))
+    assert len(calls) == 3
+
+
+def test_backoff_delays_are_fed_to_sleep():
+    slept = []
+
+    async def sleep(s):
+        slept.append(s)
+
+    async def fn():
+        raise ConnectionError("blip")
+
+    with pytest.raises(ConnectionError):
+        asyncio.run(call_with_retry(
+            fn, method="send_token",
+            policy=RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                               max_delay_s=1.0, jitter="none"),
+            sleep=sleep,
+        ))
+    assert slept == [0.1, 0.2]
+
+
+# ---- per-class defaults ---------------------------------------------------
+
+def test_health_check_is_pinned_to_one_attempt():
+    # the monitor's fail_threshold x interval IS the probe retry budget;
+    # transport-level retries would silently stretch detection
+    assert policy_for("health_check").max_attempts == 1
+
+
+def test_unknown_method_uses_settings_defaults():
+    from dnet_tpu.config import get_settings
+
+    p = policy_for("not_a_known_rpc_class")
+    s = get_settings().resilience
+    assert p.max_attempts == max(s.retry_attempts, 1)
+    assert p.base_delay_s == s.retry_base_s
+
+
+def test_retry_attempts_setting_is_honored_per_class():
+    """DNET_RESILIENCE_RETRY_ATTEMPTS must actually move every class
+    except the health_check pin (send_token rides one above it)."""
+    import os
+
+    from dnet_tpu.config import reset_settings_cache
+
+    old = os.environ.get("DNET_RESILIENCE_RETRY_ATTEMPTS")
+    os.environ["DNET_RESILIENCE_RETRY_ATTEMPTS"] = "7"
+    reset_settings_cache()
+    try:
+        assert policy_for("send_activation").max_attempts == 7
+        assert policy_for("reset_cache").max_attempts == 7
+        assert policy_for("send_token").max_attempts == 8  # +1: token path
+        assert policy_for("health_check").max_attempts == 1  # pinned
+    finally:
+        if old is None:
+            os.environ.pop("DNET_RESILIENCE_RETRY_ATTEMPTS", None)
+        else:
+            os.environ["DNET_RESILIENCE_RETRY_ATTEMPTS"] = old
+        reset_settings_cache()

@@ -69,3 +69,116 @@ def test_idle_cleanup():
         await sm.shutdown()
 
     asyncio.run(go())
+
+
+class BrokenOnceCall(FakeStreamCall):
+    """First write raises like a torn bidi stream; later writes succeed."""
+
+    def __init__(self, fail_times=1):
+        super().__init__()
+        self.fail_times = fail_times
+
+    async def write(self, f):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionResetError("stream torn")
+        await super().write(f)
+
+
+def _metric(name):
+    from dnet_tpu.obs import metric
+
+    return metric(name)
+
+
+def test_broken_stream_reopens_and_resends_same_seq():
+    """A dead stream mid-send must re-open and re-send the in-flight frame
+    with its ORIGINAL seq (the end-to-end step identity the shard dedups
+    on), within the send_activation retry budget."""
+
+    async def go():
+        calls = []
+
+        def opener():
+            call = BrokenOnceCall(fail_times=1) if not calls else FakeStreamCall()
+            calls.append(call)
+            return call
+
+        sm = StreamManager(opener)
+        before = _metric("dnet_stream_reopens_total").value
+        await sm.send("n", frame("n", seq=7))
+        assert len(calls) == 2  # broken stream dropped, fresh one opened
+        assert [f.seq for f in calls[1].written] == [7]  # seq preserved
+        assert _metric("dnet_stream_reopens_total").value - before == 1
+        await sm.shutdown()
+
+    asyncio.run(go())
+
+
+def test_persistently_broken_stream_exhausts_retries_and_raises():
+    async def go():
+        calls = []
+
+        def opener():
+            call = BrokenOnceCall(fail_times=99)
+            calls.append(call)
+            return call
+
+        sm = StreamManager(opener)
+        with pytest.raises(ConnectionResetError):
+            await sm.send("n", frame("n"))
+        # one open per attempt, bounded by the send_activation policy
+        from dnet_tpu.resilience.policy import policy_for
+
+        assert len(calls) == policy_for("send_activation").max_attempts
+        await sm.shutdown()
+
+    asyncio.run(go())
+
+
+def test_non_retryable_write_error_propagates_without_reopen():
+    async def go():
+        calls = []
+
+        class BadFrameCall(FakeStreamCall):
+            async def write(self, f):
+                raise ValueError("serialization bug")
+
+        def opener():
+            call = BadFrameCall()
+            calls.append(call)
+            return call
+
+        sm = StreamManager(opener)
+        with pytest.raises(ValueError):
+            await sm.send("n", frame("n"))
+        assert len(calls) == 1
+        await sm.shutdown()
+
+    asyncio.run(go())
+
+
+def test_chaos_send_activation_fault_is_absorbed_by_reopen():
+    """An injected transport fault takes the same reopen+resend path as a
+    real one — and the retried send goes through cleanly."""
+    from dnet_tpu.resilience.chaos import clear_chaos, install_chaos
+
+    async def go():
+        calls = []
+
+        def opener():
+            call = FakeStreamCall()
+            calls.append(call)
+            return call
+
+        sm = StreamManager(opener)
+        install_chaos("send_activation:error_at:1", seed=5)
+        try:
+            await sm.send("n", frame("n", seq=2))
+        finally:
+            clear_chaos()
+        assert len(calls) == 2  # fault dropped stream 1; retry reopened
+        assert [f.seq for f in calls[1].written] == [2]
+        await sm.shutdown()
+
+    asyncio.run(go())
