@@ -1,0 +1,17 @@
+"""Admission control: bounded queueing, load shedding, deadlines, drain.
+
+The front door of the serving path (ROADMAP: survive heavy traffic, not
+just failures).  `controller.AdmissionController` replaces the decode
+driver's raw semaphore; `controller.Deadline` objects ride activation
+frame headers so every hop — including the shard compute-queue dequeue —
+can drop work nobody is waiting for.  `reasons` declares the reject-
+reason and deadline-stage label sets the metrics lint cross-checks.
+
+Import submodules directly (``from dnet_tpu.admission.controller import
+AdmissionController``).  This ``__init__`` stays import-free on purpose:
+the metrics registry's core registration imports ``reasons`` for the
+label sets, and an eager ``controller`` import here would re-enter the
+registry lock through its module-level `metric()` handles.
+"""
+
+__all__ = ["controller", "reasons"]

@@ -27,8 +27,11 @@ from typing import Optional
 from aiohttp import web
 from pydantic import ValidationError
 
+from dnet_tpu.admission.controller import AdmissionRejected
 from dnet_tpu.api.catalog import model_catalog
 from dnet_tpu.api.inference import (
+    BackpressureError,
+    DeadlineExceededError,
     InferenceError,
     InferenceManager,
     PromptTooLongError,
@@ -48,9 +51,20 @@ from dnet_tpu.utils.logger import get_logger
 log = get_logger()
 
 
-def _json_error(status: int, message: str, err_type: str = "invalid_request_error"):
+def _json_error(
+    status: int,
+    message: str,
+    err_type: str = "invalid_request_error",
+    retry_after_s: Optional[float] = None,
+):
+    headers = None
+    if retry_after_s is not None:
+        # Retry-After is integral seconds per RFC 9110; never advertise 0
+        headers = {"Retry-After": str(max(1, round(retry_after_s)))}
     return web.json_response(
-        {"error": {"message": message, "type": err_type}}, status=status
+        {"error": {"message": message, "type": err_type}},
+        status=status,
+        headers=headers,
     )
 
 
@@ -102,7 +116,17 @@ class ApiHTTPServer:
 
     # ---- decode-endpoint scaffolding ---------------------------------
     def _gate(self):
-        """Shared admission checks for decode endpoints (None = admitted)."""
+        """Shared pre-admission checks for decode endpoints (None = pass)."""
+        admission = self.inference.admission
+        if admission.draining:
+            # drain window (SIGTERM): in-flight streams finish; new work
+            # is told exactly when to come back
+            return _json_error(
+                503,
+                "server is draining for shutdown",
+                "service_unavailable",
+                retry_after_s=admission.retry_after_s(),
+            )
         if not self.inference.ready:
             return _json_error(400, "no model loaded; POST /v1/load_model first")
         monitor = self.inference.failure_monitor
@@ -115,36 +139,92 @@ class ApiHTTPServer:
         return None
 
     async def _sse(self, request, req, reshape) -> web.StreamResponse:
-        """Stream the decode chunks as SSE; `reshape(chunk) -> [json str]`."""
-        resp = web.StreamResponse(
-            status=200,
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-            },
-        )
-        await resp.prepare(request)
-        try:
-            async for chunk in self.inference.generate_stream(req):
-                for payload in reshape(chunk):
-                    await resp.write(f"data: {payload}\n\n".encode())
-            await resp.write(b"data: [DONE]\n\n")
-        except PromptTooLongError as exc:
-            err = json.dumps(
-                {"error": {"message": str(exc), "type": "invalid_request_error"}}
-            )
-            await resp.write(f"data: {err}\n\n".encode())
-        except InferenceError as exc:
-            err = json.dumps({"error": {"message": str(exc), "type": "server_error"}})
-            await resp.write(f"data: {err}\n\n".encode())
-        except ConnectionResetError:
-            log.info("client disconnected mid-stream")
-        await resp.write_eof()
-        return resp
+        """Stream the decode chunks as SSE; `reshape(chunk) -> [json str]`.
 
-    @staticmethod
-    def _map_inference_errors(exc: Exception):
+        The FIRST chunk is awaited before the SSE response commits to a
+        200: anything shed before the first token — admission rejection
+        (429 + Retry-After), drain (503), expired deadline (504), prompt
+        too long (400), prefill backpressure (429) — keeps its real HTTP
+        status instead of dying inside a 200 stream.  Past the first
+        chunk the status is sent; errors become in-band SSE events.
+
+        The generator is ALWAYS closed on the way out: a client that
+        disconnects mid-stream closes it (GeneratorExit), which fans
+        cancel + reset_cache out through the ring (InferenceManager) and
+        frees the admission slot immediately."""
+        gen = self.inference.generate_stream(req)
+        try:
+            try:
+                first = await gen.__anext__()
+            except StopAsyncIteration:
+                first = None
+            except Exception as exc:
+                return self._map_inference_errors(exc)
+            resp = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "Connection": "keep-alive",
+                },
+            )
+            await resp.prepare(request)
+            try:
+                if first is not None:
+                    for payload in reshape(first):
+                        await resp.write(f"data: {payload}\n\n".encode())
+                    async for chunk in gen:
+                        for payload in reshape(chunk):
+                            await resp.write(f"data: {payload}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+            except PromptTooLongError as exc:
+                err = json.dumps(
+                    {"error": {"message": str(exc), "type": "invalid_request_error"}}
+                )
+                await resp.write(f"data: {err}\n\n".encode())
+            except DeadlineExceededError as exc:
+                err = json.dumps(
+                    {"error": {"message": str(exc), "type": "deadline_exceeded"}}
+                )
+                await resp.write(f"data: {err}\n\n".encode())
+            except BackpressureError as exc:
+                # capacity shed mid-stream is not a server fault: keep the
+                # status contract's semantics in the in-band event type
+                err = json.dumps(
+                    {"error": {"message": str(exc), "type": "rate_limit_exceeded"}}
+                )
+                await resp.write(f"data: {err}\n\n".encode())
+            except InferenceError as exc:
+                err = json.dumps({"error": {"message": str(exc), "type": "server_error"}})
+                await resp.write(f"data: {err}\n\n".encode())
+            except ConnectionResetError:
+                log.info("client disconnected mid-stream")
+            await resp.write_eof()
+            return resp
+        finally:
+            # closing an already-finished generator is a no-op; closing an
+            # abandoned one (disconnect / handler error) triggers the
+            # cancel fan-out in InferenceManager._run
+            await gen.aclose()
+
+    def _map_inference_errors(self, exc: Exception):
+        if isinstance(exc, AdmissionRejected):
+            status = 503 if exc.reason == "draining" else 429
+            return _json_error(
+                status,
+                str(exc),
+                "service_unavailable" if status == 503 else "rate_limit_exceeded",
+                retry_after_s=exc.retry_after_s,
+            )
+        if isinstance(exc, BackpressureError):
+            return _json_error(
+                429,
+                str(exc),
+                "rate_limit_exceeded",
+                retry_after_s=self.inference.admission.retry_after_s(),
+            )
+        if isinstance(exc, DeadlineExceededError):
+            return _json_error(504, str(exc), "deadline_exceeded")
         if isinstance(exc, PromptTooLongError):
             return _json_error(400, str(exc))
         if isinstance(exc, ServiceDegradedError):
@@ -505,6 +585,17 @@ class ApiHTTPServer:
         body["slo"] = slo
         if slo["burning"]:
             body["status"] = "degraded"
+        # admission picture: queue/in-flight depths, and the drain state —
+        # "draining" wins over "degraded" (load balancers must stop
+        # routing here regardless of how healthy the ring looks)
+        admission = self.inference.admission
+        body["admission"] = {
+            "active": admission.active,
+            "queued": admission.queued,
+            "capacity": admission.capacity,
+        }
+        if admission.draining:
+            body["status"] = "draining"
         return web.json_response(body)
 
     async def metrics(self, request: web.Request) -> web.Response:

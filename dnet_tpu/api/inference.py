@@ -26,6 +26,12 @@ from dnet_tpu.api.schemas import (
     Usage,
     new_request_id,
 )
+from dnet_tpu.admission.controller import (
+    AdmissionController,
+    Deadline,
+    deadline_expired,
+    request_deadline,
+)
 from dnet_tpu.api.strategies import ApiAdapterBase
 from dnet_tpu.core.types import DecodingParams
 from dnet_tpu.obs import get_recorder, get_slo_tracker, metric
@@ -40,6 +46,7 @@ _TTFT_MS = metric("dnet_ttft_ms")
 _REQUESTS = metric("dnet_requests_total")
 _REQUEST_ERRORS = metric("dnet_request_errors_total")
 _TOKENS_TOTAL = metric("dnet_tokens_generated_total")
+_CANCELS = metric("dnet_cancel_propagated_total")
 
 
 class InferenceError(Exception):
@@ -53,6 +60,39 @@ class PromptTooLongError(InferenceError):
 class ServiceDegradedError(InferenceError):
     """Ring has DOWN shards: maps to HTTP 503 immediately (fast-fail
     instead of the reference's 300s token-future timeout)."""
+
+
+class DeadlineExceededError(InferenceError):
+    """The request's end-to-end deadline expired mid-flight: maps to
+    HTTP 504 (api/http.py).  Raised by the driver's between-step check or
+    classified from a shard's `deadline exceeded` error final."""
+
+
+class BackpressureError(InferenceError):
+    """A capacity limit refused the work (paged-KV pool exhausted, lane /
+    batch-slot pools full): maps to HTTP 429 + Retry-After, never 500 —
+    the client should back off and retry, nothing is broken."""
+
+
+# capacity-exhaustion signatures that cross the compute/wire boundary as
+# error STRINGS (TokenResult.error); the single choke point turning them
+# back into typed backpressure
+_BACKPRESSURE_MARKERS = (
+    "paged KV pool exhausted",   # kv/paged.py KVPoolExhausted
+    "no free lanes",             # shard/lanes.py lane-pool overflow
+    "no free batch slots",       # core/batch.py slot-pool overflow
+)
+
+
+def classify_result_error(error: str) -> InferenceError:
+    """Map a step's error string to the typed exception the HTTP layer
+    translates into a status code (429 backpressure / 504 deadline /
+    500 otherwise)."""
+    if "deadline exceeded" in error:
+        return DeadlineExceededError(error)
+    if any(marker in error for marker in _BACKPRESSURE_MARKERS):
+        return BackpressureError(error)
+    return InferenceError(error)
 
 
 def completion_logprobs(entries: list, offset0: int = 0):
@@ -89,23 +129,38 @@ class InferenceManager:
         adapter: ApiAdapterBase,
         request_timeout_s: float = 300.0,
         max_concurrent: int = 8,
+        admission: Optional[AdmissionController] = None,
     ) -> None:
         self.adapter = adapter
         self.tokenizer = None  # set by ModelManager on load
         self.model_id: Optional[str] = None
         self.request_timeout_s = request_timeout_s
         self._max_concurrent = max_concurrent
-        self._semaphore = asyncio.Semaphore(max_concurrent)
+        if admission is None:
+            from dnet_tpu.config import get_settings
+
+            adm = get_settings().admission
+            admission = AdmissionController(
+                max_concurrent,
+                queue_depth=adm.admit_queue_depth,
+                queue_timeout_s=adm.admit_queue_timeout_s,
+            )
+        # the admission-aware front end replacing the old raw semaphore:
+        # bounded queue, deadline-aware shedding, Retry-After estimates,
+        # and drain mode all live here (dnet_tpu/admission/)
+        self.admission = admission
         self.failure_monitor = None  # RingFailureMonitor in ring mode
+        # detached cancel-cleanup tasks (client-disconnect fan-out): strong
+        # refs so the loop's weak task set cannot GC a reclaim mid-flight
+        self._cancel_cleanups: set = set()
 
     def set_concurrency_limit(self, n: Optional[int]) -> None:
         """Re-cap request admission (ring lanes: the shard lane pools hold
         exactly `lanes` KV rows, so admitting more mid-decode requests than
         lanes would hard-fail the overflow instead of queueing it).  None
-        restores the configured default.  Requests already inside the old
-        semaphore finish under it; new arrivals use the new cap."""
-        cap = self._max_concurrent if n is None else min(n, self._max_concurrent)
-        self._semaphore = asyncio.Semaphore(max(cap, 1))
+        restores the configured default.  Requests already admitted finish
+        under the old cap; new arrivals use the new one."""
+        self.admission.set_capacity(n)
 
     @property
     def ready(self) -> bool:
@@ -145,17 +200,33 @@ class InferenceManager:
             top_logprobs=top,
         )
 
+    def _deadline_for(self, req) -> Optional[Deadline]:
+        from dnet_tpu.config import get_settings
+
+        return request_deadline(
+            getattr(req, "deadline_s", None),
+            get_settings().admission.request_deadline_s,
+        )
+
     async def generate_stream(
         self, req: ChatCompletionRequest
     ) -> AsyncIterator[ChatCompletionChunk]:
-        """Per-token chunks; final chunk carries finish_reason/usage/metrics."""
+        """Per-token chunks; final chunk carries finish_reason/usage/metrics.
+
+        Admission happens on the consumer's FIRST `anext`: a shed request
+        raises `AdmissionRejected` (429 + Retry-After upstream) before any
+        chunk — the HTTP layer peeks the first chunk before committing to
+        an SSE 200, so rejections keep real status codes."""
         if not self.ready:
             raise InferenceError("no model loaded")
-        async with self._semaphore:
-            async for chunk in self._run(req):
+        deadline = self._deadline_for(req)
+        async with self.admission.slot(deadline):
+            async for chunk in self._run(req, deadline):
                 yield chunk
 
-    async def _run(self, req: ChatCompletionRequest) -> AsyncIterator[ChatCompletionChunk]:
+    async def _run(
+        self, req: ChatCompletionRequest, deadline: Optional[Deadline] = None
+    ) -> AsyncIterator[ChatCompletionChunk]:
         if self.failure_monitor is not None and self.failure_monitor.degraded:
             raise ServiceDegradedError(
                 f"ring degraded: shard(s) {self.failure_monitor.down_shards()} down"
@@ -195,6 +266,11 @@ class InferenceManager:
         stopped_by_seq = False
 
         await self.adapter.reset_cache(nonce)
+        if deadline is not None:
+            # the deadline rides every activation frame header from here:
+            # shards shed expired frames at dequeue (zero compute), and
+            # the lane flusher sheds expired members (api/ring.py)
+            self.adapter.set_deadline(nonce, deadline.t_deadline)
         # resume controller: owns the wire nonce + step mapping so a
         # mid-decode shard failure can (behind DNET_RESILIENCE_RESUME=1)
         # checkpoint, wait out recovery, and replay prompt+generated on the
@@ -207,9 +283,28 @@ class InferenceManager:
             monitor=self.failure_monitor,
             timeout_s=self.request_timeout_s,
         )
+        cleanup_detached = False
         try:
             send_ids = list(prompt_ids)
             for step in range(max_new):
+                if deadline is not None:
+                    if deadline.expired:
+                        # between-step shed: the client's deadline passed,
+                        # so every further token is work nobody is waiting
+                        # for
+                        deadline_expired("api_step")
+                        raise DeadlineExceededError(
+                            f"request deadline expired after {generated} "
+                            f"token(s)"
+                        )
+                    # re-bound the token await per step: a shard that
+                    # hangs without dying must surface the 504 when the
+                    # deadline passes, not after the frozen request
+                    # timeout (remaining() shrinks every step)
+                    resume.timeout_s = min(
+                        self.request_timeout_s,
+                        max(deadline.remaining(), 0.001),
+                    )
                 t_step = time.perf_counter()
                 try:
                     # re-check per step: the monitor's one-shot fail_pending
@@ -229,7 +324,9 @@ class InferenceManager:
                     )
                     result = await resume.await_token(step)
                     if result.error:
-                        raise InferenceError(result.error)
+                        # typed: deadline / backpressure errors keep their
+                        # HTTP semantics (504 / 429) across the wire
+                        raise classify_result_error(result.error)
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
@@ -244,6 +341,25 @@ class InferenceManager:
                     # propagate.  None = resume disabled/exhausted —
                     # surface the failure as before (fast 503 /
                     # InferenceError).
+                    if (
+                        deadline is not None
+                        and deadline.expired
+                        and isinstance(exc, asyncio.TimeoutError)
+                    ):
+                        # the deadline-bounded await lapsed: this is the
+                        # deadline expiring mid-step, not a generic hang
+                        deadline_expired("api_step")
+                        raise DeadlineExceededError(
+                            f"request deadline expired awaiting step "
+                            f"{step}"
+                        ) from exc
+                    if isinstance(
+                        exc, (DeadlineExceededError, BackpressureError)
+                    ):
+                        # shed work is not failed work: replaying a request
+                        # nobody waits for (or that capacity just refused)
+                        # would recreate the very overload being shed
+                        raise
                     if not (
                         isinstance(
                             exc, (InferenceError, asyncio.TimeoutError)
@@ -415,6 +531,20 @@ class InferenceManager:
                 metrics=metrics,
             )
             slo.record_request(ok=True)
+        except (GeneratorExit, asyncio.CancelledError):
+            # the client went away (an SSE disconnect closes this
+            # generator; a task cancel lands here too): fan the cancel out
+            # through the ring NOW as a DETACHED task — the dying request
+            # task must not be able to interrupt the reset_cache fan-out
+            # that reclaims shard lanes and paged-KV blocks.  The
+            # admission slot itself frees in generate_stream's
+            # `async with` as this exception keeps propagating.
+            _CANCELS.inc()
+            cleanup_detached = True
+            task = asyncio.ensure_future(resume.cleanup())
+            self._cancel_cleanups.add(task)
+            task.add_done_callback(self._cancel_cleanups.discard)
+            raise
         except Exception:
             # client disconnects / task cancels (BaseException) are not
             # server errors; InferenceError and friends are
@@ -426,14 +556,22 @@ class InferenceManager:
             # just died, which would mask the original error and crash the
             # SSE generator — the controller logs + swallows transport
             # errors on this path only
-            await resume.cleanup()
+            if not cleanup_detached:
+                await resume.cleanup()
 
     async def embeddings(self, req) -> "EmbeddingsResponse":
         """Serve /v1/embeddings: mean-pooled final-hidden-state vectors
         (beyond the reference, which schemas the route but never serves
         it).  Accepts the full OpenAI input envelope — a string, a list of
         strings, a token list, or a batch of token lists — and the base64
-        encoding_format."""
+        encoding_format.  Embeddings compete for the same compute as
+        decode, so they pass the same admission controller — an
+        embeddings burst is bounded, shed with 429s, and drained like
+        everything else."""
+        async with self.admission.slot(self._deadline_for(req)):
+            return await self._embeddings(req)
+
+    async def _embeddings(self, req) -> "EmbeddingsResponse":
         from dnet_tpu.api.schemas import (
             EmbeddingData,
             EmbeddingsResponse,

@@ -14,6 +14,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from dnet_tpu.admission.controller import deadline_expired
 from dnet_tpu.api.strategies import ApiAdapterBase, _TokenFutures
 from dnet_tpu.core.types import DecodingParams, TokenResult
 from dnet_tpu.obs import get_recorder, metric
@@ -59,6 +60,9 @@ class RingApiAdapter(ApiAdapterBase):
         self._stream_idle_s = stream_idle_s
         self._sweeper: Optional[asyncio.Task] = None
         self._pos_state: Dict[str, int] = {}  # nonce -> prompt length (pos derives from step)
+        # nonce -> absolute wall-clock deadline (epoch s): stamped into
+        # every frame header so shards drop expired work at dequeue
+        self._deadlines: Dict[str, float] = {}
         self._shard_clients: Dict[str, object] = {}
         # decode grants (ring self-continuation): a frame may authorize the
         # tail shard to feed up to `auto_steps` sampled tokens straight back
@@ -132,11 +136,16 @@ class RingApiAdapter(ApiAdapterBase):
     def max_seq(self) -> Optional[int]:
         return self._max_seq
 
+    def set_deadline(self, nonce: str, deadline_ts: float) -> None:
+        if deadline_ts > 0:
+            self._deadlines[nonce] = float(deadline_ts)
+
     async def reset_cache(self, nonce: str) -> None:
         """Reset per-nonce KV on every shard (gRPC fan-out, reference
         inference.py:118)."""
         self._futures.cancel_nonce(nonce)
         self._pos_state.pop(nonce, None)
+        self._deadlines.pop(nonce, None)
         self._granted.pop(nonce, None)
         self._active.pop(nonce, None)
         self._refill_state.pop(nonce, None)
@@ -257,6 +266,7 @@ class RingApiAdapter(ApiAdapterBase):
             auto_steps=auto,
             prefix_hit=prefix_hit,
             prefix_store=prefix_store,
+            deadline=self._deadlines.get(nonce, 0.0),
         )
         if auto:
             self._granted[nonce] = step + auto
@@ -306,6 +316,25 @@ class RingApiAdapter(ApiAdapterBase):
                     await asyncio.sleep(0.0005)
             batch = self._pending[: self._lanes]
             self._pending = self._pending[len(batch):]
+            # shed expired members HERE rather than stamping the batch
+            # frame: one late member must not expire the whole frame at a
+            # shard dequeue and kill its live co-members
+            live = []
+            for e in batch:
+                dl = self._deadlines.get(e["nonce"], 0.0)
+                if dl and time.time() >= dl:
+                    deadline_expired("lane_flush")
+                    self.resolve_token(
+                        TokenResult(
+                            nonce=e["nonce"], token_id=-1, step=e["seq"],
+                            error="deadline exceeded at lane flush",
+                        )
+                    )
+                    continue
+                live.append(e)
+            batch = live
+            if not batch:
+                continue
             _LANE_DEPTH.observe(len(batch))
             now = time.monotonic()
             for e in batch:

@@ -53,6 +53,11 @@ class SamplingRequest(BaseModel):
     n: int = Field(default=1, ge=1, le=1)  # >1 unsupported (parity w/ reference)
     user: Optional[str] = None
     profile: bool = False  # dnet extension: include perf metrics in final chunk
+    # dnet extension: end-to-end deadline for THIS request (seconds from
+    # arrival), overriding DNET_REQUEST_DEADLINE_S.  Expired work is shed
+    # at every stage — admission queue, decode driver, shard dequeue —
+    # and surfaces as HTTP 504 (api/http.py).
+    deadline_s: Optional[float] = Field(default=None, gt=0.0)
     # OpenAI logit_bias: token-id (stringified, per the OpenAI wire shape)
     # -> additive bias in [-100, 100].  APPLIED here (the reference's
     # DecodingConfig carries the field unused, src/dnet/api/models.py:70).

@@ -199,7 +199,21 @@ async def serve_async(args) -> None:
             pass
     log.info("dnet-api ready")
     await stop.wait()
-    log.info("shutting down")
+    # graceful drain (SIGTERM/SIGINT): flip admission into drain mode —
+    # /health reports "draining", new decode requests get 503 +
+    # Retry-After, queued waiters shed — while the HTTP server stays up
+    # so in-flight streams can finish, bounded by DNET_DRAIN_DEADLINE_S.
+    # Only then do adapters/transports tear down.
+    drain_s = s.admission.drain_deadline_s
+    log.info(
+        "shutdown signal: draining %d in-flight request(s) (bounded %.1fs)",
+        inference.admission.active, drain_s,
+    )
+    inference.admission.begin_drain()
+    if await inference.admission.wait_drained(drain_s):
+        log.info("drain complete; shutting down")
+    else:
+        log.warning("drain deadline hit; shutting down with work in flight")
     if inference.failure_monitor is not None:
         await inference.failure_monitor.stop()
     if tui_task is not None:

@@ -74,6 +74,13 @@ class ApiAdapterBase(abc.ABC):
     def resolve_token(self, result: TokenResult) -> None:
         """Called by the transport when a token arrives (default: no-op)."""
 
+    def set_deadline(self, nonce: str, deadline_ts: float) -> None:
+        """Register the request's absolute wall-clock deadline (epoch
+        seconds).  Adapters that serialize frames stamp it into every
+        frame header so downstream hops can shed expired work
+        (dnet_tpu/admission/).  Local adapters need no stamp — the driver
+        itself checks between steps — so the default is a no-op."""
+
     def fail_pending(self, error: str) -> None:
         """Fail every in-flight token wait with `error` (fast-fail on shard
         death — the failure monitor calls this instead of letting requests

@@ -244,6 +244,33 @@ class ResilienceSettings(_EnvGroup):
 
 
 @dataclass
+class AdmissionSettings(_EnvGroup):
+    """Overload survival (dnet_tpu/admission/): bounded admission, load
+    shedding, end-to-end deadlines, graceful drain.
+
+    The wait queue holds at most ``ADMIT_QUEUE_DEPTH`` requests beyond the
+    executing set (``DNET_API_MAX_CONCURRENT_REQUESTS``); the rest shed
+    immediately with 429 + ``Retry-After`` derived from the observed
+    service rate.  ``REQUEST_DEADLINE_S`` (per-request ``deadline_s``
+    overrides it) rides activation frame headers so shards drop expired
+    frames at dequeue.  On SIGTERM the server drains: 503 for new work,
+    in-flight requests bounded by ``DRAIN_DEADLINE_S``.
+    """
+
+    env_prefix = "DNET_"
+    # waiting requests beyond the executing set; 0 = shed everything that
+    # cannot start immediately
+    admit_queue_depth: int = 32
+    # longest a request may wait for a slot before shedding with 429
+    admit_queue_timeout_s: float = 10.0
+    # default end-to-end deadline; 0 disables (per-request `deadline_s`
+    # still applies when set)
+    request_deadline_s: float = 0.0
+    # how long SIGTERM waits for in-flight requests before tearing down
+    drain_deadline_s: float = 30.0
+
+
+@dataclass
 class ChaosSettings(_EnvGroup):
     """Deterministic fault injection (dnet_tpu/resilience/chaos.py).
 
@@ -386,6 +413,7 @@ class Settings:
     compute: ComputeSettings = field(default_factory=ComputeSettings.from_env)
     transport: TransportSettings = field(default_factory=TransportSettings.from_env)
     resilience: ResilienceSettings = field(default_factory=ResilienceSettings.from_env)
+    admission: AdmissionSettings = field(default_factory=AdmissionSettings.from_env)
     chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
     grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
     api: ApiSettings = field(default_factory=ApiSettings.from_env)
@@ -401,6 +429,7 @@ for _cls in (
     ComputeSettings,
     TransportSettings,
     ResilienceSettings,
+    AdmissionSettings,
     ChaosSettings,
     GrpcSettings,
     ApiSettings,

@@ -99,6 +99,11 @@ class ActivationMessage:
     # only the SUFFIX tokens at pos = the snapshot length).
     prefix_store: str = ""
     prefix_hit: str = ""
+    # end-to-end request deadline (epoch seconds, 0 = none): stamped by the
+    # API's admission layer, rides every hop so ShardRuntime can drop an
+    # expired frame at dequeue instead of burning compute on work nobody is
+    # waiting for (dnet_tpu/admission/)
+    deadline: float = 0.0
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0

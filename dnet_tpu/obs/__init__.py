@@ -179,6 +179,55 @@ def _register_core(reg: MetricsRegistry) -> None:
         "dnet_resume_replay_tokens_total",
         "Tokens (prompt + generated) replayed by request-resume prefills",
     )
+    # admission / overload survival (dnet_tpu/admission/): bounded queue,
+    # load shedding, end-to-end deadlines, drain.  Reason/stage label sets
+    # are DECLARED in admission/reasons.py and cross-checked both ways by
+    # the metrics lint (pass 6).
+    reg.gauge(
+        "dnet_admit_queue_depth",
+        "Requests currently waiting in the bounded admission queue",
+    )
+    reg.gauge(
+        "dnet_admit_inflight",
+        "Requests currently holding an admission slot (executing)",
+    )
+    reg.counter(
+        "dnet_admit_admitted_total",
+        "Requests granted an admission slot",
+    )
+    reg.histogram(
+        "dnet_admit_wait_ms",
+        "Admission-queue wait before a slot was granted (ms)",
+    )
+    from dnet_tpu.admission.reasons import DEADLINE_STAGES, REJECT_REASONS
+
+    rejected = reg.counter(
+        "dnet_admit_rejected_total",
+        "Requests shed at admission (reason per admission/reasons.py)",
+        labelnames=("reason",),
+    )
+    for reason in REJECT_REASONS:
+        rejected.labels(reason=reason)  # pre-touch: the lint checks these
+    exceeded = reg.counter(
+        "dnet_deadline_exceeded_total",
+        "End-to-end request deadlines found expired, by pipeline stage",
+        labelnames=("stage",),
+    )
+    for stage in DEADLINE_STAGES:
+        exceeded.labels(stage=stage)  # pre-touch: the lint checks these
+    reg.counter(
+        "dnet_cancel_propagated_total",
+        "Client disconnects fanned out as cancel + reset_cache to the ring",
+    )
+    reg.gauge(
+        "dnet_drain_state",
+        "1 while the server is draining for shutdown (503 for new work)",
+    )
+    reg.counter(
+        "dnet_shard_outq_dropped_total",
+        "Shard output-queue frames dropped on overflow (error surfaced "
+        "upstream in their place)",
+    )
     from dnet_tpu.resilience.chaos import INJECTION_POINTS
 
     chaos_fam = reg.counter(

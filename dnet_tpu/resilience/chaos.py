@@ -4,7 +4,9 @@ Every retry/resume path this subsystem ships is exercised by reproducible
 tests rather than by killing processes and hoping: named injection points
 are wired into the transport send (``send_activation``), the shard->API
 token callback (``token_cb``), the failure monitor's probe
-(``health_check``) and the shard compute thread (``shard_compute``), and a
+(``health_check``), the shard compute thread (``shard_compute``), the
+admission controller (``admit`` — a delay here reproduces overload
+deterministically), and a
 spec string — ``DNET_CHAOS="shard_compute:error_at:5,
 send_activation:error:0.1,token_cb:delay:50ms"`` — schedules faults at
 them.  The schedule is a pure function of the seed and each point's call
@@ -46,6 +48,9 @@ INJECTION_POINTS: Tuple[str, ...] = (
     "token_cb",         # shard -> API token callback (RingAdapter._cb_send)
     "health_check",     # RingFailureMonitor's per-shard probe
     "shard_compute",    # ShardRuntime compute thread, before process()
+    "admit",            # AdmissionController.acquire, before any check —
+                        # a delay here backs the bounded queue up exactly
+                        # like a slow burst (deterministic overload tests)
 )
 
 _KINDS = ("error", "error_at", "delay")

@@ -188,6 +188,7 @@ class RingAdapter:
             lanes=list(msg.lanes),
             prefix_store=msg.prefix_store,
             prefix_hit=msg.prefix_hit,
+            deadline=msg.deadline,
         )
         await streams.send(msg.nonce, frame)
         # the tx leg of this hop's dequeue -> compute -> tx trace triple
@@ -340,6 +341,7 @@ class RingAdapter:
             committed=list(msg.committed),
             t_sent=time.time(),
             t_sent_mono=time.perf_counter(),
+            deadline=msg.deadline,
         )
         streams = self._ensure_next()
         await streams.send(msg.nonce, frame)

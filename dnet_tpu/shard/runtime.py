@@ -15,12 +15,43 @@ import time
 from typing import Optional
 
 from dnet_tpu.core.types import ActivationMessage
-from dnet_tpu.obs import get_recorder
+from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.resilience import chaos
 from dnet_tpu.shard.compute import ShardCompute
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
+
+_OUTQ_DROPPED = metric("dnet_shard_outq_dropped_total")
+_DEADLINE_EXCEEDED = metric("dnet_deadline_exceeded_total")
+
+
+def _error_final(
+    msg: ActivationMessage, error: str, members: Optional[list] = None
+) -> ActivationMessage:
+    """Payload-free error final for `msg` — the ONE shape every failure
+    path emits upstream (compute failure, deadline drop, outq overflow).
+    `members` ({"nonce", "seq"} dicts) fails each batch-frame member
+    individually; without it the frame's own nonce carries the error."""
+    out = ActivationMessage(
+        nonce=msg.nonce, layer_id=msg.layer_id, seq=msg.seq,
+        dtype="error", shape=(), pos=msg.pos,
+        callback_url=msg.callback_url, is_final=True,
+    )
+    if members:
+        out.lane_finals = [
+            {
+                "nonce": m["nonce"],
+                "step": int(m["seq"]),
+                "token_id": -1,
+                "error": error,
+            }
+            for m in members
+        ]
+    else:
+        out.token_id = -1
+        out.error = error
+    return out
 
 
 class ShardRuntime:
@@ -35,6 +66,9 @@ class ShardRuntime:
         self._stop = threading.Event()
         self._model_lock = threading.Lock()
         self._sweeper_task = None
+        # awaited puts of overflow-replacement error finals (_put_out):
+        # held so the tasks aren't GC'd mid-flight
+        self._pending_errs: set = set()
 
     # ---- lifecycle ------------------------------------------------------
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
@@ -152,6 +186,14 @@ class ShardRuntime:
             if compute is None:
                 log.warning("dropping frame for %s: no model loaded", msg.nonce)
                 continue
+            if msg.deadline and time.time() >= msg.deadline:
+                # the request's end-to-end deadline expired while this frame
+                # sat in the ingress queue: nobody is waiting for the result,
+                # so drop it BEFORE spending compute.  A tiny error final
+                # still flows upstream so the driver fails fast instead of
+                # burning its await timeout on a token that will never come.
+                self._drop_expired(msg)
+                continue
             try:
                 # per-hop trace spans, keyed by the request id (== nonce):
                 # dequeue (ingress -> compute thread pickup, the queue
@@ -171,6 +213,9 @@ class ShardRuntime:
                 # path a real compute failure takes (error final -> driver)
                 chaos.inject("shard_compute")
                 out = compute.process(msg)
+                # the deadline rides every downstream hop (compute builds
+                # fresh messages; stamping here covers all of them)
+                out.deadline = msg.deadline
                 rec.span(
                     msg.nonce, "shard_compute",
                     (time.perf_counter() - t_deq) * 1000.0,
@@ -179,46 +224,27 @@ class ShardRuntime:
                 self._emit(out)
             except Exception as exc:
                 log.exception("compute failed for nonce %s", msg.nonce)
-                if msg.lanes:
-                    # a batch frame's carrier nonce has no future API-side:
-                    # fail every MEMBER so their drivers surface the error
-                    # instead of blocking the full request timeout
-                    self._emit(
-                        ActivationMessage(
-                            nonce=msg.nonce,
-                            layer_id=msg.layer_id,
-                            seq=msg.seq,
-                            dtype="error",
-                            shape=(),
-                            pos=msg.pos,
-                            callback_url=msg.callback_url,
-                            is_final=True,
-                            lane_finals=[
-                                {
-                                    "nonce": lane["nonce"],
-                                    "step": int(lane["seq"]),
-                                    "token_id": -1,
-                                    "error": str(exc),
-                                }
-                                for lane in msg.lanes
-                            ],
-                        )
-                    )
-                    continue
-                self._emit(
-                    ActivationMessage(
-                        nonce=msg.nonce,
-                        layer_id=msg.layer_id,
-                        seq=msg.seq,
-                        dtype="error",
-                        shape=(),
-                        pos=msg.pos,
-                        callback_url=msg.callback_url,
-                        is_final=True,
-                        token_id=-1,
-                        error=str(exc),
-                    )
-                )
+                # a batch frame's carrier nonce has no future API-side:
+                # fail every MEMBER so their drivers surface the error
+                # instead of blocking the full request timeout
+                self._emit(_error_final(msg, str(exc), msg.lanes))
+
+    def _drop_expired(self, msg: ActivationMessage) -> None:
+        """Shed one deadline-expired frame at dequeue: zero compute spent,
+        counted per stage, and an error final surfaced upstream (batch
+        frames fail every member so each driver sees it)."""
+        _DEADLINE_EXCEEDED.labels(stage="shard_dequeue").inc()
+        get_recorder().span(
+            msg.nonce, "deadline_drop", 0.0, seq=msg.seq,
+            deadline=msg.deadline,
+        )
+        log.warning(
+            "dropping expired frame for %s seq=%d (deadline %.3f past)",
+            msg.nonce, msg.seq, time.time() - msg.deadline,
+        )
+        self._emit(
+            _error_final(msg, "deadline exceeded at shard dequeue", msg.lanes)
+        )
 
     def _emit(self, out: ActivationMessage) -> None:
         if self._loop is None or self.out_q is None:
@@ -230,7 +256,29 @@ class ShardRuntime:
         try:
             self.out_q.put_nowait(out)
         except asyncio.QueueFull:
-            log.error("output queue full; dropping frame for %s", out.nonce)
+            # never lose the token silently: count the drop and surface a
+            # payload-free error final in its place.  The replacement is
+            # enqueued through an awaited put (runs when the egress worker
+            # frees a slot), so the driver gets a prompt, explicit failure
+            # instead of hanging its full request timeout on a frame that
+            # evaporated here.
+            _OUTQ_DROPPED.inc()
+            log.error(
+                "output queue full; dropping frame for %s seq=%d "
+                "(error surfaced upstream)", out.nonce, out.seq,
+            )
+            # a dropped batch frame must fail every member driver (a
+            # dropped lane-finals message names its members by `step`)
+            members = out.lanes or [
+                {"nonce": f["nonce"], "seq": f["step"]}
+                for f in (out.lane_finals or [])
+            ]
+            err = _error_final(
+                out, "shard output queue overflowed; frame dropped", members
+            )
+            task = asyncio.ensure_future(self.out_q.put(err))
+            self._pending_errs.add(task)
+            task.add_done_callback(self._pending_errs.discard)
 
     # ---- maintenance ------------------------------------------------------
     async def sweeper(self, interval_s: float = 30.0) -> None:

@@ -82,6 +82,11 @@ class ActivationFrame:
     # ring prefix caching: store/seed keys on prompt frames (core/types.py)
     prefix_store: str = ""
     prefix_hit: str = ""
+    # end-to-end request deadline (sender's wall clock, epoch seconds;
+    # 0 = none).  Receivers compare against their OWN wall clock — the
+    # error is cross-host NTP skew, negligible against any sane deadline.
+    # Shards drop expired frames at compute-queue dequeue.
+    deadline: float = 0.0
 
     def to_bytes(self) -> bytes:
         d = asdict(self)
@@ -112,6 +117,7 @@ class ActivationFrame:
             lanes=list(self.lanes),
             prefix_store=self.prefix_store,
             prefix_hit=self.prefix_hit,
+            deadline=self.deadline,
         )
 
 

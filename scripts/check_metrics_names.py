@@ -115,6 +115,18 @@ _REQUIRED_FAMILIES = (
     "dnet_request_resumed_total",
     "dnet_resume_replay_tokens_total",
     "dnet_chaos_injected_total",
+    # admission / overload survival (dnet_tpu/admission/) — the shed-rate
+    # alert, drain runbook, and the label cross-check (pass 6) depend on
+    # these
+    "dnet_admit_queue_depth",
+    "dnet_admit_inflight",
+    "dnet_admit_admitted_total",
+    "dnet_admit_wait_ms",
+    "dnet_admit_rejected_total",
+    "dnet_deadline_exceeded_total",
+    "dnet_cancel_propagated_total",
+    "dnet_drain_state",
+    "dnet_shard_outq_dropped_total",
 )
 
 
@@ -247,6 +259,50 @@ def check_chaos_points(errors: list) -> int:
     return n
 
 
+def _cross_check_labels(
+    errors: list, text: str, family: str, label: str, declared, where: str
+) -> int:
+    """Exposed `family{label=...}` series must match `declared` EXACTLY in
+    both directions: every declared value pre-touched, no stray label."""
+    import re
+
+    n = 0
+    for value in declared:
+        n += 1
+        if f'{family}{{{label}="{value}"}}' not in text:
+            errors.append(
+                f"admission: {where} value {value!r} has no {family} "
+                f"series (pre-touch it in dnet_tpu.obs._register_core)"
+            )
+    for m in re.finditer(rf'{family}\{{{label}="([^"]+)"\}}', text):
+        if m.group(1) not in declared:
+            errors.append(
+                f"admission: exposed {family} {label} label "
+                f"{m.group(1)!r} is not declared in {where}"
+            )
+    return n
+
+
+def check_admission_labels(errors: list) -> int:
+    """Pass 6: the admission surface's labeled families must agree with
+    the declared enums (dnet_tpu/admission/reasons.py) both ways — a new
+    reject reason or deadline stage cannot ship without its series, and a
+    renamed one cannot strand a stale label on dashboards."""
+    from dnet_tpu.admission.reasons import DEADLINE_STAGES, REJECT_REASONS
+    from dnet_tpu.obs import get_registry
+
+    text = get_registry().expose()
+    n = _cross_check_labels(
+        errors, text, "dnet_admit_rejected_total", "reason",
+        REJECT_REASONS, "admission.reasons.REJECT_REASONS",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_deadline_exceeded_total", "stage",
+        DEADLINE_STAGES, "admission.reasons.DEADLINE_STAGES",
+    )
+    return n
+
+
 def main() -> int:
     errors: list[str] = []
     n_reg = check_registry(errors)
@@ -254,13 +310,15 @@ def main() -> int:
     n_fed = check_federation(errors)
     n_pool = check_paged_conservation(errors)
     n_chaos = check_chaos_points(errors)
+    n_admit = check_admission_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
         return 1
     print(f"ok: {n_reg} registered families, {n_src} source-literal "
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
-          f"audits, {n_chaos} chaos points, all conform")
+          f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
+          f"all conform")
     return 0
 
 
