@@ -12,6 +12,7 @@ from typing import List, Optional  # noqa: F401
 import httpx
 
 from dnet_tpu.core.types import DeviceInfo, TopologyInfo
+from dnet_tpu.membership import EpochClock, set_epoch_gauge
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
@@ -23,6 +24,37 @@ class ClusterManager:
         self.current_topology: Optional[TopologyInfo] = None
         # instance -> measured/predicted stage-time ratio (calibration loop)
         self.stage_ratios: dict = {}
+        # membership epoch mint (dnet_tpu/membership/): every INSTALLED
+        # topology gets a strictly larger epoch — the fencing token the
+        # load fan-out pins on each shard
+        self.epoch_clock = EpochClock()
+
+    @property
+    def epoch(self) -> int:
+        """Epoch of the currently installed topology (0 = none)."""
+        topo = self.current_topology
+        return topo.epoch if topo is not None else 0
+
+    def install_topology(self, topo: TopologyInfo) -> TopologyInfo:
+        """Mint a fresh epoch for `topo` and make it current.  THE way a
+        solved/manual topology becomes active — direct assignment to
+        `current_topology` skips the mint and leaves the ring unfenced
+        (tests only)."""
+        self.epoch_clock.observe(topo.epoch)
+        topo.epoch = self.epoch_clock.mint()
+        self.current_topology = topo
+        log.info(
+            "topology installed: epoch %d over %d shard(s)",
+            topo.epoch, len(topo.assignments),
+        )
+        return topo
+
+    def restore_topology(self, topo: Optional[TopologyInfo]) -> None:
+        """Roll back to a previously installed topology (failed reload):
+        its already-minted epoch becomes current again — the aborted
+        epoch is burned, never reused."""
+        self.current_topology = topo
+        set_epoch_gauge(topo.epoch if topo is not None else 0)
 
     async def scan_devices(self) -> List[DeviceInfo]:
         # manager (API) nodes are not compute shards

@@ -344,8 +344,24 @@ class ApiHTTPServer:
             req = LoadModelRequest.model_validate(body)
         except (json.JSONDecodeError, ValidationError) as exc:
             return _json_error(400, f"invalid request: {exc}")
+        kwargs = {}
+        if req.delta:
+            # `delta` only reaches managers that speak it (the ring
+            # manager); the single-process manager has no fan-out to diff
+            import inspect
+
+            params = inspect.signature(
+                self.model_manager.load_model
+            ).parameters
+            if "delta" not in params:
+                return _json_error(
+                    400, "delta reload is only available in ring mode"
+                )
+            kwargs["delta"] = True
         try:
-            dt = await self.model_manager.load_model(req.model, max_seq=req.max_seq_len)
+            dt = await self.model_manager.load_model(
+                req.model, max_seq=req.max_seq_len, **kwargs
+            )
         except FileNotFoundError as exc:
             return _json_error(404, str(exc), "model_not_found")
         except Exception as exc:
@@ -409,13 +425,16 @@ class ApiHTTPServer:
         except ValueError as exc:
             return _json_error(400, str(exc))
         topo.model = req.model
-        self.cluster_manager.current_topology = topo
+        # install (not assign): minting the membership epoch here is what
+        # arms the zombie fence for the upcoming load fan-out
+        self.cluster_manager.install_topology(topo)
         return web.json_response(
             {
                 "status": "ok",
                 "topology": {
                     "model": topo.model,
                     "num_layers": topo.num_layers,
+                    "epoch": topo.epoch,
                     "solution": topo.solution,
                     "assignments": [
                         {
@@ -467,13 +486,14 @@ class ApiHTTPServer:
             )
         except ValueError as exc:
             return _json_error(400, str(exc))
-        self.cluster_manager.current_topology = topo
+        self.cluster_manager.install_topology(topo)
         return web.json_response(
             {
                 "status": "ok",
                 "topology": {
                     "model": topo.model,
                     "num_layers": topo.num_layers,
+                    "epoch": topo.epoch,
                     "assignments": [
                         {
                             "instance": a.instance,
@@ -532,6 +552,7 @@ class ApiHTTPServer:
                     "model": topo.model,
                     "num_layers": topo.num_layers,
                     "kv_bits": topo.kv_bits,
+                    "epoch": topo.epoch,
                     "assignments": [
                         {
                             "instance": a.instance,
@@ -574,7 +595,19 @@ class ApiHTTPServer:
         from dnet_tpu.obs import get_slo_tracker
 
         body = HealthResponse(model=self.model_manager.current_model_id).model_dump()
+        # membership view: the installed topology's epoch and the fenced-out
+        # (quarantined, still-probed) shards — a degraded-membership ring is
+        # visible here and through the federation scrape at a glance
+        if self.cluster_manager is not None:
+            body["epoch"] = getattr(self.cluster_manager, "epoch", 0)
         monitor = self.inference.failure_monitor
+        quarantine = getattr(monitor, "quarantine", None)
+        if quarantine is not None:
+            # quarantined shards don't degrade `status` — the re-solved
+            # ring serves fine, just below full capacity — but operators
+            # (and the rejoin runbook) see exactly who is out and for how
+            # long they've probed green
+            body["quarantine"] = quarantine.snapshot()
         if monitor is not None and monitor.health:
             body["shards"] = monitor.snapshot()
             if monitor.degraded:
@@ -596,6 +629,13 @@ class ApiHTTPServer:
         }
         if admission.draining:
             body["status"] = "draining"
+            # the drain snapshot names the membership state too: a load
+            # balancer pulling this node out should know whether the rest
+            # of the ring it routes to is at full membership
+            body["admission"]["epoch"] = body.get("epoch", 0)
+            body["admission"]["quarantine"] = list(
+                body.get("quarantine") or ()
+            )
         return web.json_response(body)
 
     async def metrics(self, request: web.Request) -> web.Response:

@@ -17,6 +17,7 @@ import numpy as np
 from dnet_tpu.admission.controller import deadline_expired
 from dnet_tpu.api.strategies import ApiAdapterBase, _TokenFutures
 from dnet_tpu.core.types import DecodingParams, TokenResult
+from dnet_tpu.membership import epoch as epoch_fence
 from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.transport.protocol import ActivationFrame, Empty, TokenPayload
 from dnet_tpu.transport.stream_manager import StreamManager
@@ -46,11 +47,17 @@ class RingApiAdapter(ApiAdapterBase):
         auto_steps: int = 0,
         lanes: int = 1,
         prefix_cache: int = 0,
+        epoch: int = 0,
     ) -> None:
         from dnet_tpu.transport.grpc_transport import RingClient
 
         self.head_addr = head_addr
         self.callback_url = callback_url
+        # topology epoch this adapter serves (dnet_tpu/membership/):
+        # stamped into every frame header and reset RPC; token callbacks
+        # minted under any OTHER nonzero epoch are zombies and are dropped
+        # (counted) in resolve_token.  0 = unfenced (single-process tests).
+        self._epoch = int(epoch)
         self.shard_addrs = shard_grpc_addrs or [head_addr]
         self._make_client = ring_client_factory or (lambda addr: RingClient(addr))
         self._head_client = None
@@ -110,7 +117,9 @@ class RingApiAdapter(ApiAdapterBase):
     async def start(self) -> None:
         self._head_client = self._make_client(self.head_addr)
         self._streams = StreamManager(
-            self._head_client.open_stream, idle_timeout_s=self._stream_idle_s
+            self._head_client.open_stream,
+            idle_timeout_s=self._stream_idle_s,
+            on_nack=self._on_stream_nack,
         )
         # persistent control channels to every shard (reset fan-out per
         # request must not pay N channel handshakes)
@@ -136,6 +145,26 @@ class RingApiAdapter(ApiAdapterBase):
     def max_seq(self) -> Optional[int]:
         return self._max_seq
 
+    def _on_stream_nack(self, ack) -> None:
+        """A shard REFUSED a frame outright (epoch fence): fail the
+        awaiting step now instead of letting the driver burn its full
+        token timeout — the refusal is definitive, the token can never
+        come.  This is how an adapter that turned zombie mid-request
+        (its topology re-solved underneath) fails fast so the resume
+        controller can replay on the NEW adapter.  Batch carrier frames
+        have no future of their own and other NACK kinds (relay hiccups)
+        keep their existing retry semantics."""
+        if ack.nonce == self.LANES_NONCE:
+            return
+        if not str(ack.message).startswith("stale epoch"):
+            return
+        self.resolve_token(
+            TokenResult(
+                nonce=ack.nonce, token_id=-1, step=ack.seq,
+                error=f"frame rejected: {ack.message}",
+            )
+        )
+
     def set_deadline(self, nonce: str, deadline_ts: float) -> None:
         if deadline_ts > 0:
             self._deadlines[nonce] = float(deadline_ts)
@@ -160,7 +189,7 @@ class RingApiAdapter(ApiAdapterBase):
 
         async def _reset(addr: str, client) -> None:
             try:
-                await client.reset_cache(nonce)
+                await client.reset_cache(nonce, epoch=self._epoch)
             except Exception as exc:
                 log.warning("reset_cache on %s failed: %s", addr, exc)
 
@@ -267,6 +296,7 @@ class RingApiAdapter(ApiAdapterBase):
             prefix_hit=prefix_hit,
             prefix_store=prefix_store,
             deadline=self._deadlines.get(nonce, 0.0),
+            epoch=self._epoch,
         )
         if auto:
             self._granted[nonce] = step + auto
@@ -367,6 +397,7 @@ class RingApiAdapter(ApiAdapterBase):
                     {k: e[k] for k in ("nonce", "seq", "pos", "decoding")}
                     for e in batch
                 ],
+                epoch=self._epoch,
             )
             self._batch_seq += 1
             log.info(
@@ -425,6 +456,17 @@ class RingApiAdapter(ApiAdapterBase):
         return await self._futures.wait(nonce, step, timeout)
 
     def resolve_token(self, result: TokenResult) -> None:
+        # Zombie fence (dnet_tpu/membership/): a token minted under a dead
+        # topology epoch — a fenced-out shard finishing in-flight compute,
+        # a partitioned "dead" shard coming back — must never resolve a
+        # live future or reach an SSE stream.  Counted, then dropped.
+        if epoch_fence.is_stale(self._epoch, result.epoch):
+            err = epoch_fence.reject("token_cb", self._epoch, result.epoch)
+            log.warning(
+                "zombie token for %s step %d dropped: %s",
+                result.nonce, result.step, err,
+            )
+            return
         sent = self._sent_at.pop((result.nonce, result.step), None)
         if sent is not None:
             dt = time.monotonic() - sent
@@ -480,7 +522,10 @@ class RingApiAdapter(ApiAdapterBase):
         try:
             token_ids = state["token_ids"]
             await asyncio.gather(
-                *(c.reset_cache(nonce) for c in self._shard_clients.values()),
+                *(
+                    c.reset_cache(nonce, epoch=self._epoch)
+                    for c in self._shard_clients.values()
+                ),
                 return_exceptions=True,
             )
             pos = self._pos_for(nonce, 0, len(token_ids))

@@ -14,6 +14,7 @@ import httpx
 
 from dnet_tpu.api.model_manager import resolve_model_dir
 from dnet_tpu.core.types import DeviceInfo, LayerAssignment, TopologyInfo
+from dnet_tpu.membership import body_signature, split_delta
 from dnet_tpu.utils.logger import get_logger
 from dnet_tpu.utils.tokenizer import load_tokenizer
 
@@ -94,6 +95,7 @@ class RingModelManager:
         param_dtype: str = "bfloat16",
         request_timeout_s: float = 600.0,
         weight_quant_bits: int = 0,
+        ring_client_factory=None,
     ) -> None:
         self.inference = inference
         self.cluster = cluster_manager
@@ -103,6 +105,16 @@ class RingModelManager:
         self.param_dtype = param_dtype
         self.request_timeout_s = request_timeout_s
         self.weight_quant_bits = weight_quant_bits
+        # injectable gRPC channel factory for the adapters this manager
+        # builds (tests/fakes pattern: the whole manager runs over fakes)
+        self._ring_client_factory = ring_client_factory
+        # instance -> signature of the load body last successfully shipped
+        # (dnet_tpu/membership/delta.py).  Entries survive re-solves —
+        # including for quarantined shards — so a rejoin whose parameters
+        # are unchanged rides the delta path too; the shard-side proof in
+        # /update_topology (409 on mismatch) is the safety net for a shard
+        # that restarted and silently lost its weights.
+        self._last_load: dict = {}
 
     @property
     def current_model_id(self) -> Optional[str]:
@@ -111,7 +123,20 @@ class RingModelManager:
     def is_model_available(self, model_id: str) -> bool:
         return resolve_model_dir(model_id, self.models_dir) is not None
 
-    async def load_model(self, model_id: str, max_seq: Optional[int] = None) -> float:
+    async def load_model(
+        self,
+        model_id: str,
+        max_seq: Optional[int] = None,
+        delta: bool = False,
+    ) -> float:
+        """Fan the topology out to every shard.  With ``delta=True``
+        (recovery/rejoin re-solves) shards whose load body is unchanged
+        since their last successful load get a cheap ``/update_topology``
+        (epoch bump + state drop + rewire, weights kept) instead of a full
+        ``/load_model`` — recovery cost shrinks from full-cluster reload to
+        the delta.  A delta update the shard refuses (409: restarted,
+        different model/layers) falls back to the full load for that shard
+        alone."""
         topo = self.cluster.current_topology
         if topo is None:
             raise RuntimeError("no topology; POST /v1/prepare_topology_manual first")
@@ -121,54 +146,86 @@ class RingModelManager:
         t0 = time.perf_counter()
         by_instance = {d.instance: d for d in topo.devices}
         max_seq = max_seq or self.max_seq
+        # remember the resolved value: recovery/rejoin reloads call with
+        # max_seq=None and MUST reproduce the operator's last choice — a
+        # different max_seq_len would change every body (silently turning
+        # the delta reload into a full one) and resize every shard's KV
+        self.max_seq = max_seq
         lanes = self._lanes_for(topo, model_dir)
         spec = 0 if lanes > 1 else self._spec_lookahead_for(topo, model_dir, max_seq)
         prefix = self._prefix_for(topo)
 
+        bodies: dict = {}
+        for a in topo.assignments:
+            nxt = by_instance.get(a.next_instance)
+            bodies[a.instance] = {
+                "model_path": model_id,
+                "layers": a.layers,
+                # the ring is fully wired, tail included: the tail's
+                # next IS the head, which carries k-round mid-frames
+                # AND decode-grant continuations (final tokens still go
+                # to the API callback)
+                "next_node": {"host": nxt.host, "grpc_port": nxt.grpc_port},
+                "window_size": a.window_size,
+                "residency_size": a.residency_size,
+                "kv_bits": topo.kv_bits,
+                "max_seq_len": max_seq,
+                "api_callback_address": f"grpc://{self.api_callback_addr}",
+                "param_dtype": self.param_dtype,
+                "weight_quant_bits": self.weight_quant_bits,
+                # mesh-backed shards: the solve (or manual topology) may
+                # give this ring node a host-local tp/sp mesh; 0 defers
+                # to the shard's own DNET_SHARD_MESH_* defaults.  sp
+                # must divide the LOAD-time max_seq (the solve checked
+                # its own seq_len, which may differ) — drop it here
+                # rather than failing every shard load.
+                "mesh_tp": a.mesh_tp,
+                "mesh_sp": self._check_sp(a, max_seq),
+                # ring speculation: head drafts, tail verifies
+                # (0 when the topology/model can't rewind — see
+                # _spec_lookahead_for)
+                "spec_lookahead": spec,
+                # batched lanes: every shard allocates the same pooled
+                # lane count so coalesced frames serve end to end
+                "lanes": lanes,
+                # ring prefix caching: same snapshot capacity on every
+                # shard (the API index mirrors their LRU sequence)
+                "prefix_cache": prefix,
+                # membership epoch (dnet_tpu/membership/): the shard pins
+                # it and fences frames/RPCs from any other epoch
+                "epoch": topo.epoch,
+            }
+        if delta:
+            changed, unchanged = split_delta(self._last_load, bodies)
+        else:
+            changed, unchanged = dict(bodies), {}
+
         async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
             for a in topo.assignments:
                 dev = by_instance[a.instance]
-                nxt = by_instance.get(a.next_instance)
-                body = {
-                    "model_path": model_id,
-                    "layers": a.layers,
-                    # the ring is fully wired, tail included: the tail's
-                    # next IS the head, which carries k-round mid-frames
-                    # AND decode-grant continuations (final tokens still go
-                    # to the API callback)
-                    "next_node": {"host": nxt.host, "grpc_port": nxt.grpc_port},
-                    "window_size": a.window_size,
-                    "residency_size": a.residency_size,
-                    "kv_bits": topo.kv_bits,
-                    "max_seq_len": max_seq,
-                    "api_callback_address": f"grpc://{self.api_callback_addr}",
-                    "param_dtype": self.param_dtype,
-                    "weight_quant_bits": self.weight_quant_bits,
-                    # mesh-backed shards: the solve (or manual topology) may
-                    # give this ring node a host-local tp/sp mesh; 0 defers
-                    # to the shard's own DNET_SHARD_MESH_* defaults.  sp
-                    # must divide the LOAD-time max_seq (the solve checked
-                    # its own seq_len, which may differ) — drop it here
-                    # rather than failing every shard load.
-                    "mesh_tp": a.mesh_tp,
-                    "mesh_sp": self._check_sp(a, max_seq),
-                    # ring speculation: head drafts, tail verifies
-                    # (0 when the topology/model can't rewind — see
-                    # _spec_lookahead_for)
-                    "spec_lookahead": spec,
-                    # batched lanes: every shard allocates the same pooled
-                    # lane count so coalesced frames serve end to end
-                    "lanes": lanes,
-                    # ring prefix caching: same snapshot capacity on every
-                    # shard (the API index mirrors their LRU sequence)
-                    "prefix_cache": prefix,
-                }
+                body = bodies[a.instance]
+                if a.instance in unchanged:
+                    if await self._update_topology(client, dev, body):
+                        # stored signature already equals this body's (that
+                        # is what `unchanged` means) — nothing to re-store
+                        continue
+                    # the shard could not prove it still holds the
+                    # weights (restart while quarantined, different
+                    # model): full load for this shard alone
+                    log.warning(
+                        "delta update of %s refused; falling back to full "
+                        "load", a.instance,
+                    )
                 url = f"http://{dev.host}:{dev.http_port}/load_model"
                 r = await client.post(url, json=body)
                 if r.status_code != 200:
+                    # a half-shipped topology must not leave stale
+                    # signatures claiming this shard is loadable by delta
+                    self._last_load.pop(a.instance, None)
                     raise RuntimeError(
                         f"shard {a.instance} load failed ({r.status_code}): {r.text}"
                     )
+                self._last_load[a.instance] = body_signature(body)
 
         # tokenizer API-side (reference model_manager.py:169-182)
         tokenizer = load_tokenizer(model_dir)
@@ -185,10 +242,12 @@ class RingModelManager:
                 f"{by_instance[a.instance].host}:{by_instance[a.instance].grpc_port}"
                 for a in topo.assignments
             ],
+            ring_client_factory=self._ring_client_factory,
             max_seq_len=max_seq,
             auto_steps=get_settings().api.ring_auto_steps,
             lanes=max(lanes, 1),
             prefix_cache=prefix,
+            epoch=topo.epoch,
         )
         await adapter.start()
         self.inference.adapter = adapter
@@ -200,8 +259,39 @@ class RingModelManager:
         if old is not None:
             await old.shutdown()
         dt = time.perf_counter() - t0
-        log.info("ring model %s loaded across %d shard(s) in %.1fs", model_id, len(topo.assignments), dt)
+        log.info(
+            "ring model %s loaded across %d shard(s) in %.1fs "
+            "(epoch %d, %d full load(s), %d delta update(s))",
+            model_id, len(topo.assignments), dt, topo.epoch,
+            len(changed), len(unchanged),
+        )
         return dt
+
+    async def _update_topology(self, client, dev, body) -> bool:
+        """One shard's cheap delta half: POST /update_topology.  True on
+        success; False (any refusal or transport failure) sends the caller
+        down the full-load path for that shard."""
+        url = f"http://{dev.host}:{dev.http_port}/update_topology"
+        try:
+            r = await client.post(
+                url,
+                json={
+                    "model_path": body["model_path"],
+                    "layers": body["layers"],
+                    "epoch": body["epoch"],
+                    "next_node": body["next_node"],
+                },
+            )
+        except httpx.HTTPError as exc:
+            log.warning("update_topology on %s failed: %s", dev.instance, exc)
+            return False
+        if r.status_code != 200:
+            log.warning(
+                "update_topology on %s answered %d: %s",
+                dev.instance, r.status_code, r.text,
+            )
+            return False
+        return True
 
     @staticmethod
     def _single_round_resident(topo) -> bool:
@@ -317,6 +407,7 @@ class RingModelManager:
 
     async def unload_model(self) -> None:
         topo = self.cluster.current_topology
+        self._last_load.clear()  # unloaded shards hold nothing to delta from
         self.inference.model_id = None
         self.inference.tokenizer = None
         adapter = self.inference.adapter

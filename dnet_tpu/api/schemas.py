@@ -363,6 +363,11 @@ class LoadModelRequest(BaseModel):
     model: str
     kv_bits: int = 0
     max_seq_len: Optional[int] = None
+    # ring mode: reuse weights on shards whose load body is unchanged —
+    # only the epoch bumps and per-request state drops (delta reload,
+    # dnet_tpu/membership/).  Recovery/rejoin always use the delta path;
+    # this opts an operator-driven reload into it too.
+    delta: bool = False
 
 
 class LoadModelResponse(BaseModel):

@@ -271,6 +271,30 @@ class AdmissionSettings(_EnvGroup):
 
 
 @dataclass
+class MembershipSettings(_EnvGroup):
+    """Elastic ring membership (dnet_tpu/membership/): topology epochs,
+    quarantine, and automatic shard rejoin.
+
+    With auto-recovery on, a permanently lost shard is fenced out by an
+    epoch-bumping re-solve and moves to a QUARANTINE list that keeps
+    health-probing it.  ``DNET_REJOIN=1`` lets a quarantined shard that
+    probes green for ``REJOIN_STABLE_S`` seconds trigger a re-profile +
+    re-solve through the delta-reload path, restoring full capacity with
+    no operator action.  ``RECOVERY_MAX_ROUNDS`` bounds the convergence
+    loop when further shards die during an in-flight recovery.
+    """
+
+    env_prefix = "DNET_"
+    # automatic rejoin of quarantined shards that probe healthy again
+    rejoin: bool = False
+    # consecutive-green seconds before a quarantined shard may rejoin
+    rejoin_stable_s: float = 15.0
+    # recovery convergence: max re-solve rounds per failure burst (each
+    # round re-checks down_shards() after its reload)
+    recovery_max_rounds: int = 3
+
+
+@dataclass
 class ChaosSettings(_EnvGroup):
     """Deterministic fault injection (dnet_tpu/resilience/chaos.py).
 
@@ -414,6 +438,7 @@ class Settings:
     transport: TransportSettings = field(default_factory=TransportSettings.from_env)
     resilience: ResilienceSettings = field(default_factory=ResilienceSettings.from_env)
     admission: AdmissionSettings = field(default_factory=AdmissionSettings.from_env)
+    membership: MembershipSettings = field(default_factory=MembershipSettings.from_env)
     chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
     grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
     api: ApiSettings = field(default_factory=ApiSettings.from_env)
@@ -430,6 +455,7 @@ for _cls in (
     TransportSettings,
     ResilienceSettings,
     AdmissionSettings,
+    MembershipSettings,
     ChaosSettings,
     GrpcSettings,
     ApiSettings,

@@ -104,6 +104,10 @@ class ActivationMessage:
     # expired frame at dequeue instead of burning compute on work nobody is
     # waiting for (dnet_tpu/admission/)
     deadline: float = 0.0
+    # topology epoch the frame entered under (dnet_tpu/membership/):
+    # carried across hops and stamped into the final token callback so the
+    # epoch fence holds end to end.  0 = unfenced.
+    epoch: int = 0
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0
@@ -131,6 +135,9 @@ class TokenResult:
     top_logprobs: Optional[List[tuple]] = None  # [(token_id, logprob), ...]
     step: int = 0
     error: str = ""
+    # topology epoch the emitting shard held (dnet_tpu/membership/);
+    # 0 = unfenced.  The API drops results minted under a dead epoch.
+    epoch: int = 0
 
 
 @dataclass
@@ -205,6 +212,9 @@ class TopologyInfo:
     devices: List[DeviceInfo]
     assignments: List[LayerAssignment]
     solution: dict = field(default_factory=dict)  # solver diagnostics (k, w, n, obj)
+    # membership epoch minted when the API installed this topology
+    # (dnet_tpu/membership/epoch.py); 0 = never installed (manual tests)
+    epoch: int = 0
 
     def assignment_for(self, instance: str) -> Optional[LayerAssignment]:
         for a in self.assignments:

@@ -164,7 +164,7 @@ def _register_core(reg: MetricsRegistry) -> None:
         labelnames=("method",),
     )
     for m in ("send_activation", "send_token", "reset_cache",
-              "measure_latency"):
+              "measure_latency", "load_model"):
         retries.labels(method=m)  # pre-touch: expose at 0 from the start
     reg.counter(
         "dnet_stream_reopens_total",
@@ -227,6 +227,41 @@ def _register_core(reg: MetricsRegistry) -> None:
         "dnet_shard_outq_dropped_total",
         "Shard output-queue frames dropped on overflow (error surfaced "
         "upstream in their place)",
+    )
+    # elastic ring membership (dnet_tpu/membership/): epoch fence +
+    # recovery/rejoin accounting.  Kind/outcome label sets are DECLARED in
+    # membership/epoch.py (a leaf module, like admission/reasons.py) and
+    # cross-checked both ways by the metrics lint (pass 7).
+    reg.gauge(
+        "dnet_topology_epoch",
+        "Ring topology epoch this process holds (API: minted; shard: "
+        "pinned at load; 0 = unfenced)",
+    )
+    from dnet_tpu.membership.epoch import RECOVERY_OUTCOMES, STALE_EPOCH_KINDS
+
+    stale = reg.counter(
+        "dnet_stale_epoch_rejected_total",
+        "Messages fenced out for carrying a dead topology epoch "
+        "(kind per membership/epoch.py)",
+        labelnames=("kind",),
+    )
+    for kind in STALE_EPOCH_KINDS:
+        stale.labels(kind=kind)  # pre-touch: the lint checks these
+    recovery = reg.counter(
+        "dnet_recovery_total",
+        "Ring recovery/rejoin rounds by outcome (membership/epoch.py)",
+        labelnames=("outcome",),
+    )
+    for outcome in RECOVERY_OUTCOMES:
+        recovery.labels(outcome=outcome)  # pre-touch: the lint checks these
+    reg.histogram(
+        "dnet_recovery_duration_seconds",
+        "Wall time of one recovery/rejoin round (re-solve + reload)",
+        buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+    )
+    reg.counter(
+        "dnet_shard_rejoins_total",
+        "Quarantined shards re-admitted to the ring without operator action",
     )
     from dnet_tpu.resilience.chaos import INJECTION_POINTS
 

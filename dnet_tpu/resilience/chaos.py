@@ -51,6 +51,12 @@ INJECTION_POINTS: Tuple[str, ...] = (
     "admit",            # AdmissionController.acquire, before any check —
                         # a delay here backs the bounded queue up exactly
                         # like a slow burst (deterministic overload tests)
+    "zombie_frame",     # shard ingress epoch fence (RingAdapter): an
+                        # injected error marks the frame STALE, simulating
+                        # a zombie sender without racing a real partition
+    "rejoin",           # failure monitor's rejoin attempt: an injected
+                        # error aborts the attempt (the shard re-earns its
+                        # stability window), exercising rejoin retry
 )
 
 _KINDS = ("error", "error_at", "delay")

@@ -74,20 +74,29 @@ def policy_for(method: str) -> RetryPolicy:
       and transport retries would silently stretch detection;
     - ``send_token`` gets one extra attempt — a lost token callback
       strands the whole request until its timeout, so the token path is
-      worth one more try than bulk data-plane traffic.
+      worth one more try than bulk data-plane traffic;
+    - ``load_model`` (the failure monitor's recovery reload) backs off on
+      the scale of the operation — a whole-(delta-)cluster reload retried
+      at unary-RPC cadence would hammer shards still tearing down the
+      failed attempt, so its base delay is 20x the unary base.
     """
     from dnet_tpu.config import get_settings
 
     s = get_settings().resilience
     attempts = max(int(s.retry_attempts), 1)
+    base = float(s.retry_base_s)
+    max_delay = float(s.retry_max_s)
     if method == "health_check":
         attempts = 1
     elif method == "send_token":
         attempts += 1
+    elif method == "load_model":
+        base *= 20.0
+        max_delay = max(max_delay, base)
     return RetryPolicy(
         max_attempts=attempts,
-        base_delay_s=float(s.retry_base_s),
-        max_delay_s=float(s.retry_max_s),
+        base_delay_s=base,
+        max_delay_s=max_delay,
     )
 
 
@@ -139,11 +148,16 @@ async def call_with_retry(
     rng: Optional[random.Random] = None,
     sleep: Callable[[float], Awaitable] = asyncio.sleep,
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    retryable: Optional[Callable[[BaseException], bool]] = None,
 ):
     """Run `fn` under the method's retry policy.  Non-retryable errors and
-    the final attempt's error propagate unchanged."""
+    the final attempt's error propagate unchanged.  `retryable` overrides
+    the transient-failure classifier for calls whose failures don't look
+    like transport errors but ARE worth retrying (a recovery reload failing
+    through an HTTP 500 is a cluster-state problem, not a logic bug)."""
     policy = policy or policy_for(method)
     rng = rng or jitter_rng()
+    classify = retryable or is_retryable
     attempt = 0
     while True:
         try:
@@ -151,7 +165,7 @@ async def call_with_retry(
         except asyncio.CancelledError:
             raise
         except Exception as exc:
-            if attempt + 1 >= policy.max_attempts or not is_retryable(exc):
+            if attempt + 1 >= policy.max_attempts or not classify(exc):
                 raise
             _RETRIES.labels(method=method).inc()
             if on_retry is not None:

@@ -17,6 +17,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 from dnet_tpu.core.types import ActivationMessage, TokenResult
+from dnet_tpu.membership import epoch as epoch_fence
 from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.resilience import chaos
 from dnet_tpu.resilience.policy import call_with_retry
@@ -128,6 +129,24 @@ class RingAdapter:
             frame.nonce, "transport_recv", 0.0,
             bytes=n_bytes, seq=frame.seq, t_sent=frame.t_sent,
         )
+        # Topology-epoch fence (dnet_tpu/membership/): a frame minted under
+        # a dead epoch — a zombie sender that was fenced out by a re-solve,
+        # or a partitioned peer replaying old state — is rejected BEFORE it
+        # can reach compute or relay.  The chaos point deterministically
+        # simulates a zombie frame so the rejection path is testable
+        # without racing a real partition.
+        held = self.runtime.epoch
+        stale = epoch_fence.is_stale(held, frame.epoch)
+        try:
+            await chaos.inject_async("zombie_frame")
+        except chaos.ChaosError:
+            stale = True
+        if stale:
+            err = epoch_fence.reject("frame", held, frame.epoch)
+            log.warning(
+                "fenced frame %s seq=%d: %s", frame.nonce, frame.seq, err
+            )
+            return False, str(err)
         compute = self.runtime.compute
         if compute is not None and compute.wants(frame.layer_id):
             key = (frame.nonce, frame.seq, frame.layer_id)
@@ -189,6 +208,7 @@ class RingAdapter:
             prefix_store=msg.prefix_store,
             prefix_hit=msg.prefix_hit,
             deadline=msg.deadline,
+            epoch=msg.epoch,
         )
         await streams.send(msg.nonce, frame)
         # the tx leg of this hop's dequeue -> compute -> tx trace triple
@@ -237,6 +257,7 @@ class RingAdapter:
                             top_ids=list(f.get("top_ids") or []),
                             top_logprobs=list(f.get("top_logprobs") or []),
                             error=f.get("error", ""),
+                            epoch=msg.epoch,
                         ),
                     )
                     for f in msg.lane_finals
@@ -277,6 +298,7 @@ class RingAdapter:
             top_ids=[t for t, _ in (msg.top_logprobs or [])],
             top_logprobs=[lp for _, lp in (msg.top_logprobs or [])],
             error=msg.error,
+            epoch=msg.epoch,
         )
         t0 = time.perf_counter()
         await self._cb_send(client, payload)
@@ -285,7 +307,10 @@ class RingAdapter:
         for step, token_id in msg.extra_finals or ():
             await self._cb_send(
                 client,
-                TokenPayload(nonce=msg.nonce, step=step, token_id=int(token_id)),
+                TokenPayload(
+                    nonce=msg.nonce, step=step, token_id=int(token_id),
+                    epoch=msg.epoch,
+                ),
             )
         # record first, then log the RECORDED value (the [PROFILE] line is
         # now a view over the same measurement the registry aggregates)
@@ -312,7 +337,10 @@ class RingAdapter:
             self._cb_clients[addr] = client
         await self._cb_send(
             client,
-            TokenPayload(nonce=msg.nonce, step=step, token_id=-1, error=error),
+            TokenPayload(
+                nonce=msg.nonce, step=step, token_id=-1, error=error,
+                epoch=msg.epoch,
+            ),
         )
 
     async def _send_continuation(self, msg: ActivationMessage) -> None:
@@ -342,6 +370,7 @@ class RingAdapter:
             t_sent=time.time(),
             t_sent_mono=time.perf_counter(),
             deadline=msg.deadline,
+            epoch=msg.epoch,
         )
         streams = self._ensure_next()
         await streams.send(msg.nonce, frame)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import time
 
+from dnet_tpu.membership import epoch as epoch_fence
 from dnet_tpu.transport.protocol import (
     ActivationFrame,
     Empty,
@@ -50,9 +51,16 @@ class ShardRingServicer:
             model=self.runtime.model_path,
             layers=list(compute.layers) if compute else [],
             queue_depth=self.runtime.queue_depth,
+            epoch=self.runtime.epoch,
         )
 
     async def reset_cache(self, request: ResetCacheRequest, context) -> Empty:
+        # epoch fence: a reset minted under a dead topology (a zombie API
+        # adapter, a partitioned peer) must not clear live-ring sessions.
+        # Epoch 0 is the unfenced admin reset and always passes.
+        held = self.runtime.epoch
+        if epoch_fence.is_stale(held, request.epoch):
+            raise epoch_fence.reject("reset_cache", held, request.epoch)
         await self.adapter.reset_cache(request.nonce)
         return Empty()
 

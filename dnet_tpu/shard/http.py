@@ -55,6 +55,22 @@ class ShardLoadModelRequest(BaseModel):
     # ring prefix caching (shard/compute.py): per-shard KV snapshot count;
     # the API keys every store/hit through the prompt frames
     prefix_cache: int = 0
+    # topology epoch this load pins (dnet_tpu/membership/): the shard
+    # rejects frames/RPCs carrying any other nonzero epoch afterwards
+    epoch: int = 0
+
+
+class UpdateTopologyRequest(BaseModel):
+    """Delta reconfiguration (dnet_tpu/membership/): bump the epoch, drop
+    per-request state, rewire the next pointer — WITHOUT re-reading
+    weights.  The shard verifies it really holds `model_path` + `layers`
+    (a restarted shard holds neither) and answers 409 so the API falls
+    back to a full /load_model."""
+
+    model_path: str
+    layers: List[int]
+    epoch: int = 0
+    next_node: Optional[NextNode] = None
 
 
 class MeasureLatencyRequest(BaseModel):
@@ -73,6 +89,7 @@ class ShardHTTPServer:
             "/v1/debug/timeline/{rid}", self.debug_timeline
         )
         self.app.router.add_post("/load_model", self.load_model)
+        self.app.router.add_post("/update_topology", self.update_topology)
         self.app.router.add_post("/unload_model", self.unload_model)
         self.app.router.add_post("/measure_latency", self.measure_latency)
         self.app.router.add_post("/profile", self.profile)
@@ -133,6 +150,7 @@ class ShardHTTPServer:
                 "model": rt.model_path or None,
                 "layers": list(compute.layers) if compute else [],
                 "queue_depth": rt.queue_depth,
+                "epoch": rt.epoch,
                 **mesh,
             }
         )
@@ -158,6 +176,34 @@ class ShardHTTPServer:
             )
         return web.json_response(
             {"status": "ok", "load_time_s": time.perf_counter() - t0}
+        )
+
+    async def update_topology(self, request: web.Request) -> web.Response:
+        """Delta reload's cheap half: epoch bump + state drop + rewire for
+        a shard whose layer range (and every other load parameter) is
+        unchanged.  409 when this shard cannot prove it holds the expected
+        model/layers — the API then ships a full /load_model instead."""
+        try:
+            req = UpdateTopologyRequest.model_validate(await request.json())
+        except (json.JSONDecodeError, ValidationError) as exc:
+            return web.json_response(
+                {"status": "error", "message": f"invalid request: {exc}"}, status=400
+            )
+        try:
+            await self.shard.update_topology(req)
+        except ValueError as exc:
+            # holds nothing / wrong model / wrong layers: a delta update
+            # would serve garbage — refuse so the caller full-loads
+            return web.json_response(
+                {"status": "error", "message": str(exc)}, status=409
+            )
+        except Exception as exc:
+            log.exception("shard update_topology failed")
+            return web.json_response(
+                {"status": "error", "message": str(exc)}, status=500
+            )
+        return web.json_response(
+            {"status": "ok", "epoch": self.shard.runtime.epoch}
         )
 
     async def unload_model(self, request: web.Request) -> web.Response:

@@ -36,7 +36,7 @@ def _error_final(
     out = ActivationMessage(
         nonce=msg.nonce, layer_id=msg.layer_id, seq=msg.seq,
         dtype="error", shape=(), pos=msg.pos,
-        callback_url=msg.callback_url, is_final=True,
+        callback_url=msg.callback_url, is_final=True, epoch=msg.epoch,
     )
     if members:
         out.lane_finals = [
@@ -59,6 +59,11 @@ class ShardRuntime:
         self.shard_id = shard_id
         self.compute: Optional[ShardCompute] = None
         self.model_path: str = ""
+        # topology epoch pinned at load (dnet_tpu/membership/): the
+        # adapter's ingress fence rejects frames from any other epoch, and
+        # every egress message carries it so the fence holds end to end.
+        # 0 = unfenced (no epoch-aware load yet).
+        self.epoch: int = 0
         self.recv_q: queue.Queue = queue.Queue(maxsize=queue_size)
         self.out_q: Optional[asyncio.Queue] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -109,6 +114,7 @@ class ShardRuntime:
         spec_lookahead: int = 0,
         lanes: int = 0,
         prefix_cache: int = 0,
+        epoch: int = 0,
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
@@ -135,13 +141,23 @@ class ShardRuntime:
                 prefix_cache=prefix_cache,
             )
             self.model_path = str(model_dir)
+            self.set_epoch(epoch)
             log.info(
-                "shard %s loaded layers %s..%s in %.1fs",
+                "shard %s loaded layers %s..%s (epoch %d) in %.1fs",
                 self.shard_id,
                 min(layers),
                 max(layers),
+                self.epoch,
                 time.perf_counter() - t0,
             )
+
+    def set_epoch(self, epoch: int) -> None:
+        """Pin the topology epoch this shard serves under and publish it
+        (dnet_topology_epoch) for the federation scrape."""
+        from dnet_tpu.membership import set_epoch_gauge
+
+        self.epoch = int(epoch)
+        set_epoch_gauge(self.epoch)
 
     def unload_model_core(self) -> None:
         with self._model_lock:
@@ -150,6 +166,7 @@ class ShardRuntime:
                 self.compute.engine.close()
             self.compute = None
             self.model_path = ""
+            self.set_epoch(0)
             import gc
 
             gc.collect()
@@ -160,6 +177,13 @@ class ShardRuntime:
                 self.recv_q.get_nowait()
         except queue.Empty:
             pass
+
+    def drain_ingress(self) -> None:
+        """Discard queued-but-unprocessed frames (delta reconfiguration:
+        frames admitted under the old epoch would otherwise run against
+        freshly-cleared KV and emit old-epoch outputs downstream fences
+        reject anyway — wasted compute, guaranteed-dropped results)."""
+        self._drain_queue()
 
     # ---- data path --------------------------------------------------------
     def submit(self, msg: ActivationMessage, timeout: float = 5.0) -> bool:
@@ -213,9 +237,10 @@ class ShardRuntime:
                 # path a real compute failure takes (error final -> driver)
                 chaos.inject("shard_compute")
                 out = compute.process(msg)
-                # the deadline rides every downstream hop (compute builds
-                # fresh messages; stamping here covers all of them)
+                # the deadline and epoch ride every downstream hop (compute
+                # builds fresh messages; stamping here covers all of them)
                 out.deadline = msg.deadline
+                out.epoch = msg.epoch
                 rec.span(
                     msg.nonce, "shard_compute",
                     (time.perf_counter() - t_deq) * 1000.0,

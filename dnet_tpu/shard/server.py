@@ -65,6 +65,7 @@ class Shard:
                 spec_lookahead=req.spec_lookahead,
                 lanes=req.lanes,
                 prefix_cache=req.prefix_cache,
+                epoch=req.epoch,
                 # engine ignores it unless plan_policy chose a streaming
                 # policy — no second copy of that decision here
                 repack_dir=get_settings().shard.repack_dir,
@@ -72,6 +73,52 @@ class Shard:
         )
         next_addr = f"{req.next_node.host}:{req.next_node.grpc_port}" if req.next_node else ""
         self.adapter.configure_topology(next_addr)
+
+    async def update_topology(self, req) -> None:
+        """Delta reconfiguration (dnet_tpu/membership/): this shard's load
+        parameters are unchanged in the new topology, so it keeps its
+        weights and only (1) proves it actually holds what the API thinks
+        it holds, (2) drops every per-request state (KV sessions, lanes,
+        prefix snapshots, stream dedup keys), (3) pins the new epoch, and
+        (4) rewires its next pointer.  Raises ValueError when the proof
+        fails — the HTTP layer answers 409 and the API full-loads."""
+        from dnet_tpu.api.model_manager import resolve_model_dir
+
+        compute = self.runtime.compute
+        if compute is None:
+            raise ValueError("no model loaded; cannot delta-update")
+        model_dir = resolve_model_dir(
+            req.model_path, get_settings().shard.models_dir
+        )
+        if model_dir is None or str(model_dir) != self.runtime.model_path:
+            raise ValueError(
+                f"loaded model {self.runtime.model_path!r} does not match "
+                f"requested {req.model_path!r}"
+            )
+        if sorted(compute.layers) != sorted(req.layers):
+            raise ValueError(
+                f"loaded layers {sorted(compute.layers)} do not match "
+                f"requested {sorted(req.layers)}"
+            )
+        # drop per-request state minted under the old epoch: stale lanes /
+        # KV must not leak into the new ring, queued old-epoch frames must
+        # not burn compute on results the fences will reject, and the old
+        # next-hop streams (possibly pointed at a fenced-out shard) must
+        # close
+        await self.adapter.reset_topology()
+        self.runtime.drain_ingress()
+        compute.reset("")
+        self.runtime.set_epoch(req.epoch)
+        next_addr = (
+            f"{req.next_node.host}:{req.next_node.grpc_port}"
+            if req.next_node
+            else ""
+        )
+        self.adapter.configure_topology(next_addr)
+        log.info(
+            "shard %s delta-updated to epoch %d (next=%s, weights kept)",
+            self.shard_id, req.epoch, next_addr or "<tail>",
+        )
 
     async def unload_model(self) -> None:
         await self.adapter.reset_topology()

@@ -90,10 +90,13 @@ class RingClient:
             method="health_check",
         )
 
-    async def reset_cache(self, nonce: str = "", timeout: float = 10.0):
+    async def reset_cache(
+        self, nonce: str = "", timeout: float = 10.0, epoch: int = 0
+    ):
         return await call_with_retry(
             lambda: self._reset(
-                proto.ResetCacheRequest(nonce=nonce), timeout=timeout
+                proto.ResetCacheRequest(nonce=nonce, epoch=epoch),
+                timeout=timeout,
             ),
             method="reset_cache",
         )

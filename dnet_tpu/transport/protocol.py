@@ -87,6 +87,10 @@ class ActivationFrame:
     # error is cross-host NTP skew, negligible against any sane deadline.
     # Shards drop expired frames at compute-queue dequeue.
     deadline: float = 0.0
+    # topology epoch this frame was minted under (membership/epoch.py);
+    # 0 = unfenced.  Shards pin their epoch at load and NACK any frame
+    # from a different epoch — the zombie/split-brain fence.
+    epoch: int = 0
 
     def to_bytes(self) -> bytes:
         d = asdict(self)
@@ -118,6 +122,7 @@ class ActivationFrame:
             prefix_store=self.prefix_store,
             prefix_hit=self.prefix_hit,
             deadline=self.deadline,
+            epoch=self.epoch,
         )
 
 
@@ -148,6 +153,10 @@ class TokenPayload:
     top_ids: List[int] = field(default_factory=list)
     top_logprobs: List[float] = field(default_factory=list)
     error: str = ""
+    # topology epoch the emitting shard held (0 = unfenced): the API
+    # rejects tokens minted under a dead epoch, so a zombie shard's late
+    # callback can never reach a live SSE stream
+    epoch: int = 0
 
     def to_bytes(self) -> bytes:
         return pack(asdict(self))
@@ -165,6 +174,7 @@ class TokenPayload:
             top_logprobs=top,
             step=self.step,
             error=self.error,
+            epoch=self.epoch,
         )
 
     @classmethod
@@ -178,6 +188,7 @@ class TokenPayload:
             top_ids=[t for t, _ in top],
             top_logprobs=[lp for _, lp in top],
             error=r.error,
+            epoch=r.epoch,
         )
 
 
@@ -187,6 +198,8 @@ class HealthInfo:
     model: str = ""
     layers: List[int] = field(default_factory=list)
     queue_depth: int = 0
+    # topology epoch this shard pinned at load (0 = none pinned)
+    epoch: int = 0
 
     def to_bytes(self) -> bytes:
         return pack(asdict(self))
@@ -199,6 +212,9 @@ class HealthInfo:
 @dataclass
 class ResetCacheRequest:
     nonce: str = ""  # empty = reset all
+    # sender's topology epoch (0 = unfenced admin reset, always allowed):
+    # a reset minted under a dead epoch must not clear live-ring state
+    epoch: int = 0
 
     def to_bytes(self) -> bytes:
         return pack(asdict(self))

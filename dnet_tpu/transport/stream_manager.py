@@ -50,12 +50,18 @@ class StreamManager:
         open_stream: Callable[[], object],
         backoff_s: float = 0.25,
         idle_timeout_s: float = 30.0,
+        on_nack: Optional[Callable[[StreamAck], None]] = None,
     ) -> None:
         self._open_stream = open_stream  # () -> stream-stream call
         self._streams: Dict[str, StreamContext] = {}
         self._backoff_s = backoff_s
         self._idle_timeout_s = idle_timeout_s
         self._lock = asyncio.Lock()
+        # outright-rejection observer (non-backpressure NACK): the epoch
+        # fence answers fenced frames with a NACK the sender must be able
+        # to act on — without this hook a fenced request would hang its
+        # full await timeout on a token that can never come
+        self._on_nack = on_nack
 
     async def get_or_create(self, nonce: str) -> StreamContext:
         async with self._lock:
@@ -138,6 +144,11 @@ class StreamManager:
                     )
                 elif not ack.ok:
                     log.warning("stream %s NACK seq=%d: %s", ctx.nonce, ack.seq, ack.message)
+                    if self._on_nack is not None:
+                        try:
+                            self._on_nack(ack)
+                        except Exception:
+                            log.exception("on_nack handler failed")
         except asyncio.CancelledError:
             raise
         except Exception as exc:

@@ -127,6 +127,14 @@ _REQUIRED_FAMILIES = (
     "dnet_cancel_propagated_total",
     "dnet_drain_state",
     "dnet_shard_outq_dropped_total",
+    # elastic ring membership (dnet_tpu/membership/) — the epoch-fence
+    # dashboards, recovery alert, and the label cross-check (pass 7)
+    # depend on these
+    "dnet_topology_epoch",
+    "dnet_stale_epoch_rejected_total",
+    "dnet_recovery_total",
+    "dnet_recovery_duration_seconds",
+    "dnet_shard_rejoins_total",
 )
 
 
@@ -267,17 +275,18 @@ def _cross_check_labels(
     import re
 
     n = 0
+    scope = where.split(".", 1)[0]
     for value in declared:
         n += 1
         if f'{family}{{{label}="{value}"}}' not in text:
             errors.append(
-                f"admission: {where} value {value!r} has no {family} "
+                f"{scope}: {where} value {value!r} has no {family} "
                 f"series (pre-touch it in dnet_tpu.obs._register_core)"
             )
     for m in re.finditer(rf'{family}\{{{label}="([^"]+)"\}}', text):
         if m.group(1) not in declared:
             errors.append(
-                f"admission: exposed {family} {label} label "
+                f"{scope}: exposed {family} {label} label "
                 f"{m.group(1)!r} is not declared in {where}"
             )
     return n
@@ -303,6 +312,27 @@ def check_admission_labels(errors: list) -> int:
     return n
 
 
+def check_membership_labels(errors: list) -> int:
+    """Pass 7: the membership surface's labeled families must agree with
+    the declared enums (dnet_tpu/membership/epoch.py) both ways — a new
+    stale-epoch kind or recovery outcome cannot ship without its series,
+    and a renamed one cannot strand a stale label on dashboards.  Same
+    pattern as passes 5-6."""
+    from dnet_tpu.membership.epoch import RECOVERY_OUTCOMES, STALE_EPOCH_KINDS
+    from dnet_tpu.obs import get_registry
+
+    text = get_registry().expose()
+    n = _cross_check_labels(
+        errors, text, "dnet_stale_epoch_rejected_total", "kind",
+        STALE_EPOCH_KINDS, "membership.epoch.STALE_EPOCH_KINDS",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_recovery_total", "outcome",
+        RECOVERY_OUTCOMES, "membership.epoch.RECOVERY_OUTCOMES",
+    )
+    return n
+
+
 def main() -> int:
     errors: list[str] = []
     n_reg = check_registry(errors)
@@ -311,6 +341,7 @@ def main() -> int:
     n_pool = check_paged_conservation(errors)
     n_chaos = check_chaos_points(errors)
     n_admit = check_admission_labels(errors)
+    n_member = check_membership_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -318,7 +349,7 @@ def main() -> int:
     print(f"ok: {n_reg} registered families, {n_src} source-literal "
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
           f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
-          f"all conform")
+          f"{n_member} membership labels, all conform")
     return 0
 
 

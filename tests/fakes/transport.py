@@ -63,7 +63,7 @@ class FakeRingClient:
     async def health_check(self, timeout=5.0):
         return HealthInfo(ok=True)
 
-    async def reset_cache(self, nonce="", timeout=10.0):
+    async def reset_cache(self, nonce="", timeout=10.0, epoch=0):
         self.resets.append(nonce)
         return Empty()
 

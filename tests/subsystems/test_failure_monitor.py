@@ -186,8 +186,8 @@ def test_auto_recover_resolves_over_healthy(monkeypatch, tiny_llama_dir):
         class StubManager:
             models_dir = None
 
-            async def load_model(self, model_id, max_seq=None):
-                reloads.append(model_id)
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                reloads.append((model_id, delta))
                 return 0.1
 
         monitor = RingFailureMonitor(
@@ -220,11 +220,16 @@ def test_auto_recover_resolves_over_healthy(monkeypatch, tiny_llama_dir):
         cluster.profile_cluster = profiled
         FlakyClient.dead = {"h1:20"}
         await monitor._tick()
-        assert monitor.down_shards() == ["s1"]
-        assert reloads == [str(tiny_llama_dir)]
+        # recovery goes through the DELTA reload path
+        assert reloads == [(str(tiny_llama_dir), True)]
         # topology re-solved over the surviving shard only
         topo = cluster.current_topology
         assert [a.instance for a in topo.assignments] == ["s0"]
         assert sorted(l for a in topo.assignments for l in a.layers) == [0, 1, 2, 3]
+        # the fenced-out shard is QUARANTINED (still probed), not pruned
+        # forever: degraded clears immediately so resumes can replay
+        assert monitor.down_shards() == []
+        assert not monitor.degraded
+        assert "s1" in monitor.quarantine
 
     asyncio.run(go())

@@ -1,0 +1,955 @@
+"""Elastic ring membership (dnet_tpu/membership/): epoch fence units,
+delta-reload planning, convergent recovery, quarantine + rejoin.
+
+The fence contract under test: a shard holding epoch N rejects activation
+frames, reset_cache RPCs — and the API rejects token callbacks — minted
+under epoch N-1, each with a typed `StaleEpochError` that is COUNTED
+(`dnet_stale_epoch_rejected_total{kind=}`), never computed.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dnet_tpu.api.failure import RingFailureMonitor
+from dnet_tpu.api.inference import InferenceManager
+from dnet_tpu.core.types import DeviceInfo, LayerAssignment, TopologyInfo
+from dnet_tpu.membership import (
+    EpochClock,
+    QuarantineSet,
+    StaleEpochError,
+    body_signature,
+    is_stale,
+    split_delta,
+)
+from dnet_tpu.obs import metric
+from dnet_tpu.resilience.chaos import clear_chaos, install_chaos
+from dnet_tpu.utils.tokenizer import ByteTokenizer
+from tests.fakes.transport import FakeCallbackClient, FakeRingClient
+
+pytestmark = pytest.mark.api
+
+
+def _stale(kind: str) -> float:
+    return metric("dnet_stale_epoch_rejected_total").labels(kind=kind).value
+
+
+# ---- epoch primitives ------------------------------------------------------
+
+
+def test_epoch_clock_monotonic_and_observe():
+    clock = EpochClock()
+    assert clock.mint() == 1
+    assert clock.mint() == 2
+    clock.observe(10)  # an externally seen larger epoch fast-forwards
+    assert clock.mint() == 11
+    clock.observe(3)  # never goes backwards
+    assert clock.mint() == 12
+
+
+def test_is_stale_zero_is_unfenced():
+    assert not is_stale(0, 5)  # holder unfenced
+    assert not is_stale(5, 0)  # sender unfenced (legacy frame)
+    assert not is_stale(3, 3)
+    assert is_stale(3, 2)
+    assert is_stale(2, 3)  # NEWER epochs fence too: the holder is the zombie
+
+
+def test_cluster_manager_mints_on_install():
+    from dnet_tpu.api.cluster import ClusterManager
+
+    cm = ClusterManager(discovery=None)
+    topo = _topo()
+    cm.install_topology(topo)
+    assert topo.epoch == 1 and cm.epoch == 1
+    t2 = _topo()
+    cm.install_topology(t2)
+    assert t2.epoch == 2
+    assert metric("dnet_topology_epoch").value == 2.0
+    # rollback restores the OLD epoch; the aborted one is burned
+    cm.restore_topology(topo)
+    assert cm.epoch == 1
+    assert metric("dnet_topology_epoch").value == 1.0
+    t3 = _topo()
+    cm.install_topology(t3)
+    assert t3.epoch == 3  # never reuses the burned epoch 2
+
+
+# ---- delta planning --------------------------------------------------------
+
+
+def test_body_signature_ignores_volatile_keys():
+    a = {"layers": [0, 1], "epoch": 1, "next_node": {"host": "a"}, "lanes": 0}
+    b = {"layers": [0, 1], "epoch": 9, "next_node": {"host": "z"}, "lanes": 0}
+    assert body_signature(a) == body_signature(b)
+    c = dict(a, layers=[0, 1, 2])
+    assert body_signature(a) != body_signature(c)
+
+
+def test_split_delta_unknown_instance_always_changed():
+    last = {"s0": body_signature({"layers": [0]})}
+    bodies = {"s0": {"layers": [0]}, "s1": {"layers": [1]}}
+    changed, unchanged = split_delta(last, bodies)
+    assert set(changed) == {"s1"} and set(unchanged) == {"s0"}
+
+
+# ---- quarantine ------------------------------------------------------------
+
+
+def test_quarantine_stability_window_and_defer():
+    qs = QuarantineSet()
+    dev = DeviceInfo(instance="s1", host="h", http_port=1, grpc_port=2)
+    q = qs.add(dev)
+    assert "s1" in qs and not qs.ready(0.0)
+    q.mark_green(now=100.0)
+    q.mark_green(now=105.0)
+    assert q.stable_for(now=107.0) == pytest.approx(7.0)
+    assert qs.ready(5.0, now=107.0) == [q]
+    q.mark_red("probe lost")  # one red probe resets the window
+    assert q.stable_for(now=200.0) == 0.0 and not qs.ready(0.0, now=200.0)
+    q.mark_green(now=300.0)
+    q.defer(now=305.0)  # failed rejoin attempt: re-earn the window
+    assert q.stable_for(now=306.0) == pytest.approx(1.0)
+    snap = qs.snapshot()["s1"]
+    assert set(snap) == {"quarantined_s", "green_s", "probes_ok", "last_error"}
+    assert qs.remove("s1") is q and "s1" not in qs
+
+
+# ---- shard-side fences -----------------------------------------------------
+
+
+def _frame(epoch=0, nonce="n", seq=0):
+    from dnet_tpu.transport.protocol import ActivationFrame
+
+    return ActivationFrame(
+        nonce=nonce, seq=seq, layer_id=-1, pos=0, dtype="tokens",
+        shape=(1, 1), payload=b"\x01\x00\x00\x00", epoch=epoch,
+    )
+
+
+def test_shard_ingress_fences_stale_frame():
+    from dnet_tpu.shard.adapter import RingAdapter
+    from dnet_tpu.shard.runtime import ShardRuntime
+
+    async def go():
+        rt = ShardRuntime("s")
+        rt.set_epoch(2)
+        adapter = RingAdapter(
+            rt,
+            ring_client_factory=lambda addr: FakeRingClient(addr),
+            callback_client_factory=lambda addr: FakeCallbackClient(addr),
+        )
+        adapter.configure_topology("next:1")
+        before = _stale("frame")
+        ok, msg = await adapter.ingress_frame(_frame(epoch=1))
+        assert not ok and "stale epoch" in msg
+        assert _stale("frame") - before == 1
+        # same epoch and unfenced (0) frames pass the fence (and relay,
+        # since this shard holds no layers)
+        for good in (2, 0):
+            ok, msg = await adapter.ingress_frame(_frame(epoch=good, seq=good))
+            assert ok and msg == "relayed"
+        assert _stale("frame") - before == 1
+        await adapter.shutdown()
+
+    asyncio.run(go())
+
+
+def test_zombie_frame_chaos_point_forces_rejection():
+    """The chaos `zombie_frame` point deterministically simulates a frame
+    minted under a dead epoch: matching epochs still fence."""
+    from dnet_tpu.shard.adapter import RingAdapter
+    from dnet_tpu.shard.runtime import ShardRuntime
+
+    async def go():
+        rt = ShardRuntime("s")
+        rt.set_epoch(3)
+        adapter = RingAdapter(
+            rt,
+            ring_client_factory=lambda addr: FakeRingClient(addr),
+            callback_client_factory=lambda addr: FakeCallbackClient(addr),
+        )
+        before = _stale("frame")
+        injected0 = metric("dnet_chaos_injected_total").labels(
+            point="zombie_frame"
+        ).value
+        install_chaos("zombie_frame:error:1.0", seed=3)
+        try:
+            ok, msg = await adapter.ingress_frame(_frame(epoch=3))
+        finally:
+            clear_chaos()
+        assert not ok and "stale epoch" in msg
+        assert _stale("frame") - before == 1
+        assert metric("dnet_chaos_injected_total").labels(
+            point="zombie_frame"
+        ).value - injected0 == 1
+        await adapter.shutdown()
+
+    asyncio.run(go())
+
+
+def test_reset_cache_fenced_by_epoch():
+    from dnet_tpu.shard.adapter import RingAdapter
+    from dnet_tpu.shard.grpc_servicer import ShardRingServicer
+    from dnet_tpu.shard.runtime import ShardRuntime
+    from dnet_tpu.transport.protocol import ResetCacheRequest
+
+    async def go():
+        rt = ShardRuntime("s")
+        rt.set_epoch(2)
+        adapter = RingAdapter(
+            rt,
+            ring_client_factory=lambda addr: FakeRingClient(addr),
+            callback_client_factory=lambda addr: FakeCallbackClient(addr),
+        )
+        servicer = ShardRingServicer(adapter, rt)
+        before = _stale("reset_cache")
+        with pytest.raises(StaleEpochError):
+            await servicer.reset_cache(ResetCacheRequest(nonce="n", epoch=1), None)
+        assert _stale("reset_cache") - before == 1
+        # matching and unfenced (admin) resets pass
+        await servicer.reset_cache(ResetCacheRequest(nonce="n", epoch=2), None)
+        await servicer.reset_cache(ResetCacheRequest(nonce="n", epoch=0), None)
+        assert _stale("reset_cache") - before == 1
+        # health answers the pinned epoch
+        health = await servicer.health_check(None, None)
+        assert health.epoch == 2
+        await adapter.shutdown()
+
+    asyncio.run(go())
+
+
+def test_shard_update_topology_endpoint(tiny_llama_dir):
+    """The real /update_topology handler: proof-of-holding (409 on wrong
+    layers/model/no model), epoch bump + per-request state drop + rewire
+    on success — weights kept (same engine object)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from dnet_tpu.shard.adapter import RingAdapter
+    from dnet_tpu.shard.http import ShardHTTPServer, ShardLoadModelRequest
+    from dnet_tpu.shard.runtime import ShardRuntime
+    from dnet_tpu.shard.server import Shard
+
+    async def go():
+        rt = ShardRuntime("s0")
+        adapter = RingAdapter(
+            rt,
+            ring_client_factory=lambda addr: FakeRingClient(addr),
+            callback_client_factory=lambda addr: FakeCallbackClient(addr),
+        )
+        shard = Shard("s0", rt, adapter)
+        await shard.start()
+        client = TestClient(TestServer(ShardHTTPServer(shard).app))
+        await client.start_server()
+        try:
+            # no model yet: delta update must refuse
+            r = await client.post(
+                "/update_topology",
+                json={"model_path": str(tiny_llama_dir),
+                      "layers": [0, 1, 2, 3], "epoch": 9},
+            )
+            assert r.status == 409
+
+            await shard.load_model(
+                ShardLoadModelRequest(
+                    model_path=str(tiny_llama_dir), layers=[0, 1, 2, 3],
+                    max_seq_len=64, param_dtype="float32", epoch=5,
+                )
+            )
+            engine = rt.compute.engine
+            health = await (await client.get("/health")).json()
+            assert health["epoch"] == 5
+
+            # wrong layers / unresolvable model: cannot prove -> 409
+            r = await client.post(
+                "/update_topology",
+                json={"model_path": str(tiny_llama_dir),
+                      "layers": [0, 1], "epoch": 6},
+            )
+            assert r.status == 409
+            r = await client.post(
+                "/update_topology",
+                json={"model_path": "/nonexistent/model",
+                      "layers": [0, 1, 2, 3], "epoch": 6},
+            )
+            assert r.status == 409
+            assert rt.epoch == 5  # refused updates change nothing
+
+            # matching proof: epoch bumps, next rewires, WEIGHTS KEPT
+            r = await client.post(
+                "/update_topology",
+                json={"model_path": str(tiny_llama_dir),
+                      "layers": [0, 1, 2, 3], "epoch": 6,
+                      "next_node": {"host": "peer", "grpc_port": 7}},
+            )
+            assert r.status == 200 and (await r.json())["epoch"] == 6
+            assert rt.epoch == 6
+            assert rt.compute.engine is engine  # no reload happened
+            assert adapter.next_addr == "peer:7"
+            assert len(rt.compute.engine.sessions) == 0  # state dropped
+            health = await (await client.get("/health")).json()
+            assert health["epoch"] == 6
+        finally:
+            await client.close()
+            await shard.stop()
+
+    asyncio.run(go())
+
+
+def test_api_health_exposes_epoch_and_quarantine(tiny_llama_dir):
+    """Operators (and the federation scrape) see a degraded-membership
+    ring at a glance: /health carries the installed epoch and the
+    quarantine list, and the drain snapshot repeats both."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from dnet_tpu.api.cluster import ClusterManager
+    from dnet_tpu.api.http import ApiHTTPServer
+    from dnet_tpu.api.model_manager import LocalModelManager
+
+    async def go():
+        inference = InferenceManager(adapter=None, request_timeout_s=5.0)
+        manager = LocalModelManager(inference, max_seq=64)
+        cluster = ClusterManager(discovery=None)
+        cluster.install_topology(_topo())
+        cluster.install_topology(_topo())  # epoch 2
+        monitor = RingFailureMonitor(
+            cluster, inference,
+            ring_client_factory=lambda addr: FakeRingClient(addr),
+        )
+        monitor.quarantine.add(
+            DeviceInfo(instance="s9", host="h9", http_port=9, grpc_port=90)
+        )
+        inference.failure_monitor = monitor
+        server = ApiHTTPServer(inference, manager, cluster)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            body = await (await client.get("/health")).json()
+            assert body["epoch"] == 2
+            assert list(body["quarantine"]) == ["s9"]
+            # quarantine alone does not degrade status: the re-solved
+            # ring serves, just below capacity
+            assert body["status"] == "ok"
+            inference.admission.begin_drain()
+            body = await (await client.get("/health")).json()
+            assert body["status"] == "draining"
+            assert body["admission"]["epoch"] == 2
+            assert body["admission"]["quarantine"] == ["s9"]
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_rejoin_knobs_read_from_env(monkeypatch):
+    from dnet_tpu.config import reset_settings_cache
+
+    monkeypatch.setenv("DNET_REJOIN", "1")
+    monkeypatch.setenv("DNET_REJOIN_STABLE_S", "2.5")
+    monkeypatch.setenv("DNET_RECOVERY_MAX_ROUNDS", "5")
+    reset_settings_cache()
+    try:
+        monitor = RingFailureMonitor(
+            None, None, ring_client_factory=lambda addr: FakeRingClient(addr)
+        )
+        assert monitor.rejoin_enabled is True
+        assert monitor.rejoin_stable_s == 2.5
+        assert monitor.max_recovery_rounds == 5
+    finally:
+        reset_settings_cache()
+
+
+# ---- API-side token fence --------------------------------------------------
+
+
+def test_api_drops_zombie_token_callback():
+    from dnet_tpu.api.ring import RingApiAdapter
+    from dnet_tpu.core.types import TokenResult
+
+    async def go():
+        adapter = RingApiAdapter(
+            head_addr="h:1",
+            callback_url="grpc://api:1",
+            ring_client_factory=lambda addr: FakeRingClient(addr),
+            epoch=2,
+        )
+        fut = adapter._futures.expect("r1", 0)
+        before = _stale("token_cb")
+        adapter.resolve_token(
+            TokenResult(nonce="r1", token_id=999, step=0, epoch=1)
+        )
+        await asyncio.sleep(0)  # resolve() lands via call_soon_threadsafe
+        assert not fut.done()  # the zombie token resolved NOTHING
+        assert _stale("token_cb") - before == 1
+        adapter.resolve_token(
+            TokenResult(nonce="r1", token_id=7, step=0, epoch=2)
+        )
+        await asyncio.sleep(0)
+        assert fut.done() and fut.result().token_id == 7
+        assert _stale("token_cb") - before == 1
+
+    asyncio.run(go())
+
+
+def test_stale_nack_fails_awaiting_step_fast():
+    """A shard's stale-epoch NACK is definitive — the sender's awaiting
+    step fails NOW (resume can replay on the new adapter) instead of
+    hanging the full token timeout."""
+    from dnet_tpu.api.ring import RingApiAdapter
+    from dnet_tpu.core.types import DecodingParams
+
+    from dnet_tpu.transport.protocol import StreamAck
+
+    async def go():
+        def fenced_ack(frame):
+            return StreamAck(
+                nonce=frame.nonce, seq=frame.seq, ok=False,
+                message="stale epoch: frame carries epoch 2, holder is at "
+                        "epoch 3",
+            )
+
+        adapter = RingApiAdapter(
+            head_addr="h:1",
+            callback_url="grpc://api:1",
+            ring_client_factory=lambda addr: FakeRingClient(
+                addr, on_frame=fenced_ack
+            ),
+            epoch=2,
+        )
+        await adapter.start()
+        try:
+            await adapter.send_tokens(
+                "r1", [1, 2, 3], DecodingParams(), step=0
+            )
+            result = await adapter.await_token("r1", 0, timeout=2.0)
+            assert result.error and "stale epoch" in result.error
+        finally:
+            await adapter.shutdown()
+
+    asyncio.run(go())
+
+
+# ---- recovery: convergence, retry, rejoin ---------------------------------
+
+
+class FlakyClient(FakeRingClient):
+    dead: set = set()
+
+    async def health_check(self, timeout=5.0):
+        if self.addr in self.dead:
+            raise ConnectionError(f"{self.addr} unreachable")
+        return await super().health_check(timeout)
+
+
+def _devs(n=3):
+    return [
+        DeviceInfo(
+            instance=f"s{i}", host=f"h{i}", http_port=i + 1,
+            grpc_port=10 * (i + 1), flops_bf16=1e14, hbm_bw=8e11,
+            host_to_hbm_bw=1e10, hbm_bytes=16 << 30,
+        )
+        for i in range(n)
+    ]
+
+
+def _topo(n=2):
+    devs = _devs(n)[:n]
+    per = 4 // n
+    las = [
+        LayerAssignment(
+            instance=f"s{i}",
+            layers=list(range(i * per, (i + 1) * per)),
+            next_instance=f"s{(i + 1) % n}",
+        )
+        for i in range(n)
+    ]
+    return TopologyInfo(
+        model="m", num_layers=4, kv_bits=0, devices=devs, assignments=las
+    )
+
+
+class StubCluster:
+    def __init__(self, n=2):
+        self.current_topology = _topo(n)
+        self.installed = []
+        self.restored = []
+
+    def install_topology(self, topo):
+        topo.epoch = len(self.installed) + 1
+        self.installed.append(topo)
+        self.current_topology = topo
+        return topo
+
+    def restore_topology(self, topo):
+        self.restored.append(topo)
+        self.current_topology = topo
+
+
+@pytest.fixture
+def fast_retry(monkeypatch):
+    from dnet_tpu.config import reset_settings_cache
+
+    monkeypatch.setenv("DNET_RESILIENCE_RETRY_BASE_S", "0.001")
+    monkeypatch.setenv("DNET_RESILIENCE_RETRY_MAX_S", "0.005")
+    reset_settings_cache()
+    yield
+    reset_settings_cache()
+
+
+def _inference():
+    m = InferenceManager(None, request_timeout_s=5.0)
+    m.tokenizer = ByteTokenizer()
+    return m
+
+
+def _monitor(cluster, inference, manager, tiny_llama_dir, **kw):
+    inference.model_id = str(tiny_llama_dir)
+    return RingFailureMonitor(
+        cluster,
+        inference,
+        model_manager=manager,
+        interval_s=0.01,
+        fail_threshold=1,
+        auto_recover=True,
+        ring_client_factory=lambda addr: FlakyClient(addr),
+        **kw,
+    )
+
+
+def _recovered() -> float:
+    return metric("dnet_recovery_total").labels(outcome="recovered").value
+
+
+def test_second_failure_during_recovery_converges(tiny_llama_dir, fast_retry):
+    """The lost-second-failure bug: a shard dying while a recovery reload
+    is in flight used to be swallowed by the `_recovering` early-return
+    forever.  Now the bounded-round loop re-checks down_shards() after
+    each reload and re-solves until the ring is stable."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=3)
+        inference = _inference()
+        reloads = []
+
+        class SlowManager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                reloads.append(sorted(
+                    a.instance
+                    for a in cluster.current_topology.assignments
+                ))
+                # long enough for the OTHER shard's concurrent probe (same
+                # gather) to mark DOWN mid-recovery and be deferred
+                await asyncio.sleep(0.05)
+                return 0.1
+
+        monitor = _monitor(
+            cluster, inference, SlowManager(), tiny_llama_dir,
+        )
+
+        async def profiled():
+            return _devs(3)
+
+        cluster.profile_cluster = profiled
+        rec0 = _recovered()
+        # both s1 and s2 die in the same tick: s1's check enters recovery,
+        # s2's check fires mid-reload and must NOT be lost
+        FlakyClient.dead = {"h1:20", "h2:30"}
+        await monitor._tick()
+        # two rounds: first re-solve excludes only the first-detected
+        # shard, the convergence re-check catches the second
+        assert len(reloads) == 2
+        assert reloads[1] == ["s0"]  # second round: only the survivor
+        assert monitor.down_shards() == []
+        assert sorted(monitor.quarantine.instances()) == ["s1", "s2"]
+        assert _recovered() - rec0 == 2
+        # epochs minted per round
+        assert cluster.current_topology.epoch == 2
+
+    asyncio.run(go())
+
+
+def test_reload_failure_retries_then_restores(tiny_llama_dir, fast_retry):
+    """A load_model that throws mid-recovery retries under the `load_model`
+    policy class (the old code never retried), and only after exhaustion
+    is the old degraded topology restored."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=2)
+        old_topo = cluster.current_topology
+        inference = _inference()
+        attempts = []
+
+        class FailingManager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                attempts.append(model_id)
+                raise RuntimeError("shard load failed (500)")
+
+        monitor = _monitor(
+            cluster, inference, FailingManager(), tiny_llama_dir,
+        )
+
+        async def profiled():
+            return _devs(2)
+
+        cluster.profile_cluster = profiled
+        failed0 = metric("dnet_recovery_total").labels(outcome="failed").value
+        retries0 = metric("dnet_rpc_retries_total").labels(
+            method="load_model"
+        ).value
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()
+        # default policy: 3 attempts total => 2 retries, plus ONE
+        # best-effort restore fan-out after the rollback
+        assert len(attempts) == 4
+        assert metric("dnet_rpc_retries_total").labels(
+            method="load_model"
+        ).value - retries0 == 2
+        assert metric("dnet_recovery_total").labels(
+            outcome="failed"
+        ).value - failed0 == 1
+        # old topology (and its epoch) restored; shard still DOWN, not
+        # quarantined — the next DOWN transition re-enters recovery
+        assert cluster.current_topology is old_topo
+        assert cluster.restored == [old_topo]
+        assert monitor.down_shards() == ["s1"]
+        assert "s1" not in monitor.quarantine
+
+    asyncio.run(go())
+
+
+def test_partial_recovery_one_shard_dead_one_quarantined(
+    tiny_llama_dir, fast_retry
+):
+    """Outcome accounting: an unsolvable re-solve counts no_capacity and
+    leaves the ring degraded (no healthy shard left)."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=2)
+        inference = _inference()
+
+        class NeverCalled:
+            models_dir = None
+
+            async def load_model(self, *a, **k):
+                raise AssertionError("reload must not run with no capacity")
+
+        monitor = _monitor(cluster, inference, NeverCalled(), tiny_llama_dir)
+
+        async def profiled():
+            return []  # nobody answers /profile
+
+        cluster.profile_cluster = profiled
+        nc0 = metric("dnet_recovery_total").labels(outcome="no_capacity").value
+        FlakyClient.dead = {"h0:10", "h1:20"}
+        await monitor._tick()
+        assert metric("dnet_recovery_total").labels(
+            outcome="no_capacity"
+        ).value - nc0 >= 1
+        assert monitor.degraded  # honestly still down
+
+    asyncio.run(go())
+
+
+def test_rejoin_readmits_stable_green_shard(tiny_llama_dir, fast_retry):
+    """Loss -> quarantine -> green probes -> automatic rejoin: full
+    capacity restored with no operator call, epoch advanced again,
+    dnet_shard_rejoins_total incremented exactly once."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=2)
+        inference = _inference()
+        reloads = []
+
+        class Manager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                reloads.append(sorted(
+                    a.instance
+                    for a in cluster.current_topology.assignments
+                ))
+                return 0.1
+
+        monitor = _monitor(
+            cluster, inference, Manager(), tiny_llama_dir,
+            rejoin=True, rejoin_stable_s=0.0,
+        )
+
+        async def profiled():
+            return _devs(2)
+
+        cluster.profile_cluster = profiled
+        rejoins0 = metric("dnet_shard_rejoins_total").value
+        # lose s1 -> recovery quarantines it
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()
+        assert "s1" in monitor.quarantine and reloads == [["s0"]]
+        epoch_after_loss = cluster.current_topology.epoch
+        # s1 comes back: quarantine probe green + stable window elapsed
+        # (stable_s=0) -> rejoin re-solves with s1 included
+        FlakyClient.dead = set()
+        await monitor._tick()
+        assert "s1" not in monitor.quarantine
+        assert reloads[-1] == ["s0", "s1"]
+        assert metric("dnet_shard_rejoins_total").value - rejoins0 == 1
+        assert cluster.current_topology.epoch == epoch_after_loss + 1
+        # subsequent ticks probe the full ring again; no double rejoin
+        await monitor._tick()
+        assert metric("dnet_shard_rejoins_total").value - rejoins0 == 1
+
+    asyncio.run(go())
+
+
+def test_solver_dropped_healthy_shard_is_quarantined(
+    tiny_llama_dir, fast_retry, monkeypatch
+):
+    """A healthy survivor the re-solve leaves out (singleton merge / zero
+    layers) must land in quarantine — still probed, rejoinable — not be
+    silently pruned from all monitoring."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=3)
+        inference = _inference()
+
+        class Manager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                return 0.1
+
+        monitor = _monitor(cluster, inference, Manager(), tiny_llama_dir)
+
+        async def profiled():
+            return _devs(3)
+
+        cluster.profile_cluster = profiled
+
+        def merging_solve(devices, profile, **kw):
+            # the solver collapses everything onto s0, dropping healthy s1
+            from dnet_tpu.api.ring_manager import build_manual_topology
+
+            return build_manual_topology(
+                "m", 4, [{"instance": "s0", "layers": [0, 1, 2, 3]}],
+                devices,
+            )
+
+        monkeypatch.setattr(
+            "dnet_tpu.parallel.solver.solve_topology", merging_solve
+        )
+        FlakyClient.dead = {"h2:30"}
+        await monitor._tick()
+        # BOTH the dead shard and the solver-dropped healthy one are
+        # quarantined (probed, rejoinable) — neither is pruned forever
+        assert sorted(monitor.quarantine.instances()) == ["s1", "s2"]
+        assert monitor.down_shards() == []
+
+    asyncio.run(go())
+
+
+def test_rejoin_not_counted_when_solver_drops_candidate(
+    tiny_llama_dir, fast_retry, monkeypatch
+):
+    """A rejoin whose re-solve gives the candidate zero layers is NOT a
+    rejoin: the shard stays quarantined and the counter does not move."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=2)
+        inference = _inference()
+
+        class Manager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                return 0.1
+
+        monitor = _monitor(
+            cluster, inference, Manager(), tiny_llama_dir,
+            rejoin=True, rejoin_stable_s=0.0,
+        )
+
+        async def profiled():
+            return _devs(2)
+
+        cluster.profile_cluster = profiled
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()
+        assert "s1" in monitor.quarantine
+
+        def dropping_solve(devices, profile, **kw):
+            from dnet_tpu.api.ring_manager import build_manual_topology
+
+            return build_manual_topology(
+                "m", 4, [{"instance": "s0", "layers": [0, 1, 2, 3]}],
+                devices,
+            )
+
+        monkeypatch.setattr(
+            "dnet_tpu.parallel.solver.solve_topology", dropping_solve
+        )
+        rejoins0 = metric("dnet_shard_rejoins_total").value
+        FlakyClient.dead = set()
+        await monitor._tick()
+        # reload went through but s1 got no layers: still quarantined,
+        # counter untouched, stability window re-earned
+        assert "s1" in monitor.quarantine
+        assert metric("dnet_shard_rejoins_total").value == rejoins0
+
+    asyncio.run(go())
+
+
+def test_failed_rejoin_reships_restored_topology(tiny_llama_dir, fast_retry):
+    """A rejoin whose reload fails after some shards already pinned the
+    aborted epoch must RE-SHIP the restored topology — otherwise the
+    partially-updated (healthy, serving) ring would fence the live
+    adapter forever."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=2)
+        inference = _inference()
+        calls = []
+
+        class Manager:
+            models_dir = None
+            fail_next = 0
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                calls.append(
+                    (sorted(
+                        a.instance
+                        for a in cluster.current_topology.assignments
+                    ), cluster.current_topology.epoch)
+                )
+                if self.fail_next > 0:
+                    self.fail_next -= 1
+                    raise RuntimeError("rejoin reload exploded")
+                return 0.1
+
+        manager = Manager()
+        monitor = _monitor(
+            cluster, inference, manager, tiny_llama_dir,
+            rejoin=True, rejoin_stable_s=0.0,
+        )
+
+        async def profiled():
+            return _devs(2)
+
+        cluster.profile_cluster = profiled
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()  # lose + quarantine s1 (epoch 1)
+        loss_epoch = cluster.current_topology.epoch
+        FlakyClient.dead = set()
+        # the rejoin reload fails on every retry (3 attempts), then the
+        # RESTORE fan-out runs against the rolled-back topology
+        manager.fail_next = 3
+        await monitor._tick()
+        assert "s1" in monitor.quarantine  # rejoin failed, still out
+        # last call is the restore fan-out: old single-shard topology at
+        # the old epoch — shards that pinned the aborted epoch re-pin it
+        assert calls[-1] == (["s0"], loss_epoch)
+        assert cluster.current_topology.epoch == loss_epoch
+        # a later tick rejoins cleanly
+        await monitor._tick()
+        assert "s1" not in monitor.quarantine
+
+    asyncio.run(go())
+
+
+def test_rejoin_disabled_keeps_probing_without_readmission(
+    tiny_llama_dir, fast_retry
+):
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=2)
+        inference = _inference()
+
+        class Manager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                return 0.1
+
+        monitor = _monitor(
+            cluster, inference, Manager(), tiny_llama_dir,
+            rejoin=False, rejoin_stable_s=0.0,
+        )
+
+        async def profiled():
+            return _devs(2)
+
+        cluster.profile_cluster = profiled
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()
+        assert "s1" in monitor.quarantine
+        FlakyClient.dead = set()
+        await monitor._tick()
+        await monitor._tick()
+        q = monitor.quarantine.get("s1")
+        assert q is not None and q.probes_ok >= 2  # probed, never readmitted
+
+    asyncio.run(go())
+
+
+def test_rejoin_chaos_point_defers_attempt(tiny_llama_dir, fast_retry):
+    """An injected `rejoin` fault aborts the attempt: the shard stays
+    quarantined and must re-earn its stability window."""
+
+    async def go():
+        FlakyClient.dead = set()
+        cluster = StubCluster(n=2)
+        inference = _inference()
+        reloads = []
+
+        class Manager:
+            models_dir = None
+
+            async def load_model(self, model_id, max_seq=None, delta=False):
+                reloads.append(1)
+                return 0.1
+
+        monitor = _monitor(
+            cluster, inference, Manager(), tiny_llama_dir,
+            rejoin=True, rejoin_stable_s=0.0,
+        )
+
+        async def profiled():
+            return _devs(2)
+
+        cluster.profile_cluster = profiled
+        FlakyClient.dead = {"h1:20"}
+        await monitor._tick()
+        assert "s1" in monitor.quarantine
+        n_loss_reloads = len(reloads)
+        FlakyClient.dead = set()
+        injected0 = metric("dnet_chaos_injected_total").labels(
+            point="rejoin"
+        ).value
+        install_chaos("rejoin:error:1.0", seed=5)
+        try:
+            await monitor._tick()
+        finally:
+            clear_chaos()
+        assert "s1" in monitor.quarantine  # aborted, still out
+        assert len(reloads) == n_loss_reloads  # no reload happened
+        assert metric("dnet_chaos_injected_total").labels(
+            point="rejoin"
+        ).value - injected0 == 1
+        q = monitor.quarantine.get("s1")
+        assert q.green_since is not None
+        assert q.stable_for() < 0.5  # window restarted by defer()
+        # with chaos gone the next tick rejoins
+        await monitor._tick()
+        assert "s1" not in monitor.quarantine
+
+    asyncio.run(go())

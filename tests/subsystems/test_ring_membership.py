@@ -1,0 +1,488 @@
+"""The full membership arc on a REAL in-process ring: loss -> epoch-fenced
+recovery (delta reload) -> transparent resume -> zombie rejection ->
+automatic rejoin.
+
+Three shards with real ShardRuntime compute threads (tiny llama, 4 layers:
+s0=[0,1], s1=[2], s2=[3]) behind a real RingModelManager (HTTP fan-out
+faked at the httpx seam), real ClusterManager (epoch mint), real
+RingFailureMonitor, and the PR 4 ResumableDecode driver.  Chaos faults
+`shard_compute` persistently; the monitor marks the dead shard DOWN,
+re-solves to {s0, s1} — s0's layer range is UNCHANGED so it gets
+/update_topology (no weight re-read: the load spy stays at one), s1 gets a
+full reload — the in-flight SSE stream resumes byte-identical, a late
+token callback minted under the old epoch is rejected and counted, and
+with rejoin enabled the shard re-enters the ring at the next epoch.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dnet_tpu.api.cluster import ClusterManager
+from dnet_tpu.api.failure import RingFailureMonitor
+from dnet_tpu.api.inference import InferenceManager
+from dnet_tpu.api.ring_manager import RingModelManager, build_manual_topology
+from dnet_tpu.api.schemas import ChatCompletionRequest
+from dnet_tpu.config import reset_settings_cache
+from dnet_tpu.core.types import DeviceInfo, TokenResult
+from dnet_tpu.obs import metric
+from dnet_tpu.resilience.chaos import clear_chaos, install_chaos
+from dnet_tpu.shard.adapter import RingAdapter
+from dnet_tpu.shard.runtime import ShardRuntime
+from dnet_tpu.transport.protocol import StreamAck
+from tests.fakes.transport import FakeCallbackClient, FakeRingClient
+
+pytestmark = [pytest.mark.ring, pytest.mark.shard, pytest.mark.chaos]
+
+_ENV = {
+    "DNET_RESILIENCE_RESUME": "1",
+    "DNET_RESILIENCE_RESUME_DEADLINE_S": "30",
+    # the resume loop spins (fail -> replay -> fail) until the monitor
+    # notices the dead shard; give it room — each attempt costs >= one
+    # pump poll, so detection (a few 20ms ticks) wins comfortably
+    "DNET_RESILIENCE_MAX_RESUMES": "200",
+    "DNET_RESILIENCE_RETRY_BASE_S": "0.001",
+    "DNET_RESILIENCE_RETRY_MAX_S": "0.01",
+    "DNET_API_RING_AUTO_STEPS": "0",  # per-step frames: deterministic arc
+}
+
+
+@pytest.fixture
+def membership_env():
+    old = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    reset_settings_cache()
+    yield
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    reset_settings_cache()
+
+
+class FlakyClient(FakeRingClient):
+    """Monitor probe client: fails while its addr is in the dead set."""
+
+    dead: set = set()
+
+    async def health_check(self, timeout=5.0):
+        if self.addr in self.dead:
+            raise ConnectionError(f"{self.addr} unreachable")
+        return await super().health_check(timeout)
+
+
+class InProcessShards:
+    """Three real shard runtimes + adapters, addressable the way the ring
+    manager's HTTP fan-out and the gRPC frames address them."""
+
+    def __init__(self, model_dir, sink):
+        self.model_dir = model_dir
+        self.sink = sink
+        self.loads: dict = {}    # instance -> full /load_model count
+        self.updates: dict = {}  # instance -> /update_topology count
+        self.on_full_load = None  # hook(instance) fired per full load
+        self.shards = {}
+        for i in range(3):
+            inst = f"s{i}"
+            rt = ShardRuntime(inst)
+            adapter = RingAdapter(
+                rt,
+                ring_client_factory=self._ring_factory,
+                callback_client_factory=lambda addr: FakeCallbackClient(
+                    addr, self.sink
+                ),
+            )
+            self.shards[inst] = (rt, adapter)
+        # grpc addr -> instance (the frames' routing table)
+        self.by_grpc = {f"h{i}:{10 * (i + 1)}": f"s{i}" for i in range(3)}
+        # http "host:port" -> instance (the fan-out's routing table)
+        self.by_http = {f"h{i}:{i + 1}": f"s{i}" for i in range(3)}
+
+    def _ring_factory(self, addr):
+        return FakeRingClient(
+            addr, on_frame=lambda f, _a=addr: self.ingress_ack(_a, f)
+        )
+
+    async def ingress_ack(self, addr, frame):
+        rt, adapter = self.shards[self.by_grpc[addr]]
+        ok, msg = await adapter.ingress_frame(frame)
+        return StreamAck(nonce=frame.nonce, seq=frame.seq, ok=ok, message=msg)
+
+    def devices(self):
+        return [
+            DeviceInfo(
+                instance=f"s{i}", host=f"h{i}", http_port=i + 1,
+                grpc_port=10 * (i + 1), flops_bf16=1e14, hbm_bw=8e11,
+                host_to_hbm_bw=1e10, hbm_bytes=16 << 30,
+            )
+            for i in range(3)
+        ]
+
+    async def start(self):
+        loop = asyncio.get_running_loop()
+        for rt, adapter in self.shards.values():
+            rt.start(loop)
+            await adapter.start()
+
+    async def stop(self):
+        for rt, adapter in self.shards.values():
+            await adapter.shutdown()
+            rt.stop()
+
+    # ---- the faked HTTP control plane ---------------------------------
+    async def handle_post(self, url, body):
+        """(status_code, response_body) for one fan-out POST."""
+        hostport, _, path = url.removeprefix("http://").partition("/")
+        inst = self.by_http[hostport]
+        rt, adapter = self.shards[inst]
+        nxt = body.get("next_node") or {}
+        next_addr = (
+            f"{nxt['host']}:{nxt['grpc_port']}" if nxt else ""
+        )
+        if path == "load_model":
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None,
+                lambda: rt.load_model_core(
+                    str(self.model_dir), body["layers"],
+                    max_seq=body["max_seq_len"],
+                    param_dtype=body["param_dtype"],
+                    epoch=body["epoch"],
+                ),
+            )
+            adapter.configure_topology(next_addr)
+            self.loads[inst] = self.loads.get(inst, 0) + 1
+            if self.on_full_load is not None:
+                self.on_full_load(inst)
+            return 200, {"status": "ok"}
+        if path == "update_topology":
+            # mirror Shard.update_topology's proof + state drop
+            if rt.compute is None or sorted(rt.compute.layers) != sorted(
+                body["layers"]
+            ):
+                return 409, {"status": "error", "message": "cannot prove"}
+            await adapter.reset_topology()
+            rt.drain_ingress()
+            rt.compute.reset("")
+            rt.set_epoch(body["epoch"])
+            adapter.configure_topology(next_addr)
+            self.updates[inst] = self.updates.get(inst, 0) + 1
+            return 200, {"status": "ok", "epoch": rt.epoch}
+        if path == "unload_model":
+            return 200, {"status": "ok"}
+        raise AssertionError(f"unexpected fan-out POST {url}")
+
+
+class FakeHttpx:
+    """Stands in for the `httpx` module inside api.ring_manager."""
+
+    class HTTPError(Exception):
+        pass
+
+    class _Resp:
+        def __init__(self, status_code, body):
+            self.status_code = status_code
+            self._body = body
+            self.text = json.dumps(body)
+
+        def json(self):
+            return self._body
+
+    def __init__(self, cluster: InProcessShards):
+        self._cluster = cluster
+        outer = self
+
+        class AsyncClient:
+            def __init__(self, timeout=None):
+                pass
+
+            async def __aenter__(self):
+                return self
+
+            async def __aexit__(self, *exc):
+                return False
+
+            async def post(self, url, json=None):
+                status, body = await outer._cluster.handle_post(url, json)
+                return outer._Resp(status, body)
+
+        self.AsyncClient = AsyncClient
+
+
+def _assignments(shape):
+    return [
+        {"instance": inst, "layers": list(layers)}
+        for inst, layers in shape
+    ]
+
+
+def _req(max_tokens=6):
+    return ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hello ring"}],
+            "max_tokens": max_tokens,
+            "temperature": 0.0,
+        }
+    )
+
+
+async def _pump(sink, inference, stop):
+    seen = 0
+    while not stop.is_set():
+        while seen < len(sink):
+            payload = sink[seen]
+            seen += 1
+            if inference.adapter is not None:
+                inference.adapter.resolve_token(payload.to_result())
+        await asyncio.sleep(0.005)
+
+
+async def _wait(cond, timeout_s, what):
+    import time as _t
+
+    t0 = _t.monotonic()
+    while not cond():
+        if _t.monotonic() - t0 > timeout_s:
+            raise TimeoutError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.02)
+
+
+def test_delta_update_refused_falls_back_to_full_load(
+    tiny_llama_dir, membership_env, monkeypatch
+):
+    """A shard that silently restarted (lost its weights) cannot prove it
+    holds the expected model: /update_topology answers 409 and the delta
+    path falls back to a full /load_model for that shard ALONE."""
+    model_id = str(tiny_llama_dir)
+
+    async def go():
+        sink = []
+        shards = InProcessShards(tiny_llama_dir, sink)
+        monkeypatch.setattr(
+            "dnet_tpu.api.ring_manager.httpx", FakeHttpx(shards)
+        )
+        await shards.start()
+        try:
+            cluster = ClusterManager(discovery=None)
+            inference = InferenceManager(None, request_timeout_s=10.0)
+            mgr = RingModelManager(
+                inference,
+                cluster,
+                api_callback_addr="api:1",
+                max_seq=64,
+                param_dtype="float32",
+                ring_client_factory=shards._ring_factory,
+            )
+            shape = (("s0", (0, 1)), ("s1", (2,)), ("s2", (3,)))
+            cluster.install_topology(
+                build_manual_topology(
+                    model_id, 4, _assignments(shape), shards.devices()
+                )
+            )
+            await mgr.load_model(model_id)
+            assert shards.loads == {"s0": 1, "s1": 1, "s2": 1}
+
+            # s0 "restarts": same address, no weights
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, shards.shards["s0"][0].unload_model_core
+            )
+            # identical topology re-installed (epoch 2): every body is
+            # unchanged, so the delta path tries updates everywhere
+            cluster.install_topology(
+                build_manual_topology(
+                    model_id, 4, _assignments(shape), shards.devices()
+                )
+            )
+            await mgr.load_model(model_id, delta=True)
+            # s0 could not prove it holds the weights -> full reload;
+            # s1/s2 bumped epoch in place
+            assert shards.loads == {"s0": 2, "s1": 1, "s2": 1}
+            assert shards.updates.get("s0") is None
+            assert shards.updates == {"s1": 1, "s2": 1}
+            assert all(
+                rt.epoch == 2 for rt, _ in shards.shards.values()
+            )
+        finally:
+            if inference.adapter is not None:
+                await inference.adapter.shutdown()
+            await shards.stop()
+
+    asyncio.run(go())
+
+
+def test_loss_recover_zombie_rejoin_arc(
+    tiny_llama_dir, membership_env, monkeypatch
+):
+    model_id = str(tiny_llama_dir)
+
+    def scripted_solve(devices, profile, **kw):
+        insts = sorted(d.instance for d in devices)
+        if insts == ["s0", "s1"]:
+            shape = (("s0", (0, 1)), ("s1", (2, 3)))
+        elif insts == ["s0", "s1", "s2"]:
+            shape = (("s0", (0, 1)), ("s1", (2,)), ("s2", (3,)))
+        else:
+            raise ValueError(f"unexpected solve over {insts}")
+        return build_manual_topology(model_id, 4, _assignments(shape), devices)
+
+    monkeypatch.setattr(
+        "dnet_tpu.parallel.solver.solve_topology", scripted_solve
+    )
+
+    async def go():
+        FlakyClient.dead = set()
+        sink = []
+        shards = InProcessShards(tiny_llama_dir, sink)
+        monkeypatch.setattr(
+            "dnet_tpu.api.ring_manager.httpx", FakeHttpx(shards)
+        )
+        await shards.start()
+        stop = asyncio.Event()
+        pump_task = None
+        monitor = None
+        try:
+            cluster = ClusterManager(discovery=None)
+
+            async def profiled():
+                return shards.devices()
+
+            cluster.profile_cluster = profiled
+            inference = InferenceManager(None, request_timeout_s=30.0)
+            mgr = RingModelManager(
+                inference,
+                cluster,
+                api_callback_addr="api:1",
+                max_seq=64,
+                param_dtype="float32",
+                ring_client_factory=shards._ring_factory,
+            )
+            pump_task = asyncio.ensure_future(_pump(sink, inference, stop))
+
+            # ---- epoch 1: install + full load of the 3-shard ring -----
+            topo = build_manual_topology(
+                model_id, 4,
+                _assignments((("s0", (0, 1)), ("s1", (2,)), ("s2", (3,)))),
+                shards.devices(),
+            )
+            cluster.install_topology(topo)
+            assert topo.epoch == 1
+            await mgr.load_model(model_id)
+            assert shards.loads == {"s0": 1, "s1": 1, "s2": 1}
+            assert all(rt.epoch == 1 for rt, _ in shards.shards.values())
+
+            monitor = RingFailureMonitor(
+                cluster,
+                inference,
+                model_manager=mgr,
+                interval_s=0.02,
+                fail_threshold=1,
+                timeout_s=0.5,
+                auto_recover=True,
+                ring_client_factory=lambda addr: FlakyClient(addr),
+                rejoin=True,
+                rejoin_stable_s=0.1,
+            )
+            inference.failure_monitor = monitor
+            monitor.start()
+
+            baseline = await inference.generate(_req())
+            content = baseline.choices[0].message.content
+            assert content
+
+            # ---- loss: persistent shard_compute faults + s2 unreachable
+            resumed0 = metric("dnet_request_resumed_total").value
+            stale0 = metric("dnet_stale_epoch_rejected_total").labels(
+                kind="token_cb"
+            ).value
+            rejoins0 = metric("dnet_shard_rejoins_total").value
+            # the cluster is "repaired" the moment the re-solve ships a
+            # full reload — chaos clears deterministically at that event
+            shards.on_full_load = lambda inst: clear_chaos()
+            FlakyClient.dead = {"h2:30"}
+            install_chaos("shard_compute:error:1.0", seed=7)
+            try:
+                out = await inference.generate(_req())
+            finally:
+                clear_chaos()
+                shards.on_full_load = None
+
+            # the PR 4 resume kept the SAME stream byte-identical across
+            # the epoch bump — zero stale/garbage tokens reached it
+            assert out.choices[0].message.content == content
+            assert out.usage == baseline.usage
+            assert metric("dnet_request_resumed_total").value > resumed0
+
+            # ---- delta reload observed: s0's layer range was unchanged,
+            # so it did NOT re-read weights (load spy still 1) yet serves
+            # at the new epoch; s1 took the full reload for [2, 3]
+            assert shards.loads["s0"] == 1
+            assert shards.updates.get("s0") == 1
+            assert shards.loads["s1"] == 2
+            assert cluster.epoch == 2
+            s0_rt = shards.shards["s0"][0]
+            s1_rt = shards.shards["s1"][0]
+            s2_rt = shards.shards["s2"][0]
+            assert s0_rt.epoch == 2 and s1_rt.epoch == 2
+            assert s2_rt.epoch == 1  # the zombie still pins the old epoch
+            assert "s2" in monitor.quarantine
+
+            # ---- zombie fence: a late token callback minted under epoch
+            # 1 (the fenced-out shard finishing old work) is rejected and
+            # counted, never resolved into a stream
+            inference.adapter.resolve_token(
+                TokenResult(
+                    nonce=out.id, token_id=12345, step=1, epoch=1
+                )
+            )
+            assert metric("dnet_stale_epoch_rejected_total").labels(
+                kind="token_cb"
+            ).value - stale0 == 1
+
+            # a zombie FRAME from the old epoch is fenced at shard ingress
+            frame_stale0 = metric("dnet_stale_epoch_rejected_total").labels(
+                kind="frame"
+            ).value
+            from tests.subsystems.test_membership import _frame
+
+            ok, msg = await shards.shards["s0"][1].ingress_frame(
+                _frame(epoch=1, nonce="zombie")
+            )
+            assert not ok and "stale epoch" in msg
+            assert metric("dnet_stale_epoch_rejected_total").labels(
+                kind="frame"
+            ).value - frame_stale0 == 1
+
+            # ---- rejoin: s2 probes green, stays stable, and re-enters
+            # the ring with no operator call; its own load body is
+            # unchanged so it delta-updates (weights kept) at epoch 3
+            FlakyClient.dead = set()
+            await _wait(
+                lambda: "s2" not in monitor.quarantine, 15.0, "rejoin"
+            )
+            assert metric("dnet_shard_rejoins_total").value - rejoins0 == 1
+            assert cluster.epoch == 3
+            assert s0_rt.epoch == 3 and s1_rt.epoch == 3 and s2_rt.epoch == 3
+            assert shards.loads["s0"] == 1  # STILL never re-read weights
+            assert shards.loads["s2"] == 1  # rejoin rode the delta path too
+            assert shards.updates.get("s2") == 1
+
+            # subsequent decode uses the re-solved 3-shard assignment and
+            # stays byte-identical to the pre-failure baseline
+            after = await inference.generate(_req())
+            assert after.choices[0].message.content == content
+        finally:
+            if monitor is not None:
+                await monitor.stop()
+            stop.set()
+            if pump_task is not None:
+                await pump_task
+            if inference.adapter is not None:
+                await inference.adapter.shutdown()
+            await shards.stop()
+
+    asyncio.run(go())

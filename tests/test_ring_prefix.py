@@ -127,6 +127,7 @@ def test_adapter_prefix_index_roundtrip():
     a._sent_at = {}
     a._step_ema = 0.0
     a._refill_state = {}
+    a._epoch = 0  # unfenced: no epoch checks in this unit
     ids1 = tuple(range(20))
     key1 = a._prefix_put(ids1)
     assert a._prefix_put(ids1) == key1  # idempotent
