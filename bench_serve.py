@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Serving-grade load bench: open-loop traffic -> BENCH_SERVE_*.json.
+
+The served-throughput gate ROADMAP item 5(b) calls for: where bench.py
+measures one stream's device rate, this drives a SEEDED open-loop arrival
+process of concurrent OpenAI-API streaming clients (dnet_tpu/loadgen/) and
+reports what serving actually delivered — goodput over completed requests
+only, TTFT/TPOT/E2E p50/p95/p99, the shed-rate breakdown by status and
+admission reason, SLO attainment cross-validated against the live
+`dnet_slo_*` gauges, and the decode-step phase / JIT-compile attribution
+that says WHERE the time went.
+
+Two targets:
+
+- default: an IN-PROCESS single-node server over `--model` (CPU or
+  whatever backend jax resolves) — the tier-1-reproducible smoke shape;
+- `--base-url http://api:8080`: any live deployment, including a real
+  multi-shard ring (the bench is then a pure client; phase attribution
+  reflects whatever the target's /metrics expose).
+
+Every knob also rides DNET_LOADGEN_* (config.LoadgenSettings); CLI flags
+win.  The report lands in BENCH_SERVE_r<NN>.json (next free index) unless
+--out names a path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import re
+import socket
+import sys
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="bench_serve", description=__doc__)
+    p.add_argument("--model", default="",
+                   help="checkpoint dir or catalog id (in-process mode); "
+                   "for --base-url, the model name to put in request bodies")
+    p.add_argument("--base-url", default="",
+                   help="drive a live server instead of serving in-process")
+    p.add_argument("--requests", type=int, default=None)
+    p.add_argument("--rate", type=float, default=None, dest="rate_rps",
+                   help="mean arrival rate (requests/s)")
+    p.add_argument("--arrival", choices=["poisson", "fixed"], default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--buckets", default=None,
+                   help="prompt:max_tokens,... length classes")
+    p.add_argument("--weights", default=None, help="bucket weights")
+    p.add_argument("--temperature", type=float, default=None)
+    p.add_argument("--warmup-s", type=float, default=None,
+                   help="exclude requests scheduled before this offset")
+    p.add_argument("--timeout-s", type=float, default=None)
+    p.add_argument("--slots", type=int, default=4,
+                   help="in-process: continuous-batching slots (1 = local)")
+    p.add_argument("--max-seq", type=int, default=1024)
+    p.add_argument("--param-dtype", default="bfloat16")
+    p.add_argument("--out", default="", help="report path (default: next "
+                   "BENCH_SERVE_r<NN>.json)")
+    p.add_argument("--no-rows", action="store_true",
+                   help="omit per-request rows from the report")
+    return p
+
+
+def _spec_from(args):
+    from dnet_tpu.config import get_settings
+    from dnet_tpu.loadgen import WorkloadSpec, parse_buckets
+
+    s = get_settings().loadgen
+
+    def pick(cli, env):
+        return env if cli is None else cli
+
+    return WorkloadSpec(
+        seed=pick(args.seed, s.seed),
+        requests=pick(args.requests, s.requests),
+        rate_rps=pick(args.rate_rps, s.rate_rps),
+        arrival=pick(args.arrival, s.arrival),
+        buckets=parse_buckets(
+            pick(args.buckets, s.buckets), pick(args.weights, s.weights)
+        ),
+        temperature=pick(args.temperature, s.temperature),
+        warmup_s=pick(args.warmup_s, s.warmup_s),
+        timeout_s=pick(args.timeout_s, s.timeout_s),
+    )
+
+
+def _next_report_path() -> Path:
+    used = set()
+    for f in Path(".").glob("BENCH_SERVE_r*.json"):
+        m = re.match(r"BENCH_SERVE_r(\d+)\.json$", f.name)
+        if m:
+            used.add(int(m.group(1)))
+    n = 1
+    while n in used:
+        n += 1
+    return Path(f"BENCH_SERVE_r{n:02d}.json")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _run_remote(args, spec) -> dict:
+    import aiohttp
+
+    from dnet_tpu.loadgen import run_load
+
+    # no session-level cap: the per-request budget (spec.timeout_s via
+    # run_request's wait_for) owns the timeout; aiohttp's default
+    # ClientTimeout(total=300) would silently override longer budgets
+    async with aiohttp.ClientSession(
+        base_url=args.base_url, timeout=aiohttp.ClientTimeout(total=None)
+    ) as session:
+        result = await run_load(
+            session, spec, args.model or "default",
+            include_rows=not args.no_rows,
+            meta={"target": args.base_url, "mode": "remote"},
+        )
+    return result.report
+
+
+async def _run_inprocess(args, spec) -> dict:
+    """Single-node serving stack in this process (the bench.py-measured
+    engines behind the REAL admission/SSE/driver path), driven over a
+    loopback HTTP port so the client half is identical to remote mode."""
+    import aiohttp
+
+    from dnet_tpu.api.http import ApiHTTPServer
+    from dnet_tpu.api.inference import InferenceManager
+    from dnet_tpu.api.model_manager import LocalModelManager
+    from dnet_tpu.config import get_settings
+    from dnet_tpu.loadgen import run_load
+
+    api = get_settings().api
+    inference = InferenceManager(
+        adapter=None,
+        request_timeout_s=api.request_timeout_s,
+        # admission must not out-admit the engine's slot pool: excess load
+        # then queues (and sheds with Retry-After) at the admission layer
+        # instead of hard-failing against the batch-slot pool
+        max_concurrent=min(api.max_concurrent_requests, max(args.slots, 1)),
+    )
+    manager = LocalModelManager(
+        inference,
+        models_dir=api.models_dir,
+        max_seq=args.max_seq,
+        param_dtype=args.param_dtype,
+        batch_slots=args.slots,
+    )
+    await manager.load_model(args.model, max_seq=args.max_seq)
+    server = ApiHTTPServer(inference, manager)
+    port = _free_port()
+    await server.start("127.0.0.1", port)
+    try:
+        async with aiohttp.ClientSession(
+            base_url=f"http://127.0.0.1:{port}",
+            # per-request wait_for owns the budget (see remote mode)
+            timeout=aiohttp.ClientTimeout(total=None),
+        ) as session:
+            result = await run_load(
+                session, spec, args.model,
+                include_rows=not args.no_rows,
+                meta={
+                    "mode": "in-process",
+                    "slots": args.slots,
+                    "max_seq": args.max_seq,
+                    "param_dtype": args.param_dtype,
+                },
+            )
+    finally:
+        await server.stop()
+        await manager.unload_model()
+    return result.report
+
+
+def _summarize(report: dict) -> str:
+    r = report["requests"]
+    g = report["goodput"]
+    lat = report["latency_ms"]
+    lines = [
+        f"requests: {r['completed']}/{r['measured']} completed, "
+        f"{r['shed']} shed ({r['shed_by_status']}), {r['failed']} failed",
+        f"goodput: {g['tok_s']} tok/s ({g['tokens_out']} tokens over "
+        f"{report['measured_window_s']}s)",
+        f"ttft ms p50/p95/p99: {lat['ttft']['p50_ms']}/"
+        f"{lat['ttft']['p95_ms']}/{lat['ttft']['p99_ms']}",
+        f"tpot ms p50/p95/p99: {lat['tpot']['p50_ms']}/"
+        f"{lat['tpot']['p95_ms']}/{lat['tpot']['p99_ms']}",
+    ]
+    pa = report.get("phase_attribution")
+    if pa and pa["decode_step"]["count"]:
+        parts = ", ".join(
+            f"{ph}={v['sum_ms']:.0f}ms" for ph, v in pa["phases"].items()
+        )
+        lines.append(f"decode phases: {parts} (coverage {pa['coverage']})")
+    slo = report.get("slo")
+    if slo:
+        lines.append(
+            f"slo attained: {slo['attained']} (burning: {slo['burning']})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import os
+
+    # honest attribution needs the obs fences; the bench opts in for its
+    # own process (a remote target keeps its own setting)
+    os.environ.setdefault("DNET_OBS_ENABLED", "1")
+    args = build_parser().parse_args(argv)
+    if not args.base_url and not args.model:
+        print("error: --model is required without --base-url",
+              file=sys.stderr)
+        return 2
+    from dnet_tpu.config import reset_settings_cache
+
+    reset_settings_cache()
+    spec = _spec_from(args)
+    runner = _run_remote if args.base_url else _run_inprocess
+    report = asyncio.run(runner(args, spec))
+    out = Path(args.out) if args.out else _next_report_path()
+    out.write_text(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    print(_summarize(report))
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

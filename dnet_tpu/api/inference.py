@@ -545,11 +545,20 @@ class InferenceManager:
             self._cancel_cleanups.add(task)
             task.add_done_callback(self._cancel_cleanups.discard)
             raise
-        except Exception:
+        except Exception as exc:
             # client disconnects / task cancels (BaseException) are not
-            # server errors; InferenceError and friends are
-            _REQUEST_ERRORS.inc()
-            slo.record_request(ok=False)
+            # server errors; InferenceError and friends are.  Shed work is
+            # not FAILED work either (the PR 5 status-code contract): a 429
+            # capacity refusal or 504 expired deadline must not burn the
+            # availability SLO or the error counter — otherwise every
+            # overload the admission layer survives correctly would read
+            # as an outage, and the load harness's availability (which
+            # also excludes shed) could never cross-validate against the
+            # live gauge.  Shed volume stays visible through
+            # dnet_admit_rejected_total / dnet_deadline_exceeded_total.
+            if not isinstance(exc, (BackpressureError, DeadlineExceededError)):
+                _REQUEST_ERRORS.inc()
+                slo.record_request(ok=False)
             raise
         finally:
             # guarded cleanup: reset_cache can itself raise when the ring

@@ -155,6 +155,11 @@ class ObsSettings(_EnvGroup):
     slo_availability: float = 0.0  # e.g. 0.999; fraction of requests OK
     # /v1/cluster/metrics + cluster timeline: per-shard HTTP fetch timeout
     cluster_scrape_timeout_s: float = 5.0
+    # flight-recorder sampling under load: record every Nth request's full
+    # span timeline (summary spans — ttft, the closing request span — are
+    # recorded for EVERY request regardless).  1 = record everything; N > 1
+    # keeps a load run from thrashing the bounded timeline ring.
+    trace_sample: int = 1
 
     def sync_stride(self) -> int:
         """Normalized decode-step sync cadence: 0 = never fence, N >= 1 =
@@ -268,6 +273,39 @@ class AdmissionSettings(_EnvGroup):
     request_deadline_s: float = 0.0
     # how long SIGTERM waits for in-flight requests before tearing down
     drain_deadline_s: float = 30.0
+
+
+@dataclass
+class LoadgenSettings(_EnvGroup):
+    """Serving-grade load generation (dnet_tpu/loadgen/): an OPEN-LOOP
+    arrival process (requests fire on schedule, never gated on completions)
+    of N concurrent OpenAI-API streaming clients with a seeded mixed
+    prompt/output-length workload.  `bench_serve.py` drives it and emits a
+    machine-readable ``BENCH_SERVE_*.json`` report (goodput over completed
+    requests only, TTFT/TPOT/E2E tail percentiles, shed-rate breakdown,
+    SLO cross-validation, decode-phase and JIT-compile summaries).
+    """
+
+    env_prefix = "DNET_LOADGEN_"
+    # workload schedule: a pure function of (seed, requests, rate, buckets)
+    seed: int = 0
+    requests: int = 64
+    # mean arrival rate; poisson draws exponential inter-arrivals, fixed
+    # spaces arrivals exactly 1/rate apart
+    rate_rps: float = 8.0
+    arrival: str = "poisson"  # poisson | fixed
+    # mixed length classes "prompt:max_tokens,..." (tokens are exact for
+    # byte-level tokenizers, approximate for BPE)
+    buckets: str = "8:16,32:8,64:4"
+    # optional comma floats weighting the buckets (default: uniform)
+    weights: str = ""
+    temperature: float = 0.0
+    # report measurement starts here: requests SCHEDULED before warmup_s
+    # still run (they warm compiles/caches) but are excluded from goodput
+    # and percentiles
+    warmup_s: float = 0.0
+    # per-request client-side budget (stream must finish within this)
+    timeout_s: float = 120.0
 
 
 @dataclass
@@ -438,6 +476,7 @@ class Settings:
     transport: TransportSettings = field(default_factory=TransportSettings.from_env)
     resilience: ResilienceSettings = field(default_factory=ResilienceSettings.from_env)
     admission: AdmissionSettings = field(default_factory=AdmissionSettings.from_env)
+    loadgen: LoadgenSettings = field(default_factory=LoadgenSettings.from_env)
     membership: MembershipSettings = field(default_factory=MembershipSettings.from_env)
     chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
     grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
@@ -455,6 +494,7 @@ for _cls in (
     TransportSettings,
     ResilienceSettings,
     AdmissionSettings,
+    LoadgenSettings,
     MembershipSettings,
     ChaosSettings,
     GrpcSettings,

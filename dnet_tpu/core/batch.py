@@ -52,10 +52,20 @@ from dnet_tpu.kv import (
     PageTable,
     paged_enabled,
 )
-from dnet_tpu.obs import get_recorder
+from dnet_tpu.obs import get_recorder, metric, obs_enabled
+from dnet_tpu.obs.jit import instrument_jit
+from dnet_tpu.obs.phases import (
+    PHASE_COMPUTE,
+    PHASE_KV_GATHER,
+    PHASE_KV_SCATTER,
+    PHASE_SAMPLE,
+)
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
+
+_PHASE_MS = metric("dnet_step_phase_ms")
+_DECODE_STEP_MS = metric("dnet_decode_step_ms")
 
 
 class BatchedEngine:
@@ -251,7 +261,9 @@ class BatchedEngine:
             in_axes=(None, None, 0, kv_axes, 0, 0, sp_axes, 0, 0),
             out_axes=(0, kv_axes, 0, 0),
         )
-        self._step = jax.jit(self._vmapped, donate_argnums=(3, 8))
+        self._step = instrument_jit(
+            jax.jit(self._vmapped, donate_argnums=(3, 8)), "batched_step"
+        )
         # fused R-step chunks (budget-driven): sampled tokens re-enter their
         # lanes on device, one dispatch + one packed read per R tokens
         self._chunks: Dict[int, Any] = {}
@@ -293,7 +305,10 @@ class BatchedEngine:
                 in_axes=(None, None, 0, 0, kv_axes, 0, 0),
                 out_axes=(0, 0, kv_axes),
             )
-            self._spec_step = jax.jit(self._spec_vmapped, donate_argnums=(3, 4))
+            self._spec_step = instrument_jit(
+                jax.jit(self._spec_vmapped, donate_argnums=(3, 4)),
+                "batched_spec",
+            )
 
     # chunk widths tried largest-first (bounded compiled-program set, same
     # discipline as LocalEngine.DECODE_CHUNK_BUCKETS)
@@ -322,7 +337,9 @@ class BatchedEngine:
                 )
                 return stacked, kv, counts, keys
 
-            fn = jax.jit(chunk, donate_argnums=(3, 8))
+            fn = instrument_jit(
+                jax.jit(chunk, donate_argnums=(3, 8)), "batched_chunk"
+            )
             self._chunks[R] = fn
         return fn
 
@@ -718,6 +735,14 @@ class BatchedEngine:
             cap = min((budgets.get(n) or 1) for n in order)
             cap = min(cap, *(int(self.max_seq - self.pos[s]) for s in order.values()))
             R = next((r for r in self.CHUNK_BUCKETS if r <= cap), 1)
+        # performance attribution (obs/phases.py): when obs is enabled the
+        # phase boundaries are FENCED (block_until_ready) so kv_gather /
+        # compute / kv_scatter / sample carry honest device time instead of
+        # async-dispatch noise — the device-sync gating contract from
+        # dnet_tpu.obs.  The parent dnet_decode_step_ms observation always
+        # records (the step ends in a synchronous host readback anyway).
+        attribute = obs_enabled()
+        t_parent = time.perf_counter()
         if self.kv_pool is not None:
             # block-table extension is admission: a lane the pool cannot
             # cover fails ALONE with the typed backpressure message
@@ -725,7 +750,14 @@ class BatchedEngine:
             if not order:
                 return out_buf, errors
         paged = self.kv_pool is not None
-        kv_in = self.kv if not paged else self.kv_store.gather(self._table_ids())
+        if paged:
+            t0 = time.perf_counter()
+            kv_in = self.kv_store.gather(self._table_ids())
+            if attribute:
+                jax.block_until_ready(kv_in)
+                self._observe_phase(PHASE_KV_GATHER, t0, order, R)
+        else:
+            kv_in = self.kv
         args = (
             self.eng.window_params,
             self.eng.edge_params,
@@ -737,10 +769,16 @@ class BatchedEngine:
             self.keys,
             self.counts,
         )
+        t0 = time.perf_counter()
         if R > 1:
             stacked, kv_out, self.counts, self.keys = self._chunk_fn(R)(*args)
+            src = stacked
         else:
             res, kv_out, self.counts, self.keys = self._step(*args)
+            src = res
+        if attribute:
+            jax.block_until_ready((src, kv_out))
+            self._observe_phase(PHASE_COMPUTE, t0, order, R)
         if paged:
             # persist ONLY the blocks this step wrote (block-append write);
             # the contiguous view kv_out is scratch and dies here
@@ -753,20 +791,26 @@ class BatchedEngine:
                     (slot, b, tbl.blocks[b])
                     for b in range(p0 // bt, (p0 + R - 1) // bt + 1)
                 )
+            t0 = time.perf_counter()
             self.kv_store.scatter(kv_out, triples)
+            if attribute:
+                jax.block_until_ready(self.kv_store.kv)
+                self._observe_phase(PHASE_KV_SCATTER, t0, order, R)
         else:
             self.kv = kv_out
         now = time.time()
         out: Dict[str, SampleResult] = dict(out_buf)
-        if R > 1:
-            # ONE packed device->host read per field per chunk (the
-            # pipelined engine's drain pattern), then host-side slicing —
-            # per-element device gathers would reintroduce the dispatch
-            # overhead the fused chunk exists to remove
-            toks = np.asarray(stacked.token)
-            lps = np.asarray(stacked.logprob)
-            tts = np.asarray(stacked.top_tokens)
-            tlps = np.asarray(stacked.top_logprobs)
+        # ONE packed device->host read per field per dispatch (the
+        # pipelined engine's drain pattern), then host-side slicing —
+        # per-element device gathers would reintroduce the dispatch
+        # overhead the fused chunk exists to remove
+        t0 = time.perf_counter()
+        toks = np.asarray(src.token)
+        lps = np.asarray(src.logprob)
+        tts = np.asarray(src.top_tokens)
+        tlps = np.asarray(src.top_logprobs)
+        if attribute:
+            self._observe_phase(PHASE_SAMPLE, t0, order, R)
         for nonce, slot in order.items():
             self.pos[slot] += R
             self.last_used[slot] = now
@@ -780,12 +824,30 @@ class BatchedEngine:
                 self._buffer.setdefault(nonce, []).extend(rows[1:])
             else:
                 out[nonce] = SampleResult(
-                    token=res.token[slot],
-                    logprob=res.logprob[slot],
-                    top_tokens=res.top_tokens[slot],
-                    top_logprobs=res.top_logprobs[slot],
+                    token=toks[slot], logprob=lps[slot],
+                    top_tokens=tts[slot], top_logprobs=tlps[slot],
                 )
+        # per-token share, observed tokens-served times: the family's
+        # count stays == tokens across the local / chunked / speculative /
+        # batched paths (LocalEngine's amortization convention), and the
+        # sum stays == dispatch wall so the phase sums still account for it
+        n_tok = R * len(order)
+        per_tok_ms = (time.perf_counter() - t_parent) * 1000.0 / n_tok
+        for _ in range(n_tok):
+            _DECODE_STEP_MS.observe(per_tok_ms)
         return out, errors
+
+    def _observe_phase(
+        self, phase: str, t0: float, order: Dict[str, int], R: int
+    ) -> None:
+        """One histogram observation per dispatch, plus a recorder span on
+        every participating request's timeline (the recorder applies its
+        own trace sampling)."""
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        _PHASE_MS.labels(phase=phase).observe(dur_ms)
+        rec = get_recorder()
+        for nonce in order:
+            rec.span(nonce, phase, dur_ms, batch=len(order), chunk=R)
 
     # adaptive spec gate, same thresholds/semantics as LocalEngine's
     SPEC_WARMUP_BLOCKS = LocalEngine.SPEC_WARMUP_BLOCKS
@@ -810,18 +872,22 @@ class BatchedEngine:
             token[slot, 0] = tok
             active[slot] = True
             pos[slot] = self.pos[slot]
+        t_blk = time.perf_counter()
         out_block, self.hist, self.kv = self._spec_step(
             self.eng.window_params, self.eng.edge_params, jnp.asarray(token),
             self.hist, self.kv, jnp.asarray(pos), jnp.asarray(active),
         )
         out_h = np.asarray(out_block)  # [slots, L+1]; -1 past acceptance
+        blk_ms = (time.perf_counter() - t_blk) * 1000.0
         now = time.time()
         zero_lp = np.zeros((1,), np.float32)
         zero_tt = np.zeros((1, MAX_TOP_LOGPROBS), np.int32)
         zero_tlp = np.zeros((1, MAX_TOP_LOGPROBS), np.float32)
         res: Dict[str, SampleResult] = {}
+        total_emitted = 0
         for nonce, (_tok, slot, budget) in spec_reqs.items():
             emitted = min(int((out_h[slot] >= 0).sum()), budget)
+            total_emitted += emitted
             rows = [
                 SampleResult(
                     np.ascontiguousarray(out_h[slot, i : i + 1]).astype(np.int32),
@@ -837,6 +903,13 @@ class BatchedEngine:
             res[nonce] = rows[0]
             if rows[1:]:
                 self._buffer.setdefault(nonce, []).extend(rows[1:])
+        # the verify block amortizes one dispatch over every accepted
+        # token: per-token share, observed tokens-served times (the same
+        # convention as the plain batched dispatch and LocalEngine's spec
+        # path, keeping the family's count == tokens on every path)
+        per_tok_ms = blk_ms / max(total_emitted, 1)
+        for _ in range(total_emitted):
+            _DECODE_STEP_MS.observe(per_tok_ms)
         return res
 
     def warm_chunks(self) -> None:

@@ -41,6 +41,7 @@ from dnet_tpu.kv import (
 )
 from dnet_tpu.models import ModelConfig, get_ring_model_cls
 from dnet_tpu.obs import get_recorder, metric
+from dnet_tpu.obs.jit import instrument_jit
 from dnet_tpu.utils.checkpoint import Checkpoint
 from dnet_tpu.utils.logger import get_logger
 
@@ -436,7 +437,11 @@ class LocalEngine:
             return logits[:, 0], kv
 
         # donate kv (arg 3): each step reuses the cache buffers in place
-        self._forward = jax.jit(full_logits, donate_argnums=(3,))
+        # (instrumented: dnet_jit_compiles_total{fn=} separates warmup
+        # compiles from steady state in load reports)
+        self._forward = instrument_jit(
+            jax.jit(full_logits, donate_argnums=(3,)), "local_prefill"
+        )
 
         def decode_and_sample(window_params, edge_params, token, kv, pos, sp, key, counts,
                               plan=None):
@@ -445,7 +450,11 @@ class LocalEngine:
             counts = counts.at[jnp.arange(counts.shape[0]), res.token].add(1)
             return res, kv, counts
 
-        self._decode = jax.jit(decode_and_sample, static_argnums=(8,), donate_argnums=(3, 7))
+        self._decode = instrument_jit(
+            jax.jit(decode_and_sample, static_argnums=(8,),
+                    donate_argnums=(3, 7)),
+            "local_decode",
+        )
 
         def decode_chunk_fn(window_params, edge_params, token, kv, pos, sp, key, counts,
                             n_steps, plan=None):
@@ -476,8 +485,10 @@ class LocalEngine:
             packed = pack_chunk_results(results, plan is None or plan.logprobs)
             return packed, last_tok, kv, key, counts
 
-        self._decode_chunk = jax.jit(
-            decode_chunk_fn, static_argnums=(8, 9), donate_argnums=(3, 7)
+        self._decode_chunk = instrument_jit(
+            jax.jit(decode_chunk_fn, static_argnums=(8, 9),
+                    donate_argnums=(3, 7)),
+            "local_decode_chunk",
         )
 
         def hidden_step(window_params, x, kv, pos, t_real, kinds=None):

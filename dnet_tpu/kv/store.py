@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dnet_tpu.kv.paged import PagedKVConfig
+from dnet_tpu.obs.jit import instrument_jit
 
 
 def _bucket_pow2(n: int) -> int:
@@ -116,8 +117,12 @@ class BlockStore:
 
             return jax.tree.map(one, pool, dense)
 
-        self._gather = jax.jit(gather)
-        self._scatter = jax.jit(scatter, donate_argnums=(0,))
+        # instrumented: a page-table geometry leak re-tracing these per
+        # step shows as climbing dnet_jit_compiles_total{fn=kv_*}
+        self._gather = instrument_jit(jax.jit(gather), "kv_gather")
+        self._scatter = instrument_jit(
+            jax.jit(scatter, donate_argnums=(0,)), "kv_scatter"
+        )
 
     # ---- ops ----------------------------------------------------------
     def gather(self, ids: np.ndarray) -> dict:

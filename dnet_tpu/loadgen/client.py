@@ -1,0 +1,222 @@
+"""One OpenAI-API streaming client request -> one outcome row.
+
+`run_request` drives a single planned request over any aiohttp-compatible
+session (a real `aiohttp.ClientSession(base_url=...)` against a live ring,
+or an `aiohttp.test_utils.TestClient` for the in-process tier-1 smoke run —
+both expose `.post(path, json=...)` returning a streaming response) and
+records everything the report needs: HTTP status, shed classification,
+TTFT, per-token inter-arrival latencies, tokens out, end-to-end wall time.
+
+Timing is CLIENT-side (send -> SSE chunk arrivals), the latency a caller
+actually experiences; the report cross-validates these against the
+server-side `dnet_slo_*` gauges.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from dnet_tpu.loadgen.workload import PlannedRequest
+
+# admission/overload shed statuses: these rows are SHED (never goodput,
+# never availability failures) — everything else non-200 is a failure
+SHED_STATUSES = (429, 503, 504)
+
+# message-substring -> shed reason, mirroring the server's typed surfaces
+# (admission/reasons.py reject reasons + the backpressure markers of
+# api/inference.py)
+_REASON_MARKERS = (
+    ("queue full", "queue_full"),
+    # AdmissionController's queue_timeout rejection reads "no slot within
+    # {timeout}s (DNET_ADMIT_QUEUE_TIMEOUT_S)" — match that, not the
+    # reason's enum name (which never appears in the message)
+    ("no slot within", "queue_timeout"),
+    ("draining", "draining"),
+    ("deadline", "deadline"),
+    ("degraded", "degraded"),
+    ("paged KV pool exhausted", "backpressure"),
+    ("no free lanes", "backpressure"),
+    ("no free batch slots", "backpressure"),
+)
+
+
+def classify_shed(status: int, message: str) -> str:
+    """Map a shed response to the admission-reason vocabulary."""
+    for marker, reason in _REASON_MARKERS:
+        if marker in message:
+            return reason
+    return {429: "backpressure", 503: "unavailable", 504: "deadline"}.get(
+        status, "other"
+    )
+
+
+@dataclass
+class RequestOutcome:
+    """One row of the load report (ISSUE: one outcome row per request)."""
+
+    index: int
+    t_sched_s: float  # planned arrival offset
+    t_start_s: float  # actual send offset from run start
+    status: int = 0
+    ok: bool = False  # 200 AND the stream finished cleanly
+    shed: bool = False
+    shed_reason: str = ""
+    error: str = ""
+    finish_reason: str = ""
+    ttft_ms: float = 0.0
+    e2e_ms: float = 0.0
+    tokens_out: int = 0
+    prompt_tokens: int = 0
+    retry_after_s: float = 0.0
+    itl_ms: List[float] = field(default_factory=list)  # inter-token gaps
+
+    def as_dict(self) -> dict:
+        d = {
+            "index": self.index,
+            "t_sched_s": round(self.t_sched_s, 4),
+            "t_start_s": round(self.t_start_s, 4),
+            "status": self.status,
+            "ok": self.ok,
+            "ttft_ms": round(self.ttft_ms, 2),
+            "e2e_ms": round(self.e2e_ms, 2),
+            "tokens_out": self.tokens_out,
+            "prompt_tokens": self.prompt_tokens,
+        }
+        if self.shed:
+            d["shed"] = True
+            d["shed_reason"] = self.shed_reason
+            if self.retry_after_s:
+                d["retry_after_s"] = self.retry_after_s
+        if self.error:
+            d["error"] = self.error[:200]
+        if self.finish_reason:
+            d["finish_reason"] = self.finish_reason
+        return d
+
+
+def chat_body(planned: PlannedRequest, model: str) -> dict:
+    body = {
+        "model": model,
+        "messages": [{"role": "user", "content": planned.prompt}],
+        "max_tokens": planned.max_tokens,
+        "temperature": planned.temperature,
+        "stream": True,
+    }
+    if planned.temperature > 0:
+        body["seed"] = planned.seed
+    return body
+
+
+async def run_request(
+    session,
+    planned: PlannedRequest,
+    model: str,
+    t0: float,
+    *,
+    path: str = "/v1/chat/completions",
+    timeout_s: float = 120.0,
+) -> RequestOutcome:
+    """Execute one planned request NOW (the runner owns the arrival sleep)
+    and return its outcome row.  Never raises: transport/timeout errors
+    become failed rows so one bad request cannot sink the run."""
+    out = RequestOutcome(
+        index=planned.index,
+        t_sched_s=planned.t_s,
+        t_start_s=time.perf_counter() - t0,
+    )
+    try:
+        out_done = asyncio.wait_for(
+            _drive(session, planned, model, path, out), timeout_s
+        )
+        await out_done
+    except asyncio.TimeoutError:
+        out.ok = False
+        out.error = f"client timeout after {timeout_s}s"
+    except Exception as exc:  # transport-level failure
+        out.ok = False
+        out.error = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+async def _drive(session, planned, model, path, out: RequestOutcome) -> None:
+    t_send = time.perf_counter()
+    resp = await session.post(path, json=chat_body(planned, model))
+    try:
+        out.status = resp.status
+        if resp.status != 200:
+            out.shed = resp.status in SHED_STATUSES
+            try:
+                body = await resp.json()
+                message = body.get("error", {}).get("message", "")
+            except Exception:
+                message = ""
+            out.error = message or f"HTTP {resp.status}"
+            if out.shed:
+                out.shed_reason = classify_shed(resp.status, message)
+                ra = resp.headers.get("Retry-After")
+                if ra is not None:
+                    try:
+                        out.retry_after_s = float(ra)
+                    except ValueError:
+                        pass
+            return
+        t_last: Optional[float] = None
+        finished = False
+        async for raw in resp.content:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data:"):
+                continue
+            payload = line[len("data:"):].strip()
+            if payload == "[DONE]":
+                finished = True
+                break
+            try:
+                chunk = json.loads(payload)
+            except json.JSONDecodeError:
+                continue
+            err = chunk.get("error")
+            if err:
+                # in-band mid-stream error event (post-commit shed/failure)
+                out.error = err.get("message", "stream error")
+                kind = err.get("type", "")
+                if kind in ("deadline_exceeded", "rate_limit_exceeded"):
+                    out.shed = True
+                    out.status = 504 if kind == "deadline_exceeded" else 429
+                    out.shed_reason = classify_shed(out.status, out.error)
+                continue
+            choices = chunk.get("choices") or []
+            delta = (choices[0].get("delta") or {}) if choices else {}
+            if delta.get("content"):
+                now = time.perf_counter()
+                if t_last is None:
+                    out.ttft_ms = (now - t_send) * 1000.0
+                else:
+                    out.itl_ms.append((now - t_last) * 1000.0)
+                t_last = now
+            if choices and choices[0].get("finish_reason"):
+                out.finish_reason = choices[0]["finish_reason"]
+            usage = chunk.get("usage")
+            if usage:
+                out.tokens_out = int(usage.get("completion_tokens", 0))
+                out.prompt_tokens = int(usage.get("prompt_tokens", 0))
+        out.e2e_ms = (time.perf_counter() - t_send) * 1000.0
+        if out.ttft_ms == 0.0 and t_last is None and finished:
+            # zero-content stream (immediate EOS): TTFT is the final-chunk
+            # arrival — there was never a content token to stamp
+            out.ttft_ms = out.e2e_ms
+        out.ok = finished and not out.error and not out.shed
+        if not finished and not out.error:
+            out.error = "stream ended without [DONE]"
+    finally:
+        release = getattr(resp, "release", None)
+        if release is not None:
+            try:
+                maybe = release()
+                if asyncio.iscoroutine(maybe):
+                    await maybe
+            except Exception:
+                pass

@@ -1,0 +1,92 @@
+"""Open-loop run orchestration: schedule -> concurrent clients -> report.
+
+`run_load` drives one seeded workload against a live server through any
+aiohttp-compatible session, bracketing the run with `/metrics` scrapes (for
+the phase/JIT attribution deltas) and closing with a `/health` fetch (for
+the live SLO cross-check).  Arrivals are open-loop: every planned request
+gets its own task that sleeps until its scheduled offset and fires
+regardless of how many are still in flight — backpressure shows up as shed
+rows, not as a silently stretched schedule.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from dnet_tpu.loadgen.client import RequestOutcome, run_request
+from dnet_tpu.loadgen.report import build_report, parse_prometheus
+from dnet_tpu.loadgen.workload import PlannedRequest, WorkloadSpec, schedule
+
+
+@dataclass
+class LoadResult:
+    outcomes: List[RequestOutcome]
+    report: dict
+    duration_s: float
+
+
+async def _scrape_metrics(session) -> Optional[Dict[str, float]]:
+    try:
+        resp = await session.get("/metrics")
+        text = await resp.text()
+        if resp.status != 200:
+            return None
+        return parse_prometheus(text)
+    except Exception:
+        return None
+
+
+async def _fetch_health(session) -> Optional[dict]:
+    try:
+        resp = await session.get("/health")
+        return await resp.json()
+    except Exception:
+        return None
+
+
+async def run_load(
+    session,
+    spec: WorkloadSpec,
+    model: str,
+    *,
+    path: str = "/v1/chat/completions",
+    include_rows: bool = True,
+    meta: Optional[dict] = None,
+    on_outcome=None,
+) -> LoadResult:
+    """Execute the spec's full schedule and build the BENCH_SERVE report."""
+    plan = schedule(spec)
+    metrics_before = await _scrape_metrics(session)
+    t0 = time.perf_counter()
+
+    async def fire(p: PlannedRequest) -> RequestOutcome:
+        delay = p.t_s - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        out = await run_request(
+            session, p, model, t0, path=path, timeout_s=spec.timeout_s
+        )
+        if on_outcome is not None:
+            on_outcome(out)
+        return out
+
+    outcomes = list(await asyncio.gather(*(fire(p) for p in plan)))
+    duration_s = time.perf_counter() - t0
+    # /health FIRST: its snapshot() refresh is what also makes the metrics
+    # scrape's slo gauges current for the same instant
+    health = await _fetch_health(session)
+    metrics_after = await _scrape_metrics(session)
+    report = build_report(
+        outcomes,
+        spec=spec,
+        duration_s=duration_s,
+        health=health,
+        metrics_before=metrics_before,
+        metrics_after=metrics_after,
+        include_rows=include_rows,
+        meta=meta,
+    )
+    return LoadResult(outcomes=outcomes, report=report, duration_s=duration_s)

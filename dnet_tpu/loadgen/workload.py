@@ -1,0 +1,183 @@
+"""Seeded workload specs and open-loop arrival schedules.
+
+The schedule is a PURE function of the spec: `schedule(spec)` with the same
+seed yields byte-identical arrival times, prompts, and token budgets, so a
+load run — and any regression it catches — replays exactly (the same
+discipline as the chaos harness).  Arrivals are OPEN-LOOP: each request
+fires at its scheduled offset regardless of how the server is keeping up,
+which is what makes shed rate and tail latency honest under overload
+(closed-loop clients self-throttle and hide both; vLLM-style serving
+benchmarks use the same methodology).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Tuple
+
+# deterministic lexicon for prompt text: lowercase words keep byte-level
+# tokenizers exact (1 char = 1 token) and BPE tokenizers close
+_WORD_CHARS = "abcdefghijklmnopqrstuvwxyz"
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One length class of the mixed workload."""
+
+    prompt_tokens: int
+    max_tokens: int
+    weight: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.prompt_tokens < 1 or self.max_tokens < 1:
+            raise ValueError("bucket lengths must be >= 1")
+        if self.weight <= 0:
+            raise ValueError("bucket weight must be > 0")
+
+
+def parse_buckets(spec: str, weights: str = "") -> Tuple[Bucket, ...]:
+    """``"8:16,32:8"`` (+ optional ``"3,1"`` weights) -> Bucket tuple."""
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    if not parts:
+        raise ValueError("empty bucket spec")
+    ws = [w.strip() for w in weights.split(",") if w.strip()]
+    if ws and len(ws) != len(parts):
+        raise ValueError(
+            f"{len(ws)} weights for {len(parts)} buckets"
+        )
+    out = []
+    for i, part in enumerate(parts):
+        try:
+            p, _, m = part.partition(":")
+            out.append(
+                Bucket(
+                    prompt_tokens=int(p),
+                    max_tokens=int(m),
+                    weight=float(ws[i]) if ws else 1.0,
+                )
+            )
+        except ValueError as exc:
+            raise ValueError(
+                f"bad bucket {part!r} (want prompt:max_tokens): {exc}"
+            ) from exc
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class PlannedRequest:
+    """One scheduled request of the open-loop run."""
+
+    index: int
+    t_s: float  # arrival offset from run start
+    prompt: str
+    prompt_tokens: int  # the bucket's nominal prompt length
+    max_tokens: int
+    temperature: float = 0.0
+    seed: int = 0  # per-request sampling seed (deterministic streams)
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    seed: int = 0
+    requests: int = 64
+    rate_rps: float = 8.0
+    arrival: str = "poisson"  # poisson | fixed
+    buckets: Tuple[Bucket, ...] = (
+        Bucket(8, 16), Bucket(32, 8), Bucket(64, 4),
+    )
+    temperature: float = 0.0
+    warmup_s: float = 0.0
+    timeout_s: float = 120.0
+
+    def __post_init__(self) -> None:
+        if self.requests < 1:
+            raise ValueError("requests must be >= 1")
+        if self.rate_rps <= 0:
+            raise ValueError("rate_rps must be > 0")
+        if self.arrival not in ("poisson", "fixed"):
+            raise ValueError(
+                f"arrival must be poisson|fixed, got {self.arrival!r}"
+            )
+        if not self.buckets:
+            raise ValueError("spec needs at least one bucket")
+
+    @classmethod
+    def from_settings(cls, settings=None) -> "WorkloadSpec":
+        """Resolve from the DNET_LOADGEN_* group."""
+        if settings is None:
+            from dnet_tpu.config import get_settings
+
+            settings = get_settings().loadgen
+        return cls(
+            seed=settings.seed,
+            requests=settings.requests,
+            rate_rps=settings.rate_rps,
+            arrival=settings.arrival,
+            buckets=parse_buckets(settings.buckets, settings.weights),
+            temperature=settings.temperature,
+            warmup_s=settings.warmup_s,
+            timeout_s=settings.timeout_s,
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "requests": self.requests,
+            "rate_rps": self.rate_rps,
+            "arrival": self.arrival,
+            "buckets": [
+                {"prompt_tokens": b.prompt_tokens,
+                 "max_tokens": b.max_tokens, "weight": b.weight}
+                for b in self.buckets
+            ],
+            "temperature": self.temperature,
+            "warmup_s": self.warmup_s,
+            "timeout_s": self.timeout_s,
+        }
+
+
+def _prompt_text(rng: random.Random, n_tokens: int) -> str:
+    """Deterministic prose of exactly `n_tokens` characters: words of 2-8
+    lowercase letters separated by single spaces (every char one token
+    under a byte-level tokenizer; close under BPE)."""
+    chars: List[str] = []
+    while len(chars) < n_tokens:
+        remaining = n_tokens - len(chars)
+        if remaining <= 2:
+            chars.extend(rng.choice(_WORD_CHARS) for _ in range(remaining))
+            break
+        w = min(rng.randint(2, 8), remaining - 1 if remaining > 2 else remaining)
+        chars.extend(rng.choice(_WORD_CHARS) for _ in range(w))
+        if len(chars) < n_tokens:
+            chars.append(" ")
+    return "".join(chars[:n_tokens])
+
+
+def schedule(spec: WorkloadSpec) -> List[PlannedRequest]:
+    """The full run plan, deterministically derived from the spec."""
+    # str seeds hash with a stable algorithm (unlike tuples, whose hash
+    # varies per process under PYTHONHASHSEED randomization)
+    rng = random.Random(f"dnet-loadgen:{spec.seed}")
+    weights = [b.weight for b in spec.buckets]
+    t = 0.0
+    out: List[PlannedRequest] = []
+    for i in range(spec.requests):
+        if i > 0:
+            if spec.arrival == "poisson":
+                t += rng.expovariate(spec.rate_rps)
+            else:
+                t += 1.0 / spec.rate_rps
+        bucket = rng.choices(spec.buckets, weights=weights, k=1)[0]
+        out.append(
+            PlannedRequest(
+                index=i,
+                t_s=t,
+                prompt=_prompt_text(rng, bucket.prompt_tokens),
+                prompt_tokens=bucket.prompt_tokens,
+                max_tokens=bucket.max_tokens,
+                temperature=spec.temperature,
+                seed=rng.randrange(2**31),
+            )
+        )
+    return out

@@ -287,6 +287,17 @@ def _register_core(reg: MetricsRegistry) -> None:
         "dnet_slo_decode_p95_ms",
         "Rolling-window decode-step p95 against the SLO target (ms)",
     )
+    # p99 twins for load-report cross-validation (attainment logic stays
+    # p95-based; these exist so loadgen tail percentiles have a live peer)
+    reg.gauge(
+        "dnet_slo_ttft_p99_ms",
+        "Rolling-window TTFT p99 (informational; attainment is p95-based)",
+    )
+    reg.gauge(
+        "dnet_slo_decode_p99_ms",
+        "Rolling-window decode-step p99 (informational; attainment is "
+        "p95-based)",
+    )
     reg.gauge(
         "dnet_slo_availability",
         "Rolling-window request availability (1 - errors/requests)",
@@ -300,6 +311,42 @@ def _register_core(reg: MetricsRegistry) -> None:
 
     for kind in SLO_KINDS:
         burning.labels(slo=kind)  # pre-touch: expose at 0 from the start
+    # performance attribution (obs/phases.py, obs/jit.py): decode-step
+    # sub-phase breakdown, jit compile tracking, device memory.  Phase /
+    # fn / kind label sets are DECLARED in obs/phases.py (a leaf module)
+    # and cross-checked both ways by the metrics lint (pass 8).
+    from dnet_tpu.obs.phases import DEVICE_MEM_KINDS, JIT_FNS, STEP_PHASES
+
+    phase_fam = reg.histogram(
+        "dnet_step_phase_ms",
+        "Batched decode-step sub-phase wall time (obs/phases.py; fenced "
+        "timings recorded when obs_enabled())",
+        labelnames=("phase",),
+    )
+    for phase in STEP_PHASES:
+        phase_fam.labels(phase=phase)  # pre-touch: the lint checks these
+    compiles = reg.counter(
+        "dnet_jit_compiles_total",
+        "Traced+compiled calls per instrumented jit entry point "
+        "(obs/phases.py JIT_FNS)",
+        labelnames=("fn",),
+    )
+    for fn in JIT_FNS:
+        compiles.labels(fn=fn)  # pre-touch: the lint checks these
+    reg.histogram(
+        "dnet_jit_compile_ms",
+        "Wall time of calls that compiled (trace + compile + first run)",
+        buckets=(10.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                 10000.0, 30000.0, 60000.0),
+    )
+    mem = reg.gauge(
+        "dnet_device_mem_bytes",
+        "Backend device memory summed over local devices, where the PJRT "
+        "backend reports stats (0 on CPU)",
+        labelnames=("kind",),
+    )
+    for kind in DEVICE_MEM_KINDS:
+        mem.labels(kind=kind)  # pre-touch: expose at 0 from the start
 
 
 def _ensure_core() -> None:

@@ -26,8 +26,12 @@ async def metrics_response(request: web.Request) -> web.Response:
     """Prometheus text exposition of this process's registry.  SLO gauges
     refresh lazily here: their values are windowed aggregates, so the
     scrape instant — not the last record_*() call — is when they must be
-    current."""
+    current.  Device-memory gauges refresh the same way (they snapshot the
+    backend's live allocator, not an event stream)."""
+    from dnet_tpu.obs.jit import update_device_mem_gauges
+
     get_slo_tracker().snapshot()
+    update_device_mem_gauges()
     return web.Response(
         body=get_registry().expose().encode("utf-8"),
         headers={"Content-Type": CONTENT_TYPE_LATEST},

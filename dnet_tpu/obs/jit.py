@@ -1,0 +1,124 @@
+"""JIT-compile tracking and device-memory gauges.
+
+`instrument_jit(fn, name)` wraps a `jax.jit`-ed callable so every
+compilation is visible: warmup vs steady-state separates cleanly in load
+reports, and a recompile storm (a shape leak re-tracing per request) shows
+up as a climbing `dnet_jit_compiles_total{fn=}` instead of a mystery
+latency cliff.  Detection rides the jitted function's executable cache: a
+call that grew `_cache_size()` traced+compiled, and its wall time — trace +
+compile + first execute — is recorded in `dnet_jit_compile_ms`.  On a jax
+build without `_cache_size` the wrapper degrades to a transparent
+pass-through (no counts, never an error).
+
+`update_device_mem_gauges()` publishes `dnet_device_mem_bytes{kind=}` from
+the backend's PJRT memory stats where available (TPU/GPU; CPU reports
+none), summed over local devices.  Refreshed lazily at /metrics scrape
+(obs/http.py), the same discipline as the SLO gauges.
+"""
+
+from __future__ import annotations
+
+import time
+
+from dnet_tpu.obs.phases import DEVICE_MEM_KINDS, JIT_FNS
+
+
+class _InstrumentedJit:
+    """Transparent wrapper: __call__ counts compiles, everything else
+    (lower, _cache_size, ...) forwards to the wrapped jitted callable."""
+
+    __slots__ = ("_fn", "_name", "_compiles", "_compile_ms")
+
+    def __init__(self, fn, name: str) -> None:
+        from dnet_tpu.obs import metric
+
+        if name not in JIT_FNS:
+            # same discipline as chaos points: an entry point cannot ship
+            # without its declared, lint-checked label
+            raise ValueError(
+                f"jit fn name {name!r} is not declared in "
+                f"dnet_tpu.obs.phases.JIT_FNS"
+            )
+        self._fn = fn
+        self._name = name
+        self._compiles = metric("dnet_jit_compiles_total").labels(fn=name)
+        self._compile_ms = metric("dnet_jit_compile_ms")
+
+    def __call__(self, *args, **kwargs):
+        fn = self._fn
+        try:
+            before = fn._cache_size()
+        except Exception:
+            before = None
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        if before is not None:
+            try:
+                compiled = fn._cache_size() > before
+            except Exception:
+                compiled = False
+            if compiled:
+                self._compiles.inc()
+                self._compile_ms.observe((time.perf_counter() - t0) * 1000.0)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def instrument_jit(fn, name: str):
+    """Wrap a jitted callable; `name` must be declared in phases.JIT_FNS."""
+    return _InstrumentedJit(fn, name)
+
+
+def _backend_initialized() -> bool:
+    """True only if a jax backend ALREADY exists in this process.  A
+    /metrics scrape must never be the thing that creates it —
+    jax.local_devices() on a cold process stalls the scrape for the whole
+    XLA client bring-up and, on accelerator hosts, acquires the devices /
+    preallocates memory before the serving path's own deliberate init."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        # private-surface drift on a future jax: fall back to refreshing
+        # (the pre-0.5 behavior) rather than silently freezing the gauges
+        return True
+
+
+def update_device_mem_gauges() -> bool:
+    """Refresh dnet_device_mem_bytes{kind=} from jax.local_devices()'
+    memory_stats(), summed across devices.  Returns False (gauges left
+    untouched at their pre-touched zeros) when the backend is not up yet
+    or no backend reports stats — the CPU fallback — so absence is
+    visible as all-zero, never stale."""
+    from dnet_tpu.obs import metric
+
+    if not _backend_initialized():
+        return False
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return False
+    totals = dict.fromkeys(DEVICE_MEM_KINDS, 0.0)
+    seen = False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        seen = True
+        totals["in_use"] += float(stats.get("bytes_in_use", 0) or 0)
+        totals["peak"] += float(stats.get("peak_bytes_in_use", 0) or 0)
+        totals["limit"] += float(stats.get("bytes_limit", 0) or 0)
+    if not seen:
+        return False
+    fam = metric("dnet_device_mem_bytes")
+    for kind, v in totals.items():
+        fam.labels(kind=kind).set(v)
+    return True

@@ -1,0 +1,40 @@
+"""Declared label sets for the performance-attribution metric families.
+
+A LEAF module (like admission/reasons.py and membership/epoch.py): imported
+by `dnet_tpu.obs` for pre-touching and by the metrics lint (pass 8), which
+cross-checks the exposed label sets against these tuples BOTH directions —
+a new phase or instrumented jit entry point cannot ship without its series,
+and a renamed one cannot strand a stale label on dashboards.
+"""
+
+from __future__ import annotations
+
+# Sub-phases of one batched decode dispatch (core/batch.py decode_batch):
+#   kv_gather  — page-table gather building the contiguous per-slot KV view
+#                (paged only; the copy the ragged-attention kernel removes)
+#   compute    — the jitted forward + on-device sampling program
+#   kv_scatter — block write-back of the rows the step touched (paged only)
+#   sample     — device->host readback of the sampled token fields
+PHASE_KV_GATHER = "kv_gather"
+PHASE_COMPUTE = "compute"
+PHASE_KV_SCATTER = "kv_scatter"
+PHASE_SAMPLE = "sample"
+STEP_PHASES = (PHASE_KV_GATHER, PHASE_COMPUTE, PHASE_KV_SCATTER, PHASE_SAMPLE)
+
+# Instrumented jitted entry points (obs/jit.py instrument_jit): the `fn`
+# label of dnet_jit_compiles_total.  Every instrument_jit call site must use
+# one of these names — the lint fails a stray label either direction.
+JIT_FNS = (
+    "local_prefill",        # LocalEngine._forward (bucketed prefill)
+    "local_decode",         # LocalEngine._decode (fused decode+sample)
+    "local_decode_chunk",   # LocalEngine._decode_chunk (R-step scan)
+    "batched_step",         # BatchedEngine._step (vmapped decode+sample)
+    "batched_chunk",        # BatchedEngine fused R-step chunk programs
+    "batched_spec",         # BatchedEngine._spec_step (verify blocks)
+    "kv_gather",            # BlockStore page-table gather
+    "kv_scatter",           # BlockStore block write-back
+)
+
+# dnet_device_mem_bytes{kind=}: backend memory stats summed over local
+# devices, where the PJRT backend reports them (TPU/GPU; CPU returns none)
+DEVICE_MEM_KINDS = ("in_use", "peak", "limit")

@@ -14,6 +14,13 @@ recorder's memory is O(1) regardless of traffic.  The defaults (64 x 2048)
 cap worst-case retention at ~131k span dicts (~tens of MB); long
 generations that out-span the cap keep their earliest spans and report the
 tail in `dropped`.
+
+Sampling under load (`DNET_OBS_TRACE_SAMPLE=N`, ObsSettings.trace_sample):
+every Nth opened timeline is `sampled` and records its full span stream;
+the rest keep ONLY forced summary spans (ttft, the closing request span)
+and count everything else in `dropped` — so a load run's request flood
+cannot thrash the 64-timeline ring into uselessness while still giving
+RequestMetrics its per-request summary for every response.
 """
 
 from __future__ import annotations
@@ -26,14 +33,35 @@ from typing import Iterator, List, Optional
 
 
 class FlightRecorder:
-    def __init__(self, max_requests: int = 64, max_spans: int = 2048) -> None:
+    def __init__(
+        self,
+        max_requests: int = 64,
+        max_spans: int = 2048,
+        sample_every: Optional[int] = None,
+    ) -> None:
         if max_requests < 1 or max_spans < 1:
             raise ValueError("recorder bounds must be >= 1")
         self.max_requests = max_requests
         self.max_spans = max_spans
+        # None = read ObsSettings.trace_sample lazily per opened timeline
+        # (the process-global recorder is built before settings are)
+        self.sample_every = sample_every
+        self._opened = 0  # timelines ever opened (sampling phase counter)
         self._lock = threading.Lock()
-        # rid -> {"t_unix", "t0" (perf_counter origin), "spans", "dropped"}
+        # rid -> {"t_unix", "t0" (perf_counter origin), "spans", "dropped",
+        #         "sampled"}
         self._requests: "OrderedDict[str, dict]" = OrderedDict()
+
+    def _sample_n(self) -> int:
+        n = self.sample_every
+        if n is None:
+            try:
+                from dnet_tpu.config import get_settings
+
+                n = get_settings().obs.trace_sample
+            except Exception:
+                n = 1
+        return max(int(n), 1)
 
     def begin(self, rid: str) -> None:
         """Open (or re-open at the back of the ring) a request timeline."""
@@ -43,12 +71,17 @@ class FlightRecorder:
     def _begin_locked(self, rid: str) -> dict:
         entry = self._requests.get(rid)
         if entry is None:
+            n = self._sample_n()
             entry = {
                 "t_unix": time.time(),
                 "t0": time.perf_counter(),
                 "spans": [],
                 "dropped": 0,
+                # the 1st, N+1th, ... opened timeline records fully; the
+                # rest keep only forced summary spans
+                "sampled": self._opened % n == 0,
             }
+            self._opened += 1
             self._requests[rid] = entry
             while len(self._requests) > self.max_requests:
                 self._requests.popitem(last=False)
@@ -88,7 +121,10 @@ class FlightRecorder:
                 # an in-flight long request outlives idle completed
                 # timelines in the ring
                 self._requests.move_to_end(rid)
-            if not force and len(entry["spans"]) >= self.max_spans:
+            if not force and (
+                not entry.get("sampled", True)
+                or len(entry["spans"]) >= self.max_spans
+            ):
                 entry["dropped"] += 1
                 return
             if t_ms is None:
@@ -118,6 +154,7 @@ class FlightRecorder:
                 "t_unix": entry["t_unix"],
                 "spans": [dict(s) for s in entry["spans"]],
                 "dropped": entry["dropped"],
+                "sampled": entry.get("sampled", True),
             }
 
     def request_ids(self) -> List[str]:
@@ -127,3 +164,4 @@ class FlightRecorder:
     def clear(self) -> None:
         with self._lock:
             self._requests.clear()
+            self._opened = 0  # sampling phase restarts with the ring

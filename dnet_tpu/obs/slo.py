@@ -30,6 +30,19 @@ SLO_AVAILABILITY = "availability"
 SLO_KINDS = (SLO_TTFT, SLO_DECODE, SLO_AVAILABILITY)
 
 
+def nearest_rank(values: List[float], q: float) -> float:
+    """THE nearest-rank quantile convention (0..1; empty -> 0.0), shared
+    by the live SLO windows and the loadgen report so "report percentile"
+    and "live gauge" are the same statistic over two vantage points."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError("q must be in [0, 1]")
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    rank = max(math.ceil(q * len(vals)), 1)
+    return vals[rank - 1]
+
+
 class RollingWindow:
     """Bounded (time, value) ring over the trailing `window_s` seconds.
 
@@ -63,13 +76,7 @@ class RollingWindow:
     def percentile(self, q: float, now: Optional[float] = None) -> float:
         """Nearest-rank q-quantile (0..1) of the live window; 0.0 when
         empty (callers treat an empty window as "no evidence")."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError("q must be in [0, 1]")
-        vals = sorted(self._values(now))
-        if not vals:
-            return 0.0
-        rank = max(math.ceil(q * len(vals)), 1)
-        return vals[rank - 1]
+        return nearest_rank(self._values(now), q)
 
     def mean(self, now: Optional[float] = None) -> float:
         vals = self._values(now)
@@ -125,6 +132,10 @@ class SloTracker:
         self._g_decode = metric("dnet_slo_decode_p95_ms")
         self._g_avail = metric("dnet_slo_availability")
         self._g_burning = metric("dnet_slo_burning")
+        # p99 twins (informational): loadgen cross-checks its client-side
+        # tail percentiles against these; attainment stays p95-based
+        self._g_ttft_p99 = metric("dnet_slo_ttft_p99_ms")
+        self._g_decode_p99 = metric("dnet_slo_decode_p99_ms")
 
     # -- recording (hot path: one deque append under a lock) -------------
     def record_ttft(self, ms: float, now: Optional[float] = None) -> None:
@@ -164,17 +175,28 @@ class SloTracker:
     def snapshot(self, now: Optional[float] = None) -> dict:
         """Evaluate every SLO, refresh the gauges, and return the /health
         payload: windowed values, targets, and which SLOs are burning."""
+        # one clock read shared by the p95 statuses and the p99 reads, for
+        # the same expiry-race reason statuses() documents
+        now = time.monotonic() if now is None else now
         statuses = self.statuses(now)
         by_name = {s.name: s for s in statuses}
         self._g_ttft.set(by_name[SLO_TTFT].value)
         self._g_decode.set(by_name[SLO_DECODE].value)
         self._g_avail.set(by_name[SLO_AVAILABILITY].value)
+        ttft_p99 = self._ttft.percentile(0.99, now)
+        decode_p99 = self._decode.percentile(0.99, now)
+        self._g_ttft_p99.set(ttft_p99)
+        self._g_decode_p99.set(decode_p99)
         for s in statuses:
             self._g_burning.labels(slo=s.name).set(1.0 if s.burning else 0.0)
         return {
             "window_s": self.window_s,
             "slos": [s.as_dict() for s in statuses],
             "burning": [s.name for s in statuses if s.burning],
+            "p99": {
+                "ttft_ms": round(ttft_p99, 3),
+                "decode_ms": round(decode_p99, 3),
+            },
         }
 
     def burning(self, now: Optional[float] = None) -> List[str]:

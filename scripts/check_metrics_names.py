@@ -135,6 +135,15 @@ _REQUIRED_FAMILIES = (
     "dnet_recovery_total",
     "dnet_recovery_duration_seconds",
     "dnet_shard_rejoins_total",
+    # performance attribution (obs/phases.py, obs/jit.py) — the loadgen
+    # report's phase/JIT/memory sections and the p99 cross-check (pass 8)
+    # depend on these
+    "dnet_step_phase_ms",
+    "dnet_jit_compiles_total",
+    "dnet_jit_compile_ms",
+    "dnet_device_mem_bytes",
+    "dnet_slo_ttft_p99_ms",
+    "dnet_slo_decode_p99_ms",
 )
 
 
@@ -333,6 +342,45 @@ def check_membership_labels(errors: list) -> int:
     return n
 
 
+def check_attribution_labels(errors: list) -> int:
+    """Pass 8: the performance-attribution families must agree with the
+    declared enums (dnet_tpu/obs/phases.py) both ways.  Histogram families
+    expose per-label `_bucket`/`_sum`/`_count` series, so presence is
+    checked on `_count` and strays on any exposition suffix."""
+    import re
+
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs.phases import DEVICE_MEM_KINDS, JIT_FNS, STEP_PHASES
+
+    text = get_registry().expose()
+    n = 0
+    for phase in STEP_PHASES:
+        n += 1
+        if f'dnet_step_phase_ms_count{{phase="{phase}"}}' not in text:
+            errors.append(
+                f"attribution: obs.phases.STEP_PHASES value {phase!r} has "
+                f"no dnet_step_phase_ms series (pre-touch it in "
+                f"dnet_tpu.obs._register_core)"
+            )
+    for m in re.finditer(
+        r'dnet_step_phase_ms(?:_bucket|_sum|_count)\{phase="([^"]+)"', text
+    ):
+        if m.group(1) not in STEP_PHASES:
+            errors.append(
+                f"attribution: exposed dnet_step_phase_ms phase label "
+                f"{m.group(1)!r} is not declared in obs.phases.STEP_PHASES"
+            )
+    n += _cross_check_labels(
+        errors, text, "dnet_jit_compiles_total", "fn",
+        JIT_FNS, "obs.phases.JIT_FNS",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_device_mem_bytes", "kind",
+        DEVICE_MEM_KINDS, "obs.phases.DEVICE_MEM_KINDS",
+    )
+    return n
+
+
 def main() -> int:
     errors: list[str] = []
     n_reg = check_registry(errors)
@@ -342,6 +390,7 @@ def main() -> int:
     n_chaos = check_chaos_points(errors)
     n_admit = check_admission_labels(errors)
     n_member = check_membership_labels(errors)
+    n_attr = check_attribution_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -349,7 +398,8 @@ def main() -> int:
     print(f"ok: {n_reg} registered families, {n_src} source-literal "
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
           f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
-          f"{n_member} membership labels, all conform")
+          f"{n_member} membership labels, {n_attr} attribution labels, "
+          f"all conform")
     return 0
 
 

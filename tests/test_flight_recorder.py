@@ -163,3 +163,56 @@ def test_auto_opened_first_span_starts_at_zero():
     rec.span("nonce", "token_rpc", 5.0)
     tl = rec.timeline("nonce")
     assert tl["spans"][0]["t_ms"] == 0.0
+
+
+def test_trace_sampling_every_nth():
+    """DNET_OBS_TRACE_SAMPLE semantics: the 1st, N+1th, ... opened timeline
+    records fully; the rest keep only FORCED summary spans and count the
+    remainder in dropped — so a load run cannot thrash the ring."""
+    rec = FlightRecorder(sample_every=3)
+    for i in range(6):
+        rid = f"r{i}"
+        rec.begin(rid)
+        rec.span(rid, "decode_step", 1.0, step=0)
+        rec.span(rid, "ttft", 2.0, t_ms=0.0, force=True)
+    for i in range(6):
+        tl = rec.timeline(f"r{i}")
+        names = [s["name"] for s in tl["spans"]]
+        if i % 3 == 0:
+            assert tl["sampled"] and names == ["decode_step", "ttft"]
+            assert tl["dropped"] == 0
+        else:
+            # summary spans survive for EVERY request
+            assert not tl["sampled"] and names == ["ttft"]
+            assert tl["dropped"] == 1
+
+
+def test_trace_sampling_reads_env_setting(monkeypatch):
+    from dnet_tpu.config import reset_settings_cache
+
+    monkeypatch.setenv("DNET_OBS_TRACE_SAMPLE", "2")
+    reset_settings_cache()
+    try:
+        rec = FlightRecorder()  # sample_every=None -> settings
+        for i in range(4):
+            rec.begin(f"r{i}")
+        sampled = [rec.timeline(f"r{i}")["sampled"] for i in range(4)]
+        assert sampled == [True, False, True, False]
+        # clear() restarts the sampling phase with the ring
+        rec.clear()
+        rec.begin("again")
+        assert rec.timeline("again")["sampled"] is True
+    finally:
+        monkeypatch.delenv("DNET_OBS_TRACE_SAMPLE")
+        reset_settings_cache()
+
+
+def test_sampling_applies_to_auto_opened_timelines():
+    """Shard-side spans auto-open timelines; sampling must bound those the
+    same way (the recorder protects its ring per process, not per role)."""
+    rec = FlightRecorder(sample_every=2)
+    rec.span("a", "shard_compute", 1.0)  # auto-open #1: sampled
+    rec.span("b", "shard_compute", 1.0)  # auto-open #2: unsampled
+    assert rec.timeline("a")["spans"] and rec.timeline("a")["sampled"]
+    tl_b = rec.timeline("b")
+    assert not tl_b["sampled"] and tl_b["spans"] == [] and tl_b["dropped"] == 1

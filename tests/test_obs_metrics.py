@@ -204,3 +204,31 @@ def test_wired_counters_prefix_cache():
     assert pc.lookup([1, 2, 3, 4, 5]) is not None  # hit
     assert hits.value == h0 + 1
     assert misses.value == m0 + 1
+
+
+def test_instrument_jit_counts_compiles_and_is_transparent():
+    """obs/jit.py: a call that grew the jitted executable cache counts as a
+    compile (with its wall time observed); cache hits count nothing; the
+    wrapper forwards everything else to the wrapped callable."""
+    import jax
+    import jax.numpy as jnp
+
+    from dnet_tpu.obs import metric
+    from dnet_tpu.obs.jit import instrument_jit
+
+    child = metric("dnet_jit_compiles_total").labels(fn="batched_step")
+    hist = metric("dnet_jit_compile_ms")
+    before, before_n = child.value, hist.count
+    f = instrument_jit(jax.jit(lambda x: x * 2), "batched_step")
+    assert float(f(jnp.ones(3))[0]) == 2.0
+    f(jnp.ones(3))   # cache hit: no compile counted
+    f(jnp.ones(5))   # new shape: second compile
+    assert child.value == before + 2
+    assert hist.count == before_n + 2
+    # attribute forwarding (the jitted callable's own surface)
+    assert f._cache_size() == 2
+    # undeclared fn labels are refused at wrap time (lint discipline)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        instrument_jit(jax.jit(lambda x: x), "not_a_declared_fn")

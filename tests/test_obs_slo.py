@@ -132,3 +132,24 @@ def test_tracker_singleton_rebuilds_from_settings(monkeypatch):
         monkeypatch.delenv("DNET_OBS_SLO_WINDOW_S")
         reset_settings_cache()
         reset_obs()
+
+
+def test_p99_gauges_and_snapshot_payload():
+    """The p99 twins (loadgen cross-check peers) export from snapshot();
+    attainment logic stays p95-based — a p99 spike alone never burns."""
+    reset_obs()
+    t = SloTracker(window_s=300.0, ttft_p95_ms=1000.0)
+    for v in range(1, 101):
+        t.record_ttft(float(v), now=1.0)
+        t.record_decode(float(v) * 2, now=1.0)
+    snap = t.snapshot(now=1.0)
+    assert snap["p99"] == {"ttft_ms": 99.0, "decode_ms": 198.0}
+    assert metric("dnet_slo_ttft_p99_ms").value == 99.0
+    assert metric("dnet_slo_decode_p99_ms").value == 198.0
+    # p95 below its 1000ms target: nothing burns despite the p99 export
+    assert snap["burning"] == []
+    # empty windows export 0 (no evidence), matching the p95 convention
+    reset_obs()
+    t2 = SloTracker(window_s=300.0)
+    snap2 = t2.snapshot(now=1.0)
+    assert snap2["p99"] == {"ttft_ms": 0.0, "decode_ms": 0.0}

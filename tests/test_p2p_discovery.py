@@ -1,5 +1,14 @@
-"""Native UDP discovery: build + two-process peer exchange on loopback."""
+"""Native UDP discovery.
 
+Two tiers: fast SINGLE-process unit tests (tier-1) that inject announce
+datagrams straight into one listener's UDP port — deterministic, no
+subprocess spawn, no broadcast, no multi-second sleeps — and the original
+two-process broadcast e2e tests, which exercise the real announce loop but
+are timing-sensitive under CI load and therefore marked `slow` (excluded
+from the tier-1 `-m 'not slow'` gate; run them explicitly with `-m slow`).
+"""
+
+import json
 import socket
 import subprocess
 import sys
@@ -26,6 +35,88 @@ def test_build():
     assert lib.is_file()
 
 
+def _announce(port: int, payload: dict) -> None:
+    """Inject one announce datagram into the listener (what a peer's
+    announce loop would broadcast, minus the second process)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.sendto(
+            json.dumps(payload, separators=(",", ":")).encode(),
+            ("127.0.0.1", port),
+        )
+
+
+def _wait_peer(disc, instance, present=True, deadline_s=8.0, port=None,
+               payload=None):
+    """Poll the peer table (the native listener polls at 200ms); re-inject
+    the announce each round when building presence so one dropped datagram
+    cannot flake the test."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        found = disc.get(instance)
+        if (found is not None) == present:
+            return found
+        if present and port is not None and payload is not None:
+            _announce(port, payload)
+        time.sleep(0.05)
+    return disc.get(instance)
+
+
+def test_unit_injected_peer_appears_and_filters():
+    """Tier-1 replacement for the two-process exchange: one listener, peers
+    injected as raw datagrams — full parse path (addr stamping, field
+    extraction, cluster scoping, self-exclusion, malformed resilience)
+    without a second process."""
+    from dnet_tpu.utils.p2p import UdpDiscovery
+
+    port = free_udp_port()
+    peer = {
+        "instance": "peer-b", "cluster": "default", "http_port": "8181",
+        "grpc_port": "58181", "is_manager": "0", "slice_id": "3",
+    }
+    with UdpDiscovery(
+        "peer-a", 8080, 58080, udp_port=port,
+        target_addr="127.0.0.1", interval_ms=50,
+    ) as disc:
+        # malformed + foreign-cluster datagrams must be absorbed silently
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.sendto(b"{not json", ("127.0.0.1", port))
+        _announce(port, {**peer, "instance": "other", "cluster": "lan-2"})
+        _announce(port, peer)
+        found = _wait_peer(disc, "peer-b", port=port, payload=peer)
+        assert found is not None, "injected peer never appeared"
+        assert found.http_port == 8181
+        assert found.grpc_port == 58181
+        assert found.slice_id == 3
+        assert found.host.startswith("127.")
+        # a different cluster token sharing the port is filtered out
+        assert disc.get("other") is None
+        # self must not appear in own peer table
+        assert disc.get("peer-a") is None
+
+
+def test_unit_ttl_evicts_silent_peer():
+    """Tier-1 replacement for the two-process TTL test: announce once,
+    stop announcing, and the listener's TTL sweep must evict."""
+    from dnet_tpu.utils.p2p import UdpDiscovery
+
+    port = free_udp_port()
+    ghost = {
+        "instance": "ghost", "cluster": "default", "http_port": "1",
+        "grpc_port": "2", "is_manager": "0", "slice_id": "0",
+    }
+    with UdpDiscovery(
+        "watcher", 3, 4, udp_port=port, target_addr="127.0.0.1",
+        interval_ms=50, ttl_s=0.5,
+    ) as disc:
+        _announce(port, ghost)
+        assert _wait_peer(disc, "ghost", port=port, payload=ghost) is not None
+        # no further announces: the sweep (driven by the watcher's own
+        # announce traffic hitting the listener) must TTL it out
+        gone = _wait_peer(disc, "ghost", present=False)
+        assert gone is None, "stale peer not evicted"
+
+
+@pytest.mark.slow
 def test_two_process_peer_exchange():
     from dnet_tpu.utils.p2p import UdpDiscovery
 
@@ -64,6 +155,7 @@ d.stop()
         proc.wait(timeout=10)
 
 
+@pytest.mark.slow
 def test_ttl_eviction():
     from dnet_tpu.utils.p2p import UdpDiscovery
 
