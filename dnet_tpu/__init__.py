@@ -22,5 +22,6 @@ if _os.environ.get("JAX_PLATFORMS"):
         import jax as _jax
 
         _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    # dnetlint: disable=DL007 pre-import bootstrap: jax absent or already initialized; the logger does not exist yet
     except Exception:  # pragma: no cover - jax absent or already initialized
         pass

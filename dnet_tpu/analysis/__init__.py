@@ -1,0 +1,63 @@
+"""dnet_tpu.analysis — repo-native static analysis (dnetlint).
+
+CLI: ``python scripts/dnetlint.py``; tier-1 hook:
+tests/test_static_analysis.py.  See core.py for the framework and the
+README "Static analysis" section for the check catalog + suppression
+syntax (``# dnetlint: disable=DLxxx <reason>``).
+"""
+
+from dnet_tpu.analysis.checks_async import (
+    BlockingCallInAsync,
+    DroppedCoroutine,
+    LockAcrossAwait,
+)
+from dnet_tpu.analysis.checks_contract import (
+    ContractDrift,
+    EnvReadOutsideConfig,
+    SilentExceptionSwallow,
+)
+from dnet_tpu.analysis.checks_jit import JitPurity, UngatedDeviceSync
+from dnet_tpu.analysis.core import (
+    DEFAULT_BASELINE,
+    Check,
+    Finding,
+    Project,
+    Report,
+    SourceFile,
+    analyze_texts,
+    load_baseline,
+    next_report_path,
+    run_analysis,
+    write_baseline,
+    write_report_json,
+)
+from dnet_tpu.analysis.metrics_checks import METRICS_CHECKS
+
+#: the full suite, DL-code order; metrics checks carry requires_runtime
+ALL_CHECKS = [
+    BlockingCallInAsync(),
+    LockAcrossAwait(),
+    DroppedCoroutine(),
+    JitPurity(),
+    UngatedDeviceSync(),
+    EnvReadOutsideConfig(),
+    SilentExceptionSwallow(),
+    ContractDrift(),
+    *METRICS_CHECKS,
+]
+
+__all__ = [
+    "ALL_CHECKS",
+    "Check",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Project",
+    "Report",
+    "SourceFile",
+    "analyze_texts",
+    "load_baseline",
+    "next_report_path",
+    "run_analysis",
+    "write_baseline",
+    "write_report_json",
+]

@@ -1,0 +1,197 @@
+"""Async-safety checks: the event loop owns the serving path.
+
+DL001 — blocking calls inside ``async def``: one ``time.sleep`` /
+``subprocess.run`` / sync-socket call in a coroutine stalls EVERY
+in-flight request on the loop (TTFT cliffs that profile as "mystery
+scheduler jitter").
+
+DL002 — locks held across an ``await``: a ``threading.Lock`` held over a
+suspension point blocks the loop thread itself (latent deadlock with any
+other coroutine wanting the lock); an ``asyncio.Lock`` held across a
+sleep serializes unrelated requests behind a timer.
+
+DL003 — dropped coroutines/tasks: a bare ``foo()`` where ``foo`` is
+``async def`` never runs; a bare ``asyncio.create_task(...)`` whose
+result is dropped can be garbage-collected MID-FLIGHT (CPython keeps no
+strong reference) and its exceptions vanish.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from dnet_tpu.analysis.core import (
+    Check,
+    Finding,
+    Project,
+    SourceFile,
+    dotted,
+    is_serving_path,
+    scoped_walk,
+)
+
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.waitpid",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "grpc.insecure_channel",
+    "grpc.secure_channel",
+    "urllib.request.urlopen",
+}
+_BLOCKING_PREFIX = ("subprocess.", "requests.", "urllib.request.", "http.client.")
+
+_LOCKISH_RE = re.compile(r"(?:^|[._])(?:lock|mutex|semaphore|sem)s?$", re.I)
+_SLEEPISH_RE = re.compile(r"(?:^|\.)sleep$")
+
+_SPAWN_EXACT = {"asyncio.create_task", "asyncio.ensure_future", "ensure_future"}
+_SPAWN_SUFFIX = (".create_task", ".ensure_future")
+
+
+def _async_defs(tree: ast.AST) -> List[ast.AsyncFunctionDef]:
+    return [n for n in ast.walk(tree) if isinstance(n, ast.AsyncFunctionDef)]
+
+
+class BlockingCallInAsync(Check):
+    code = "DL001"
+    name = "blocking-call-in-async"
+    description = (
+        "time.sleep / subprocess / sync socket-gRPC-urllib I/O inside an "
+        "async def on a serving path stalls the whole event loop"
+    )
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        if not is_serving_path(src.rel):
+            return
+        for fn in _async_defs(src.tree):
+            for node in scoped_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d in _BLOCKING_EXACT or d.startswith(_BLOCKING_PREFIX):
+                    yield self.finding(
+                        src.rel, node.lineno,
+                        f"blocking call {d}() inside async def "
+                        f"{fn.name}() stalls the event loop",
+                        col=node.col_offset,
+                    )
+
+
+class LockAcrossAwait(Check):
+    code = "DL002"
+    name = "lock-across-await"
+    description = (
+        "a threading lock held across an await blocks the loop thread; an "
+        "asyncio lock held across a sleep serializes requests behind a timer"
+    )
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        if not is_serving_path(src.rel):
+            return
+        for fn in _async_defs(src.tree):
+            for node in scoped_walk(fn):
+                if isinstance(node, ast.With):
+                    name = self._lockish_item(node)
+                    if name is None:
+                        continue
+                    hit = self._first_await(node.body)
+                    if hit is not None:
+                        yield self.finding(
+                            src.rel, hit.lineno,
+                            f"sync 'with {name}:' held across an await in "
+                            f"{fn.name}() — a threading lock here blocks "
+                            f"the event loop thread",
+                            col=hit.col_offset,
+                        )
+                elif isinstance(node, ast.AsyncWith):
+                    name = self._lockish_item(node)
+                    if name is None:
+                        continue
+                    for sub in self._scoped_body(node.body):
+                        if isinstance(sub, ast.Await) and _SLEEPISH_RE.search(
+                            dotted(getattr(sub.value, "func", sub.value))
+                        ):
+                            yield self.finding(
+                                src.rel, sub.lineno,
+                                f"'async with {name}:' holds the lock "
+                                f"across a sleep in {fn.name}()",
+                                col=sub.col_offset,
+                            )
+
+    @staticmethod
+    def _lockish_item(node):
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):  # e.g. lock.acquire_timeout(...)
+                expr = expr.func
+            d = dotted(expr)
+            if d and _LOCKISH_RE.search(d):
+                return d
+        return None
+
+    @staticmethod
+    def _scoped_body(body) -> Iterable[ast.AST]:
+        for stmt in body:
+            yield stmt
+            if not isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                yield from scoped_walk(stmt)
+
+    @classmethod
+    def _first_await(cls, body):
+        for sub in cls._scoped_body(body):
+            if isinstance(sub, ast.Await):
+                return sub
+        return None
+
+
+class DroppedCoroutine(Check):
+    code = "DL003"
+    name = "dropped-coroutine"
+    description = (
+        "a coroutine called without await never runs; a create_task / "
+        "ensure_future result dropped without retention can be GC'd "
+        "mid-flight and its exceptions vanish"
+    )
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        if not is_serving_path(src.rel):
+            return
+        local_async = {fn.name for fn in _async_defs(src.tree)}
+        for node in ast.walk(src.tree):
+            call = None
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_"
+                and isinstance(node.value, ast.Call)
+            ):
+                call = node.value
+            if call is None:
+                continue
+            d = dotted(call.func)
+            if d in _SPAWN_EXACT or d.endswith(_SPAWN_SUFFIX):
+                yield self.finding(
+                    src.rel, call.lineno,
+                    f"{d}(...) result dropped — keep a reference (the loop "
+                    f"holds only a weak one) or await it",
+                    col=call.col_offset,
+                )
+                continue
+            last = d.split(".")[-1]
+            if last in local_async and (d == last or d == f"self.{last}"):
+                yield self.finding(
+                    src.rel, call.lineno,
+                    f"coroutine {d}(...) is never awaited — the call "
+                    f"builds the coroutine object and discards it",
+                    col=call.col_offset,
+                )

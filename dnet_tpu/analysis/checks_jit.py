@@ -1,0 +1,216 @@
+"""JIT-tracing checks: traced code must stay pure and sync-free.
+
+DL004 — JIT purity: a function traced by ``jax.jit`` runs its Python body
+ONCE per compile, then never again.  ``time.*`` / host RNG /
+``os.environ`` / metrics observers inside traced code either bake a
+stale value into the compiled program or silently stop recording after
+warmup — both lie.  The check walks the intra-module call graph from
+every jitted entry point (``jax.jit(fn)`` call sites, ``@jit`` /
+``@partial(jax.jit, ...)`` decorators, and ``instrument_jit``-wrapped
+entries declared in ``obs.phases.JIT_FNS``).
+
+DL005 — forced device syncs: ``.item()`` / ``block_until_ready`` /
+``jax.device_get`` on the serving path outside ``obs_enabled()``-style
+gating.  The PR 7 contract: phase attribution may fence the device ONLY
+when observability asked for it, otherwise async dispatch must stay
+async — an ungated sync is a silent decode-throughput regression.  A
+sync is considered gated when an enclosing ``if``/``while`` test
+mentions an obs/sync gate (``obs_enabled``, ``attribute``, ``*sync*``,
+``*profile*``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from dnet_tpu.analysis.core import (
+    Check,
+    Finding,
+    Project,
+    SourceFile,
+    dotted,
+    is_serving_path,
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+_IMPURE_PREFIX = (
+    "time.",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "os.environ",
+    "os.getenv",
+    "subprocess.",
+)
+_IMPURE_EXACT = {"print", "input", "metric", "get_recorder", "obs_enabled"}
+
+_SYNC_ATTRS = {"block_until_ready", "item"}
+_SYNC_DOTTED = {"jax.block_until_ready", "jax.device_get"}
+# NOTE: 'sync' must NOT match inside 'async' (an async-heavy codebase would
+# silently exempt itself), and 'attribute' is word-bounded so arbitrary
+# attribute-ish identifiers don't count as gates
+_GATE_RE = re.compile(r"obs_enabled|\battribute\b|(?<!a)sync|profile", re.I)
+
+
+def _collect_defs(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _jit_entries(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(label, entry) pairs: entry is a def node or a Lambda, label the
+    name shown in findings."""
+    defs = _collect_defs(tree)
+    entries: List[Tuple[str, ast.AST]] = []
+    seen: Set[int] = set()
+
+    def add_name(name: str) -> None:
+        for fd in defs.get(name, ()):
+            if id(fd) not in seen:
+                seen.add(id(fd))
+                entries.append((name, fd))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in _JIT_NAMES:
+            if node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Name):
+                    add_name(arg0.id)
+                elif isinstance(arg0, ast.Attribute):
+                    add_name(arg0.attr)
+                elif isinstance(arg0, ast.Lambda) and id(arg0) not in seen:
+                    seen.add(id(arg0))
+                    entries.append(("<lambda>", arg0))
+    for name, fds in defs.items():
+        for fd in fds:
+            for dec in getattr(fd, "decorator_list", ()):
+                d = dotted(dec)
+                if d in _JIT_NAMES:
+                    add_name(name)
+                elif (
+                    isinstance(dec, ast.Call)
+                    and dotted(dec.func) in _PARTIAL_NAMES
+                    and dec.args
+                    and dotted(dec.args[0]) in _JIT_NAMES
+                ):
+                    add_name(name)
+                elif isinstance(dec, ast.Call) and dotted(dec.func) in _JIT_NAMES:
+                    add_name(name)
+    return entries
+
+
+def _is_impure(d: str) -> bool:
+    if not d:
+        return False
+    if d in _IMPURE_EXACT:
+        return True
+    if d == "random" or d.startswith(_IMPURE_PREFIX):
+        return True
+    return False
+
+
+class JitPurity(Check):
+    code = "DL004"
+    name = "jit-purity"
+    description = (
+        "functions reachable from jitted entry points must not call "
+        "time.*, host RNG, metrics observers, or os.environ — traced "
+        "Python runs once per compile, so side effects bake in or vanish"
+    )
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        defs = _collect_defs(src.tree)
+        emitted: Set[Tuple[int, str]] = set()
+        for label, entry in _jit_entries(src.tree):
+            stack = [entry]
+            visited: Set[int] = set()
+            while stack:
+                fn = stack.pop()
+                if id(fn) in visited:
+                    continue
+                visited.add(id(fn))
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Subscript) and dotted(
+                        node.value
+                    ) == "os.environ":
+                        key = (node.lineno, "os.environ[]")
+                        if key not in emitted:
+                            emitted.add(key)
+                            yield self.finding(
+                                src.rel, node.lineno,
+                                f"os.environ read inside jit-traced "
+                                f"'{label}' — traced once, stale forever",
+                                col=node.col_offset,
+                            )
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = dotted(node.func)
+                    if _is_impure(d):
+                        key = (node.lineno, d)
+                        if key not in emitted:
+                            emitted.add(key)
+                            yield self.finding(
+                                src.rel, node.lineno,
+                                f"impure call {d}() reachable from "
+                                f"jit-traced entry '{label}'",
+                                col=node.col_offset,
+                            )
+                        continue
+                    last = d.split(".")[-1]
+                    if last and (d == last or d.startswith(("self.", "cls."))):
+                        stack.extend(defs.get(last, ()))
+
+
+class UngatedDeviceSync(Check):
+    code = "DL005"
+    name = "ungated-device-sync"
+    description = (
+        ".item() / block_until_ready / device_get on the serving path "
+        "outside obs_enabled()-style gating — the PR 7 device-sync "
+        "contract: fence only when observability asked for it"
+    )
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        if not is_serving_path(src.rel):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            is_sync = d in _SYNC_DOTTED or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_ATTRS
+                and not node.args
+                and not node.keywords
+            )
+            if not is_sync or self._gated(src, node):
+                continue
+            what = d or node.func.attr
+            yield self.finding(
+                src.rel, node.lineno,
+                f"forced device sync {what}() outside obs_enabled() "
+                f"gating on a serving path",
+                col=node.col_offset,
+            )
+
+    @staticmethod
+    def _gated(src: SourceFile, node: ast.AST) -> bool:
+        for anc in src.ancestors(node):
+            if isinstance(anc, (ast.If, ast.While)):
+                try:
+                    test_src = ast.unparse(anc.test)
+                except Exception:
+                    test_src = ""
+                if _GATE_RE.search(test_src):
+                    return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _GATE_RE.search(anc.name):
+                    return True
+        return False

@@ -1,0 +1,447 @@
+"""dnetlint core: the repo-native static-analysis framework.
+
+The serving stack is held together by conventions — device-sync only under
+``obs_enabled()``, typed errors mapped to status codes, ``DNET_*`` config
+routed through ``config.py``, epoch/deadline headers stamped on every wire
+frame — that nothing enforced except reviewer memory.  This package turns
+each convention into a machine-checked *check* with a stable ``DLxxx`` code,
+run from tier-1 (tests/test_static_analysis.py) and from the CLI
+(``scripts/dnetlint.py``).
+
+Framework pieces (all dependency-free, stdlib ``ast`` only):
+
+- :class:`Finding` — one violation: (path, line, col, code, message,
+  severity).  Ordering is total and deterministic.
+- :class:`SourceFile` — a parsed module plus its inline-suppression map.
+  Suppression syntax: ``# dnetlint: disable=DL001 <reason>`` — trailing on
+  the offending line or standalone on the line above; the reason is
+  MANDATORY (a bare disable is itself reported as DL000).
+- :class:`Project` — the scanned file set; cross-file checks look other
+  modules up by path suffix.
+- :class:`Check` — base class.  ``run_file`` fires per module,
+  ``run_project`` once per run (cross-file / runtime checks).  Checks with
+  ``requires_runtime = True`` import live dnet_tpu modules (the metrics
+  passes) and are skipped by ``analyze_texts`` and ``--ast-only``.
+- Baseline — a committed file of grandfathered fingerprints
+  (``.dnetlint-baseline``); matched findings report as *baselined* and do
+  not fail the run, stale entries DO fail (a baseline cannot rot).
+- :func:`run_analysis` — discover -> check -> suppress -> baseline ->
+  sort -> :class:`Report` (with ``--json`` emission for ANALYSIS_r<NN>.json).
+
+Adding a check: subclass :class:`Check` in a ``checks_*`` module, set
+``code``/``name``/``description``, implement ``run_file`` or
+``run_project``, append it to ``ALL_CHECKS`` in ``__init__.py``, and add a
+firing + quiet fixture pair in tests/test_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARN = "warn"
+
+#: repo-relative scan roots for a full run
+SCAN_DIRS = ("dnet_tpu", "scripts")
+SCAN_FILES = ("bench.py", "bench_serve.py")
+
+#: prefixes NOT on the serving path: async-safety / sync-contract checks
+#: (DL001/2/3/5/7) stay out of CLI glue, offline tooling, and pure compute
+#: layers; repo-global checks (DL004/6/8) ignore this scope.
+NON_SERVING_PREFIXES = (
+    "dnet_tpu/cli/",
+    "dnet_tpu/tui.py",
+    "dnet_tpu/utils/",
+    "dnet_tpu/models/",
+    "dnet_tpu/ops/",
+    "dnet_tpu/parallel/",
+    "dnet_tpu/analysis/",
+    "scripts/",
+    "bench.py",
+    "bench_serve.py",
+)
+
+DEFAULT_BASELINE = ".dnetlint-baseline"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dnetlint:\s*disable=(?P<codes>[A-Za-z0-9_,]+)(?:\s+(?P<reason>\S.*))?"
+)
+
+
+def is_serving_path(rel: str) -> bool:
+    return not any(rel.startswith(p) for p in NON_SERVING_PREFIXES)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One violation.  Field order IS the sort order (path, line, col,
+    code) so reports are deterministic across runs and machines."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    severity: str = SEVERITY_ERROR
+
+    def fingerprint(self) -> str:
+        """Baseline identity: stable across reruns of the same tree."""
+        return f"{self.code} {self.path}:{self.line} {self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """A parsed module: AST, line table, suppression map, parent links."""
+
+    def __init__(self, rel: str, text: str) -> None:
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(text)
+        except SyntaxError as exc:
+            self.tree = None
+            self.parse_error = f"syntax error: {exc.msg} (line {exc.lineno})"
+        # line -> set of codes suppressed there; malformed -> DL000
+        self.suppressed: Dict[int, set] = {}
+        self.bad_suppressions: List[int] = []
+        self._parents: Optional[Dict[int, ast.AST]] = None
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            if not (m.group("reason") or "").strip():
+                self.bad_suppressions.append(i)
+                continue
+            codes = {c.strip().upper() for c in m.group("codes").split(",") if c.strip()}
+            # standalone comment line applies to the NEXT line; a trailing
+            # comment applies to its own line
+            target = i + 1 if line.lstrip().startswith("#") else i
+            self.suppressed.setdefault(target, set()).update(codes)
+
+    def is_suppressed(self, line: int, code: str) -> bool:
+        return code in self.suppressed.get(line, ())
+
+    def parents(self) -> Dict[int, ast.AST]:
+        """id(node) -> parent node map, built lazily."""
+        if self._parents is None:
+            self._parents = {}
+            if self.tree is not None:
+                for parent in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(parent):
+                        self._parents[id(child)] = parent
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        parents = self.parents()
+        cur = parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = parents.get(id(cur))
+
+
+class Project:
+    """The file set under analysis plus the repo root (runtime checks and
+    the CLI need the real tree; synthetic projects in tests pass texts)."""
+
+    def __init__(self, files: Sequence[SourceFile], root: Optional[Path] = None):
+        self.files = list(files)
+        self.root = root
+        self._by_rel = {f.rel: f for f in self.files}
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+    def find_suffix(self, suffix: str) -> Optional[SourceFile]:
+        if suffix in self._by_rel:
+            return self._by_rel[suffix]
+        for f in self.files:
+            if f.rel.endswith(suffix):
+                return f
+        return None
+
+
+class Check:
+    """Base check.  Subclasses set the class attrs and implement one of
+    the two hooks; both yield :class:`Finding`."""
+
+    code: str = "DL000"
+    name: str = "meta"
+    description: str = ""
+    severity: str = SEVERITY_ERROR
+    #: True: imports live dnet_tpu modules (registry/pool/federation); run
+    #: only in full-repo mode, never on synthetic fixture projects.
+    requires_runtime: bool = False
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        return ()
+
+    def run_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, path: str, line: int, message: str, col: int = 0) -> Finding:
+        return Finding(
+            path=path, line=line, col=col, code=self.code,
+            message=message, severity=self.severity,
+        )
+
+
+# ---- shared AST helpers ---------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression (``self._lock`` ->
+    ``self._lock``); empty string when it isn't a plain name chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def scoped_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``fn``'s body without descending into nested function/class
+    scopes (their lines belong to the nested scope's own visit)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def contains_await(nodes: Iterable[ast.AST]) -> Optional[ast.Await]:
+    for node in nodes:
+        if isinstance(node, ast.Await):
+            return node
+    return None
+
+
+# ---- baseline -------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> Dict[str, str]:
+    """fingerprint -> justification.  Format, one entry per line::
+
+        DL005 dnet_tpu/core/x.py:42 message text  # why this is grandfathered
+    """
+    entries: Dict[str, str] = {}
+    if not path.is_file():
+        return entries
+    for raw in path.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        fp, _, justification = line.partition("  # ")
+        entries[fp.strip()] = justification.strip()
+    return entries
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    lines = [
+        "# dnetlint baseline — grandfathered findings.",
+        "# One per line: '<code> <path>:<line> <message>  # justification'.",
+        "# Prefer fixing or inline-suppressing (with a reason) over baselining;",
+        "# stale entries FAIL the run, so this file cannot rot.",
+    ]
+    for f in sorted(findings):
+        if f.path == "<baseline>":
+            # a stale-entry meta-finding can never match a scanned file —
+            # writing it would poison every subsequent run
+            continue
+        lines.append(f"{f.fingerprint()}  # TODO justify")
+    path.write_text("\n".join(lines) + "\n")
+
+
+# ---- runner ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]          # new (failing) findings
+    baselined: List[Finding]         # grandfathered by the baseline file
+    suppressed: int                  # inline-suppressed count
+    files_scanned: int
+    checks_run: List[str]
+    baseline_size: int
+    counts: Dict[str, int]           # per-code NEW finding counts
+
+    @property
+    def clean(self) -> bool:
+        return not any(f.severity == SEVERITY_ERROR for f in self.findings)
+
+    def to_json(self) -> dict:
+        return {
+            "tool": "dnetlint",
+            "clean": self.clean,
+            "files_scanned": self.files_scanned,
+            "checks_run": self.checks_run,
+            "counts": self.counts,
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [f.to_json() for f in self.baselined],
+            "baseline_size": self.baseline_size,
+            "suppressed": self.suppressed,
+        }
+
+
+def discover_files(root: Path) -> List[SourceFile]:
+    paths: List[Path] = []
+    for d in SCAN_DIRS:
+        base = root / d
+        if base.is_dir():
+            paths.extend(sorted(base.rglob("*.py")))
+    for f in SCAN_FILES:
+        p = root / f
+        if p.is_file():
+            paths.append(p)
+    out = []
+    for p in paths:
+        rel = p.relative_to(root).as_posix()
+        if "__pycache__" in rel:
+            continue
+        try:
+            text = p.read_text()
+        except OSError:
+            continue
+        out.append(SourceFile(rel, text))
+    return out
+
+
+def run_checks(
+    project: Project,
+    checks: Sequence[Check],
+    baseline: Optional[Dict[str, str]] = None,
+) -> Report:
+    raw: List[Finding] = []
+    meta = Check()  # DL000 emitter
+    for src in project.files:
+        if src.parse_error:
+            raw.append(meta.finding(src.rel, 1, src.parse_error))
+        for line in src.bad_suppressions:
+            raw.append(meta.finding(
+                src.rel, line,
+                "suppression without a reason: use "
+                "'# dnetlint: disable=DLxxx <why>'",
+            ))
+    for check in checks:
+        for src in project.files:
+            if src.tree is None:
+                continue
+            raw.extend(check.run_file(src, project))
+        raw.extend(check.run_project(project))
+
+    suppressed = 0
+    kept: List[Finding] = []
+    for f in raw:
+        src = project.get(f.path)
+        if src is not None and f.code != "DL000" and src.is_suppressed(f.line, f.code):
+            suppressed += 1
+            continue
+        kept.append(f)
+
+    baseline = baseline or {}
+    matched_fps = set()
+    new: List[Finding] = []
+    grandfathered: List[Finding] = []
+    for f in sorted(set(kept)):
+        if f.fingerprint() in baseline:
+            matched_fps.add(f.fingerprint())
+            grandfathered.append(f)
+        else:
+            new.append(f)
+    # staleness is judged only against the checks that actually ran: a
+    # partial run (--select / --ast-only) must not flag entries belonging
+    # to deliberately-skipped checks
+    run_codes = {c.code for c in checks} | {"DL000"}
+    for fp in sorted(set(baseline) - matched_fps):
+        if fp.split(" ", 1)[0] not in run_codes:
+            continue
+        new.append(meta.finding(
+            "<baseline>", 0,
+            f"stale baseline entry (finding no longer fires): {fp}",
+        ))
+
+    new.sort()
+    counts: Dict[str, int] = {}
+    for f in new:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    return Report(
+        findings=new,
+        baselined=grandfathered,
+        suppressed=suppressed,
+        files_scanned=len(project.files),
+        checks_run=[c.code for c in checks],
+        baseline_size=len(baseline),
+        counts=counts,
+    )
+
+
+def analyze_texts(
+    texts: Dict[str, str], checks: Optional[Sequence[Check]] = None
+) -> List[Finding]:
+    """Fixture entry point: run the AST checks over in-memory sources.
+    Returns NEW findings (suppressions applied, no baseline)."""
+    from dnet_tpu.analysis import ALL_CHECKS
+
+    project = Project([SourceFile(rel, text) for rel, text in texts.items()])
+    selected = [
+        c for c in (checks if checks is not None else ALL_CHECKS)
+        if not c.requires_runtime
+    ]
+    return run_checks(project, selected).findings
+
+
+def run_analysis(
+    root: Path,
+    checks: Optional[Sequence[Check]] = None,
+    include_runtime: bool = True,
+    baseline_path: Optional[Path] = None,
+    ignore_baseline: bool = False,
+) -> Report:
+    """Full-repo run: discover files under ``root``, apply the baseline.
+    ``ignore_baseline=True`` reports every finding as new — the
+    ``--write-baseline`` path, so still-firing grandfathered entries are
+    re-captured instead of dropped."""
+    from dnet_tpu.analysis import ALL_CHECKS
+
+    selected = list(checks if checks is not None else ALL_CHECKS)
+    if not include_runtime:
+        selected = [c for c in selected if not c.requires_runtime]
+    project = Project(discover_files(root), root=root)
+    bp = baseline_path if baseline_path is not None else root / DEFAULT_BASELINE
+    baseline = {} if ignore_baseline else load_baseline(bp)
+    return run_checks(project, selected, baseline=baseline)
+
+
+def next_report_path(root: Path) -> Path:
+    """ANALYSIS_r<NN>.json numbering: continue the BENCH_r* sequence so
+    lint debt is tracked across PRs the way perf is."""
+    nums = [0]
+    for pat in ("ANALYSIS_r*.json", "BENCH_r*.json"):
+        for p in root.glob(pat):
+            m = re.search(r"_r(\d+)\.json$", p.name)
+            if m:
+                nums.append(int(m.group(1)))
+    return root / f"ANALYSIS_r{max(nums) + 1:02d}.json"
+
+
+def write_report_json(report: Report, path: Path) -> None:
+    path.write_text(json.dumps(report.to_json(), indent=2, sort_keys=True) + "\n")

@@ -1,0 +1,501 @@
+"""Metric/observability contract passes (DL010+), folded in from
+``scripts/check_metrics_names.py`` (which remains as a thin CLI shim).
+
+These are *runtime* checks (``requires_runtime = True``): they import the
+live registry, exercise the paged-KV pool, and round-trip the federation
+path — so they run from the full-repo suite (CLI and tier-1 wrapper), not
+over synthetic fixture projects.
+
+Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
+
+- DL010 registry      — every registered family name matches
+  ``dnet_[a-z0-9_]+`` and carries a help string
+- DL011 source-scan   — literal ``counter(/gauge(/histogram(`` calls in the
+  tree conform even when registered lazily
+- DL012 federation    — two-node relabel/merge round trip re-parses, one
+  ``node`` label per sample, required families present
+- DL013 paged-pool    — alloc/share/COW/release script keeps the block
+  books balanced and the gauges honest
+- DL014 chaos-points  — chaos injection points <-> pre-touched series, both
+  directions
+- DL015 admission     — reject-reason / deadline-stage labels <-> declared
+  enums, both directions
+- DL016 membership    — stale-epoch kinds / recovery outcomes <-> declared
+  enums, both directions
+- DL017 attribution   — step phases / jit fns / device-mem kinds <->
+  declared enums, both directions
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import Iterable
+
+from dnet_tpu.analysis.core import Check, Finding, Project
+
+REPO = Path(__file__).resolve().parents[2]
+if str(REPO) not in sys.path:  # runnable via the scripts/ shim
+    sys.path.insert(0, str(REPO))
+
+# metric-registration calls with a literal name; help must be the next
+# argument and a non-empty string literal
+_CALL_RE = re.compile(
+    r"""\.\s*(counter|gauge|histogram)\(\s*
+        (?P<q>['"])(?P<name>[^'"]+)(?P=q)\s*,\s*
+        (?P<rest>.{0,120})""",
+    re.VERBOSE | re.DOTALL,
+)
+_HELP_RE = re.compile(r"""^(?P<q>['"])(?P<help>[^'"]*)""")
+
+_SCAN_DIRS = ("dnet_tpu", "scripts")
+_SCAN_FILES = ("bench.py",)
+
+
+def _check_name(name: str, where: str, errors: list) -> None:
+    from dnet_tpu.obs import METRIC_NAME_RE
+
+    if not METRIC_NAME_RE.match(name):
+        errors.append(
+            f"{where}: metric name {name!r} does not match "
+            f"{METRIC_NAME_RE.pattern}"
+        )
+
+
+def check_registry(errors: list) -> int:
+    from dnet_tpu.obs import get_registry
+
+    fams = get_registry().families()
+    for name, fam in fams.items():
+        _check_name(name, "registry", errors)
+        if not fam.help.strip():
+            errors.append(f"registry: metric {name} has an empty help string")
+    return len(fams)
+
+
+def check_sources(errors: list) -> int:
+    n = 0
+    files = [REPO / f for f in _SCAN_FILES]
+    for d in _SCAN_DIRS:
+        files.extend(sorted((REPO / d).rglob("*.py")))
+    for path in files:
+        if not path.is_file():
+            continue
+        text = path.read_text()
+        for m in _CALL_RE.finditer(text):
+            name = m.group("name")
+            if not name.startswith("dnet_"):
+                continue  # not one of ours (e.g. a generic helper call)
+            n += 1
+            where = f"{path.relative_to(REPO)}"
+            _check_name(name, where, errors)
+            hm = _HELP_RE.match(m.group("rest").lstrip())
+            if hm is None or not hm.group("help").strip():
+                errors.append(
+                    f"{where}: metric {name} registered without a literal "
+                    f"non-empty help string"
+                )
+    return n
+
+
+# families the cluster observability surface registers; their absence means
+# a refactor silently dropped a series dashboards/alerts depend on
+_REQUIRED_FAMILIES = (
+    "dnet_slo_ttft_p95_ms",
+    "dnet_slo_decode_p95_ms",
+    "dnet_slo_availability",
+    "dnet_slo_burning",
+    "dnet_prefix_refill_total",
+    "dnet_federation_scrape_ok",
+    # paged KV pool (dnet_tpu/kv/) — capacity dashboards and the
+    # backpressure alert depend on these
+    "dnet_kv_blocks_used",
+    "dnet_kv_blocks_free",
+    "dnet_kv_pool_blocks",
+    "dnet_kv_cow_copies_total",
+    "dnet_kv_prefix_shared_blocks_total",
+    "dnet_kv_admission_rejected_total",
+    # resilience (dnet_tpu/resilience/) — the retry/resume dashboards and
+    # the chaos-coverage lint (pass 5) depend on these
+    "dnet_rpc_retries_total",
+    "dnet_stream_reopens_total",
+    "dnet_request_resumed_total",
+    "dnet_resume_replay_tokens_total",
+    "dnet_chaos_injected_total",
+    # admission / overload survival (dnet_tpu/admission/) — the shed-rate
+    # alert, drain runbook, and the label cross-check (pass 6) depend on
+    # these
+    "dnet_admit_queue_depth",
+    "dnet_admit_inflight",
+    "dnet_admit_admitted_total",
+    "dnet_admit_wait_ms",
+    "dnet_admit_rejected_total",
+    "dnet_deadline_exceeded_total",
+    "dnet_cancel_propagated_total",
+    "dnet_drain_state",
+    "dnet_shard_outq_dropped_total",
+    # elastic ring membership (dnet_tpu/membership/) — the epoch-fence
+    # dashboards, recovery alert, and the label cross-check (pass 7)
+    # depend on these
+    "dnet_topology_epoch",
+    "dnet_stale_epoch_rejected_total",
+    "dnet_recovery_total",
+    "dnet_recovery_duration_seconds",
+    "dnet_shard_rejoins_total",
+    # performance attribution (obs/phases.py, obs/jit.py) — the loadgen
+    # report's phase/JIT/memory sections and the p99 cross-check (pass 8)
+    # depend on these
+    "dnet_step_phase_ms",
+    "dnet_jit_compiles_total",
+    "dnet_jit_compile_ms",
+    "dnet_device_mem_bytes",
+    "dnet_slo_ttft_p99_ms",
+    "dnet_slo_decode_p99_ms",
+)
+
+
+def check_federation(errors: list) -> int:
+    """Pass 3: federate the live exposition with itself under two node ids
+    and re-validate the merged document sample by sample."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs.federation import _SAMPLE_RE, _family_of, federate
+
+    fams = get_registry().families()
+    for req in _REQUIRED_FAMILIES:
+        if req not in fams:
+            errors.append(f"federation: required family {req} not registered")
+    text = get_registry().expose()
+    merged, skipped = federate([("api", text), ("shard-0", text)])
+    for line in skipped:
+        errors.append(f"federation: dropped unparseable line {line!r}")
+    n = 0
+    typed: set = set()
+    for line in merged.splitlines():
+        if line.startswith("# TYPE "):
+            name = line.split()[2]
+            if name in typed:
+                errors.append(f"federation: duplicate TYPE for {name}")
+            typed.add(name)
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"federation: emitted unparseable sample {line!r}")
+            continue
+        n += 1
+        _check_name(_family_of(m.group("name")), "federation", errors)
+        if line.count('node="') != 1:
+            errors.append(
+                f"federation: sample must carry exactly one node label: "
+                f"{line!r}"
+            )
+    return n
+
+
+def check_paged_conservation(errors: list) -> int:
+    """Pass 4: exercise the paged KV pool through an alloc / share / COW /
+    table-release / prefix-eviction script and assert the books balance at
+    every step — used + free == pool (shared blocks counted once), the
+    free list stays duplicate-free and disjoint, refcounts match holders,
+    and the gauges report exactly what the pool says."""
+    from dnet_tpu.kv import BlockPool, KVPoolExhausted, PagedKVConfig, PageTable
+    from dnet_tpu.obs import metric
+
+    pool = BlockPool(PagedKVConfig(block_tokens=8, pool_blocks=12))
+    steps = 0
+
+    def audit(holders):
+        nonlocal steps
+        steps += 1
+        try:
+            pool.check_conservation(holders)
+        except AssertionError as exc:
+            errors.append(f"paged-conservation step {steps}: {exc}")
+            return
+        used = metric("dnet_kv_blocks_used").value
+        free = metric("dnet_kv_blocks_free").value
+        if (used, free) != (pool.used, pool.free):
+            errors.append(
+                f"paged-conservation step {steps}: gauges ({used}, {free}) "
+                f"!= pool ({pool.used}, {pool.free})"
+            )
+
+    t1, t2 = PageTable(), PageTable()
+    entry = pool.alloc(2)  # a prefix entry's blocks
+    audit([entry])
+    pool.ensure(t1, 20)  # 3 blocks
+    audit([entry, t1.blocks])
+    t2.blocks.extend(pool.share(entry))  # adoption aliases the entry
+    pool.ensure(t2, 30)  # grows past the shared run
+    audit([entry, t1.blocks, entry, t2.blocks[2:]])
+    old = t2.blocks[1]
+    t2.blocks[1] = pool.cow(old)  # diverge mid-run
+    audit([entry, t1.blocks, [entry[0]], t2.blocks[1:]])
+    try:
+        pool.alloc(pool.free + 1)
+        errors.append("paged-conservation: overdraw did not raise")
+    except KVPoolExhausted:
+        pass
+    audit([entry, t1.blocks, [entry[0]], t2.blocks[1:]])
+    pool.release_table(t1)
+    pool.release_table(t2)
+    pool.free_blocks(entry)  # prefix eviction
+    audit([])
+    if pool.used != 0 or pool.free != pool.total:
+        errors.append(
+            f"paged-conservation: end state leaks ({pool.used} used, "
+            f"{pool.free}/{pool.total} free)"
+        )
+    return steps
+
+
+def check_chaos_points(errors: list) -> int:
+    """Pass 5: every chaos injection point declared in
+    dnet_tpu/resilience/chaos.py must have a pre-touched
+    dnet_chaos_injected_total{point=} series — a new point cannot ship
+    without its observability, and a renamed point cannot strand a stale
+    label."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.resilience.chaos import INJECTION_POINTS
+
+    text = get_registry().expose()
+    n = 0
+    for point in INJECTION_POINTS:
+        n += 1
+        if f'dnet_chaos_injected_total{{point="{point}"}}' not in text:
+            errors.append(
+                f"chaos: injection point {point!r} has no "
+                f"dnet_chaos_injected_total label (pre-touch it in "
+                f"dnet_tpu.obs._register_core)"
+            )
+    # reverse direction: no exposed point label without a declaration
+    for m in re.finditer(
+        r'dnet_chaos_injected_total\{point="([^"]+)"\}', text
+    ):
+        if m.group(1) not in INJECTION_POINTS:
+            errors.append(
+                f"chaos: exposed point label {m.group(1)!r} is not declared "
+                f"in chaos.INJECTION_POINTS"
+            )
+    return n
+
+
+def _cross_check_labels(
+    errors: list, text: str, family: str, label: str, declared, where: str
+) -> int:
+    """Exposed `family{label=...}` series must match `declared` EXACTLY in
+    both directions: every declared value pre-touched, no stray label."""
+    n = 0
+    scope = where.split(".", 1)[0]
+    for value in declared:
+        n += 1
+        if f'{family}{{{label}="{value}"}}' not in text:
+            errors.append(
+                f"{scope}: {where} value {value!r} has no {family} "
+                f"series (pre-touch it in dnet_tpu.obs._register_core)"
+            )
+    for m in re.finditer(rf'{family}\{{{label}="([^"]+)"\}}', text):
+        if m.group(1) not in declared:
+            errors.append(
+                f"{scope}: exposed {family} {label} label "
+                f"{m.group(1)!r} is not declared in {where}"
+            )
+    return n
+
+
+def check_admission_labels(errors: list) -> int:
+    """Pass 6: the admission surface's labeled families must agree with
+    the declared enums (dnet_tpu/admission/reasons.py) both ways — a new
+    reject reason or deadline stage cannot ship without its series, and a
+    renamed one cannot strand a stale label on dashboards."""
+    from dnet_tpu.admission.reasons import DEADLINE_STAGES, REJECT_REASONS
+    from dnet_tpu.obs import get_registry
+
+    text = get_registry().expose()
+    n = _cross_check_labels(
+        errors, text, "dnet_admit_rejected_total", "reason",
+        REJECT_REASONS, "admission.reasons.REJECT_REASONS",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_deadline_exceeded_total", "stage",
+        DEADLINE_STAGES, "admission.reasons.DEADLINE_STAGES",
+    )
+    return n
+
+
+def check_membership_labels(errors: list) -> int:
+    """Pass 7: the membership surface's labeled families must agree with
+    the declared enums (dnet_tpu/membership/epoch.py) both ways — a new
+    stale-epoch kind or recovery outcome cannot ship without its series,
+    and a renamed one cannot strand a stale label on dashboards.  Same
+    pattern as passes 5-6."""
+    from dnet_tpu.membership.epoch import RECOVERY_OUTCOMES, STALE_EPOCH_KINDS
+    from dnet_tpu.obs import get_registry
+
+    text = get_registry().expose()
+    n = _cross_check_labels(
+        errors, text, "dnet_stale_epoch_rejected_total", "kind",
+        STALE_EPOCH_KINDS, "membership.epoch.STALE_EPOCH_KINDS",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_recovery_total", "outcome",
+        RECOVERY_OUTCOMES, "membership.epoch.RECOVERY_OUTCOMES",
+    )
+    return n
+
+
+def check_attribution_labels(errors: list) -> int:
+    """Pass 8: the performance-attribution families must agree with the
+    declared enums (dnet_tpu/obs/phases.py) both ways.  Histogram families
+    expose per-label `_bucket`/`_sum`/`_count` series, so presence is
+    checked on `_count` and strays on any exposition suffix."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs.phases import DEVICE_MEM_KINDS, JIT_FNS, STEP_PHASES
+
+    text = get_registry().expose()
+    n = 0
+    for phase in STEP_PHASES:
+        n += 1
+        if f'dnet_step_phase_ms_count{{phase="{phase}"}}' not in text:
+            errors.append(
+                f"attribution: obs.phases.STEP_PHASES value {phase!r} has "
+                f"no dnet_step_phase_ms series (pre-touch it in "
+                f"dnet_tpu.obs._register_core)"
+            )
+    for m in re.finditer(
+        r'dnet_step_phase_ms(?:_bucket|_sum|_count)\{phase="([^"]+)"', text
+    ):
+        if m.group(1) not in STEP_PHASES:
+            errors.append(
+                f"attribution: exposed dnet_step_phase_ms phase label "
+                f"{m.group(1)!r} is not declared in obs.phases.STEP_PHASES"
+            )
+    n += _cross_check_labels(
+        errors, text, "dnet_jit_compiles_total", "fn",
+        JIT_FNS, "obs.phases.JIT_FNS",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_device_mem_bytes", "kind",
+        DEVICE_MEM_KINDS, "obs.phases.DEVICE_MEM_KINDS",
+    )
+    return n
+
+
+def main() -> int:
+    """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
+    and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
+    violations (tests/test_metrics_lint.py asserts this format)."""
+    errors: list[str] = []
+    n_reg = check_registry(errors)
+    n_src = check_sources(errors)
+    n_fed = check_federation(errors)
+    n_pool = check_paged_conservation(errors)
+    n_chaos = check_chaos_points(errors)
+    n_admit = check_admission_labels(errors)
+    n_member = check_membership_labels(errors)
+    n_attr = check_attribution_labels(errors)
+    if errors:
+        for e in errors:
+            print(f"FAIL {e}")
+        return 1
+    print(f"ok: {n_reg} registered families, {n_src} source-literal "
+          f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
+          f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
+          f"{n_member} membership labels, {n_attr} attribution labels, "
+          f"all conform")
+    return 0
+
+
+# ---- framework wrappers ---------------------------------------------------
+
+
+class _MetricsCheck(Check):
+    """Adapter: one legacy errors-list pass -> one DL01x check."""
+
+    requires_runtime = True
+    severity = "error"
+    pass_name = ""  # looked up in this module at run time
+
+    def run_project(self, project: Project) -> Iterable[Finding]:
+        errors: list = []
+        fn = globals()[self.pass_name]
+        try:
+            fn(errors)
+        except Exception as exc:  # a crashed pass is itself a finding
+            yield self.finding(
+                "dnet_tpu/analysis/metrics_checks.py", 0,
+                f"{self.pass_name} crashed: {type(exc).__name__}: {exc}",
+            )
+            return
+        for e in errors:
+            yield self.finding("dnet_tpu/analysis/metrics_checks.py", 0, e)
+
+
+class MetricRegistryNames(_MetricsCheck):
+    code = "DL010"
+    name = "metric-registry-names"
+    description = "registered families match dnet_[a-z0-9_]+ with help text"
+    pass_name = "check_registry"
+
+
+class MetricSourceLiterals(_MetricsCheck):
+    code = "DL011"
+    name = "metric-source-literals"
+    description = "literal counter/gauge/histogram registrations conform"
+    pass_name = "check_sources"
+
+
+class FederationRoundTrip(_MetricsCheck):
+    code = "DL012"
+    name = "federation-round-trip"
+    description = "two-node relabel/merge re-parses; required families exist"
+    pass_name = "check_federation"
+
+
+class PagedPoolConservation(_MetricsCheck):
+    code = "DL013"
+    name = "paged-pool-conservation"
+    description = "block books balance through alloc/share/COW/release"
+    pass_name = "check_paged_conservation"
+
+
+class ChaosPointCoverage(_MetricsCheck):
+    code = "DL014"
+    name = "chaos-point-coverage"
+    description = "chaos injection points <-> pre-touched series, both ways"
+    pass_name = "check_chaos_points"
+
+
+class AdmissionLabelContract(_MetricsCheck):
+    code = "DL015"
+    name = "admission-label-contract"
+    description = "reject/deadline labels <-> declared enums, both ways"
+    pass_name = "check_admission_labels"
+
+
+class MembershipLabelContract(_MetricsCheck):
+    code = "DL016"
+    name = "membership-label-contract"
+    description = "epoch/recovery labels <-> declared enums, both ways"
+    pass_name = "check_membership_labels"
+
+
+class AttributionLabelContract(_MetricsCheck):
+    code = "DL017"
+    name = "attribution-label-contract"
+    description = "phase/jit/mem labels <-> declared enums, both ways"
+    pass_name = "check_attribution_labels"
+
+
+METRICS_CHECKS = [
+    MetricRegistryNames(),
+    MetricSourceLiterals(),
+    FederationRoundTrip(),
+    PagedPoolConservation(),
+    ChaosPointCoverage(),
+    AdmissionLabelContract(),
+    MembershipLabelContract(),
+    AttributionLabelContract(),
+]

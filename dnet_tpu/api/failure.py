@@ -126,8 +126,8 @@ class RingFailureMonitor:
         for c in clients.values():
             try:
                 await c.close()
-            except Exception:
-                pass
+            except Exception as exc:
+                log.debug("channel close failed during stop: %s", exc)
 
     # ---- state ----------------------------------------------------------
     @property
@@ -221,8 +221,8 @@ class RingFailureMonitor:
             client = self._clients.pop(addr)
             try:
                 await client.close()
-            except Exception:
-                pass
+            except Exception as exc:
+                log.debug("pruned channel close failed for %s: %s", addr, exc)
 
     # ---- failure handling -------------------------------------------------
     async def _on_shard_down(self, instance: str) -> None:

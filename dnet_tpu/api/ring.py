@@ -113,6 +113,10 @@ class RingApiAdapter(ApiAdapterBase):
         # surfacing an InferenceError (popped on step-0 resolution either
         # way — one retry per request, a second miss fails loudly)
         self._refill_state: Dict[str, dict] = {}
+        # strong refs to in-flight refill tasks: the loop only keeps a
+        # weak one, so a bare ensure_future could be GC'd mid-refill and
+        # its exceptions vanish (DL003)
+        self._refill_tasks: set = set()
 
     async def start(self) -> None:
         self._head_client = self._make_client(self.head_addr)
@@ -381,6 +385,7 @@ class RingApiAdapter(ApiAdapterBase):
                 self._sent_at[(e["nonce"], e["seq"])] = now
             tokens = np.asarray([[e["token"]] for e in batch], dtype=np.int32)
             payload, _dtype, shape = tensor_to_bytes(tokens)
+            # dnetlint: disable=DL008 lane batch frame: many requests share it, so a single deadline would fate-share lanes; per-request deadlines are enforced at API admission and per-lane resolve
             frame = ActivationFrame(
                 nonce=self.LANES_NONCE,
                 seq=self._batch_seq,
@@ -486,9 +491,11 @@ class RingApiAdapter(ApiAdapterBase):
             state = self._refill_state.pop(result.nonce, None)
             if state is not None and result.step == 0:
                 try:
-                    asyncio.ensure_future(
+                    task = asyncio.ensure_future(
                         self._refill_prefill(result.nonce, state)
                     )
+                    self._refill_tasks.add(task)
+                    task.add_done_callback(self._refill_tasks.discard)
                 except RuntimeError:
                     # no running loop (sync caller): surface the error
                     # instead of silently dropping the request

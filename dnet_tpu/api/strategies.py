@@ -349,8 +349,8 @@ class BatchedLocalAdapter(ApiAdapterBase):
             log.exception("chunked batched prefill failed")
             try:
                 await loop.run_in_executor(self._executor, eng.abandon_prefill, nonce)
-            except Exception:  # executor already shut down
-                pass
+            except Exception as exc:  # executor already shut down
+                log.debug("abandon_prefill skipped for %s: %s", nonce, exc)
             self._futures.resolve(
                 TokenResult(nonce=nonce, token_id=-1, error=str(exc), step=step)
             )

@@ -14,6 +14,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
 _LANE = 128
 
 
@@ -68,8 +72,8 @@ def column_l2_norms(x: jnp.ndarray) -> jnp.ndarray:
     if on_tpu and C % _LANE == 0 and R % 8 == 0 and R % row_tile == 0:
         try:
             return _column_sq_norms_pallas(x, row_tile=row_tile)
-        except Exception:  # pallas unavailable/mosaic error: fall back
-            pass
+        except Exception as exc:  # pallas unavailable/mosaic error: fall back
+            log.debug("pallas column_sq_norms fell back to jnp: %s", exc)
     xf = x.astype(jnp.float32)
     return jnp.sum(xf * xf, axis=0)
 
@@ -150,8 +154,8 @@ def gather_columns(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
         onehot = (jnp.arange(D)[:, None] == idx[None, :]).astype(jnp.float32)
         try:
             return _pallas_matmul(x, onehot).astype(x.dtype)
-        except Exception:  # pallas/mosaic unavailable: fall back
-            pass
+        except Exception as exc:  # pallas/mosaic unavailable: fall back
+            log.debug("pallas gather_columns fell back to jnp: %s", exc)
     return jnp.take(x, idx, axis=1)
 
 
@@ -163,8 +167,8 @@ def scatter_columns(kept: jnp.ndarray, idx: jnp.ndarray, D: int) -> jnp.ndarray:
         onehot = (idx[:, None] == jnp.arange(D)[None, :]).astype(jnp.float32)
         try:
             return _pallas_matmul(kept, onehot).astype(kept.dtype)
-        except Exception:
-            pass
+        except Exception as exc:  # pallas/mosaic unavailable: fall back
+            log.debug("pallas scatter_columns fell back to jnp: %s", exc)
     return jnp.zeros((R, D), dtype=kept.dtype).at[:, idx].set(kept)
 
 

@@ -8,6 +8,14 @@ in this image) plus TPU-specific groups (mesh/ICI).
 
 Every field of every group is settable as ``<PREFIX><UPPER_NAME>`` in the
 environment, e.g. ``DNET_GRPC_MAX_MESSAGE_MB=128``.
+
+THIS MODULE IS THE ONLY SANCTIONED READER OF ``DNET_*`` ENVIRONMENT
+VARIABLES (static-analysis check DL006, ``scripts/dnetlint.py``): a raw
+``os.environ.get("DNET_...")`` elsewhere silently skips .env layering,
+type casting, and ``.env.example`` generation.  Consumers use a
+``Settings`` field; the handful of flags that must observe env flips
+AFTER the settings cache warmed (test toggles, operator kill-switches)
+go through :func:`env_flag` below.
 """
 
 from __future__ import annotations
@@ -504,6 +512,25 @@ for _cls in (
     MeshSettings,
 ):
     _resolve_hints(_cls)
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Sanctioned RAW process-env boolean read — the documented DL006
+    escape hatch for flags that must see ``os.environ`` flips after the
+    ``get_settings()`` cache warmed: the ``DNET_KV_PAGED`` /
+    ``DNET_PROFILE`` test toggles and the ``DNET_FLASH_DECODE`` /
+    ``DNET_FLASH_INTERPRET`` operator kill-switches.  Unset,
+    set-but-empty (``DNET_X=``, the shell/compose idiom for "unset"),
+    or unparseable values return ``default`` — an empty string must not
+    silently disable a default-enabled kill-switch.  Everything else
+    goes through a ``Settings`` field."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return _parse_bool(raw)
+    except ValueError:
+        return default
 
 
 @functools.lru_cache(maxsize=1)

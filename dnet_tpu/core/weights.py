@@ -237,7 +237,7 @@ class WeightCache:
             dev = jax.tree.map(
                 lambda v: jax.device_put(_bf16_view(v), self.device), host
             )
-        jax.block_until_ready(dev)
+        jax.block_until_ready(dev)  # dnetlint: disable=DL005 load-time weight-upload fence, not on the decode path
         log.info(
             "[PROFILE] HBM-load layer %d in %.1fms", layer, (time.perf_counter() - t0) * 1e3
         )

@@ -29,7 +29,6 @@ scripts/check_metrics_names.py):
 
 from __future__ import annotations
 
-import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -300,12 +299,11 @@ class BlockPool:
 
 def paged_enabled() -> bool:
     """THE flag gate: DNET_KV_PAGED=1 (KVSettings.paged).  A raw env read
-    backs the settings value so tests toggling os.environ after the
-    settings cache warmed still see the flip."""
-    from dnet_tpu.config import get_settings
+    (config.env_flag, the sanctioned DL006 escape hatch) backs the
+    settings value so tests toggling os.environ after the settings cache
+    warmed still see the flip."""
+    from dnet_tpu.config import env_flag, get_settings
 
     if get_settings().kv.paged:
         return True
-    return os.environ.get("DNET_KV_PAGED", "").strip().lower() in {
-        "1", "true", "yes", "on",
-    }
+    return env_flag("DNET_KV_PAGED")

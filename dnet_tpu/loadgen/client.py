@@ -21,6 +21,9 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from dnet_tpu.loadgen.workload import PlannedRequest
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
 
 # admission/overload shed statuses: these rows are SHED (never goodput,
 # never availability failures) — everything else non-200 is a failure
@@ -218,5 +221,10 @@ async def _drive(session, planned, model, path, out: RequestOutcome) -> None:
                 maybe = release()
                 if asyncio.iscoroutine(maybe):
                     await maybe
-            except Exception:
-                pass
+            except Exception as exc:
+                # connection-release failure cannot change the sample, but
+                # leave a trace (DL007 contract)
+                log.debug(
+                    "response release failed for request %d: %s",
+                    planned.index, exc,
+                )

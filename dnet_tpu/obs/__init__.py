@@ -18,7 +18,6 @@ log-line firehose and the device-sync fences.
 
 from __future__ import annotations
 
-import os
 import threading
 
 from dnet_tpu.obs.metrics import (
@@ -405,17 +404,15 @@ def metric(name: str) -> MetricFamily:
     return fam
 
 
-_TRUTHY = {"1", "true", "yes", "on"}
-
-
 def obs_enabled() -> bool:
     """Single profile-gating truth: DNET_OBS_ENABLED (ObsSettings) or the
-    legacy DNET_PROFILE env, whichever is set."""
-    from dnet_tpu.config import get_settings
+    legacy DNET_PROFILE env, whichever is set (read via config.env_flag,
+    the sanctioned DL006 escape hatch, so post-cache flips still gate)."""
+    from dnet_tpu.config import env_flag, get_settings
 
     if get_settings().obs.enabled:
         return True
-    return os.environ.get("DNET_PROFILE", "").strip().lower() in _TRUTHY
+    return env_flag("DNET_PROFILE")
 
 
 def reset_obs() -> None:

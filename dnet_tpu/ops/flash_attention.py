@@ -25,7 +25,6 @@ work like every flash implementation.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -214,7 +213,9 @@ def _pick_tile(n: int, target: int) -> int:
 
 
 def _interpret() -> bool:
-    return os.environ.get("DNET_FLASH_INTERPRET", "") in {"1", "true"}
+    from dnet_tpu.config import env_flag
+
+    return env_flag("DNET_FLASH_INTERPRET")
 
 
 _PROBE_WARNED = False

@@ -376,9 +376,9 @@ def flash_decode_eligible(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     output vma declarations — or the jnp tile-fold emulation under
     interpret mode; only a broken mesh/vma probe gates to dense (warned
     once in _under_manual_mesh)."""
-    import os
+    from dnet_tpu.config import env_flag
 
-    if os.environ.get("DNET_FLASH_DECODE", "1") == "0":
+    if not env_flag("DNET_FLASH_DECODE", default=True):
         return False
     if not _interpret() and jax.default_backend() != "tpu":
         return False
@@ -394,10 +394,10 @@ def sp_flash_eligible(q: jnp.ndarray, k_local: jnp.ndarray) -> bool:
     on TPU, the jnp tile-fold emulation under DNET_FLASH_INTERPRET=1 (the
     LSE combine — pmax/psum — is the same code either way, so CPU mesh
     tests execute the composition's algebra)."""
-    import os
+    from dnet_tpu.config import env_flag
 
     return (
-        os.environ.get("DNET_FLASH_DECODE", "1") != "0"
+        env_flag("DNET_FLASH_DECODE", default=True)
         and (jax.default_backend() == "tpu" or _interpret())
         and _shape_ok(q, k_local)
     )

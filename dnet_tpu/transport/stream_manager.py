@@ -165,8 +165,10 @@ class StreamManager:
         if done is not None:
             try:
                 await done()
-            except Exception:
-                pass
+            except Exception as exc:
+                # half-close on an already-broken stream: the stream is
+                # gone either way, but leave a trace (DL007 contract)
+                log.debug("done_writing failed for %s: %s", nonce, exc)
 
     async def cleanup_idle(self) -> int:
         """Close streams idle past the timeout; returns count closed."""

@@ -1,29 +1,22 @@
 #!/usr/bin/env python
-"""Metric-name lint: every dnet metric matches `dnet_[a-z0-9_]+` and has a
-help string.
+"""Metric-name lint CLI shim.
 
-Three passes, so drift cannot hide any way:
+The eight passes (registry names, source-literal scan, federation round
+trip, paged-pool conservation, chaos-point coverage, admission /
+membership / attribution label cross-checks) moved into the static
+analysis framework as checks DL010-DL017 —
+``dnet_tpu/analysis/metrics_checks.py`` — where they run alongside the
+async-safety / JIT-purity / contract checks via ``scripts/dnetlint.py``
+and the tier-1 wrapper (tests/test_static_analysis.py).
 
-1. **Live registry** — import `dnet_tpu.obs` (which registers the canonical
-   family set) and validate every registered family's name and help.
-2. **Source scan** — regex over the tree for `counter(` / `gauge(` /
-   `histogram(` calls whose first argument is a string literal, catching
-   series that a future PR registers lazily (never hit by pass 1) or with
-   an empty/missing help string.
-3. **Federation round trip** — relabel the live registry's exposition under
-   two node ids and merge (obs/federation.py, the `/v1/cluster/metrics`
-   path): every sample must re-parse with a valid family name and carry
-   exactly one `node` label, HELP/TYPE must emit once per family, and the
-   cluster-scope families this surface depends on (`dnet_slo_*`,
-   `dnet_prefix_refill_total`, `dnet_federation_scrape_ok`) must exist.
-
-Invoked from the tier-1 suite (tests/test_metrics_lint.py) so a bad name
-fails CI, not a 3am dashboard.  Exit 0 = clean, 1 = violations (printed).
+This shim keeps the historical entry point and output format byte-stable:
+``python scripts/check_metrics_names.py`` exits 0 with the ``ok: ...``
+summary on a clean tree, prints ``FAIL ...`` lines and exits 1 otherwise
+(tests/test_metrics_lint.py pins this contract).
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
@@ -31,377 +24,22 @@ REPO = Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:  # runnable as `python scripts/check_...py`
     sys.path.insert(0, str(REPO))
 
-# metric-registration calls with a literal name; help must be the next
-# argument and a non-empty string literal
-_CALL_RE = re.compile(
-    r"""\.\s*(counter|gauge|histogram)\(\s*
-        (?P<q>['"])(?P<name>[^'"]+)(?P=q)\s*,\s*
-        (?P<rest>.{0,120})""",
-    re.VERBOSE | re.DOTALL,
+from dnet_tpu.analysis.metrics_checks import (  # noqa: E402,F401 — re-exported
+    _CALL_RE,
+    _HELP_RE,
+    _REQUIRED_FAMILIES,
+    _check_name,
+    _cross_check_labels,
+    check_admission_labels,
+    check_attribution_labels,
+    check_chaos_points,
+    check_federation,
+    check_membership_labels,
+    check_paged_conservation,
+    check_registry,
+    check_sources,
+    main,
 )
-_HELP_RE = re.compile(r"""^(?P<q>['"])(?P<help>[^'"]*)""")
-
-_SCAN_DIRS = ("dnet_tpu", "scripts")
-_SCAN_FILES = ("bench.py",)
-
-
-def _check_name(name: str, where: str, errors: list) -> None:
-    from dnet_tpu.obs import METRIC_NAME_RE
-
-    if not METRIC_NAME_RE.match(name):
-        errors.append(
-            f"{where}: metric name {name!r} does not match "
-            f"{METRIC_NAME_RE.pattern}"
-        )
-
-
-def check_registry(errors: list) -> int:
-    from dnet_tpu.obs import get_registry
-
-    fams = get_registry().families()
-    for name, fam in fams.items():
-        _check_name(name, "registry", errors)
-        if not fam.help.strip():
-            errors.append(f"registry: metric {name} has an empty help string")
-    return len(fams)
-
-
-def check_sources(errors: list) -> int:
-    n = 0
-    files = [REPO / f for f in _SCAN_FILES]
-    for d in _SCAN_DIRS:
-        files.extend(sorted((REPO / d).rglob("*.py")))
-    for path in files:
-        if not path.is_file():
-            continue
-        text = path.read_text()
-        for m in _CALL_RE.finditer(text):
-            name = m.group("name")
-            if not name.startswith("dnet_"):
-                continue  # not one of ours (e.g. a generic helper call)
-            n += 1
-            where = f"{path.relative_to(REPO)}"
-            _check_name(name, where, errors)
-            hm = _HELP_RE.match(m.group("rest").lstrip())
-            if hm is None or not hm.group("help").strip():
-                errors.append(
-                    f"{where}: metric {name} registered without a literal "
-                    f"non-empty help string"
-                )
-    return n
-
-
-# families the cluster observability surface registers; their absence means
-# a refactor silently dropped a series dashboards/alerts depend on
-_REQUIRED_FAMILIES = (
-    "dnet_slo_ttft_p95_ms",
-    "dnet_slo_decode_p95_ms",
-    "dnet_slo_availability",
-    "dnet_slo_burning",
-    "dnet_prefix_refill_total",
-    "dnet_federation_scrape_ok",
-    # paged KV pool (dnet_tpu/kv/) — capacity dashboards and the
-    # backpressure alert depend on these
-    "dnet_kv_blocks_used",
-    "dnet_kv_blocks_free",
-    "dnet_kv_pool_blocks",
-    "dnet_kv_cow_copies_total",
-    "dnet_kv_prefix_shared_blocks_total",
-    "dnet_kv_admission_rejected_total",
-    # resilience (dnet_tpu/resilience/) — the retry/resume dashboards and
-    # the chaos-coverage lint (pass 5) depend on these
-    "dnet_rpc_retries_total",
-    "dnet_stream_reopens_total",
-    "dnet_request_resumed_total",
-    "dnet_resume_replay_tokens_total",
-    "dnet_chaos_injected_total",
-    # admission / overload survival (dnet_tpu/admission/) — the shed-rate
-    # alert, drain runbook, and the label cross-check (pass 6) depend on
-    # these
-    "dnet_admit_queue_depth",
-    "dnet_admit_inflight",
-    "dnet_admit_admitted_total",
-    "dnet_admit_wait_ms",
-    "dnet_admit_rejected_total",
-    "dnet_deadline_exceeded_total",
-    "dnet_cancel_propagated_total",
-    "dnet_drain_state",
-    "dnet_shard_outq_dropped_total",
-    # elastic ring membership (dnet_tpu/membership/) — the epoch-fence
-    # dashboards, recovery alert, and the label cross-check (pass 7)
-    # depend on these
-    "dnet_topology_epoch",
-    "dnet_stale_epoch_rejected_total",
-    "dnet_recovery_total",
-    "dnet_recovery_duration_seconds",
-    "dnet_shard_rejoins_total",
-    # performance attribution (obs/phases.py, obs/jit.py) — the loadgen
-    # report's phase/JIT/memory sections and the p99 cross-check (pass 8)
-    # depend on these
-    "dnet_step_phase_ms",
-    "dnet_jit_compiles_total",
-    "dnet_jit_compile_ms",
-    "dnet_device_mem_bytes",
-    "dnet_slo_ttft_p99_ms",
-    "dnet_slo_decode_p99_ms",
-)
-
-
-def check_federation(errors: list) -> int:
-    """Pass 3: federate the live exposition with itself under two node ids
-    and re-validate the merged document sample by sample."""
-    from dnet_tpu.obs import get_registry
-    from dnet_tpu.obs.federation import _SAMPLE_RE, _family_of, federate
-
-    fams = get_registry().families()
-    for req in _REQUIRED_FAMILIES:
-        if req not in fams:
-            errors.append(f"federation: required family {req} not registered")
-    text = get_registry().expose()
-    merged, skipped = federate([("api", text), ("shard-0", text)])
-    for line in skipped:
-        errors.append(f"federation: dropped unparseable line {line!r}")
-    n = 0
-    typed: set = set()
-    for line in merged.splitlines():
-        if line.startswith("# TYPE "):
-            name = line.split()[2]
-            if name in typed:
-                errors.append(f"federation: duplicate TYPE for {name}")
-            typed.add(name)
-            continue
-        if not line or line.startswith("#"):
-            continue
-        m = _SAMPLE_RE.match(line)
-        if m is None:
-            errors.append(f"federation: emitted unparseable sample {line!r}")
-            continue
-        n += 1
-        _check_name(_family_of(m.group("name")), "federation", errors)
-        if line.count('node="') != 1:
-            errors.append(
-                f"federation: sample must carry exactly one node label: "
-                f"{line!r}"
-            )
-    return n
-
-
-def check_paged_conservation(errors: list) -> int:
-    """Pass 4: exercise the paged KV pool through an alloc / share / COW /
-    table-release / prefix-eviction script and assert the books balance at
-    every step — used + free == pool (shared blocks counted once), the
-    free list stays duplicate-free and disjoint, refcounts match holders,
-    and the gauges report exactly what the pool says."""
-    from dnet_tpu.kv import BlockPool, KVPoolExhausted, PagedKVConfig, PageTable
-    from dnet_tpu.obs import metric
-
-    pool = BlockPool(PagedKVConfig(block_tokens=8, pool_blocks=12))
-    steps = 0
-
-    def audit(holders):
-        nonlocal steps
-        steps += 1
-        try:
-            pool.check_conservation(holders)
-        except AssertionError as exc:
-            errors.append(f"paged-conservation step {steps}: {exc}")
-            return
-        used = metric("dnet_kv_blocks_used").value
-        free = metric("dnet_kv_blocks_free").value
-        if (used, free) != (pool.used, pool.free):
-            errors.append(
-                f"paged-conservation step {steps}: gauges ({used}, {free}) "
-                f"!= pool ({pool.used}, {pool.free})"
-            )
-
-    t1, t2 = PageTable(), PageTable()
-    entry = pool.alloc(2)  # a prefix entry's blocks
-    audit([entry])
-    pool.ensure(t1, 20)  # 3 blocks
-    audit([entry, t1.blocks])
-    t2.blocks.extend(pool.share(entry))  # adoption aliases the entry
-    pool.ensure(t2, 30)  # grows past the shared run
-    audit([entry, t1.blocks, entry, t2.blocks[2:]])
-    old = t2.blocks[1]
-    t2.blocks[1] = pool.cow(old)  # diverge mid-run
-    audit([entry, t1.blocks, [entry[0]], t2.blocks[1:]])
-    try:
-        pool.alloc(pool.free + 1)
-        errors.append("paged-conservation: overdraw did not raise")
-    except KVPoolExhausted:
-        pass
-    audit([entry, t1.blocks, [entry[0]], t2.blocks[1:]])
-    pool.release_table(t1)
-    pool.release_table(t2)
-    pool.free_blocks(entry)  # prefix eviction
-    audit([])
-    if pool.used != 0 or pool.free != pool.total:
-        errors.append(
-            f"paged-conservation: end state leaks ({pool.used} used, "
-            f"{pool.free}/{pool.total} free)"
-        )
-    return steps
-
-
-def check_chaos_points(errors: list) -> int:
-    """Pass 5: every chaos injection point declared in
-    dnet_tpu/resilience/chaos.py must have a pre-touched
-    dnet_chaos_injected_total{point=} series — a new point cannot ship
-    without its observability, and a renamed point cannot strand a stale
-    label."""
-    from dnet_tpu.obs import get_registry
-    from dnet_tpu.resilience.chaos import INJECTION_POINTS
-
-    text = get_registry().expose()
-    n = 0
-    for point in INJECTION_POINTS:
-        n += 1
-        if f'dnet_chaos_injected_total{{point="{point}"}}' not in text:
-            errors.append(
-                f"chaos: injection point {point!r} has no "
-                f"dnet_chaos_injected_total label (pre-touch it in "
-                f"dnet_tpu.obs._register_core)"
-            )
-    # reverse direction: no exposed point label without a declaration
-    import re
-
-    for m in re.finditer(
-        r'dnet_chaos_injected_total\{point="([^"]+)"\}', text
-    ):
-        if m.group(1) not in INJECTION_POINTS:
-            errors.append(
-                f"chaos: exposed point label {m.group(1)!r} is not declared "
-                f"in chaos.INJECTION_POINTS"
-            )
-    return n
-
-
-def _cross_check_labels(
-    errors: list, text: str, family: str, label: str, declared, where: str
-) -> int:
-    """Exposed `family{label=...}` series must match `declared` EXACTLY in
-    both directions: every declared value pre-touched, no stray label."""
-    import re
-
-    n = 0
-    scope = where.split(".", 1)[0]
-    for value in declared:
-        n += 1
-        if f'{family}{{{label}="{value}"}}' not in text:
-            errors.append(
-                f"{scope}: {where} value {value!r} has no {family} "
-                f"series (pre-touch it in dnet_tpu.obs._register_core)"
-            )
-    for m in re.finditer(rf'{family}\{{{label}="([^"]+)"\}}', text):
-        if m.group(1) not in declared:
-            errors.append(
-                f"{scope}: exposed {family} {label} label "
-                f"{m.group(1)!r} is not declared in {where}"
-            )
-    return n
-
-
-def check_admission_labels(errors: list) -> int:
-    """Pass 6: the admission surface's labeled families must agree with
-    the declared enums (dnet_tpu/admission/reasons.py) both ways — a new
-    reject reason or deadline stage cannot ship without its series, and a
-    renamed one cannot strand a stale label on dashboards."""
-    from dnet_tpu.admission.reasons import DEADLINE_STAGES, REJECT_REASONS
-    from dnet_tpu.obs import get_registry
-
-    text = get_registry().expose()
-    n = _cross_check_labels(
-        errors, text, "dnet_admit_rejected_total", "reason",
-        REJECT_REASONS, "admission.reasons.REJECT_REASONS",
-    )
-    n += _cross_check_labels(
-        errors, text, "dnet_deadline_exceeded_total", "stage",
-        DEADLINE_STAGES, "admission.reasons.DEADLINE_STAGES",
-    )
-    return n
-
-
-def check_membership_labels(errors: list) -> int:
-    """Pass 7: the membership surface's labeled families must agree with
-    the declared enums (dnet_tpu/membership/epoch.py) both ways — a new
-    stale-epoch kind or recovery outcome cannot ship without its series,
-    and a renamed one cannot strand a stale label on dashboards.  Same
-    pattern as passes 5-6."""
-    from dnet_tpu.membership.epoch import RECOVERY_OUTCOMES, STALE_EPOCH_KINDS
-    from dnet_tpu.obs import get_registry
-
-    text = get_registry().expose()
-    n = _cross_check_labels(
-        errors, text, "dnet_stale_epoch_rejected_total", "kind",
-        STALE_EPOCH_KINDS, "membership.epoch.STALE_EPOCH_KINDS",
-    )
-    n += _cross_check_labels(
-        errors, text, "dnet_recovery_total", "outcome",
-        RECOVERY_OUTCOMES, "membership.epoch.RECOVERY_OUTCOMES",
-    )
-    return n
-
-
-def check_attribution_labels(errors: list) -> int:
-    """Pass 8: the performance-attribution families must agree with the
-    declared enums (dnet_tpu/obs/phases.py) both ways.  Histogram families
-    expose per-label `_bucket`/`_sum`/`_count` series, so presence is
-    checked on `_count` and strays on any exposition suffix."""
-    import re
-
-    from dnet_tpu.obs import get_registry
-    from dnet_tpu.obs.phases import DEVICE_MEM_KINDS, JIT_FNS, STEP_PHASES
-
-    text = get_registry().expose()
-    n = 0
-    for phase in STEP_PHASES:
-        n += 1
-        if f'dnet_step_phase_ms_count{{phase="{phase}"}}' not in text:
-            errors.append(
-                f"attribution: obs.phases.STEP_PHASES value {phase!r} has "
-                f"no dnet_step_phase_ms series (pre-touch it in "
-                f"dnet_tpu.obs._register_core)"
-            )
-    for m in re.finditer(
-        r'dnet_step_phase_ms(?:_bucket|_sum|_count)\{phase="([^"]+)"', text
-    ):
-        if m.group(1) not in STEP_PHASES:
-            errors.append(
-                f"attribution: exposed dnet_step_phase_ms phase label "
-                f"{m.group(1)!r} is not declared in obs.phases.STEP_PHASES"
-            )
-    n += _cross_check_labels(
-        errors, text, "dnet_jit_compiles_total", "fn",
-        JIT_FNS, "obs.phases.JIT_FNS",
-    )
-    n += _cross_check_labels(
-        errors, text, "dnet_device_mem_bytes", "kind",
-        DEVICE_MEM_KINDS, "obs.phases.DEVICE_MEM_KINDS",
-    )
-    return n
-
-
-def main() -> int:
-    errors: list[str] = []
-    n_reg = check_registry(errors)
-    n_src = check_sources(errors)
-    n_fed = check_federation(errors)
-    n_pool = check_paged_conservation(errors)
-    n_chaos = check_chaos_points(errors)
-    n_admit = check_admission_labels(errors)
-    n_member = check_membership_labels(errors)
-    n_attr = check_attribution_labels(errors)
-    if errors:
-        for e in errors:
-            print(f"FAIL {e}")
-        return 1
-    print(f"ok: {n_reg} registered families, {n_src} source-literal "
-          f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
-          f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
-          f"{n_member} membership labels, {n_attr} attribution labels, "
-          f"all conform")
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main())
