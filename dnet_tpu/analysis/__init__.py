@@ -16,6 +16,7 @@ from dnet_tpu.analysis.checks_contract import (
     EnvReadOutsideConfig,
     SilentExceptionSwallow,
 )
+from dnet_tpu.analysis.checks_dsan import OwnershipRegistryDrift
 from dnet_tpu.analysis.checks_jit import JitPurity, UngatedDeviceSync
 from dnet_tpu.analysis.core import (
     DEFAULT_BASELINE,
@@ -43,6 +44,7 @@ ALL_CHECKS = [
     EnvReadOutsideConfig(),
     SilentExceptionSwallow(),
     ContractDrift(),
+    OwnershipRegistryDrift(),
     *METRICS_CHECKS,
 ]
 

@@ -1,0 +1,126 @@
+"""DL009 — ownership-registry drift: the dsan declarations must match
+the code, and thread->loop bridges must stay in sanctioned modules.
+
+Two halves:
+
+1. Every entry of :data:`dnet_tpu.analysis.runtime.domains.OWNERSHIP_DOMAINS`
+   names (module, class, attribute[, lock attribute]).  The class and the
+   ``self.<attr>`` assignment must exist in that module — a refactor that
+   renames ``recv_q`` or moves ``_buffered`` would otherwise leave the
+   runtime sanitizer silently guarding nothing.  The registry half only
+   runs on trees that SHIP the registry (``analysis/runtime/domains.py``
+   present): there a missing module is itself a finding, while synthetic
+   fixture trees stay independent of the real declarations.
+
+2. ``call_soon_threadsafe`` / ``run_coroutine_threadsafe`` calls outside
+   :data:`~dnet_tpu.analysis.runtime.domains.BRIDGE_MODULES` are findings:
+   ad-hoc thread->loop bridges are exactly the seams dsan fences, so a new
+   one must be declared (and its shared state annotated) or routed through
+   an existing bridge.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set, Tuple
+
+from dnet_tpu.analysis.core import (
+    Check,
+    Finding,
+    Project,
+    SourceFile,
+    dotted,
+)
+from dnet_tpu.analysis.runtime.domains import BRIDGE_MODULES, OWNERSHIP_DOMAINS
+
+_BRIDGE_CALLS = ("call_soon_threadsafe", "run_coroutine_threadsafe")
+
+
+def _class_attrs(src: SourceFile, cls_name: str) -> Optional[Set[str]]:
+    """Attribute names assigned as ``self.<name>`` (or annotated / declared
+    at class level) anywhere in class ``cls_name``; None when the class
+    itself is missing."""
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            attrs: Set[str] = set()
+            for sub in ast.walk(node):
+                targets: Tuple[ast.AST, ...] = ()
+                if isinstance(sub, ast.Assign):
+                    targets = tuple(sub.targets)
+                elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                    targets = (sub.target,)
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        attrs.add(t.attr)
+                    elif isinstance(t, ast.Name):
+                        attrs.add(t.id)  # class-level declaration
+            return attrs
+    return None
+
+
+class OwnershipRegistryDrift(Check):
+    code = "DL009"
+    name = "ownership-registry-drift"
+    description = (
+        "dsan ownership declarations (analysis/runtime/domains.py) must "
+        "match the code, and call_soon_threadsafe / "
+        "run_coroutine_threadsafe must stay in sanctioned bridge modules"
+    )
+
+    def run_project(self, project: Project) -> Iterable[Finding]:
+        registry = project.find_suffix("dnet_tpu/analysis/runtime/domains.py")
+        if registry is None:
+            return  # fixture tree without the registry: nothing to drift
+        for entry in OWNERSHIP_DOMAINS:
+            module, cls, attr, kind, arg = entry
+            src = project.find_suffix(module)
+            if src is None or src.tree is None:
+                yield self.finding(
+                    registry.rel, 0,
+                    f"ownership declaration for {cls}.{attr} names "
+                    f"missing module {module}",
+                )
+                continue
+            attrs = _class_attrs(src, cls)
+            if attrs is None:
+                yield self.finding(
+                    src.rel, 0,
+                    f"ownership declaration names missing class {cls} "
+                    f"(declared for attribute {attr})",
+                )
+                continue
+            if attr not in attrs:
+                yield self.finding(
+                    src.rel, 0,
+                    f"ownership declaration names missing attribute "
+                    f"{cls}.{attr} [{kind}]",
+                )
+            if kind == "lock" and arg not in attrs:
+                yield self.finding(
+                    src.rel, 0,
+                    f"ownership declaration guarded-by({arg}) for "
+                    f"{cls}.{attr} names a lock attribute {cls}.{arg} "
+                    f"that does not exist",
+                )
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        if src.rel in BRIDGE_MODULES or src.rel.endswith("/conftest.py"):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1]
+            if leaf in _BRIDGE_CALLS:
+                yield self.finding(
+                    src.rel, node.lineno,
+                    f"{leaf}() outside the sanctioned bridge modules "
+                    f"({', '.join(BRIDGE_MODULES)}): declare the bridge in "
+                    f"analysis/runtime/domains.py and annotate its shared "
+                    f"state, or route through an existing bridge",
+                    col=node.col_offset,
+                )

@@ -443,5 +443,12 @@ def next_report_path(root: Path) -> Path:
     return root / f"ANALYSIS_r{max(nums) + 1:02d}.json"
 
 
-def write_report_json(report: Report, path: Path) -> None:
-    path.write_text(json.dumps(report.to_json(), indent=2, sort_keys=True) + "\n")
+def write_report_json(
+    report: Report, path: Path, extra: Optional[dict] = None
+) -> None:
+    """Emit the JSON record; ``extra`` merges additional top-level
+    sections (the CLI adds the dsan ``runtime`` section here)."""
+    data = report.to_json()
+    if extra:
+        data.update(extra)
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")

@@ -24,6 +24,8 @@ Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
   enums, both directions
 - DL017 attribution   — step phases / jit fns / device-mem kinds <->
   declared enums, both directions
+- DL018 sanitizer     — dsan check codes / zombie-thread kinds <->
+  declared enums, both directions (pass 9)
 """
 
 from __future__ import annotations
@@ -152,6 +154,10 @@ _REQUIRED_FAMILIES = (
     "dnet_device_mem_bytes",
     "dnet_slo_ttft_p99_ms",
     "dnet_slo_decode_p99_ms",
+    # runtime sanitizer (dnet_tpu/analysis/runtime/) — the dsan findings
+    # dashboard and the zombie-thread alert (pass 9) depend on these
+    "dnet_san_findings_total",
+    "dnet_san_zombie_threads_total",
 )
 
 
@@ -383,6 +389,30 @@ def check_attribution_labels(errors: list) -> int:
     return n
 
 
+def check_san_labels(errors: list) -> int:
+    """Pass 9: the runtime sanitizer's labeled families must agree with
+    the declared enums (dnet_tpu/analysis/runtime/domains.py) both ways —
+    a new DS check or zombie-able worker thread cannot ship without its
+    series, and a renamed one cannot strand a stale label.  Same pattern
+    as passes 5-8."""
+    from dnet_tpu.analysis.runtime.domains import (
+        RUNTIME_CHECK_CODES,
+        ZOMBIE_THREAD_KINDS,
+    )
+    from dnet_tpu.obs import get_registry
+
+    text = get_registry().expose()
+    n = _cross_check_labels(
+        errors, text, "dnet_san_findings_total", "check",
+        RUNTIME_CHECK_CODES, "analysis.runtime.domains.RUNTIME_CHECK_CODES",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_san_zombie_threads_total", "thread",
+        ZOMBIE_THREAD_KINDS, "analysis.runtime.domains.ZOMBIE_THREAD_KINDS",
+    )
+    return n
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -396,6 +426,7 @@ def main() -> int:
     n_admit = check_admission_labels(errors)
     n_member = check_membership_labels(errors)
     n_attr = check_attribution_labels(errors)
+    n_san = check_san_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -404,7 +435,7 @@ def main() -> int:
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
           f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
           f"{n_member} membership labels, {n_attr} attribution labels, "
-          f"all conform")
+          f"{n_san} sanitizer labels, all conform")
     return 0
 
 
@@ -489,6 +520,13 @@ class AttributionLabelContract(_MetricsCheck):
     pass_name = "check_attribution_labels"
 
 
+class SanLabelContract(_MetricsCheck):
+    code = "DL018"
+    name = "san-label-contract"
+    description = "dsan check/zombie labels <-> declared enums, both ways"
+    pass_name = "check_san_labels"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -498,4 +536,5 @@ METRICS_CHECKS = [
     AdmissionLabelContract(),
     MembershipLabelContract(),
     AttributionLabelContract(),
+    SanLabelContract(),
 ]

@@ -1,0 +1,72 @@
+"""dsan — the runtime concurrency sanitizer (``DNET_SAN=1``).
+
+The static suite (PR 8, ``dnet_tpu/analysis/checks_*``) proves what the
+AST can see; dsan proves what only a RUNNING process can: the event loop
+actually blocking (loop_monitor, DS001), a thread actually touching a
+structure outside its declared ownership domain (ownership, DS002/DS003),
+locks actually acquired in cyclic order (lockorder, DS004), and tasks
+actually leaked or left holding an unretrieved exception (tasks,
+DS005/DS006).  Findings reuse the static :class:`Finding` model and merge
+into the same ``ANALYSIS_r<NN>.json`` records via ``scripts/dnetlint.py``.
+
+Wiring:
+
+- tests/subsystems/test_dsan.py runs designated subsystem suites under
+  ``DNET_SAN=1`` in tier-1 and fails on any finding;
+- ``scripts/dnetlint.py --json`` embeds the ``runtime`` section (catalog
+  + persisted findings) and ``--list-checks`` prints the DS catalog;
+- static check DL009 cross-checks the ownership declarations
+  (:mod:`.domains`) against the code.
+
+With ``DNET_SAN`` unset every entry point here is a no-op: guards return
+their arguments unchanged and nothing is installed — zero cost on the
+serving path.
+"""
+
+from dnet_tpu.analysis.runtime import (
+    lockorder,
+    loop_monitor,
+    ownership,
+    serving,
+    tasks,
+)
+from dnet_tpu.analysis.runtime.domains import (
+    BRIDGE_MODULES,
+    OWNERSHIP_DOMAINS,
+    RUNTIME_CHECK_CODES,
+    RUNTIME_CHECKS,
+    ZOMBIE_THREAD_KINDS,
+)
+from dnet_tpu.analysis.runtime.lockorder import (
+    SanLock,
+    audit_lock_order,
+    reset_lock_order,
+)
+from dnet_tpu.analysis.runtime.sanitizer import (
+    Sanitizer,
+    get_sanitizer,
+    reset_sanitizer,
+    runtime_section,
+    san_enabled,
+)
+
+__all__ = [
+    "BRIDGE_MODULES",
+    "OWNERSHIP_DOMAINS",
+    "RUNTIME_CHECKS",
+    "RUNTIME_CHECK_CODES",
+    "ZOMBIE_THREAD_KINDS",
+    "SanLock",
+    "Sanitizer",
+    "audit_lock_order",
+    "get_sanitizer",
+    "lockorder",
+    "loop_monitor",
+    "ownership",
+    "reset_lock_order",
+    "reset_sanitizer",
+    "runtime_section",
+    "san_enabled",
+    "serving",
+    "tasks",
+]

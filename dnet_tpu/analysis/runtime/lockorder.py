@@ -1,0 +1,193 @@
+"""dsan lock-order tracking: instrumented locks, an acquisition-order
+graph, and cycle detection for potential-deadlock findings (DS004).
+
+Every :class:`SanLock` acquisition while OTHER SanLocks are held adds a
+directed edge ``held -> acquired`` (annotated with the acquisition site)
+to a process-global graph.  Two threads that take ``A then B`` and ``B
+then A`` — even if they never actually collide in a run — produce the
+cycle ``A -> B -> A`` at audit time, which is exactly the latent deadlock
+a loaded serving process would eventually hit.
+
+Lock identity is the declared NAME (``LocalAdapter._buf_lock``), not the
+instance: the discipline under test is class-level ("pool lock before
+prefix lock, never the reverse"), and instance-keyed edges would miss an
+inversion across two different adapters.  Self-edges (re-acquiring the
+same name, e.g. two pool instances) are recorded separately as they are
+legal for distinct instances but still worth surfacing in the audit when
+the same INSTANCE re-enters (threading.Lock is not reentrant — that is an
+immediate hang, caught live, not at audit).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dnet_tpu.analysis.runtime import sanitizer as _san
+
+_tls = threading.local()
+
+
+class LockOrderGraph:
+    """Directed name->name acquisition edges with first-seen sites."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: (held_name, acquired_name) -> (path, line) of first observation
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add(self, held: str, acquired: str, site: Tuple[str, int]) -> None:
+        if held == acquired:
+            return  # distinct instances of one class: legal, not an order
+        key = (held, acquired)
+        with self._lock:
+            self.edges.setdefault(key, site)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.edges.clear()
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary cycle's node list (deduped by node set),
+        deterministic order.  Graphs here are tiny (a dozen named locks),
+        so plain DFS is plenty."""
+        with self._lock:
+            adj: Dict[str, List[str]] = {}
+            for a, b in sorted(self.edges):
+                adj.setdefault(a, []).append(b)
+        seen_sets: set = set()
+        out: List[List[str]] = []
+
+        def dfs(start: str, node: str, path: List[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append(path + [start])
+                elif nxt not in path and nxt > start:
+                    # only walk nodes > start: each cycle is found exactly
+                    # once, rooted at its smallest node
+                    dfs(start, nxt, path + [nxt])
+
+        for start in sorted(adj):
+            dfs(start, start, [start])
+        return out
+
+
+_graph = LockOrderGraph()
+
+
+def get_graph() -> LockOrderGraph:
+    return _graph
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+class SanLock:
+    """A ``threading.Lock`` wrapper that records ownership (for
+    guarded-by domain checks) and acquisition order (for DS004).
+
+    Supports the full ``with`` protocol plus ``acquire``/``release``/
+    ``locked`` so it drops into any attribute that held a plain Lock.
+    Only constructed when dsan is active — the plain lock stays in place
+    otherwise (see :func:`dnet_tpu.analysis.runtime.ownership.san_lock`).
+    """
+
+    __slots__ = ("_inner", "name", "_owner")
+
+    def __init__(self, name: str, inner: Optional[threading.Lock] = None) -> None:
+        self._inner = inner if inner is not None else threading.Lock()
+        self.name = name
+        self._owner: Optional[int] = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._owner == me:
+            # non-reentrant lock re-entered by its owner: record the
+            # finding BEFORE blocking forever (the block itself would
+            # otherwise be the only diagnostic)
+            path, line = _san.caller_site()
+            _san.get_sanitizer().record(
+                "DS004",
+                f"lock {self.name} re-acquired by its owning thread "
+                f"(threading.Lock is not reentrant: this deadlocks)",
+                path, line,
+            )
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            if _san.san_enabled() and not _san.get_sanitizer().recording():
+                site = _san.caller_site()
+                stack = _held_stack()
+                for held in stack:
+                    _graph.add(held.name, self.name, site)
+                stack.append(self)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._owner = None
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    @property
+    def inner(self) -> threading.Lock:
+        """The wrapped plain lock (deinstrumentation restores it)."""
+        return self._inner
+
+    def held_by_current_thread(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def __enter__(self) -> "SanLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        state = "locked" if self.locked() else "unlocked"
+        return f"<SanLock {self.name} {state}>"
+
+
+def audit_lock_order() -> int:
+    """Run cycle detection over the recorded graph and record one DS004
+    finding per distinct cycle.  Returns how many cycles were found."""
+    cycles = _graph.cycles()
+    san = _san.get_sanitizer()
+    with _graph._lock:
+        edges = dict(_graph.edges)
+    for cyc in cycles:
+        legs = " -> ".join(cyc)
+        # attribute to the first recorded edge site of the cycle
+        path, line = "", 0
+        for a, b in zip(cyc, cyc[1:]):
+            if (a, b) in edges:
+                path, line = edges[(a, b)]
+                break
+        sites = "; ".join(
+            f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+            for a, b in zip(cyc, cyc[1:]) if (a, b) in edges
+        )
+        san.record(
+            "DS004",
+            f"lock-order cycle {legs} (potential deadlock; {sites})",
+            path or "<lockorder>", line,
+        )
+    return len(cycles)
+
+
+def reset_lock_order() -> None:
+    _graph.clear()

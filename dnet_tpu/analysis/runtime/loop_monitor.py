@@ -1,0 +1,132 @@
+"""dsan loop-stall watchdog (DS001): detect the event loop blocked.
+
+A heartbeat callback re-schedules itself on the watched loop every
+``stall_ms / 4``; a sampling daemon thread watches the heartbeat age.
+When the age exceeds ``DNET_SAN_STALL_MS`` the loop thread is wedged in
+something synchronous — a C-extension call, a hidden device sync, a
+``time.sleep`` — and the watchdog captures that thread's CURRENT stack
+via ``sys._current_frames()``, attributes the stall to the innermost
+repo frame (file:line), and records a DS001 finding.  One finding per
+stall episode: the latch re-arms only after a heartbeat lands again.
+
+The watchdog observes; it never interrupts.  Overhead while enabled is
+one timer callback + one sleeping thread; while disabled it is never
+constructed at all (:func:`install` returns None).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from dnet_tpu.analysis.runtime import sanitizer as _san
+
+_MIN_BEAT_S = 0.005
+
+
+def _attribute(frame):
+    """Render the blocked stack innermost-repo-frame first: the finding's
+    file:line is the deepest frame inside the repo (the code that made
+    the blocking call), with the raw innermost frame appended when it
+    lives outside the repo (the primitive actually blocking)."""
+    stack: List[str] = []
+    repo_site: Optional[tuple] = None
+    f = frame
+    while f is not None:
+        rel = _san._relpath(f.f_code.co_filename)
+        if repo_site is None and not rel.startswith("/") and "site-packages" not in rel:
+            repo_site = (rel, f.f_lineno, f.f_code.co_name)
+        stack.append(f"{rel}:{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+    head = " <- ".join(stack[:4])
+    return head, repo_site
+
+
+class LoopStallMonitor:
+    """Watchdog for ONE event loop; see module docstring."""
+
+    def __init__(
+        self,
+        loop: asyncio.AbstractEventLoop,
+        stall_ms: float,
+        poll_ms: float = 0.0,
+    ) -> None:
+        self.loop = loop
+        self.stall_s = max(stall_ms, 1.0) / 1000.0
+        self.poll_s = (
+            poll_ms / 1000.0 if poll_ms > 0 else max(self.stall_s / 4, _MIN_BEAT_S)
+        )
+        self.beat_s = max(self.stall_s / 4, _MIN_BEAT_S)
+        self._last_beat = time.monotonic()
+        self._loop_ident: Optional[int] = None
+        self._alive = False
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+        self.stalls = 0  # episodes observed (tests read this)
+
+    # ---- loop side ------------------------------------------------------
+    def _beat(self) -> None:
+        self._loop_ident = threading.get_ident()
+        self._last_beat = time.monotonic()
+        if self._alive:
+            self.loop.call_later(self.beat_s, self._beat)
+
+    # ---- sampler side ---------------------------------------------------
+    def _sample(self) -> None:
+        while self._alive:
+            time.sleep(self.poll_s)
+            lag = time.monotonic() - self._last_beat
+            if lag <= self.stall_s:
+                self._fired = False
+                continue
+            if self._fired or self._loop_ident is None:
+                continue
+            self._fired = True
+            self.stalls += 1
+            frame = sys._current_frames().get(self._loop_ident)
+            if frame is None:
+                continue
+            head, repo_site = _attribute(frame)
+            path, line = ("<loop>", 0)
+            where = ""
+            if repo_site is not None:
+                path, line = repo_site[0], repo_site[1]
+                where = f" in {repo_site[2]}()"
+            _san.get_sanitizer().record(
+                "DS001",
+                f"event loop blocked > {self.stall_s * 1000:.0f} ms"
+                f"{where}; loop-thread stack: {head}",
+                path, line,
+            )
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._alive = True
+        self._last_beat = time.monotonic()
+        self.loop.call_soon_threadsafe(self._beat)
+        self._thread = threading.Thread(
+            target=self._sample, name="dsan-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._alive = False
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def install(loop: asyncio.AbstractEventLoop) -> Optional[LoopStallMonitor]:
+    """Start a stall monitor for ``loop`` when dsan is active (settings
+    supply the thresholds); returns None — a no-op — otherwise."""
+    if not _san.san_enabled():
+        return None
+    from dnet_tpu.config import get_settings
+
+    san = get_settings().san
+    mon = LoopStallMonitor(loop, san.san_stall_ms, san.san_poll_ms)
+    mon.start()
+    return mon

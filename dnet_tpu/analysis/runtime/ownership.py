@@ -1,0 +1,323 @@
+"""dsan ownership domains: who may touch a shared structure, enforced.
+
+A *domain* names the concurrency contract of one shared structure:
+
+- ``loop_domain(loop)``      — loop-only: touched only from a thread with
+  the owning event loop running (asyncio.Queue, future maps, task sets);
+- ``thread_domain(name)``    — owned by the named thread (``shard-compute``;
+  executor pools match ``name_N``);
+- ``lock_domain(san_lock)``  — guarded-by: the instrumented lock must be
+  held by the current thread at every access.
+
+The guard wrappers below are applied at CONSTRUCTION time, and only when
+dsan is active — with ``DNET_SAN`` unset every factory returns its
+argument unchanged, so the serving path carries zero instrumentation
+(no proxy, no extra attribute, no check call).  Violations record DS002
+(wrong thread) / DS003 (lock not held) into the process sanitizer,
+deduped per site, and never raise — a sanitizer must observe the race,
+not change the program under test.
+
+Deliberate, audited cross-domain accesses (queue drains at teardown,
+where ``queue.Queue``'s own lock makes the cross-thread pop benign) are
+wrapped in :func:`allowed` — the runtime twin of the static
+``# dnetlint: disable=...`` suppression, and like it, scoped and named.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import OrderedDict
+from typing import Iterable, Optional
+
+from dnet_tpu.analysis.runtime import sanitizer as _san
+from dnet_tpu.analysis.runtime.lockorder import SanLock
+
+_tls = threading.local()
+
+
+class _Allowance:
+    """Context manager: suppress domain checks for the named structures
+    on this thread (deliberate cross-domain access, documented at the
+    call site)."""
+
+    __slots__ = ("names",)
+
+    def __init__(self, names: Iterable[str]) -> None:
+        self.names = set(names)
+
+    def __enter__(self) -> "_Allowance":
+        stack = getattr(_tls, "allowed", None)
+        if stack is None:
+            stack = _tls.allowed = []
+        stack.append(self.names)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.allowed.pop()
+
+
+def allowed(*names: str) -> _Allowance:
+    return _Allowance(names)
+
+
+def _is_allowed(name: str) -> bool:
+    for entry in getattr(_tls, "allowed", ()):
+        if name in entry:
+            return True
+    return False
+
+
+class Domain:
+    """Base ownership domain; subclasses implement :meth:`violation`."""
+
+    kind = "any"
+
+    def describe(self) -> str:
+        return self.kind
+
+    def violation(self) -> Optional[str]:
+        """None when the current thread satisfies the domain, else a
+        short description of the actual context."""
+        return None
+
+    def check(self, name: str, op: str) -> None:
+        san = _san.get_sanitizer()
+        if not _san.san_enabled() or san.recording() or _is_allowed(name):
+            return
+        why = self.violation()
+        if why is None:
+            return
+        code = "DS003" if self.kind == "lock" else "DS002"
+        path, line = _san.caller_site()
+        san.record(
+            code,
+            f"{name}.{op} from outside its ownership domain "
+            f"[{self.describe()}]: {why}",
+            path, line,
+        )
+
+
+class LoopDomain(Domain):
+    kind = "loop"
+
+    def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
+        self.loop = loop
+
+    def describe(self) -> str:
+        return "loop-only"
+
+    def violation(self) -> Optional[str]:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            return (
+                f"thread {threading.current_thread().name!r} has no "
+                f"running event loop"
+            )
+        if self.loop is not None and running is not self.loop:
+            return "a different event loop is running in this thread"
+        return None
+
+
+class ThreadDomain(Domain):
+    kind = "thread"
+
+    def __init__(self, thread_name: str) -> None:
+        self.thread_name = thread_name
+
+    def describe(self) -> str:
+        return f'thread("{self.thread_name}")'
+
+    def violation(self) -> Optional[str]:
+        name = threading.current_thread().name
+        # exact worker name, or an executor-pool member ("compute_0")
+        if name == self.thread_name or name.startswith(self.thread_name + "_"):
+            return None
+        return f"called from thread {name!r}"
+
+
+class LockDomain(Domain):
+    kind = "lock"
+
+    def __init__(self, lock: SanLock) -> None:
+        self.lock = lock
+
+    def describe(self) -> str:
+        return f"guarded-by({self.lock.name})"
+
+    def violation(self) -> Optional[str]:
+        if self.lock.held_by_current_thread():
+            return None
+        return (
+            f"lock {self.lock.name} not held by thread "
+            f"{threading.current_thread().name!r}"
+        )
+
+
+def loop_domain(loop: Optional[asyncio.AbstractEventLoop] = None) -> LoopDomain:
+    return LoopDomain(loop)
+
+
+def thread_domain(name: str) -> ThreadDomain:
+    return ThreadDomain(name)
+
+
+def lock_domain(lock: SanLock) -> LockDomain:
+    return LockDomain(lock)
+
+
+# ---- instrumented containers ----------------------------------------------
+
+
+def _guarded_method(base: type, mname: str):
+    orig = getattr(base, mname)
+
+    def method(self, *a, **k):
+        self._dsan_domain.check(self._dsan_name, mname)
+        return orig(self, *a, **k)
+
+    method.__name__ = mname
+    method.__qualname__ = f"Guarded{base.__name__}.{mname}"
+    return method
+
+
+_DICT_OPS = (
+    "__getitem__", "__setitem__", "__delitem__", "__contains__",
+    "__iter__", "__len__", "get", "pop", "popitem", "setdefault",
+    "update", "clear", "keys", "values", "items",
+)
+_SET_OPS = (
+    "add", "discard", "remove", "pop", "clear", "update",
+    "__contains__", "__iter__", "__len__",
+)
+_LIST_OPS = (
+    "append", "extend", "insert", "pop", "remove", "clear",
+    "__getitem__", "__setitem__", "__delitem__", "__contains__",
+    "__iter__", "__len__",
+)
+
+
+class _GuardedContainer:
+    """Mixin: slots + construction that seeds initial content under an
+    allowance (wrapping an already-populated structure is the declared
+    owner's construction step, not a domain access)."""
+
+    __slots__ = ()
+
+    def __init__(self, data, domain: Domain, name: str) -> None:
+        self._dsan_domain = domain
+        self._dsan_name = name
+        with allowed(name):
+            super().__init__(data)
+
+
+class GuardedDict(_GuardedContainer, dict):
+    __slots__ = ("_dsan_domain", "_dsan_name")
+
+
+class GuardedOrderedDict(_GuardedContainer, OrderedDict):
+    __slots__ = ("_dsan_domain", "_dsan_name")
+
+
+class GuardedSet(_GuardedContainer, set):
+    __slots__ = ("_dsan_domain", "_dsan_name")
+
+
+class GuardedList(_GuardedContainer, list):
+    __slots__ = ("_dsan_domain", "_dsan_name")
+
+
+for _op in _DICT_OPS:
+    setattr(GuardedDict, _op, _guarded_method(dict, _op))
+for _op in _DICT_OPS + ("move_to_end",):
+    setattr(GuardedOrderedDict, _op, _guarded_method(OrderedDict, _op))
+for _op in _SET_OPS:
+    setattr(GuardedSet, _op, _guarded_method(set, _op))
+for _op in _LIST_OPS:
+    setattr(GuardedList, _op, _guarded_method(list, _op))
+
+
+class GuardedProxy:
+    """Generic method-intercepting proxy for objects whose operations are
+    plain attributes (queue.Queue, asyncio.Queue).  Only the methods named
+    at wrap time are checked; everything else passes straight through."""
+
+    __slots__ = ("_dsan_obj", "_dsan_domain", "_dsan_name", "_dsan_methods")
+
+    def __init__(self, obj, domain: Domain, name: str, methods) -> None:
+        object.__setattr__(self, "_dsan_obj", obj)
+        object.__setattr__(self, "_dsan_domain", domain)
+        object.__setattr__(self, "_dsan_name", name)
+        object.__setattr__(self, "_dsan_methods", frozenset(methods))
+
+    def __getattr__(self, attr):
+        val = getattr(self._dsan_obj, attr)
+        if attr in self._dsan_methods and callable(val):
+            domain, name = self._dsan_domain, self._dsan_name
+
+            def checked(*a, _fn=val, **k):
+                domain.check(name, attr)
+                return _fn(*a, **k)
+
+            checked.__name__ = attr
+            return checked
+        return val
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<dsan guard {self._dsan_name} of {self._dsan_obj!r}>"
+
+
+# ---- construction-time factories (no-ops when dsan is off) ----------------
+
+
+def san_lock(name: str, lock: Optional[threading.Lock] = None):
+    """Wrap (or mint) a lock as a :class:`SanLock` when dsan is active;
+    otherwise return the plain lock unchanged."""
+    if not _san.san_enabled():
+        return lock if lock is not None else threading.Lock()
+    return SanLock(name, lock)
+
+
+def guard_dict(data: dict, domain: Domain, name: str):
+    if not _san.san_enabled() or not isinstance(domain, Domain):
+        return data
+    return GuardedDict(data, domain, name)
+
+
+def guard_ordered_dict(data, domain: Domain, name: str):
+    if not _san.san_enabled() or not isinstance(domain, Domain):
+        return data
+    return GuardedOrderedDict(data, domain, name)
+
+
+def guard_set(data: set, domain: Domain, name: str):
+    if not _san.san_enabled() or not isinstance(domain, Domain):
+        return data
+    return GuardedSet(data, domain, name)
+
+
+def guard_list(data: list, domain: Domain, name: str):
+    if not _san.san_enabled() or not isinstance(domain, Domain):
+        return data
+    return GuardedList(data, domain, name)
+
+
+def guard_methods(obj, domain: Domain, name: str, methods):
+    if not _san.san_enabled() or not isinstance(domain, Domain):
+        return obj
+    return GuardedProxy(obj, domain, name, methods)
+
+
+def maybe_lock_domain(lock) -> Optional[LockDomain]:
+    """lock_domain over an attribute that is only a SanLock when dsan was
+    active at construction — callers pass whatever they hold and get None
+    (=> guards become no-ops) for a plain lock."""
+    return LockDomain(lock) if isinstance(lock, SanLock) else None
+
+
+def check_access(name: str, domain: Optional[Domain], op: str = "access") -> None:
+    """Explicit check for boundaries a container proxy cannot cover (a
+    scalar attribute write like ``ShardRuntime.epoch``)."""
+    if domain is not None:
+        domain.check(name, op)

@@ -1,0 +1,205 @@
+"""dsan core: the enabled gate, the process-global finding sink, and the
+report plumbing that merges runtime findings into the dnetlint record.
+
+The sanitizer reuses the PR 8 static-analysis :class:`Finding` model
+(path, line, col, code, message, severity) with runtime ``DS00x`` codes
+(catalog in :mod:`dnet_tpu.analysis.runtime.domains`), so runtime and
+static findings sort, render, and serialize identically and land in the
+same ``ANALYSIS_r<NN>.json`` records.
+
+Gating contract: every hook in this package is constructed/installed only
+when :func:`san_enabled` is true at that moment (``DNET_SAN=1``, read via
+``config.env_flag`` so post-cache flips in tests work), and every check
+path ALSO early-returns when the flag is off — a wrapper that outlives a
+test's enable window goes quiet instead of misfiring.  With ``DNET_SAN``
+unset nothing is wrapped at all: guards return their argument unchanged,
+``san_lock`` returns the plain lock, and the serving path runs the exact
+objects it runs today (asserted by the no-op test in
+tests/subsystems/test_dsan.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from dnet_tpu.analysis.core import SEVERITY_ERROR, Finding
+from dnet_tpu.analysis.runtime.domains import RUNTIME_CHECK_CODES, RUNTIME_CHECKS
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: default persist target for :func:`persist_findings` (repo root);
+#: ``DNET_SAN_REPORT`` (SanSettings) overrides.
+DEFAULT_REPORT_NAME = ".dsan-findings.json"
+
+
+def san_enabled() -> bool:
+    """The one dsan gate: ``DNET_SAN=1`` in the process environment.  Read
+    through ``config.env_flag`` (the sanctioned DL006 escape hatch) so a
+    test that flips the env after the settings cache warmed still gates."""
+    from dnet_tpu.config import env_flag
+
+    return env_flag("DNET_SAN")
+
+
+def caller_site(skip_prefixes: Tuple[str, ...] = ()) -> Tuple[str, int]:
+    """(repo-relative path, line) of the innermost caller frame OUTSIDE
+    this package — the instrumentation site a finding attributes to."""
+    import sys
+
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR) and not any(
+            fn.startswith(p) for p in skip_prefixes
+        ):
+            return _relpath(fn), f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+def _relpath(filename: str) -> str:
+    try:
+        return Path(filename).resolve().relative_to(_REPO_ROOT).as_posix()
+    except ValueError:
+        return filename
+
+
+class Sanitizer:
+    """Thread-safe finding sink shared by every dsan detector.
+
+    Findings dedupe on (code, path, line, message) — a hot loop that
+    violates its domain ten thousand times per second produces ONE
+    finding — and each recorded finding increments
+    ``dnet_san_findings_total{check=<code>}``.  A thread-local
+    re-entrancy latch suppresses checks fired BY the recording itself
+    (counting a finding touches the instrumented metrics registry)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._findings: List[Finding] = []
+        self._seen: set = set()
+        self._tls = threading.local()
+
+    # ---- recording ------------------------------------------------------
+    def recording(self) -> bool:
+        return getattr(self._tls, "busy", False)
+
+    def record(
+        self, code: str, message: str, path: str = "", line: int = 0
+    ) -> Optional[Finding]:
+        """Record one runtime finding; returns it, or None when deduped."""
+        if code not in RUNTIME_CHECK_CODES:
+            raise ValueError(f"unknown dsan check code {code!r}")
+        if not path:
+            path, line = caller_site()
+        key = (code, path, line, message)
+        with self._lock:
+            if key in self._seen:
+                return None
+            self._seen.add(key)
+            finding = Finding(
+                path=path, line=line, col=0, code=code,
+                message=message, severity=SEVERITY_ERROR,
+            )
+            self._findings.append(finding)
+        self._tls.busy = True
+        try:
+            from dnet_tpu.obs import metric
+
+            metric("dnet_san_findings_total").labels(check=code).inc()
+        except Exception:
+            pass  # obs unavailable (bare script): the finding still counts
+        finally:
+            self._tls.busy = False
+        return finding
+
+    # ---- inspection -----------------------------------------------------
+    @property
+    def findings(self) -> List[Finding]:
+        with self._lock:
+            return sorted(self._findings)
+
+    def findings_for(self, code: str) -> List[Finding]:
+        return [f for f in self.findings if f.code == code]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._findings.clear()
+            self._seen.clear()
+
+    # ---- persistence ----------------------------------------------------
+    def persist(self, path: Path) -> None:
+        """Append-merge findings into a JSON file (sanitized runs persist;
+        ``dnetlint --json`` folds the file into the ANALYSIS record)."""
+        existing: List[dict] = []
+        if path.is_file():
+            try:
+                existing = json.loads(path.read_text()).get("findings", [])
+            except (ValueError, OSError):
+                existing = []
+        merged = {json.dumps(e, sort_keys=True) for e in existing}
+        for f in self.findings:
+            merged.add(json.dumps(f.to_json(), sort_keys=True))
+        path.write_text(json.dumps(
+            {"tool": "dsan",
+             "findings": [json.loads(m) for m in sorted(merged)]},
+            indent=2, sort_keys=True,
+        ) + "\n")
+
+
+_sanitizer = Sanitizer()
+
+
+def default_report_path() -> Path:
+    """Where a sanitized run persists findings: ``DNET_SAN_REPORT`` when
+    set, else the repo root — the same place ``runtime_section``/dnetlint
+    merge from, so findings survive a server started from any cwd."""
+    from dnet_tpu.config import get_settings
+
+    configured = get_settings().san.san_report
+    return Path(configured) if configured else _REPO_ROOT / DEFAULT_REPORT_NAME
+
+
+def get_sanitizer() -> Sanitizer:
+    return _sanitizer
+
+
+def reset_sanitizer() -> None:
+    """Drop findings and dedup state (tests).  The sink object itself is
+    stable so detector handles never go stale — mirrors reset_obs()."""
+    _sanitizer.clear()
+
+
+def runtime_section(root: Path, report_path: Optional[Path] = None) -> dict:
+    """The ``runtime`` section of an ANALYSIS record: the DS check catalog
+    plus any findings a sanitized run persisted (empty when none ran —
+    the section is always present so dashboards can rely on its shape)."""
+    src: Optional[Path] = report_path
+    if src is None:
+        from dnet_tpu.config import get_settings
+
+        configured = get_settings().san.san_report
+        src = Path(configured) if configured else root / DEFAULT_REPORT_NAME
+    findings: List[dict] = []
+    source = None
+    if src.is_file():
+        try:
+            findings = json.loads(src.read_text()).get("findings", [])
+            source = str(src)
+        except (ValueError, OSError):
+            findings = []
+    return {
+        "tool": "dsan",
+        "enabled_env": "DNET_SAN",
+        "checks": [
+            {"code": c, "name": n, "description": d}
+            for c, n, d in RUNTIME_CHECKS
+        ],
+        "findings": findings,
+        "source": source,
+    }

@@ -1,0 +1,60 @@
+"""dsan serving-lifetime wiring: one handle per server process.
+
+``install(loop)`` arms the loop-stall watchdog and the task auditor over
+the whole serving lifetime of an ``serve_async`` entry point (api and
+shard servers both call it); ``teardown()`` at shutdown runs the task
+and lock-order audits, logs every finding, and persists them where the
+next ``dnetlint --json`` run merges them into the ANALYSIS record.  With
+``DNET_SAN`` unset ``install`` returns None and the servers skip the
+teardown — zero cost, nothing constructed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from dnet_tpu.analysis.runtime import loop_monitor, tasks as san_tasks
+from dnet_tpu.analysis.runtime.lockorder import audit_lock_order
+from dnet_tpu.analysis.runtime.sanitizer import (
+    default_report_path,
+    get_sanitizer,
+    san_enabled,
+)
+
+
+class ServingSanitizer:
+    """The armed per-server handle: watchdog + task auditor + teardown."""
+
+    def __init__(self, monitor, auditor) -> None:
+        self.monitor = monitor
+        self.auditor = auditor
+
+    def teardown(self, log) -> int:
+        """Stop the detectors, run the teardown audits, log + persist the
+        findings; returns how many findings the window recorded."""
+        if self.monitor is not None:
+            self.monitor.stop()
+        if self.auditor is not None:
+            self.auditor.uninstall()
+            self.auditor.audit()
+        audit_lock_order()
+        san = get_sanitizer()
+        findings = san.findings
+        for f in findings:
+            log.error("dsan: %s", f.render())
+        report = default_report_path()
+        san.persist(report)
+        log.info(
+            "dsan: %d finding(s) persisted to %s (merged into the next "
+            "`dnetlint --json` report)", len(findings), report,
+        )
+        return len(findings)
+
+
+def install(loop: asyncio.AbstractEventLoop) -> Optional[ServingSanitizer]:
+    """Arm the serving-lifetime detectors when dsan is active; returns
+    None — a no-op — otherwise."""
+    if not san_enabled():
+        return None
+    return ServingSanitizer(loop_monitor.install(loop), san_tasks.install(loop))

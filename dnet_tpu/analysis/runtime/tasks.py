@@ -1,0 +1,145 @@
+"""dsan task audit (DS005/DS006): leaked tasks and swallowed exceptions.
+
+A task-factory hook records every task created on the instrumented loop
+together with its creation site (the first caller frame outside asyncio
+and outside this package).  At teardown :func:`TaskAuditor.audit` walks
+the records:
+
+- a task still PENDING is a leak (DS005): nobody awaited or cancelled
+  it, so it dies un-run when the loop closes — the runtime twin of the
+  static DL003 dropped-coroutine check;
+- a task that finished with an exception nobody retrieved (DS006):
+  CPython only surfaces these as a "Task exception was never retrieved"
+  log line at GC time, often long after the cause — the audit surfaces
+  them deterministically at teardown (and retrieves the exception so the
+  GC-time spam does not double-report).
+
+Tasks are held by weakref: the auditor must not keep alive what the
+program dropped — a task the GC already collected while pending was
+ALSO leaked, but CPython's own "Task was destroyed but it is pending!"
+warning covers that window.  Records of tasks that finish CLEANLY are
+pruned one tick after completion (once any awaiter has had its chance to
+retrieve), so a serving-lifetime install stays bounded by the number of
+in-flight + failed tasks, not by total tasks ever created.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from dnet_tpu.analysis.runtime import sanitizer as _san
+
+_ASYNCIO_DIR = sys.modules["asyncio"].__path__[0]
+
+
+def _creation_site() -> Tuple[str, int]:
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_ASYNCIO_DIR) and not fn.startswith(_san._PKG_DIR):
+            return _san._relpath(fn), f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+class TaskAuditor:
+    """Task-factory hook + teardown audit for ONE loop."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop) -> None:
+        self.loop = loop
+        self._prev_factory = None
+        self._installed = False
+        #: id(task) -> (weakref-to-task, name, (path, line)); cleanly
+        #: finished tasks are pruned by :meth:`_settle`
+        self._records: Dict[int, tuple] = {}
+        #: STRONG refs to tasks that finished with an exception: the
+        #: program dropped them, so without this pin the GC collects them
+        #: (logging "never retrieved" asynchronously) before the audit
+        #: can attribute the failure.  Only failures are pinned.
+        self._failed: List[asyncio.Task] = []
+
+    def _on_done(self, task: asyncio.Task) -> None:
+        # settle one tick later: the awaiter (if any) was queued as a done
+        # callback before this one ran, so by the next call_soon round it
+        # has retrieved the exception — only genuinely-unretrieved
+        # failures get pinned, and clean finishes get pruned
+        try:
+            self.loop.call_soon(self._settle, task)
+        except RuntimeError:  # loop already closing: audit() re-checks
+            pass
+
+    def _settle(self, task: asyncio.Task) -> None:
+        # _log_traceback is True from exception-set until retrieval; an
+        # awaiter that retrieves even later still clears it, and audit()
+        # re-checks before reporting
+        if getattr(task, "_log_traceback", False):
+            self._failed.append(task)
+            return
+        self._records.pop(id(task), None)
+
+    def _factory(self, loop, coro, **kwargs):
+        if self._prev_factory is not None:
+            task = self._prev_factory(loop, coro, **kwargs)
+        else:
+            task = asyncio.Task(coro, loop=loop, **kwargs)
+        site = _creation_site()
+        name = getattr(coro, "__qualname__", None) or repr(coro)
+        self._records[id(task)] = (weakref.ref(task), name, site)
+        task.add_done_callback(self._on_done)
+        return task
+
+    def install(self) -> "TaskAuditor":
+        self._prev_factory = self.loop.get_task_factory()
+        self.loop.set_task_factory(self._factory)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            self.loop.set_task_factory(self._prev_factory)
+            self._installed = False
+
+    def audit(self) -> int:
+        """Record findings for leaks and unretrieved exceptions; returns
+        the number of findings recorded."""
+        san = _san.get_sanitizer()
+        n = 0
+        for ref, name, (path, line) in list(self._records.values()):
+            task = ref()
+            if task is None:
+                continue
+            if not task.done():
+                if getattr(task, "_must_cancel", False):
+                    continue  # cancellation requested, loop closed first
+                san.record(
+                    "DS005",
+                    f"task {name} created here is still pending at the "
+                    f"teardown audit (never awaited or cancelled): it "
+                    f"dies un-run when the loop closes",
+                    path, line,
+                )
+                n += 1
+                continue
+            if task.cancelled():
+                continue
+            if getattr(task, "_log_traceback", False):
+                exc = task.exception()  # retrieve: silence the GC-time log
+                san.record(
+                    "DS006",
+                    f"task {name} created here finished with an exception "
+                    f"nobody retrieved: {type(exc).__name__}: {exc}",
+                    path, line,
+                )
+                n += 1
+        return n
+
+
+def install(loop: asyncio.AbstractEventLoop) -> Optional[TaskAuditor]:
+    """Install a task auditor on ``loop`` when dsan is active; returns
+    None — a no-op — otherwise."""
+    if not _san.san_enabled():
+        return None
+    return TaskAuditor(loop).install()

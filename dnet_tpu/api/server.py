@@ -20,6 +20,12 @@ log = get_logger()
 
 async def serve_async(args) -> None:
     s = get_settings()
+    # runtime sanitizer (DNET_SAN=1): loop-stall watchdog + task audit
+    # over the whole serving lifetime; install() is a no-op (None) when
+    # dsan is off
+    from dnet_tpu.analysis.runtime import serving as dsan_serving
+
+    san = dsan_serving.install(asyncio.get_running_loop())
     wq = getattr(args, "weight_quant_bits", None)
     weight_quant_bits = s.api.weight_quant_bits if wq is None else wq
     batch_slots = getattr(args, "batch_slots", None) or s.api.batch_slots
@@ -227,6 +233,8 @@ async def serve_async(args) -> None:
         await grpc_server.stop(grace=2)
     if inference.adapter is not None:
         await inference.adapter.shutdown()
+    if san is not None:
+        san.teardown(log)
 
 
 

@@ -16,10 +16,10 @@ from __future__ import annotations
 
 import abc
 import asyncio
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+from dnet_tpu.analysis.runtime import ownership as dsan
 from dnet_tpu.core.types import DecodingParams, TokenResult
 from dnet_tpu.utils.logger import get_logger
 
@@ -37,6 +37,30 @@ async def _embed_on_executor(hidden_fn, executor, ids_list):
     return out
 
 log = get_logger()
+
+# bound on awaiting a cancelled background task at shutdown: a step wedged
+# in run_in_executor defers cancellation until the executor job completes,
+# which for a wedged device dispatch is never — shutdown must not hang on it
+_REAP_TIMEOUT_S = 5.0
+
+
+async def _reap(task: Optional["asyncio.Task"], what: str) -> None:
+    """Cancel-and-await a background task, bounded: the dropped-cancellation
+    fix (the runtime twin of DL003) without trading it for an unbounded
+    shutdown hang.  On timeout the task is abandoned with a warning — the
+    same contract as a compute thread that fails to join."""
+    if not task:
+        return
+    task.cancel()
+    try:
+        await asyncio.wait_for(task, timeout=_REAP_TIMEOUT_S)
+    except (asyncio.CancelledError, asyncio.TimeoutError):
+        pass
+    if not task.done():
+        log.warning(
+            "%s ignored cancellation for %.0fs at shutdown; abandoning it "
+            "(likely wedged in an executor step)", what, _REAP_TIMEOUT_S,
+        )
 
 
 class ApiAdapterBase(abc.ABC):
@@ -209,12 +233,14 @@ class BatchedLocalAdapter(ApiAdapterBase):
                 log.exception("session sweep failed")
 
     async def shutdown(self) -> None:
-        if self._task:
-            self._task.cancel()
-            self._task = None
-        if getattr(self, "_sweep_task", None):
-            self._sweep_task.cancel()
-            self._sweep_task = None
+        # cancel AND await (bounded): a dropped cancellation leaves the
+        # task to die unobserved at loop close — and a sweep mid-
+        # run_in_executor would keep touching the engine after the
+        # executor below is gone
+        task, self._task = self._task, None
+        await _reap(task, "batch loop")
+        sweep, self._sweep_task = getattr(self, "_sweep_task", None), None
+        await _reap(sweep, "session sweep")
         for t in list(self._prefill_tasks):
             t.cancel()
         self._prefill_tasks.clear()
@@ -423,10 +449,17 @@ class LocalAdapter(ApiAdapterBase):
         self._futures = _TokenFutures()
         self._executor: Optional[ThreadPoolExecutor] = None
         # nonce -> {step: TokenResult}; guarded by _buf_lock (compute thread
-        # inserts, event loop consumes/clears)
-        self._buffered: Dict[str, Dict[int, TokenResult]] = {}
-        self._ramp: Dict[str, int] = {}  # nonce -> next chunk width
-        self._buf_lock = threading.Lock()
+        # inserts, event loop consumes/clears).  The guarded-by contract is
+        # declared in analysis/runtime/domains.py and enforced under
+        # DNET_SAN=1; with it unset these are the plain dicts/lock.
+        self._buf_lock = dsan.san_lock("LocalAdapter._buf_lock")
+        _buf_dom = dsan.maybe_lock_domain(self._buf_lock)
+        self._buffered: Dict[str, Dict[int, TokenResult]] = dsan.guard_dict(
+            {}, _buf_dom, "LocalAdapter._buffered"
+        )
+        self._ramp: Dict[str, int] = dsan.guard_dict(
+            {}, _buf_dom, "LocalAdapter._ramp"
+        )  # nonce -> next chunk width
 
     SWEEP_INTERVAL_S = 60.0
     # same periodic TTL sweep as the batched adapter (one implementation)
@@ -437,9 +470,11 @@ class LocalAdapter(ApiAdapterBase):
         self._sweep_task = asyncio.ensure_future(self._sweep_loop())
 
     async def shutdown(self) -> None:
-        if getattr(self, "_sweep_task", None):
-            self._sweep_task.cancel()
-            self._sweep_task = None
+        # same bounded dropped-cancellation fix as the batched adapter:
+        # await the cancelled sweep so it cannot touch the engine past
+        # executor teardown or die unobserved at loop close
+        sweep, self._sweep_task = getattr(self, "_sweep_task", None), None
+        await _reap(sweep, "session sweep")
         if self._executor:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None

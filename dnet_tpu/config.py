@@ -341,6 +341,32 @@ class MembershipSettings(_EnvGroup):
 
 
 @dataclass
+class SanSettings(_EnvGroup):
+    """Runtime concurrency sanitizer (dnet_tpu/analysis/runtime/, "dsan").
+
+    ``DNET_SAN=1`` arms the suite: the event-loop stall watchdog,
+    ownership-domain guards on the declared shared structures,
+    lock-acquisition-order tracking, and the task-leak audit.  Findings
+    (DS001-DS006) reuse the dnetlint Finding model and merge into the
+    ``ANALYSIS_r<NN>.json`` records.  Off (the default), every hook is a
+    no-op — nothing is wrapped, zero cost on the serving path.  The gate
+    is read via ``config.env_flag`` so post-cache env flips (the pytest
+    fixtures) still arm it.
+    """
+
+    env_prefix = "DNET_"
+    # master switch; also honored as a raw env flip via env_flag("DNET_SAN")
+    san: bool = False
+    # loop blocked longer than this is a DS001 stall finding
+    san_stall_ms: float = 250.0
+    # watchdog sampling cadence; 0 = stall_ms / 4
+    san_poll_ms: float = 0.0
+    # where sanitized runs persist findings for the dnetlint merge;
+    # "" = <repo>/.dsan-findings.json
+    san_report: str = ""
+
+
+@dataclass
 class ChaosSettings(_EnvGroup):
     """Deterministic fault injection (dnet_tpu/resilience/chaos.py).
 
@@ -486,6 +512,7 @@ class Settings:
     admission: AdmissionSettings = field(default_factory=AdmissionSettings.from_env)
     loadgen: LoadgenSettings = field(default_factory=LoadgenSettings.from_env)
     membership: MembershipSettings = field(default_factory=MembershipSettings.from_env)
+    san: SanSettings = field(default_factory=SanSettings.from_env)
     chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
     grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
     api: ApiSettings = field(default_factory=ApiSettings.from_env)
@@ -504,6 +531,7 @@ for _cls in (
     AdmissionSettings,
     LoadgenSettings,
     MembershipSettings,
+    SanSettings,
     ChaosSettings,
     GrpcSettings,
     ApiSettings,

@@ -30,6 +30,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 
+from dnet_tpu.analysis.runtime import ownership as dsan
 from dnet_tpu.obs import metric
 
 # one labeled family set covers both halves of ring prefix caching: the
@@ -67,8 +68,16 @@ class PrefixIndex:
         # called with each evicted VALUE after the lock drops (the paged
         # prefix cache releases its block references here)
         self.on_evict = on_evict
-        self._lock = threading.Lock()
-        self._entries: "OrderedDict[Tuple[int, ...], object]" = OrderedDict()
+        # every _entries touch happens under _lock (declared in
+        # analysis/runtime/domains.py, enforced under DNET_SAN=1)
+        self._lock = dsan.san_lock("PrefixIndex._lock")
+        self._entries: "OrderedDict[Tuple[int, ...], object]" = (
+            dsan.guard_ordered_dict(
+                OrderedDict(),
+                dsan.maybe_lock_domain(self._lock),
+                "PrefixIndex._entries",
+            )
+        )
 
     def _match(self, ids: Tuple[int, ...], max_len: int):
         """Longest entry of length <= max_len that prefixes `ids` (caller

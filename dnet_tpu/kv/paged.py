@@ -29,10 +29,10 @@ scripts/check_metrics_names.py):
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from dnet_tpu.analysis.runtime import ownership as dsan
 from dnet_tpu.obs import metric
 
 _USED = metric("dnet_kv_blocks_used")
@@ -120,9 +120,15 @@ class BlockPool:
         self.cfg = cfg
         self.block_tokens = cfg.block_tokens
         self.total = cfg.pool_blocks
-        self._lock = threading.Lock()
-        self._free: List[int] = list(range(self.total))
-        self._ref: Dict[int, int] = {}
+        # every _free/_ref touch happens under _lock; the guarded-by
+        # contract is declared in analysis/runtime/domains.py and enforced
+        # under DNET_SAN=1 (plain containers otherwise)
+        self._lock = dsan.san_lock("BlockPool._lock")
+        _dom = dsan.maybe_lock_domain(self._lock)
+        self._free: List[int] = dsan.guard_list(
+            list(range(self.total)), _dom, "BlockPool._free"
+        )
+        self._ref: Dict[int, int] = dsan.guard_dict({}, _dom, "BlockPool._ref")
         # high-water mark of used blocks (tests/bench read it; the gauge
         # only shows the current value)
         self.peak_used = 0

@@ -346,6 +346,30 @@ def _register_core(reg: MetricsRegistry) -> None:
     )
     for kind in DEVICE_MEM_KINDS:
         mem.labels(kind=kind)  # pre-touch: expose at 0 from the start
+    # runtime concurrency sanitizer (dnet_tpu/analysis/runtime/, DNET_SAN=1).
+    # Check-code / thread label sets are DECLARED in
+    # analysis/runtime/domains.py (a leaf module) and cross-checked both
+    # ways by the metrics lint (pass 9).
+    from dnet_tpu.analysis.runtime.domains import (
+        RUNTIME_CHECK_CODES,
+        ZOMBIE_THREAD_KINDS,
+    )
+
+    san_findings = reg.counter(
+        "dnet_san_findings_total",
+        "Runtime sanitizer (dsan) findings recorded, by DS check code",
+        labelnames=("check",),
+    )
+    for code in RUNTIME_CHECK_CODES:
+        san_findings.labels(check=code)  # pre-touch: the lint checks these
+    zombies = reg.counter(
+        "dnet_san_zombie_threads_total",
+        "Worker threads that failed to join at stop() and were leaked as "
+        "daemons (a wedged worker must be visible, not silent)",
+        labelnames=("thread",),
+    )
+    for kind in ZOMBIE_THREAD_KINDS:
+        zombies.labels(thread=kind)  # pre-touch: the lint checks these
 
 
 def _ensure_core() -> None:

@@ -376,5 +376,40 @@ class MetricsRegistry:
         for fam in fams:
             fam.reset()
 
+    # ---- dsan (dnet_tpu/analysis/runtime/) -----------------------------
+    # The registry is a process-global built at import — before any test
+    # can flip DNET_SAN — so its ownership guards are applied IN PLACE by
+    # the sanitized fixtures rather than at construction.  Contract as
+    # declared in analysis/runtime/domains.py: every _metrics touch under
+    # _lock.
+    def instrument_dsan(self) -> bool:
+        """Swap in the dsan lock + guarded family map; False (no-op) when
+        dsan is off or already instrumented."""
+        from dnet_tpu.analysis.runtime import ownership as dsan
+
+        if isinstance(self._lock, dsan.SanLock):
+            return False
+        lock = dsan.san_lock("MetricsRegistry._lock", self._lock)
+        if lock is self._lock:  # dsan off: factory returned it unchanged
+            return False
+        self._lock = lock
+        self._metrics = dsan.guard_ordered_dict(
+            self._metrics,
+            dsan.maybe_lock_domain(lock),
+            "MetricsRegistry._metrics",
+        )
+        return True
+
+    def deinstrument_dsan(self) -> None:
+        """Restore the plain lock/map (fixture teardown): instrumentation
+        must never outlive the sanitized window."""
+        from dnet_tpu.analysis.runtime import ownership as dsan
+
+        if not isinstance(self._lock, dsan.SanLock):
+            return
+        with dsan.allowed("MetricsRegistry._metrics"):
+            self._metrics = OrderedDict(self._metrics.items())
+        self._lock = self._lock.inner
+
 
 CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"

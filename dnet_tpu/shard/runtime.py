@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Optional
 
+from dnet_tpu.analysis.runtime import ownership as dsan
 from dnet_tpu.core.types import ActivationMessage
 from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.resilience import chaos
@@ -24,6 +25,7 @@ log = get_logger()
 
 _OUTQ_DROPPED = metric("dnet_shard_outq_dropped_total")
 _DEADLINE_EXCEEDED = metric("dnet_deadline_exceeded_total")
+_ZOMBIES = metric("dnet_san_zombie_threads_total")
 
 
 def _error_final(
@@ -64,12 +66,22 @@ class ShardRuntime:
         # every egress message carries it so the fence holds end to end.
         # 0 = unfenced (no epoch-aware load yet).
         self.epoch: int = 0
-        self.recv_q: queue.Queue = queue.Queue(maxsize=queue_size)
+        # dsan ownership domains (analysis/runtime/domains.py): only the
+        # compute thread CONSUMES ingress; epoch writes hold _model_lock.
+        # With DNET_SAN unset every dsan.* factory returns its argument
+        # unchanged — the plain queue/lock below, zero instrumentation.
+        self._model_lock = dsan.san_lock("ShardRuntime._model_lock")
+        self._epoch_domain = dsan.maybe_lock_domain(self._model_lock)
+        self.recv_q: queue.Queue = dsan.guard_methods(
+            queue.Queue(maxsize=queue_size),
+            dsan.thread_domain("shard-compute"),
+            "ShardRuntime.recv_q",
+            methods=("get", "get_nowait"),
+        )
         self.out_q: Optional[asyncio.Queue] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._model_lock = threading.Lock()
         self._sweeper_task = None
         # awaited puts of overflow-replacement error finals (_put_out):
         # held so the tasks aren't GC'd mid-flight
@@ -78,7 +90,20 @@ class ShardRuntime:
     # ---- lifecycle ------------------------------------------------------
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         self._loop = loop
-        self.out_q = asyncio.Queue(maxsize=1024)
+        # asyncio.Queue is NOT thread-safe: loop-only by contract (the
+        # compute thread reaches it only through the _emit bridge)
+        self.out_q = dsan.guard_methods(
+            asyncio.Queue(maxsize=1024),
+            dsan.loop_domain(loop),
+            "ShardRuntime.out_q",
+            methods=("put", "put_nowait", "get", "get_nowait", "qsize",
+                     "empty", "full"),
+        )
+        self._pending_errs = dsan.guard_set(
+            set(self._pending_errs),
+            dsan.loop_domain(loop),
+            "ShardRuntime._pending_errs",
+        )
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._compute_worker, name="shard-compute", daemon=True
@@ -93,6 +118,16 @@ class ShardRuntime:
             pass
         if self._thread:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # a worker wedged in XLA dispatch cannot be killed from
+                # here; leaking it silently would hide the wedge, so make
+                # it count (alert surface) and log where we left it
+                _ZOMBIES.labels(thread="shard-compute").inc()
+                log.warning(
+                    "compute thread failed to join within 5s; leaking it "
+                    "as a daemon zombie (likely wedged in device dispatch "
+                    "or a blocking queue op)"
+                )
             self._thread = None
 
     # ---- model ----------------------------------------------------------
@@ -141,7 +176,7 @@ class ShardRuntime:
                 prefix_cache=prefix_cache,
             )
             self.model_path = str(model_dir)
-            self.set_epoch(epoch)
+            self._set_epoch_locked(epoch)
             log.info(
                 "shard %s loaded layers %s..%s (epoch %d) in %.1fs",
                 self.shard_id,
@@ -153,9 +188,18 @@ class ShardRuntime:
 
     def set_epoch(self, epoch: int) -> None:
         """Pin the topology epoch this shard serves under and publish it
-        (dnet_topology_epoch) for the federation scrape."""
+        (dnet_topology_epoch) for the federation scrape.  Takes the model
+        lock: epoch writes race model (re)loads otherwise — the delta
+        /update_topology path writes from the event loop while a full
+        reload may be pinning in an executor."""
+        with self._model_lock:
+            self._set_epoch_locked(epoch)
+
+    def _set_epoch_locked(self, epoch: int) -> None:
+        """Write half; caller holds _model_lock (load/unload already do)."""
         from dnet_tpu.membership import set_epoch_gauge
 
+        dsan.check_access("ShardRuntime.epoch", self._epoch_domain, "write")
         self.epoch = int(epoch)
         set_epoch_gauge(self.epoch)
 
@@ -166,17 +210,23 @@ class ShardRuntime:
                 self.compute.engine.close()
             self.compute = None
             self.model_path = ""
-            self.set_epoch(0)
+            self._set_epoch_locked(0)
             import gc
 
             gc.collect()
 
     def _drain_queue(self) -> None:
-        try:
-            while True:
-                self.recv_q.get_nowait()
-        except queue.Empty:
-            pass
+        # deliberate cross-thread consume (unload runs in an executor,
+        # delta reconfiguration drains from the loop): queue.Queue's own
+        # lock makes the pop benign, and the epoch fence rejects anything
+        # a racing worker might still pick up — so the thread("shard-
+        # compute") consume domain is waived here, on the record
+        with dsan.allowed("ShardRuntime.recv_q"):
+            try:
+                while True:
+                    self.recv_q.get_nowait()
+            except queue.Empty:
+                pass
 
     def drain_ingress(self) -> None:
         """Discard queued-but-unprocessed frames (delta reconfiguration:

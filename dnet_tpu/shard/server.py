@@ -6,6 +6,7 @@ Composes ShardRuntime + RingAdapter + gRPC + HTTP with ordered shutdown.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import signal
 import socket
 from typing import Optional
@@ -108,7 +109,12 @@ class Shard:
         await self.adapter.reset_topology()
         self.runtime.drain_ingress()
         compute.reset("")
-        self.runtime.set_epoch(req.epoch)
+        # pin the epoch off-loop: set_epoch takes _model_lock, and a
+        # concurrent full reload holds that lock in an executor for the
+        # whole multi-second weight read — acquiring it here on the loop
+        # thread would stall every stream on this shard for the duration
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.runtime.set_epoch, req.epoch)
         next_addr = (
             f"{req.next_node.host}:{req.next_node.grpc_port}"
             if req.next_node
@@ -128,6 +134,13 @@ class Shard:
 
 async def serve_async(args) -> None:
     s = get_settings()
+    # runtime sanitizer (DNET_SAN=1): the shard is the hottest thread/loop
+    # boundary (ShardRuntime's compute worker vs the event loop), so the
+    # stall watchdog + task audit cover its whole serving lifetime too;
+    # install() is a no-op (None) when dsan is off
+    from dnet_tpu.analysis.runtime import serving as dsan_serving
+
+    san = dsan_serving.install(asyncio.get_running_loop())
     shard_id = args.shard_name or f"shard-{socket.gethostname()}-{args.grpc_port}"
     runtime = ShardRuntime(shard_id, queue_size=args.queue_size)
     adapter = RingAdapter(
@@ -205,16 +218,25 @@ async def serve_async(args) -> None:
     await stop.wait()
 
     log.info("shard shutting down")
+    # cancel AND await the periodic tasks (the runtime twin of DL003): a
+    # dropped cancellation leaves them to die unobserved at loop close —
+    # and a DS005 finding under DNET_SAN=1
     if tui_task is not None:
         tui_task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await tui_task
     if tui is not None:
         tui.stop()
     if discovery is not None:
         discovery.stop()
     sweeper.cancel()
+    with contextlib.suppress(asyncio.CancelledError):
+        await sweeper
     await http.stop()
     await grpc_server.stop(grace=2)
     await shard.stop()
+    if san is not None:
+        san.teardown(log)
 
 
 def serve(args) -> None:

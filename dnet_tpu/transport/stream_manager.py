@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
+from dnet_tpu.analysis.runtime import ownership as dsan
 from dnet_tpu.obs import get_recorder, metric
 from dnet_tpu.resilience import chaos
 from dnet_tpu.resilience.policy import call_with_retry
@@ -53,7 +54,12 @@ class StreamManager:
         on_nack: Optional[Callable[[StreamAck], None]] = None,
     ) -> None:
         self._open_stream = open_stream  # () -> stream-stream call
-        self._streams: Dict[str, StreamContext] = {}
+        # loop-only by contract (declared in analysis/runtime/domains.py):
+        # every touch happens in a coroutine; the asyncio.Lock below only
+        # serializes coroutines, it cannot protect against a raw thread
+        self._streams: Dict[str, StreamContext] = dsan.guard_dict(
+            {}, dsan.loop_domain(), "StreamManager._streams"
+        )
         self._backoff_s = backoff_s
         self._idle_timeout_s = idle_timeout_s
         self._lock = asyncio.Lock()

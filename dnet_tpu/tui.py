@@ -21,6 +21,12 @@ from rich.panel import Panel
 from rich.table import Table
 from rich.text import Text
 
+from dnet_tpu.analysis.runtime import ownership as dsan
+from dnet_tpu.obs import metric
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
 BANNER = r"""
      _            _        _
   __| |_ __   ___| |_     | |_ _ __  _   _
@@ -58,7 +64,9 @@ class DnetTUI:
         self.resident: List[int] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()  # feed thread vs render thread
+        # feed thread vs render thread; instrumented under DNET_SAN=1 so
+        # the render/feed lock participates in lock-order tracking
+        self._lock = dsan.san_lock("DnetTUI._lock")
 
         self._handler = TuiLogHandler(self.logs)
         self._handler.setFormatter(
@@ -157,6 +165,14 @@ class DnetTUI:
                 logger.addHandler(h)
 
     def start_background(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            # a second Live render loop would fight the first for the
+            # alternate screen and double-detach the console handlers
+            raise RuntimeError(
+                "TUI render thread already running (start_background "
+                "called twice without stop())"
+            )
+        self._stop.clear()
         self._thread = threading.Thread(target=self.run, daemon=True, name="tui")
         self._thread.start()
 
@@ -164,4 +180,16 @@ class DnetTUI:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
+            if self._thread.is_alive():
+                # surface the leak instead of silently abandoning the
+                # render thread (it still owns the alternate screen and
+                # the detached console handlers)
+                metric("dnet_san_zombie_threads_total").labels(
+                    thread="tui"
+                ).inc()
+                log.warning(
+                    "TUI render thread failed to join within 2s; leaking "
+                    "it as a daemon zombie (alternate screen may stay up)"
+                )
+            self._thread = None
         logging.getLogger("dnet_tpu").removeHandler(self._handler)

@@ -66,6 +66,12 @@ def main(argv=None) -> int:
         for c in ALL_CHECKS:
             kind = "runtime" if c.requires_runtime else "ast"
             print(f"{c.code}  {c.name:28s} [{kind:7s}] {c.description}")
+        # the dsan catalog: detectors that only fire in a RUNNING process
+        # (DNET_SAN=1); their findings merge into --json's runtime section
+        from dnet_tpu.analysis.runtime import RUNTIME_CHECKS
+
+        for code, name, description in RUNTIME_CHECKS:
+            print(f"{code}  {name:28s} [dsan   ] {description}")
         return 0
 
     checks = ALL_CHECKS
@@ -104,7 +110,11 @@ def main(argv=None) -> int:
         out = (
             next_report_path(REPO) if args.json == "auto" else Path(args.json)
         )
-        write_report_json(report, out)
+        # merge the runtime-sanitizer section: DS catalog + any findings a
+        # DNET_SAN=1 run persisted (DNET_SAN_REPORT / .dsan-findings.json)
+        from dnet_tpu.analysis.runtime import runtime_section
+
+        write_report_json(report, out, extra={"runtime": runtime_section(REPO)})
         if not args.quiet:
             print(f"dnetlint: report written to {out}")
     summary = (

@@ -1,0 +1,672 @@
+"""dsan — the runtime concurrency sanitizer (dnet_tpu/analysis/runtime/).
+
+Three layers, mirroring tests/test_static_analysis.py:
+
+1. **Detector units** — for every hazard class (loop stall, wrong-thread
+   access, lock-not-held access, lock-order cycle, task leak, unretrieved
+   task exception) a deterministic FIRING fixture proves the detector
+   works and a QUIET pair proves it does not cry wolf.
+2. **Sanitized subsystem suites** — the real annotated components
+   (ShardRuntime, LocalAdapter, BlockPool, PrefixIndex, the metrics
+   registry) run their ordinary flows under ``DNET_SAN=1`` and the
+   ``dsan_clean`` fixture FAILS the test on any finding: the clean-repo
+   invariant, enforced from tier-1.
+3. **No-op contract** — with ``DNET_SAN`` unset, construction produces
+   the exact plain types (dict / list / queue.Queue / _thread.lock) and
+   the installers return None: zero instrumentation on the serving path.
+
+Plus the satellite fixes: awaited sweep-task cancellation in both local
+adapters, zombie-thread counting in ShardRuntime.stop() / DnetTUI.stop(),
+and the TUI double-start guard.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import queue
+import threading
+import time
+from collections import OrderedDict
+
+import pytest
+
+from dnet_tpu.analysis.runtime import (
+    audit_lock_order,
+    get_sanitizer,
+    lockorder,
+    loop_monitor,
+    ownership,
+    reset_lock_order,
+    reset_sanitizer,
+    tasks as san_tasks,
+)
+from dnet_tpu.analysis.runtime.lockorder import SanLock
+from dnet_tpu.analysis.runtime.loop_monitor import LoopStallMonitor
+from dnet_tpu.analysis.runtime.tasks import TaskAuditor
+from dnet_tpu.config import reset_settings_cache
+from dnet_tpu.core.types import ActivationMessage, DecodingParams, TokenResult
+from dnet_tpu.obs import get_registry, metric
+
+pytestmark = pytest.mark.core
+
+THIS_FILE = "tests/subsystems/test_dsan.py"
+
+
+def _codes(san):
+    return sorted({f.code for f in san.findings})
+
+
+def _zombie_value(kind: str) -> float:
+    return metric("dnet_san_zombie_threads_total").labels(thread=kind).value
+
+
+@pytest.fixture
+def dsan_capture(monkeypatch):
+    """Arm DNET_SAN=1 for the test and yield the cleared sanitizer;
+    findings are the test's own to assert (firing fixtures)."""
+    monkeypatch.setenv("DNET_SAN", "1")
+    reset_settings_cache()
+    reset_sanitizer()
+    reset_lock_order()
+    yield get_sanitizer()
+    get_registry().deinstrument_dsan()  # safety: never leak instrumentation
+    reset_sanitizer()
+    reset_lock_order()
+    reset_settings_cache()
+
+
+@pytest.fixture
+def dsan_clean(dsan_capture):
+    """Sanitized window that FAILS on any finding at teardown — the
+    fixture that runs the designated subsystem suites under DNET_SAN=1
+    in tier-1 (the clean-repo invariant)."""
+    yield dsan_capture
+    audit_lock_order()
+    findings = dsan_capture.findings
+    assert findings == [], "dsan findings in a clean suite:\n" + "\n".join(
+        f.render() for f in findings
+    )
+
+
+# ---- DS001 loop stall ------------------------------------------------------
+
+
+def test_stall_watchdog_fires_on_blocked_loop(dsan_capture):
+    async def go():
+        mon = LoopStallMonitor(
+            asyncio.get_running_loop(), stall_ms=60, poll_ms=15
+        )
+        mon.start()
+        try:
+            await asyncio.sleep(0.1)  # healthy warmup: beats land
+            time.sleep(0.3)  # deliberate stall ON the loop thread
+            await asyncio.sleep(0.05)  # let the sampler observe + re-arm
+        finally:
+            mon.stop()
+        return mon.stalls
+
+    stalls = asyncio.run(go())
+    assert stalls >= 1
+    hits = dsan_capture.findings_for("DS001")
+    assert hits, "stall watchdog did not fire"
+    # attributed to the blocking call site in THIS file
+    assert hits[0].path == THIS_FILE
+    assert "time.sleep" not in hits[0].message or True
+    assert "blocked" in hits[0].message
+
+
+def test_stall_watchdog_quiet_on_healthy_loop(dsan_capture):
+    async def go():
+        mon = loop_monitor.install(asyncio.get_running_loop())
+        assert mon is not None  # DNET_SAN=1: installer is armed
+        try:
+            for _ in range(10):
+                await asyncio.sleep(0.02)  # healthy: beats keep landing
+        finally:
+            mon.stop()
+
+    asyncio.run(go())
+    assert dsan_capture.findings_for("DS001") == []
+
+
+# ---- DS002 wrong-thread access --------------------------------------------
+
+
+def test_thread_domain_fires_and_quiet(dsan_capture):
+    guarded = ownership.guard_methods(
+        queue.Queue(), ownership.thread_domain("shard-compute"),
+        "T.q", methods=("get_nowait",),
+    )
+    guarded.put_nowait(1)  # put is unrestricted: quiet
+    with pytest.raises(queue.Empty):
+        # consume from MainThread: wrong domain
+        guarded.get_nowait(), guarded.get_nowait()
+    hits = dsan_capture.findings_for("DS002")
+    assert len(hits) == 1 and "T.q.get_nowait" in hits[0].message
+
+    reset_sanitizer()
+    guarded.put_nowait(2)
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(guarded.get_nowait()), name="shard-compute"
+    )
+    t.start(); t.join()
+    # executor-pool members match the declared prefix too
+    t2 = threading.Thread(
+        target=lambda: guarded.put_nowait(3), name="shard-compute_0"
+    )
+    t2.start(); t2.join()
+    assert out == [2]
+    assert dsan_capture.findings == []
+
+
+def test_loop_domain_fires_from_thread_quiet_on_loop(dsan_capture):
+    async def go():
+        pend = ownership.guard_set(
+            set(), ownership.loop_domain(asyncio.get_running_loop()), "T.pend"
+        )
+        pend.add("on-loop")  # owning loop thread: quiet
+        t = threading.Thread(target=lambda: pend.add("off-loop"), name="rogue")
+        t.start()
+        t.join()
+
+    asyncio.run(go())
+    hits = dsan_capture.findings_for("DS002")
+    assert len(hits) == 1
+    assert "T.pend.add" in hits[0].message and "rogue" in hits[0].message
+
+
+def test_allowance_waives_declared_access(dsan_capture):
+    guarded = ownership.guard_methods(
+        queue.Queue(), ownership.thread_domain("shard-compute"),
+        "T.q", methods=("get_nowait",),
+    )
+    guarded.put_nowait(1)
+    with ownership.allowed("T.q"):
+        assert guarded.get_nowait() == 1  # audited cross-thread drain
+    assert dsan_capture.findings == []
+
+
+# ---- DS003 lock-not-held access -------------------------------------------
+
+
+def test_lock_domain_fires_without_lock_quiet_with(dsan_capture):
+    lk = ownership.san_lock("T._lock")
+    assert isinstance(lk, SanLock)
+    d = ownership.guard_dict({}, ownership.lock_domain(lk), "T._d")
+    with lk:
+        d["a"] = 1  # held: quiet
+    assert dsan_capture.findings == []
+    d["b"] = 2  # not held: DS003
+    hits = dsan_capture.findings_for("DS003")
+    assert len(hits) == 1
+    assert "T._d.__setitem__" in hits[0].message
+    assert "T._lock not held" in hits[0].message
+
+
+def test_lock_domain_checks_ownership_not_just_lockedness(dsan_capture):
+    """The declared lock being held by SOME OTHER thread is still a
+    violation — lockedness is not ownership."""
+    lk = ownership.san_lock("T._lock")
+    d = ownership.guard_dict({}, ownership.lock_domain(lk), "T._d")
+    lk.acquire()
+    try:
+        t = threading.Thread(target=lambda: d.get("a"), name="intruder")
+        t.start(); t.join()
+    finally:
+        lk.release()
+    hits = dsan_capture.findings_for("DS003")
+    assert len(hits) == 1 and "intruder" in hits[0].message
+
+
+# ---- DS004 lock-order cycle -----------------------------------------------
+
+
+def test_lock_order_cycle_detected_across_threads(dsan_capture):
+    a, b = SanLock("T.lockA"), SanLock("T.lockB")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    # sequential threads: the GRAPH records both orders without the test
+    # ever risking the actual deadlock
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn)
+        t.start(); t.join()
+    assert audit_lock_order() == 1
+    hits = dsan_capture.findings_for("DS004")
+    assert len(hits) == 1
+    assert "T.lockA -> T.lockB -> T.lockA" in hits[0].message
+    assert THIS_FILE in hits[0].message  # acquisition sites are named
+
+
+def test_lock_order_quiet_on_consistent_order(dsan_capture):
+    a, b = SanLock("T.lockA"), SanLock("T.lockB")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    for _ in range(2):
+        t = threading.Thread(target=ab)
+        t.start(); t.join()
+    assert audit_lock_order() == 0
+    assert dsan_capture.findings == []
+
+
+def test_lock_reacquire_by_owner_fires_before_deadlocking(dsan_capture):
+    lk = SanLock("T.lock")
+    lk.acquire()
+    try:
+        assert lk.acquire(blocking=False) is False
+    finally:
+        lk.release()
+    hits = dsan_capture.findings_for("DS004")
+    assert len(hits) == 1 and "not reentrant" in hits[0].message
+
+
+# ---- DS005/DS006 task audit -----------------------------------------------
+
+
+def test_task_leak_fires_at_audit(dsan_capture):
+    async def never():
+        await asyncio.Event().wait()
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        aud = TaskAuditor(loop).install()
+        t = loop.create_task(never())
+        await asyncio.sleep(0.01)
+        aud.uninstall()
+        assert aud.audit() == 1
+        t.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await t
+
+    asyncio.run(go())
+    hits = dsan_capture.findings_for("DS005")
+    assert len(hits) == 1
+    assert hits[0].path == THIS_FILE and "never" in hits[0].message
+
+
+def test_unretrieved_exception_fires_at_audit(dsan_capture):
+    async def boom():
+        raise ValueError("kaboom")
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        aud = TaskAuditor(loop).install()
+        loop.create_task(boom())
+        await asyncio.sleep(0.01)
+        aud.uninstall()
+        assert aud.audit() == 1
+
+    asyncio.run(go())
+    hits = dsan_capture.findings_for("DS006")
+    assert len(hits) == 1
+    assert "ValueError: kaboom" in hits[0].message
+
+
+def test_task_audit_quiet_on_awaited_and_cancelled(dsan_capture):
+    async def work():
+        await asyncio.sleep(0)
+        return 1
+
+    async def never():
+        await asyncio.Event().wait()
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        aud = TaskAuditor(loop).install()
+        assert await loop.create_task(work()) == 1
+        t = loop.create_task(never())
+        await asyncio.sleep(0)
+        t.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await t
+        aud.uninstall()
+        assert aud.audit() == 0
+
+    asyncio.run(go())
+    assert dsan_capture.findings == []
+
+
+# ---- sanitized subsystem suites (clean-repo invariant) ---------------------
+
+
+class _StubCompute:
+    """Minimal shard compute: one token final per frame."""
+
+    def process(self, msg):
+        return ActivationMessage(
+            nonce=msg.nonce, layer_id=0, seq=msg.seq, dtype="token",
+            shape=(1,), pos=msg.pos, callback_url=msg.callback_url,
+            is_final=True, token_id=7,
+        )
+
+
+def test_shard_runtime_sanitized_clean(dsan_clean):
+    """The annotated ShardRuntime flows — ingress from the loop, compute
+    on the worker, egress bridge, epoch pin, ingress drain — run with
+    ZERO findings under DNET_SAN=1."""
+    from dnet_tpu.shard.runtime import ShardRuntime
+
+    async def go():
+        rt = ShardRuntime("s0", queue_size=8)
+        rt.start(asyncio.get_running_loop())
+        assert type(rt.recv_q).__name__ == "GuardedProxy"
+        rt.compute = _StubCompute()
+        try:
+            rt.set_epoch(3)  # loop-thread write takes the model lock
+            for i in range(3):
+                assert rt.submit(ActivationMessage(
+                    nonce="req-1", layer_id=-1, seq=i, dtype="tokens",
+                    shape=(1, 1), data=b"\x01\x00\x00\x00", pos=i,
+                    callback_url="grpc://api:1", epoch=3,
+                ))
+                out = await asyncio.wait_for(rt.out_q.get(), 5.0)
+                assert out.token_id == 7 and out.epoch == 3
+            rt.drain_ingress()  # loop-side drain rides the allowance
+        finally:
+            rt.stop()
+
+    asyncio.run(go())
+
+
+class _FakeChunkEngine:
+    """LocalAdapter-shaped engine: prefill + chunked decode, no device."""
+
+    max_seq = 64
+
+    def __init__(self):
+        self.sessions = {}
+
+    def prefill_and_sample(self, nonce, ids, decoding):
+        self.sessions[nonce] = len(ids)
+        return 11
+
+    def decode_step(self, nonce, tok, decoding):
+        return 12
+
+    def decode_chunk(self, nonce, tok, decoding, width):
+        return [13] * width
+
+    def token_result(self, nonce, res, step, decoding):
+        return TokenResult(nonce=nonce, token_id=int(res), step=step)
+
+    def end_session(self, nonce):
+        self.sessions.pop(nonce, None)
+
+    def sweep_sessions(self):
+        return 0
+
+
+def test_local_adapter_sanitized_clean(dsan_clean):
+    """The annotated LocalAdapter flows — prefill, chunked decode with
+    buffered extras (_buffered/_ramp under _buf_lock from the compute
+    executor AND the loop), reset, shutdown — run with ZERO findings."""
+    from dnet_tpu.api.strategies import LocalAdapter
+
+    async def go():
+        eng = _FakeChunkEngine()
+        ad = LocalAdapter(eng, chunk_size=4)
+        assert type(ad._buffered).__name__ == "GuardedDict"
+        await ad.start()
+        try:
+            dec = DecodingParams()
+            await ad.send_tokens("r1", [1, 2, 3], dec, step=0)
+            r0 = await ad.await_token("r1", 0, timeout=5.0)
+            assert r0.token_id == 11
+            # budget>1 arms chunked decode: extras land in _buffered on
+            # the compute thread, the next step consumes them on the loop
+            await ad.send_tokens("r1", [r0.token_id], dec, step=1, budget=4)
+            r1 = await ad.await_token("r1", 1, timeout=5.0)
+            await ad.send_tokens("r1", [r1.token_id], dec, step=2, budget=3)
+            r2 = await ad.await_token("r1", 2, timeout=5.0)
+            assert (r1.token_id, r2.token_id) == (13, 13)
+            await ad.reset_cache("r1")
+        finally:
+            await ad.shutdown()
+
+    asyncio.run(go())
+
+
+def test_paged_pool_and_prefix_sanitized_clean(dsan_clean):
+    """BlockPool + PrefixIndex + the instrumented metrics registry run
+    their ordinary flows with ZERO findings: every declared guarded-by
+    contract actually holds in the shipped code."""
+    from dnet_tpu.core.prefix_cache import PrefixIndex
+    from dnet_tpu.kv import BlockPool, PagedKVConfig, PageTable
+
+    reg = get_registry()
+    assert reg.instrument_dsan() is True
+    try:
+        pool = BlockPool(PagedKVConfig(block_tokens=8, pool_blocks=16))
+        t = PageTable()
+        pool.ensure(t, 40)
+        entry = pool.alloc(2)
+        t.blocks.extend(pool.share(entry))
+        t.blocks[-1] = pool.cow(t.blocks[-1])
+        pool.release_table(t)
+        pool.free_blocks(entry)
+        assert pool.used == 0 and pool.free == pool.total
+
+        idx = PrefixIndex(capacity=2, min_tokens=2)
+        idx.put((1, 2, 3), "v1")
+        assert idx.lookup((1, 2, 3, 4)) == (3, "v1")
+        idx.put((5, 6, 7), "v2")
+        idx.put((8, 9, 10), "v3")  # evicts LRU
+        idx.clear()
+
+        metric("dnet_requests_total").inc()
+        assert "dnet_requests_total" in reg.expose()
+    finally:
+        reg.deinstrument_dsan()
+    assert type(reg._metrics) is OrderedDict
+
+
+# ---- no-op contract (DNET_SAN unset) ---------------------------------------
+
+
+def test_instrumentation_is_noop_when_disabled(monkeypatch):
+    """With DNET_SAN unset the serving path runs the EXACT plain types —
+    no proxy, no wrapper, no check calls (the overhead assertion)."""
+    monkeypatch.delenv("DNET_SAN", raising=False)
+    from dnet_tpu.api.strategies import LocalAdapter
+    from dnet_tpu.kv import BlockPool, PagedKVConfig
+    from dnet_tpu.shard.runtime import ShardRuntime
+
+    rt = ShardRuntime("s0")
+    assert type(rt.recv_q) is queue.Queue
+    assert type(rt._model_lock) is type(threading.Lock())
+
+    ad = LocalAdapter(_FakeChunkEngine())
+    assert type(ad._buffered) is dict and type(ad._ramp) is dict
+    assert type(ad._buf_lock) is type(threading.Lock())
+
+    pool = BlockPool(PagedKVConfig(block_tokens=8, pool_blocks=4))
+    assert type(pool._free) is list and type(pool._ref) is dict
+
+    obj = {"k": 1}
+    assert ownership.guard_dict(obj, ownership.loop_domain(), "x") is obj
+    assert get_registry().instrument_dsan() is False
+
+    calls = []
+    monkeypatch.setattr(
+        ownership.Domain, "check",
+        lambda self, name, op: calls.append((name, op)),
+    )
+    # drive a hot-path flow: zero check invocations because nothing wraps
+    pool.alloc(2)
+    rt.submit(ActivationMessage(
+        nonce="n", layer_id=-1, seq=0, dtype="tokens", shape=(1, 1),
+        data=b"", pos=0,
+    ))
+    assert calls == []
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        assert loop_monitor.install(loop) is None
+        assert san_tasks.install(loop) is None
+
+    asyncio.run(go())
+
+
+# ---- satellites ------------------------------------------------------------
+
+
+def test_shutdown_awaits_cancelled_sweep_tasks(dsan_capture):
+    """The dropped-cancellation satellite: both adapters' shutdown()
+    awaits the cancelled sweep/batch tasks, so the task audit stays
+    clean — before the fix the cancelled-but-never-awaited task was
+    still pending at audit (a DS005 leak)."""
+    from dnet_tpu.api.strategies import BatchedLocalAdapter, LocalAdapter
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        aud = TaskAuditor(loop).install()
+        local = LocalAdapter(_FakeChunkEngine())
+        batched = BatchedLocalAdapter(_FakeChunkEngine())
+        await local.start()
+        await batched.start()
+        sweeps = [local._sweep_task, batched._sweep_task, batched._task]
+        await local.shutdown()
+        await batched.shutdown()
+        assert all(t.done() for t in sweeps)
+        assert local._sweep_task is None and batched._sweep_task is None
+        aud.uninstall()
+        assert aud.audit() == 0
+
+    asyncio.run(go())
+    assert dsan_capture.findings == []
+
+
+class _ZombieThread:
+    name = "zombie"
+
+    def join(self, timeout=None):
+        pass
+
+    def is_alive(self):
+        return True
+
+
+def test_shard_stop_counts_zombie_compute_thread():
+    from dnet_tpu.shard.runtime import ShardRuntime
+
+    rt = ShardRuntime("s0")
+    rt._thread = _ZombieThread()
+    before = _zombie_value("shard-compute")
+    rt.stop()
+    assert rt._thread is None
+    assert _zombie_value("shard-compute") == before + 1
+
+
+def test_tui_double_start_guard_and_zombie_count():
+    from dnet_tpu.tui import DnetTUI
+
+    tui = DnetTUI(role="api")
+    try:
+        tui._thread = _ZombieThread()
+        with pytest.raises(RuntimeError, match="already running"):
+            tui.start_background()
+        before = _zombie_value("tui")
+        tui.stop()
+        assert tui._thread is None
+        assert _zombie_value("tui") == before + 1
+    finally:
+        import logging
+
+        logging.getLogger("dnet_tpu").removeHandler(tui._handler)
+
+
+def test_task_records_pruned_after_clean_finish(dsan_capture):
+    """A serving-lifetime install must stay bounded: records of cleanly
+    finished tasks are pruned one tick after completion, not held until
+    teardown."""
+    async def go():
+        loop = asyncio.get_running_loop()
+        aud = TaskAuditor(loop).install()
+        for _ in range(5):
+            await loop.create_task(asyncio.sleep(0))
+        await asyncio.sleep(0)  # one tick: the settle callbacks run
+        aud.uninstall()
+        assert aud._records == {} and aud._failed == []
+        assert aud.audit() == 0
+
+    asyncio.run(go())
+    assert dsan_capture.findings == []
+
+
+def test_serving_sanitizer_install_and_teardown(dsan_capture, tmp_path, monkeypatch):
+    """The per-server handle both servers use: armed under DNET_SAN=1, it
+    runs the teardown audits and persists; with the flag unset install()
+    returns None (the servers skip the whole block)."""
+    import logging
+
+    from dnet_tpu.analysis.runtime import serving
+
+    report = tmp_path / "server-findings.json"
+    monkeypatch.setenv("DNET_SAN_REPORT", str(report))
+    reset_settings_cache()
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        san = serving.install(loop)
+        assert san is not None
+        assert san.monitor is not None and san.auditor is not None
+        loop.create_task(asyncio.Event().wait())  # leak: DS005 at teardown
+        await asyncio.sleep(0.01)
+        assert san.teardown(logging.getLogger("test-dsan")) == 1
+
+    asyncio.run(go())
+    assert dsan_capture.findings_for("DS005") != []
+    assert report.is_file()  # findings persisted for the dnetlint merge
+
+    monkeypatch.delenv("DNET_SAN", raising=False)
+    reset_settings_cache()
+
+    async def off():
+        assert serving.install(asyncio.get_running_loop()) is None
+
+    asyncio.run(off())
+
+
+# ---- report plumbing -------------------------------------------------------
+
+
+def test_persist_and_runtime_section_round_trip(dsan_capture, tmp_path):
+    dsan_capture.record("DS002", "fixture finding", path=THIS_FILE, line=1)
+    out = tmp_path / "dsan.json"
+    dsan_capture.persist(out)
+    dsan_capture.persist(out)  # append-merge dedupes
+
+    from dnet_tpu.analysis.runtime import runtime_section
+
+    section = runtime_section(tmp_path, report_path=out)
+    assert [c["code"] for c in section["checks"]] == [
+        "DS001", "DS002", "DS003", "DS004", "DS005", "DS006",
+    ]
+    assert len(section["findings"]) == 1
+    assert section["findings"][0]["code"] == "DS002"
+    assert section["source"] == str(out)
+    # no persisted file -> same shape, empty findings
+    empty = runtime_section(tmp_path, report_path=tmp_path / "absent.json")
+    assert empty["findings"] == [] and empty["source"] is None
+
+
+def test_findings_count_into_metrics(dsan_capture):
+    fam = metric("dnet_san_findings_total")
+    before = fam.labels(check="DS002").value
+    dsan_capture.record("DS002", "counted", path=THIS_FILE, line=2)
+    dsan_capture.record("DS002", "counted", path=THIS_FILE, line=2)  # dedup
+    assert fam.labels(check="DS002").value == before + 1

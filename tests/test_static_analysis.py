@@ -3,7 +3,7 @@
 
 Three layers:
 
-1. **Per-check fixtures** — for every AST check DL001-DL008, a known-bad
+1. **Per-check fixtures** — for every AST check DL001-DL009, a known-bad
    snippet must fire with the right code and line, and a known-good
    snippet must stay quiet.  Fixtures run through the same
    ``analyze_texts`` entry the full runner uses (suppressions applied,
@@ -445,6 +445,73 @@ def test_dl008_quiet_on_stamped_frame_and_protocol_module():
     assert fs == []
 
 
+# ---- DL009 ownership-registry drift + bridge discipline -------------------
+
+_DOMAINS_REL = "dnet_tpu/analysis/runtime/domains.py"
+
+
+def test_dl009_fires_on_adhoc_thread_loop_bridge():
+    fs = findings_for(
+        "def feed(loop, q, tok):\n"
+        "    loop.call_soon_threadsafe(q.put_nowait, tok)\n"
+    )
+    assert codes(fs) == ["DL009"] and fs[0].line == 2
+    assert "sanctioned bridge modules" in fs[0].message
+
+
+def test_dl009_quiet_inside_sanctioned_bridge():
+    fs = findings_for(
+        "def feed(loop, q, tok):\n"
+        "    loop.call_soon_threadsafe(q.put_nowait, tok)\n",
+        rel="dnet_tpu/shard/runtime.py",
+    )
+    assert fs == []
+
+
+def test_dl009_registry_half_runs_only_when_registry_ships():
+    from dnet_tpu.analysis.runtime.domains import OWNERSHIP_DOMAINS
+
+    # a tree without the registry file has nothing to drift from
+    assert analyze_texts({"dnet_tpu/api/other_mod.py": "X = 1\n"}) == []
+    # with it present, every declared module must exist in the tree
+    fs = analyze_texts({_DOMAINS_REL: "# the registry ships here\n"})
+    assert codes(fs) == ["DL009"] * len(OWNERSHIP_DOMAINS)
+    assert all(f.path == _DOMAINS_REL for f in fs)
+    assert "missing module" in fs[0].message
+
+
+def test_dl009_fires_on_missing_attribute_and_lock():
+    # ShardRuntime without recv_q (declared thread-owned) and without
+    # _model_lock (declared guard of .epoch): both drift findings fire
+    fake = (
+        "class ShardRuntime:\n"
+        "    def __init__(self):\n"
+        "        self.out_q = None\n"
+        "        self.epoch = 0\n"
+        "        self._pending_errs = set()\n"
+    )
+    fs = analyze_texts({_DOMAINS_REL: "\n", "dnet_tpu/shard/runtime.py": fake})
+    mine = [f for f in fs if f.path == "dnet_tpu/shard/runtime.py"]
+    assert len(mine) == 2
+    msgs = sorted(f.message for f in mine)
+    assert "guarded-by(_model_lock)" in msgs[0]
+    assert "missing attribute ShardRuntime.recv_q" in msgs[1]
+
+
+def test_dl009_quiet_when_declarations_match():
+    fake = (
+        "class ShardRuntime:\n"
+        "    def __init__(self):\n"
+        "        self.recv_q = None\n"
+        "        self.out_q = None\n"
+        "        self.epoch = 0\n"
+        "        self._pending_errs = set()\n"
+        "        self._model_lock = None\n"
+    )
+    fs = analyze_texts({_DOMAINS_REL: "\n", "dnet_tpu/shard/runtime.py": fake})
+    assert [f for f in fs if f.path == "dnet_tpu/shard/runtime.py"] == []
+
+
 # ---- suppression syntax ---------------------------------------------------
 
 
@@ -632,13 +699,44 @@ def test_dnetlint_self_run_clean(tmp_path):
     report = json.loads(out.read_text())
     assert report["clean"] is True
     assert report["files_scanned"] > 100
-    # every shipped check ran, including the folded metric passes
-    for code in [f"DL00{i}" for i in range(1, 9)] + ["DL010", "DL017"]:
+    # every shipped check ran, including the folded metric passes and the
+    # dsan ownership-registry cross-check
+    for code in [f"DL00{i}" for i in range(1, 10)] + ["DL010", "DL017", "DL018"]:
         assert code in report["checks_run"], code
     assert report["findings"] == []
+    # the merged runtime-sanitizer section: the full DS catalog is always
+    # present (dashboards rely on the shape) and this unsanitized run
+    # contributed no findings
+    runtime = report["runtime"]
+    assert runtime["tool"] == "dsan"
+    assert runtime["enabled_env"] == "DNET_SAN"
+    assert [c["code"] for c in runtime["checks"]] == [
+        "DS001", "DS002", "DS003", "DS004", "DS005", "DS006",
+    ]
+    assert all(c["description"] for c in runtime["checks"])
+    assert isinstance(runtime["findings"], list)
     # the shipped baseline is empty (every entry would need a per-line
     # justification — the acceptance criterion)
     assert load_baseline(REPO / ".dnetlint-baseline") == {}
+
+
+def test_dnetlint_list_checks_includes_runtime_catalog():
+    """``--list-checks`` is the discoverability surface: it must name the
+    static suite (DL001..DL018, DL009 among them) AND the dsan runtime
+    catalog (DS001..DS006) so a developer sees both halves in one place."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--list-checks"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    listed = {
+        line.split()[0] for line in proc.stdout.splitlines() if line.strip()
+    }
+    for code in ["DL009", "DS001", "DS002", "DS003", "DS004", "DS005", "DS006"]:
+        assert code in listed, f"{code} missing from --list-checks"
+    # the DS rows are tagged as dsan (runtime-process) checks
+    ds_rows = [l for l in proc.stdout.splitlines() if l.startswith("DS")]
+    assert ds_rows and all("[dsan" in l for l in ds_rows)
 
 
 def test_dnetlint_detects_seeded_violation(tmp_path):
