@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout-s", type=float, default=None)
     p.add_argument("--slots", type=int, default=4,
                    help="in-process: continuous-batching slots (1 = local)")
+    p.add_argument("--sched", action="store_true",
+                   help="in-process: serve through the iteration-level "
+                   "scheduler (DNET_SCHED=1, dnet_tpu/sched/) instead of "
+                   "the legacy kick-coalescing engine path")
     p.add_argument("--max-seq", type=int, default=1024)
     p.add_argument("--param-dtype", default="bfloat16")
     p.add_argument("--out", default="", help="report path (default: next "
@@ -137,13 +141,21 @@ async def _run_inprocess(args, spec) -> dict:
     from dnet_tpu.loadgen import run_load
 
     api = get_settings().api
+    # legacy path: admission must not out-admit the engine's slot pool —
+    # excess load then queues (and sheds with Retry-After) at the admission
+    # layer instead of hard-failing against the batch-slot pool.  The
+    # scheduler path queues and preempts INTERNALLY (WAITING is a real
+    # state, admission is a function of free KV blocks), so it keeps the
+    # configured concurrency and lets the tick loop do the pacing.
+    max_concurrent = (
+        api.max_concurrent_requests
+        if args.sched
+        else min(api.max_concurrent_requests, max(args.slots, 1))
+    )
     inference = InferenceManager(
         adapter=None,
         request_timeout_s=api.request_timeout_s,
-        # admission must not out-admit the engine's slot pool: excess load
-        # then queues (and sheds with Retry-After) at the admission layer
-        # instead of hard-failing against the batch-slot pool
-        max_concurrent=min(api.max_concurrent_requests, max(args.slots, 1)),
+        max_concurrent=max_concurrent,
     )
     manager = LocalModelManager(
         inference,
@@ -167,6 +179,7 @@ async def _run_inprocess(args, spec) -> dict:
                 include_rows=not args.no_rows,
                 meta={
                     "mode": "in-process",
+                    "engine": "sched" if args.sched else "legacy",
                     "slots": args.slots,
                     "max_seq": args.max_seq,
                     "param_dtype": args.param_dtype,
@@ -217,6 +230,17 @@ def main(argv=None) -> int:
         print("error: --model is required without --base-url",
               file=sys.stderr)
         return 2
+    if args.sched:
+        if args.base_url:
+            print("error: --sched is an in-process knob; a remote target "
+                  "picks its own engine via DNET_SCHED", file=sys.stderr)
+            return 2
+        # before reset_settings_cache so SchedSettings sees it too
+        os.environ["DNET_SCHED"] = "1"
+        # --slots governs the lane count on BOTH paths (apples-to-apples:
+        # DNET_SCHED_SLOTS=0 would widen the scheduler to max(slots, 8));
+        # an explicit DNET_SCHED_SLOTS in the environment still wins
+        os.environ.setdefault("DNET_SCHED_SLOTS", str(max(args.slots, 1)))
     from dnet_tpu.config import reset_settings_cache
 
     reset_settings_cache()

@@ -26,6 +26,8 @@ Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
   declared enums, both directions
 - DL018 sanitizer     — dsan check codes / zombie-thread kinds <->
   declared enums, both directions (pass 9)
+- DL019 scheduler     — sched queue states / batch kinds / preemption
+  reasons <-> declared enums, both directions (pass 10)
 """
 
 from __future__ import annotations
@@ -158,6 +160,12 @@ _REQUIRED_FAMILIES = (
     # dashboard and the zombie-thread alert (pass 9) depend on these
     "dnet_san_findings_total",
     "dnet_san_zombie_threads_total",
+    # iteration-level scheduler (dnet_tpu/sched/) — the tick/composition
+    # dashboards and the label cross-check (pass 10) depend on these
+    "dnet_sched_tick_ms",
+    "dnet_sched_batch_tokens",
+    "dnet_sched_preemptions_total",
+    "dnet_sched_queue_depth",
 )
 
 
@@ -413,6 +421,46 @@ def check_san_labels(errors: list) -> int:
     return n
 
 
+def check_sched_labels(errors: list) -> int:
+    """Pass 10: the scheduler's labeled families must agree with the
+    declared enums (dnet_tpu/sched/kinds.py) both ways — a new queue
+    state, batch kind, or preemption reason cannot ship without its
+    series, and a renamed one cannot strand a stale label.  The
+    histogram family is checked on its exposition suffixes, like the
+    attribution pass."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.sched.kinds import BATCH_KINDS, PREEMPT_REASONS, QUEUE_STATES
+
+    text = get_registry().expose()
+    n = 0
+    for kind in BATCH_KINDS:
+        n += 1
+        if f'dnet_sched_batch_tokens_count{{kind="{kind}"}}' not in text:
+            errors.append(
+                f"sched: sched.kinds.BATCH_KINDS value {kind!r} has no "
+                f"dnet_sched_batch_tokens series (pre-touch it in "
+                f"dnet_tpu.obs._register_core)"
+            )
+    for m in re.finditer(
+        r'dnet_sched_batch_tokens(?:_bucket|_sum|_count)\{kind="([^"]+)"',
+        text,
+    ):
+        if m.group(1) not in BATCH_KINDS:
+            errors.append(
+                f"sched: exposed dnet_sched_batch_tokens kind label "
+                f"{m.group(1)!r} is not declared in sched.kinds.BATCH_KINDS"
+            )
+    n += _cross_check_labels(
+        errors, text, "dnet_sched_preemptions_total", "reason",
+        PREEMPT_REASONS, "sched.kinds.PREEMPT_REASONS",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_sched_queue_depth", "state",
+        QUEUE_STATES, "sched.kinds.QUEUE_STATES",
+    )
+    return n
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -427,6 +475,7 @@ def main() -> int:
     n_member = check_membership_labels(errors)
     n_attr = check_attribution_labels(errors)
     n_san = check_san_labels(errors)
+    n_sched = check_sched_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -435,7 +484,8 @@ def main() -> int:
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
           f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
           f"{n_member} membership labels, {n_attr} attribution labels, "
-          f"{n_san} sanitizer labels, all conform")
+          f"{n_san} sanitizer labels, {n_sched} scheduler labels, all "
+          f"conform")
     return 0
 
 
@@ -527,6 +577,13 @@ class SanLabelContract(_MetricsCheck):
     pass_name = "check_san_labels"
 
 
+class SchedLabelContract(_MetricsCheck):
+    code = "DL019"
+    name = "sched-label-contract"
+    description = "sched state/kind/reason labels <-> declared enums, both ways"
+    pass_name = "check_sched_labels"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -537,4 +594,5 @@ METRICS_CHECKS = [
     MembershipLabelContract(),
     AttributionLabelContract(),
     SanLabelContract(),
+    SchedLabelContract(),
 ]

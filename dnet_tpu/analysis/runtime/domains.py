@@ -81,6 +81,11 @@ OWNERSHIP_DOMAINS = (
     ("dnet_tpu/core/prefix_cache.py", "PrefixIndex", "_entries", "lock", "_lock"),
     ("dnet_tpu/obs/metrics.py", "MetricsRegistry", "_metrics", "lock", "_lock"),
     ("dnet_tpu/transport/stream_manager.py", "StreamManager", "_streams", "loop", ""),
+    # iteration-level scheduler (dnet_tpu/sched/): the queue and the
+    # pre-arrival deadline stash are loop-owned — the compute thread only
+    # ever sees plain snapshots inside a TickPlan
+    ("dnet_tpu/sched/queue.py", "SchedQueue", "_reqs", "loop", ""),
+    ("dnet_tpu/sched/engine.py", "SchedulerAdapter", "_deadlines", "loop", ""),
 )
 
 #: Modules sanctioned to cross the thread->loop boundary via

@@ -32,6 +32,7 @@ from dnet_tpu.api.catalog import model_catalog
 from dnet_tpu.api.inference import (
     BackpressureError,
     DeadlineExceededError,
+    EngineCapabilityError,
     InferenceError,
     InferenceManager,
     PromptTooLongError,
@@ -227,6 +228,10 @@ class ApiHTTPServer:
             return _json_error(504, str(exc), "deadline_exceeded")
         if isinstance(exc, PromptTooLongError):
             return _json_error(400, str(exc))
+        if isinstance(exc, EngineCapabilityError):
+            # the serving config asked this engine for something it cannot
+            # do — a 4xx the operator fixes, not a server fault
+            return _json_error(422, str(exc), "invalid_request_error")
         if isinstance(exc, ServiceDegradedError):
             return _json_error(503, str(exc), "service_unavailable")
         if isinstance(exc, InferenceError):
@@ -364,6 +369,11 @@ class ApiHTTPServer:
             )
         except FileNotFoundError as exc:
             return _json_error(404, str(exc), "model_not_found")
+        except EngineCapabilityError as exc:
+            # e.g. continuous batching requested over streamed weights or a
+            # model without gated KV writes (core/batch.py): the config is
+            # at fault, not the server — 422, with nothing half-loaded
+            return _json_error(422, str(exc), "invalid_request_error")
         except Exception as exc:
             log.exception("load_model failed")
             return _json_error(500, f"load failed: {exc}", "server_error")

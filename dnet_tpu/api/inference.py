@@ -74,6 +74,14 @@ class BackpressureError(InferenceError):
     the client should back off and retry, nothing is broken."""
 
 
+class EngineCapabilityError(InferenceError):
+    """The loaded engine cannot serve the requested configuration —
+    continuous batching over streamed weights, or a model without gated
+    KV writes (raised by core/batch.py at LOAD time): maps to HTTP 422,
+    an operator/config error, not the generic 500 it used to surface as
+    when a NotImplementedError crossed /v1/load_model."""
+
+
 # capacity-exhaustion signatures that cross the compute/wire boundary as
 # error STRINGS (TokenResult.error); the single choke point turning them
 # back into typed backpressure

@@ -105,6 +105,17 @@ class LocalModelManager:
             )
         t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
+        from dnet_tpu.sched import sched_enabled
+
+        # DNET_SCHED=1: the iteration-level scheduler (dnet_tpu/sched/)
+        # becomes the local serving engine — it needs the batched chunked-
+        # prefill surface, so a single-sequence load is widened to a
+        # BatchedEngine with the scheduler's slot count
+        sched_on = sched_enabled() and self.mesh is None
+        batch_slots = self.batch_slots
+        if sched_on:
+            sched_cfg = get_settings().sched
+            batch_slots = sched_cfg.sched_slots or max(self.batch_slots, 8)
 
         def _build():
             from dnet_tpu.core.kvcache import resolve_kv_bits
@@ -211,14 +222,14 @@ class LocalModelManager:
                 # mid-stream on the first request's ramp
                 if get_settings().api.warm_on_load:
                     engine.warm_chunks()
-            elif self.batch_slots > 1:
+            elif batch_slots > 1:
                 from dnet_tpu.core.batch import BatchedEngine
 
                 # per-lane acceptance (r4): greedy lanes speculate and
                 # advance unevenly; sampled lanes take the plain batched step
                 engine = BatchedEngine(
                     model_dir,
-                    slots=self.batch_slots,
+                    slots=batch_slots,
                     max_seq=max_seq or self.max_seq,
                     param_dtype=self.param_dtype,
                     kv_dtype=kv_dtype,
@@ -272,11 +283,24 @@ class LocalModelManager:
         from dnet_tpu.core.batch import BatchedEngine
         from dnet_tpu.parallel.pipelined import PipelinedMeshEngine
 
-        adapter = (
-            BatchedLocalAdapter(engine)
-            if isinstance(engine, (BatchedEngine, PipelinedMeshEngine))
-            else LocalAdapter(engine)
-        )
+        adapter = None
+        if sched_enabled():
+            if isinstance(engine, BatchedEngine):
+                from dnet_tpu.sched import SchedulerAdapter
+
+                adapter = SchedulerAdapter(engine)
+            else:
+                log.warning(
+                    "DNET_SCHED=1: %s lacks the chunked-prefill batched "
+                    "surface; serving the legacy adapter",
+                    type(engine).__name__,
+                )
+        if adapter is None:
+            adapter = (
+                BatchedLocalAdapter(engine)
+                if isinstance(engine, (BatchedEngine, PipelinedMeshEngine))
+                else LocalAdapter(engine)
+            )
         await adapter.start()
         self.inference.adapter = adapter
         self.inference.tokenizer = tokenizer

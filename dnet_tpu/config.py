@@ -341,6 +341,33 @@ class MembershipSettings(_EnvGroup):
 
 
 @dataclass
+class SchedSettings(_EnvGroup):
+    """Iteration-level continuous-batching scheduler (dnet_tpu/sched/).
+
+    ``DNET_SCHED=1`` makes the scheduler the serving engine for local
+    model loads: every tick packs up to ``SCHED_TOKEN_BUDGET`` tokens of
+    chunked-prefill segments plus one decode step per running sequence
+    into one batch plan, admits new work only when the paged-KV block
+    pool can cover it, and preempts the lowest-priority sequence back to
+    WAITING (paged prefix kept) under block starvation.  Off (the
+    default), the legacy engine-selection paths serve unchanged.  The
+    gate is also honored as a raw env flip via ``env_flag("DNET_SCHED")``
+    so post-cache toggles (tests, operators) still see it.
+    """
+
+    env_prefix = "DNET_"
+    # master switch: the scheduler becomes the local serving engine
+    sched: bool = False
+    # per-tick token budget shared by chunked-prefill segments (1 token
+    # each) and decode steps (1 per running sequence)
+    sched_token_budget: int = 2048
+    # largest chunked-prefill segment per request per tick
+    sched_prefill_chunk: int = 256
+    # batch lanes the scheduler engine allocates; 0 = max(batch_slots, 8)
+    sched_slots: int = 0
+
+
+@dataclass
 class SanSettings(_EnvGroup):
     """Runtime concurrency sanitizer (dnet_tpu/analysis/runtime/, "dsan").
 
@@ -512,6 +539,7 @@ class Settings:
     admission: AdmissionSettings = field(default_factory=AdmissionSettings.from_env)
     loadgen: LoadgenSettings = field(default_factory=LoadgenSettings.from_env)
     membership: MembershipSettings = field(default_factory=MembershipSettings.from_env)
+    sched: SchedSettings = field(default_factory=SchedSettings.from_env)
     san: SanSettings = field(default_factory=SanSettings.from_env)
     chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
     grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
@@ -531,6 +559,7 @@ for _cls in (
     AdmissionSettings,
     LoadgenSettings,
     MembershipSettings,
+    SchedSettings,
     SanSettings,
     ChaosSettings,
     GrpcSettings,

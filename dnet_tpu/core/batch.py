@@ -117,14 +117,21 @@ class BatchedEngine:
     def _init_state(
         self, slots: int, paged: bool = False, prefix_size: int = 0
     ) -> None:
+        # typed load-time refusals: the HTTP layer maps these to 422
+        # (operator/config error) instead of the generic 500 the old
+        # NotImplementedError fell through to.  Function-level import —
+        # the api layer depends on core, not the other way around, so the
+        # exception type is fetched only at this (load-time) raise site.
+        from dnet_tpu.api.inference import EngineCapabilityError
+
         if self.eng.plan.streams_weights:
-            raise NotImplementedError(
+            raise EngineCapabilityError(
                 "continuous batching needs resident weights (fit policy); "
                 "weight streaming serves single-sequence"
             )
         if not self.eng.model.supports_kv_commit:
             # fail at load, not mid-stream on the first batched step
-            raise NotImplementedError(
+            raise EngineCapabilityError(
                 f"continuous batching not supported for "
                 f"{self.eng.config.model_type} (no gated KV writes yet)"
             )

@@ -370,6 +370,43 @@ def _register_core(reg: MetricsRegistry) -> None:
     )
     for kind in ZOMBIE_THREAD_KINDS:
         zombies.labels(thread=kind)  # pre-touch: the lint checks these
+    # iteration-level scheduler (dnet_tpu/sched/, DNET_SCHED=1).  State /
+    # kind / reason label sets are DECLARED in sched/kinds.py (a leaf
+    # module, like admission/reasons.py) and cross-checked both ways by
+    # the metrics lint (pass 10).
+    from dnet_tpu.sched.kinds import BATCH_KINDS, PREEMPT_REASONS, QUEUE_STATES
+
+    reg.histogram(
+        "dnet_sched_tick_ms",
+        "One scheduler tick wall time: the mixed prefill+decode plan "
+        "executed on the compute thread",
+    )
+    batch_fam = reg.histogram(
+        "dnet_sched_batch_tokens",
+        "Per-tick batch composition: prompt tokens chunk-prefilled and "
+        "decode lanes stepped in the same tick (sched/kinds.py)",
+        labelnames=("kind",),
+        buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0, 2048.0),
+    )
+    for kind in BATCH_KINDS:
+        batch_fam.labels(kind=kind)  # pre-touch: the lint checks these
+    preempt = reg.counter(
+        "dnet_sched_preemptions_total",
+        "Sequences evicted back to WAITING by the scheduler "
+        "(reason per sched/kinds.py)",
+        labelnames=("reason",),
+    )
+    for reason in PREEMPT_REASONS:
+        preempt.labels(reason=reason)  # pre-touch: the lint checks these
+    depth = reg.gauge(
+        "dnet_sched_queue_depth",
+        "Requests resident in the scheduler queue, by live state "
+        "(sched/kinds.py)",
+        labelnames=("state",),
+    )
+    for state in QUEUE_STATES:
+        depth.labels(state=state)  # pre-touch: the lint checks these
 
 
 def _ensure_core() -> None:

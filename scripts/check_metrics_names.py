@@ -1,10 +1,10 @@
 #!/usr/bin/env python
 """Metric-name lint CLI shim.
 
-The eight passes (registry names, source-literal scan, federation round
+The passes (registry names, source-literal scan, federation round
 trip, paged-pool conservation, chaos-point coverage, admission /
-membership / attribution label cross-checks) moved into the static
-analysis framework as checks DL010-DL017 —
+membership / attribution / sanitizer / scheduler label cross-checks)
+moved into the static analysis framework as checks DL010-DL019 —
 ``dnet_tpu/analysis/metrics_checks.py`` — where they run alongside the
 async-safety / JIT-purity / contract checks via ``scripts/dnetlint.py``
 and the tier-1 wrapper (tests/test_static_analysis.py).
@@ -37,6 +37,8 @@ from dnet_tpu.analysis.metrics_checks import (  # noqa: E402,F401 — re-exporte
     check_membership_labels,
     check_paged_conservation,
     check_registry,
+    check_san_labels,
+    check_sched_labels,
     check_sources,
     main,
 )

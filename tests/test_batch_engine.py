@@ -126,9 +126,11 @@ def test_mixed_sampling_params_batch_together(batched):
 
 
 def test_streaming_weights_rejected(tiny_llama_dir):
+    from dnet_tpu.api.inference import EngineCapabilityError
     from dnet_tpu.core.batch import BatchedEngine
 
-    with pytest.raises(NotImplementedError, match="resident weights"):
+    # typed since the sched PR: api/http.py maps it to 422, not a 500
+    with pytest.raises(EngineCapabilityError, match="resident weights"):
         BatchedEngine(
             tiny_llama_dir, slots=2, max_seq=64, param_dtype="float32",
             window_size=1, residency_size=1,
