@@ -109,6 +109,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _kv_mode(engine) -> str:
+    """The KV serving mode the loaded engine RESOLVED to (not just what
+    the env asked for — a ragged refusal falls back to paged gather, and
+    the stamp must say which path the numbers measured)."""
+    if getattr(engine, "kv_ragged", False):
+        return "ragged"
+    if getattr(engine, "kv_pool", None) is not None:
+        return "paged"
+    return "dense"
+
+
 async def _run_remote(args, spec) -> dict:
     import aiohttp
 
@@ -180,6 +191,7 @@ async def _run_inprocess(args, spec) -> dict:
                 meta={
                     "mode": "in-process",
                     "engine": "sched" if args.sched else "legacy",
+                    "kv": _kv_mode(manager.engine),
                     "slots": args.slots,
                     "max_seq": args.max_seq,
                     "param_dtype": args.param_dtype,

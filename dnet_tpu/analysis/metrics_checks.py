@@ -28,6 +28,11 @@ Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
   declared enums, both directions (pass 9)
 - DL019 scheduler     — sched queue states / batch kinds / preemption
   reasons <-> declared enums, both directions (pass 10)
+- DL020 jit-coverage  — instrument_jit call-site name literals <->
+  obs/phases.py JIT_FNS, both directions (pass 11): a new jitted entry
+  point cannot ship uninstrumented under a stray label, and a declared
+  name cannot outlive its last call site (a stale series on the compile
+  dashboards)
 """
 
 from __future__ import annotations
@@ -78,12 +83,19 @@ def check_registry(errors: list) -> int:
     return len(fams)
 
 
-def check_sources(errors: list) -> int:
-    n = 0
+def _scan_paths() -> list:
+    """The source tree both literal-scanning passes walk: the standalone
+    entry points plus every .py under the scanned dirs — ONE definition,
+    so the passes can never silently diverge on the file set."""
     files = [REPO / f for f in _SCAN_FILES]
     for d in _SCAN_DIRS:
         files.extend(sorted((REPO / d).rglob("*.py")))
-    for path in files:
+    return files
+
+
+def check_sources(errors: list) -> int:
+    n = 0
+    for path in _scan_paths():
         if not path.is_file():
             continue
         text = path.read_text()
@@ -461,6 +473,64 @@ def check_sched_labels(errors: list) -> int:
     return n
 
 
+def check_jit_instrumentation(errors: list) -> int:
+    """Pass 11: every `instrument_jit(..., "name")` call site in the tree
+    must use a name declared in obs/phases.py JIT_FNS, and every declared
+    name must have at least one call site — both directions, resolved by
+    AST so nested jax.jit(...) argument parens can't confuse a regex.  A
+    non-literal name argument is itself a violation (the contract is
+    lintable only over literals)."""
+    import ast
+
+    from dnet_tpu.obs.phases import JIT_FNS
+
+    seen: dict = {}
+    n = 0
+    for path in _scan_paths():
+        if not path.is_file():
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as exc:
+            errors.append(f"jit-coverage: {path.relative_to(REPO)} "
+                          f"unparseable: {exc}")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+            if name != "instrument_jit":
+                continue
+            where = f"{path.relative_to(REPO)}:{node.lineno}"
+            n += 1
+            label = node.args[1] if len(node.args) > 1 else None
+            if label is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        label = kw.value
+            if not (isinstance(label, ast.Constant) and isinstance(label.value, str)):
+                errors.append(
+                    f"jit-coverage: {where} passes a non-literal jit fn "
+                    f"name (the JIT_FNS contract is checked over literals)"
+                )
+                continue
+            seen.setdefault(label.value, []).append(where)
+            if label.value not in JIT_FNS:
+                errors.append(
+                    f"jit-coverage: {where} instruments undeclared jit fn "
+                    f"{label.value!r} (declare it in obs.phases.JIT_FNS)"
+                )
+    for declared in JIT_FNS:
+        if declared not in seen:
+            errors.append(
+                f"jit-coverage: obs.phases.JIT_FNS declares {declared!r} "
+                f"but no instrument_jit call site uses it (remove the "
+                f"stale label or restore its entry point)"
+            )
+    return n
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -476,6 +546,7 @@ def main() -> int:
     n_attr = check_attribution_labels(errors)
     n_san = check_san_labels(errors)
     n_sched = check_sched_labels(errors)
+    n_jit = check_jit_instrumentation(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -484,8 +555,8 @@ def main() -> int:
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
           f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
           f"{n_member} membership labels, {n_attr} attribution labels, "
-          f"{n_san} sanitizer labels, {n_sched} scheduler labels, all "
-          f"conform")
+          f"{n_san} sanitizer labels, {n_sched} scheduler labels, "
+          f"{n_jit} jit call sites, all conform")
     return 0
 
 
@@ -584,6 +655,13 @@ class SchedLabelContract(_MetricsCheck):
     pass_name = "check_sched_labels"
 
 
+class JitInstrumentationContract(_MetricsCheck):
+    code = "DL020"
+    name = "jit-instrumentation-contract"
+    description = "instrument_jit call-site names <-> JIT_FNS, both ways"
+    pass_name = "check_jit_instrumentation"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -595,4 +673,5 @@ METRICS_CHECKS = [
     AttributionLabelContract(),
     SanLabelContract(),
     SchedLabelContract(),
+    JitInstrumentationContract(),
 ]

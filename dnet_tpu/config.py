@@ -196,6 +196,12 @@ class KVSettings(_EnvGroup):
     # total pool capacity in blocks; 0 = auto-size to the engine's dense
     # equivalent (slots x max_seq / block_tokens)
     pool_blocks: int = 0
+    # ragged paged attention (ops/paged_attention.py): decode attends the
+    # block pool IN PLACE through per-sequence page tables instead of the
+    # gather->step->scatter sandwich.  Requires paged KV; engines fall back
+    # to dense-gather for layouts the kernel refuses (quantized caches,
+    # non-llama-family attention stacks).
+    ragged: bool = False
 
 
 @dataclass

@@ -51,7 +51,9 @@ from dnet_tpu.kv import (
     PagedPrefixCache,
     PageTable,
     paged_enabled,
+    ragged_enabled,
 )
+from dnet_tpu.kv.store import _bucket_pow2
 from dnet_tpu.obs import get_recorder, metric, obs_enabled
 from dnet_tpu.obs.jit import instrument_jit
 from dnet_tpu.obs.phases import (
@@ -202,6 +204,28 @@ class BatchedEngine:
                     "paged KV on: %d blocks x %d tokens serving %d slots",
                     cfg.pool_blocks, cfg.block_tokens, slots,
                 )
+        # ragged paged attention (DNET_KV_RAGGED=1): decode attends the
+        # pool in place through the page tables; the dense gather/scatter
+        # round trip — and its kv_gather/kv_scatter phases — stop existing.
+        # Dense-gather stays the fallback for everything the kernel
+        # refuses (quantized caches, non-llama attention stacks), on top
+        # of the session layouts BlockStore itself already refused.
+        self.kv_ragged = False
+        if paged and ragged_enabled():
+            from dnet_tpu.ops.paged_attention import ragged_refusal
+
+            why = ragged_refusal(m, self.eng.kv_quant_bits)
+            if why is not None:
+                log.warning(
+                    "ragged paged attention disabled (%s); serving "
+                    "dense-gather decode", why,
+                )
+            else:
+                self.kv_ragged = True
+                log.info(
+                    "ragged paged attention on: decode attends the block "
+                    "pool in place"
+                )
         self.kv = (
             None
             if paged
@@ -274,6 +298,8 @@ class BatchedEngine:
         # fused R-step chunks (budget-driven): sampled tokens re-enter their
         # lanes on device, one dispatch + one packed read per R tokens
         self._chunks: Dict[int, Any] = {}
+        if self.kv_ragged:
+            self._build_ragged()
 
         L = self.spec_lookahead
         if L > 0:
@@ -316,6 +342,115 @@ class BatchedEngine:
                 jax.jit(self._spec_vmapped, donate_argnums=(3, 4)),
                 "batched_spec",
             )
+
+    def _build_ragged(self) -> None:
+        """The ragged decode programs (ops/paged_attention.py): one step
+        that reads the block pool IN PLACE — page tables and per-slot
+        positions ride along as the kernel's scalar-prefetched block index
+        map — plus fused R-step chunks that carry the (donated) pool and
+        block-append each step's new K/V rows in-program.  The per-slot
+        forward is the SAME math as the vmapped dense program: the model's
+        norm/rope/MLP stack runs unchanged (apply_window's attend_fn hook
+        swaps only the cache write + attention read), and sampling vmaps
+        the identical per-lane tail, so greedy streams are parity-testable
+        against the gather path byte for byte."""
+        from dnet_tpu.ops.paged_attention import paged_attend, paged_attend_impl
+
+        model = self.eng.model
+        impl = paged_attend_impl()
+        sp_axes = SampleParams(0, 0, 0, 0, 0, 0, 0, 0)
+
+        def one_sample(logits, active, sp, key, counts):
+            """Per-lane sampling tail, identical to the vmapped `one()`:
+            inactive lanes advance neither counts nor their RNG stream."""
+            new_key, step_key = jax.random.split(key)
+            res = sample(logits[None], sp, step_key, token_counts=counts[None])
+            counts = counts.at[res.token[0]].add(jnp.where(active, 1, 0))
+            key = jax.random.wrap_key_data(
+                jnp.where(
+                    active, jax.random.key_data(new_key), jax.random.key_data(key)
+                )
+            )
+            return res, counts, key
+
+        vsample = jax.vmap(one_sample, in_axes=(0, 0, sp_axes, 0, 0))
+
+        def ragged_step(wp, ep, token, pool, tables, pos, active, sp, keys,
+                        counts):
+            """One batched decode step against the pool (READ-ONLY here):
+            returns the sampled results plus the stacked per-layer new K/V
+            rows for the kv_append program.  tables [slots, nb] int32
+            (bucketed), pos [slots] int32 live pool rows per slot."""
+
+            def attend_fn(q, k_new, v_new, kvs):
+                attn = paged_attend(
+                    q, kvs["k"], kvs["v"], tables, pos,
+                    k_new[:, 0], v_new[:, 0], impl=impl,
+                )
+                return attn, {"k": k_new[:, 0], "v": v_new[:, 0]}
+
+            x = model.embed(ep, token)  # [slots, 1, D]
+            x, rows = model.apply_window(
+                wp, x, pool, pos[:, None], attend_fn=attend_fn
+            )
+            x = model.normalize(ep, x[:, -1:])
+            logits = model.lm_project(ep, x)[:, 0]  # [slots, V]
+            res, counts, keys = vsample(logits, active, sp, keys, counts)
+            return res, rows, counts, keys
+
+        self._ragged_step_fn = ragged_step
+        self._ragged_step = instrument_jit(
+            jax.jit(ragged_step, donate_argnums=(9,)), "paged_attend"
+        )
+        self._ragged_chunks: Dict[int, Any] = {}
+
+    def _ragged_chunk_fn(self, R: int):
+        """Fused R-step ragged chunk: the pool rides the scan carry
+        (donated — XLA appends in place), each step attends it through the
+        kernel and block-appends its new rows before the next step reads
+        them.  Same one-dispatch-per-R-tokens contract as _chunk_fn, with
+        the gather/scatter round trip deleted."""
+        fn = self._ragged_chunks.get(R)
+        if fn is None:
+            step = self._ragged_step_fn
+            bt = self._kv_cfg.block_tokens
+
+            def chunk(wp, ep, token, pool, tables, pos, active, sp, keys,
+                      counts):
+                def body(carry, _):
+                    token, pool, pos, keys, counts = carry
+                    res, rows, counts, keys = step(
+                        wp, ep, token, pool, tables, pos, active, sp, keys,
+                        counts,
+                    )
+                    nb = tables.shape[1]
+                    bidx = jnp.clip(pos // bt, 0, nb - 1)
+                    phys = jnp.take_along_axis(tables, bidx[:, None], axis=1)[:, 0]
+                    # frozen lanes write PAST the block axis (mode="drop"
+                    # discards out-of-range, but a negative index would
+                    # wrap to block N-1 and clobber a live block)
+                    phys = jnp.where(active, phys, self._kv_cfg.pool_blocks)
+                    off = pos % bt
+                    pool = jax.tree.map(
+                        lambda p, r: p.at[:, phys, off].set(
+                            r.astype(p.dtype), mode="drop"
+                        ),
+                        pool, rows,
+                    )
+                    token = jnp.where(active[:, None], res.token, token)
+                    pos = pos + active.astype(pos.dtype)
+                    return (token, pool, pos, keys, counts), res
+
+                (token, pool, pos, keys, counts), stacked = jax.lax.scan(
+                    body, (token, pool, pos, keys, counts), None, length=R
+                )
+                return stacked, pool, counts, keys
+
+            fn = instrument_jit(
+                jax.jit(chunk, donate_argnums=(3, 9)), "paged_attend"
+            )
+            self._ragged_chunks[R] = fn
+        return fn
 
     # chunk widths tried largest-first (bounded compiled-program set, same
     # discipline as LocalEngine.DECODE_CHUNK_BUCKETS)
@@ -558,15 +693,39 @@ class BatchedEngine:
                     del tbl.blocks[keep:]
             R = 1
 
-    def _table_ids(self) -> np.ndarray:
-        """[slots, max_seq/bt] physical block ids (0-padded past each
-        table; padded rows sit beyond every live pos, where the causal
-        mask zeroes them exactly)."""
+    def _table_ids(self, order: Optional[Dict[str, int]] = None) -> np.ndarray:
+        """[slots, nb] physical block ids (0-padded past each table; padded
+        rows sit beyond every live pos, where the causal mask zeroes them
+        exactly).
+
+        With `order` (the dispatch's active nonce -> slot map), nb is the
+        pow2 BUCKET of the widest active table instead of max_seq/bt: the
+        dense fallback stops gathering dead blocks every step, the ragged
+        kernel walks fewer (elided) grid steps, and the compiled-program
+        set stays bounded — the same discipline as _bucket_pow2 scatter
+        widths.  Only R==1 dispatches pass `order` (warm_chunks pre-warms
+        the step at every bucket width); fused R-step chunks keep the
+        single full-width program — they amortize the gather over R
+        tokens already, and a per-width chunk set would multiply the
+        compiled programs by the width count.  Frozen lanes' longer
+        tables truncate harmlessly (their compute is garbage, their
+        blocks are never written)."""
         nb = self.max_seq // self._kv_cfg.block_tokens
+        if order:
+            widest = max(
+                (
+                    len(self._tables[s].blocks)
+                    for s in order.values()
+                    if self._tables[s] is not None
+                ),
+                default=1,
+            )
+            nb = min(_bucket_pow2(max(widest, 1)), nb)
         ids = np.zeros((self.slots, nb), dtype=np.int32)
         for slot, tbl in enumerate(self._tables):
             if tbl is not None and tbl.blocks:
-                ids[slot, : len(tbl.blocks)] = tbl.blocks
+                n = min(len(tbl.blocks), nb)
+                ids[slot, :n] = tbl.blocks[:n]
         return ids
 
     def _move_to_slot(self, nonce: str, sess) -> None:
@@ -757,54 +916,63 @@ class BatchedEngine:
             if not order:
                 return out_buf, errors
         paged = self.kv_pool is not None
-        if paged:
-            t0 = time.perf_counter()
-            kv_in = self.kv_store.gather(self._table_ids())
-            if attribute:
-                jax.block_until_ready(kv_in)
-                self._observe_phase(PHASE_KV_GATHER, t0, order, R)
+        if paged and self.kv_ragged:
+            # ragged paged attention: the pool is attended IN PLACE through
+            # the page tables and the new rows block-append — the gather/
+            # scatter round trip (and its two phases) does not exist here
+            src = self._dispatch_ragged(order, active, R, token, pos, sp,
+                                        attribute)
         else:
-            kv_in = self.kv
-        args = (
-            self.eng.window_params,
-            self.eng.edge_params,
-            jnp.asarray(token),
-            kv_in,
-            jnp.asarray(pos),
-            jnp.asarray(active),
-            sp,
-            self.keys,
-            self.counts,
-        )
-        t0 = time.perf_counter()
-        if R > 1:
-            stacked, kv_out, self.counts, self.keys = self._chunk_fn(R)(*args)
-            src = stacked
-        else:
-            res, kv_out, self.counts, self.keys = self._step(*args)
-            src = res
-        if attribute:
-            jax.block_until_ready((src, kv_out))
-            self._observe_phase(PHASE_COMPUTE, t0, order, R)
-        if paged:
-            # persist ONLY the blocks this step wrote (block-append write);
-            # the contiguous view kv_out is scratch and dies here
-            bt = self._kv_cfg.block_tokens
-            triples = []
-            for _nonce, slot in order.items():
-                p0 = int(self.pos[slot])
-                tbl = self._tables[slot]
-                triples.extend(
-                    (slot, b, tbl.blocks[b])
-                    for b in range(p0 // bt, (p0 + R - 1) // bt + 1)
+            if paged:
+                t0 = time.perf_counter()
+                kv_in = self.kv_store.gather(
+                    self._table_ids(order if R == 1 else None)
                 )
+                if attribute:
+                    jax.block_until_ready(kv_in)
+                    self._observe_phase(PHASE_KV_GATHER, t0, order, R)
+            else:
+                kv_in = self.kv
+            args = (
+                self.eng.window_params,
+                self.eng.edge_params,
+                jnp.asarray(token),
+                kv_in,
+                jnp.asarray(pos),
+                jnp.asarray(active),
+                sp,
+                self.keys,
+                self.counts,
+            )
             t0 = time.perf_counter()
-            self.kv_store.scatter(kv_out, triples)
+            if R > 1:
+                stacked, kv_out, self.counts, self.keys = self._chunk_fn(R)(*args)
+                src = stacked
+            else:
+                res, kv_out, self.counts, self.keys = self._step(*args)
+                src = res
             if attribute:
-                jax.block_until_ready(self.kv_store.kv)
-                self._observe_phase(PHASE_KV_SCATTER, t0, order, R)
-        else:
-            self.kv = kv_out
+                jax.block_until_ready((src, kv_out))
+                self._observe_phase(PHASE_COMPUTE, t0, order, R)
+            if paged:
+                # persist ONLY the blocks this step wrote (block-append
+                # write); the contiguous view kv_out is scratch and dies here
+                bt = self._kv_cfg.block_tokens
+                triples = []
+                for _nonce, slot in order.items():
+                    p0 = int(self.pos[slot])
+                    tbl = self._tables[slot]
+                    triples.extend(
+                        (slot, b, tbl.blocks[b])
+                        for b in range(p0 // bt, (p0 + R - 1) // bt + 1)
+                    )
+                t0 = time.perf_counter()
+                self.kv_store.scatter(kv_out, triples)
+                if attribute:
+                    jax.block_until_ready(self.kv_store.kv)
+                    self._observe_phase(PHASE_KV_SCATTER, t0, order, R)
+            else:
+                self.kv = kv_out
         now = time.time()
         out: Dict[str, SampleResult] = dict(out_buf)
         # ONE packed device->host read per field per dispatch (the
@@ -840,9 +1008,58 @@ class BatchedEngine:
         # sum stays == dispatch wall so the phase sums still account for it
         n_tok = R * len(order)
         per_tok_ms = (time.perf_counter() - t_parent) * 1000.0 / n_tok
-        for _ in range(n_tok):
-            _DECODE_STEP_MS.observe(per_tok_ms)
+        _DECODE_STEP_MS.observe_n(per_tok_ms, n_tok)
         return out, errors
+
+    def _dispatch_ragged(
+        self,
+        order: Dict[str, int],
+        active: np.ndarray,
+        R: int,
+        token: np.ndarray,
+        pos: np.ndarray,
+        sp: SampleParams,
+        attribute: bool,
+    ):
+        """One ragged decode dispatch (R == 1: the read-only paged_attend
+        program + the jitted kv_append block-append; R > 1: the fused
+        chunk carrying the donated pool).  Everything here is the compute
+        phase — kv_gather/kv_scatter stop existing on this path."""
+        tables = jnp.asarray(self._table_ids(order if R == 1 else None))
+        args = (
+            self.eng.window_params,
+            self.eng.edge_params,
+            jnp.asarray(token),
+            self.kv_store.kv,
+            tables,
+            jnp.asarray(pos, dtype=jnp.int32),
+            jnp.asarray(active),
+            sp,
+            self.keys,
+            self.counts,
+        )
+        t0 = time.perf_counter()
+        if R > 1:
+            stacked, pool, self.counts, self.keys = self._ragged_chunk_fn(R)(*args)
+            self.kv_store.kv = pool
+            src = stacked
+        else:
+            res, rows, self.counts, self.keys = self._ragged_step(*args)
+            bt = self._kv_cfg.block_tokens
+            # inactive-lane sentinel: past the block axis, never negative
+            # (see BlockStore append)
+            phys = np.full(self.slots, self._kv_cfg.pool_blocks, dtype=np.int32)
+            off = np.zeros(self.slots, dtype=np.int32)
+            for _nonce, slot in order.items():
+                p0 = int(self.pos[slot])
+                phys[slot] = self._tables[slot].blocks[p0 // bt]
+                off[slot] = p0 % bt
+            self.kv_store.append_rows(rows, phys, off)
+            src = res
+        if attribute:
+            jax.block_until_ready((src, self.kv_store.kv))
+            self._observe_phase(PHASE_COMPUTE, t0, order, R)
+        return src
 
     def _observe_phase(
         self, phase: str, t0: float, order: Dict[str, int], R: int
@@ -915,8 +1132,7 @@ class BatchedEngine:
         # convention as the plain batched dispatch and LocalEngine's spec
         # path, keeping the family's count == tokens on every path)
         per_tok_ms = blk_ms / max(total_emitted, 1)
-        for _ in range(total_emitted):
-            _DECODE_STEP_MS.observe(per_tok_ms)
+        _DECODE_STEP_MS.observe_n(per_tok_ms, total_emitted)
         return res
 
     def warm_chunks(self) -> None:
@@ -946,9 +1162,43 @@ class BatchedEngine:
                 )
                 self._buffer.pop("__warm__", None)
         self.end_session("__warm__")
+        widths = 1 + len(self.CHUNK_BUCKETS)
+        if self.kv_pool is not None:
+            # R==1 dispatches gather at the pow2 bucket of the widest
+            # ACTIVE table (_table_ids): compile the step at every bucket
+            # width now, with a throwaway session grown into each bucket,
+            # so the first long-context request doesn't stall the whole
+            # batch loop on a mid-flight width compile
+            from dnet_tpu.kv import KVPoolExhausted
+
+            bt = self._kv_cfg.block_tokens
+            nb_full = self.max_seq // bt
+            # bucket ladder: pow2 widths, plus the clamped full width when
+            # nb_full itself is not a power of two (dispatches clamp to it,
+            # so it is a real compiled width too)
+            half = 1
+            while half < nb_full:
+                w = min(half * 2, nb_full)
+                # smallest prompt whose table lands in bucket w: one token
+                # past `half` full blocks (half+1 blocks round up past half)
+                n_tok = half * bt + 1
+                if n_tok + 1 >= self.max_seq:
+                    break
+                try:
+                    self.prefill_and_sample("__warm__", [0] * n_tok, dec_plain)
+                except KVPoolExhausted:
+                    # a pool this tight can never serve a table this wide,
+                    # so the width can never be dispatched either
+                    self.end_session("__warm__")
+                    break
+                self.decode_batch({"__warm__": (0, dec_plain)})
+                self._buffer.pop("__warm__", None)
+                self.end_session("__warm__")
+                widths += 1
+                half = w
         log.info(
             "[PROFILE] warmed batched chunk programs (%d widths) in %.1fs",
-            1 + len(self.CHUNK_BUCKETS), time.time() - t0,
+            widths, time.time() - t0,
         )
 
     def generate(

@@ -1118,8 +1118,7 @@ class LocalEngine:
         # record the per-token share so the histogram's count stays equal
         # to tokens served across the plain / chunked / speculative paths
         per_tok_ms = (time.perf_counter() - t_blk) * 1000 / max(emitted, 1)
-        for _ in range(emitted):
-            _DECODE_STEP_MS.observe(per_tok_ms)
+        _DECODE_STEP_MS.observe_n(per_tok_ms, emitted)
         sess.pos += emitted
         sess.spec_blocks += 1
         sess.spec_emitted += emitted
@@ -1213,8 +1212,7 @@ class LocalEngine:
         # the blocking read amortizes the chunk: record the per-token share
         # (K observations keep the histogram's count == tokens served)
         per_tok_ms = (time.perf_counter() - t0) * 1000 / K
-        for _ in range(K):
-            _DECODE_STEP_MS.observe(per_tok_ms)
+        _DECODE_STEP_MS.observe_n(per_tok_ms, K)
         toks = arr[..., 0].astype(np.int32)  # [K, B]
         if plan.logprobs:
             M = MAX_TOP_LOGPROBS

@@ -16,6 +16,7 @@ from dnet_tpu.kv.paged import (
     PageTable,
     ceil_div,
     paged_enabled,
+    ragged_enabled,
 )
 from dnet_tpu.kv.prefix import PagedPrefixCache
 from dnet_tpu.kv.store import BlockStore
@@ -29,4 +30,5 @@ __all__ = [
     "PageTable",
     "ceil_div",
     "paged_enabled",
+    "ragged_enabled",
 ]

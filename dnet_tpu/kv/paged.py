@@ -313,3 +313,16 @@ def paged_enabled() -> bool:
     if get_settings().kv.paged:
         return True
     return env_flag("DNET_KV_PAGED")
+
+
+def ragged_enabled() -> bool:
+    """DNET_KV_RAGGED=1 (KVSettings.ragged): decode attends the block pool
+    in place (ops/paged_attention.py) instead of the gather->step->scatter
+    sandwich.  Only meaningful under paged KV; eligibility is refined per
+    engine (ops.paged_attention.ragged_refusal).  Same env_flag backing as
+    paged_enabled for post-cache test flips."""
+    from dnet_tpu.config import env_flag, get_settings
+
+    if get_settings().kv.ragged:
+        return True
+    return env_flag("DNET_KV_RAGGED")

@@ -117,11 +117,31 @@ class BlockStore:
 
             return jax.tree.map(one, pool, dense)
 
+        def append(pool, rows, phys, off):
+            """Write one new token row per slot straight into its physical
+            block: rows leaves [L, slots, KVH, Hd] -> pool[:, phys[s],
+            off[s]].  Inactive lanes pass phys == pool_blocks — PAST the
+            block axis, so mode="drop" discards the write (a negative
+            sentinel would WRAP to block N-1 and clobber a live block
+            before drop semantics ever applied).  The ragged decode
+            path's replacement for the whole dense round-trip: the
+            step's ONLY cache write."""
+
+            def one(p, r):
+                return p.at[:, phys, off].set(r.astype(p.dtype), mode="drop")
+
+            return jax.tree.map(one, pool, rows)
+
         # instrumented: a page-table geometry leak re-tracing these per
-        # step shows as climbing dnet_jit_compiles_total{fn=kv_*}
+        # step shows as climbing dnet_jit_compiles_total{fn=kv_*} (gather
+        # widths are pow2-bucketed by the engines, so the compiled-program
+        # set stays bounded — see BatchedEngine._table_ids)
         self._gather = instrument_jit(jax.jit(gather), "kv_gather")
         self._scatter = instrument_jit(
             jax.jit(scatter, donate_argnums=(0,)), "kv_scatter"
+        )
+        self._append = instrument_jit(
+            jax.jit(append, donate_argnums=(0,)), "kv_append"
         )
 
     # ---- ops ----------------------------------------------------------
@@ -155,6 +175,18 @@ class BlockStore:
         block_idx = jnp.asarray([t[1] for t in padded], dtype=jnp.int32)
         phys = jnp.asarray([t[2] for t in padded], dtype=jnp.int32)
         self.kv = self._scatter(self.kv, dense, slot_idx, block_idx, phys)
+
+    def append_rows(self, rows: dict, phys, off) -> None:
+        """Ragged-decode block append: one new token row per slot, written
+        in place (donated pool buffers).  rows leaves [L, slots, KVH, Hd]
+        (the step program's stacked per-layer k/v outputs); phys/off
+        [slots] int32 physical block + in-block offset; phys ==
+        pool_blocks (out of range, NOT negative) = skip this lane."""
+        self.kv = self._append(
+            self.kv, rows,
+            jnp.asarray(phys, dtype=jnp.int32),
+            jnp.asarray(off, dtype=jnp.int32),
+        )
 
     def commit_row(
         self,

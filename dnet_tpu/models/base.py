@@ -104,6 +104,12 @@ class RingModel(abc.ABC):
     # apply_window honors the kv_commit gate (required by the pipelined-ring
     # mesh program and continuous batching); deepseek_v2 doesn't yet
     supports_kv_commit: bool = True
+    # apply_window accepts an `attend_fn` override replacing the cache
+    # write + attention of every layer (ragged paged attention,
+    # ops/paged_attention.py).  Only the llama-family stack threads it;
+    # models with bespoke attention layouts (gpt_oss paired SWA rings,
+    # deepseek MLA) keep the dense-gather decode path.
+    supports_paged_attend: bool = False
     # per-layer param names eligible for weight-only quantization (the big
     # matmuls; norms/biases/routers stay float).  Subclasses override.
     quant_keys: frozenset = frozenset(QUANTIZABLE)

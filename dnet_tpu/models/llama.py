@@ -26,6 +26,9 @@ from dnet_tpu.ops.rope import apply_rope, rope_frequencies
 
 class LlamaRingModel(RingModel):
     model_type = "llama"
+    # the standard norm->qkv->rope->cached_attend->o-proj layer body: the
+    # attention half swaps cleanly for the ragged paged program
+    supports_paged_attend = True
 
     def __init__(self, config: ModelConfig, layers):
         super().__init__(config, layers)
@@ -42,7 +45,7 @@ class LlamaRingModel(RingModel):
         """Pre-RoPE q/k hook; identity for llama (qwen3 adds per-head norms)."""
         return q, k
 
-    def _layer(self, p: dict, x: jnp.ndarray, kvs: dict, pos, mask, tp_axis=None, kv_commit=None, sp_axis=None):
+    def _layer(self, p: dict, x: jnp.ndarray, kvs: dict, pos, mask, tp_axis=None, kv_commit=None, sp_axis=None, attend_fn=None):
         """One decoder layer.  Works on full params or tensor-parallel slices:
         local head counts come from the (possibly sharded) param shapes, and
         `tp_axis` inserts the two Megatron-style psums (after o-proj and
@@ -74,10 +77,17 @@ class LlamaRingModel(RingModel):
         positions = pos + jnp.arange(T)
         q = apply_rope(q, positions, self.inv_freq, self.rope_scale)
         k = apply_rope(k, positions, self.inv_freq, self.rope_scale)
-        attn, kvs = cached_attend(
-            q, k, v, kvs, pos, mask, kv_commit=kv_commit, sp_axis=sp_axis,
-            causal=mask is None,
-        )
+        if attend_fn is not None:
+            # ragged paged attention (ops/paged_attention.py): the caller
+            # owns both the cache write (block append) and the attention
+            # read; kvs is this layer's pool slice dict, passed through so
+            # the hook can read it and return what the scan should stack
+            attn, kvs = attend_fn(q, k, v, kvs)
+        else:
+            attn, kvs = cached_attend(
+                q, k, v, kvs, pos, mask, kv_commit=kv_commit, sp_axis=sp_axis,
+                causal=mask is None,
+            )
         attn_out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
             attn_out = lax.psum(attn_out, tp_axis)
@@ -109,6 +119,7 @@ class LlamaRingModel(RingModel):
         kv_commit=None,
         sp_axis: Optional[str] = None,
         t_real=None,  # full-length caches overwrite padding before reading
+        attend_fn=None,
     ) -> Tuple[jnp.ndarray, dict]:
         # the causal predicate stays implicit (mask=None) under sp too:
         # cached_attend owns the rank-local sp mask (or the TPU split-K
@@ -120,7 +131,7 @@ class LlamaRingModel(RingModel):
             p, kvs = per_layer
             xc, kvs = self._layer(
                 p, xc, kvs, pos, mask, tp_axis=tp_axis, kv_commit=kv_commit,
-                sp_axis=sp_axis,
+                sp_axis=sp_axis, attend_fn=attend_fn,
             )
             return xc, kvs
 

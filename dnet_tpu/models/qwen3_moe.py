@@ -47,6 +47,12 @@ class Qwen3MoeRingModel(TwoSegmentStackMixin, MixtralRingModel, Qwen3RingModel):
     # mixtral's expert keys plus the dense-swiglu keys mixed layouts carry
     quant_keys = MixtralRingModel.quant_keys | {"w_gate", "w_up", "w_down"}
 
+    @property
+    def supports_paged_attend(self):  # type: ignore[override]
+        # uniform stacks ride llama's apply_window (attend_fn threads
+        # through); the mixed two-segment scans don't carry the hook
+        return not self.mixed
+
     def __init__(self, config: ModelConfig, layers):
         super().__init__(config, layers)
         # transformers Qwen3MoeConfig defaults norm_topk_prob to FALSE
@@ -192,12 +198,17 @@ class Qwen3MoeRingModel(TwoSegmentStackMixin, MixtralRingModel, Qwen3RingModel):
         sp_axis: Optional[str] = None,
         phase=None,
         t_real=None,
+        attend_fn=None,
     ) -> Tuple[jnp.ndarray, dict]:
         if not self.mixed:
             return super().apply_window(
                 window_params, x, kv, pos, mask=mask, layer_kinds=layer_kinds,
                 tp_axis=tp_axis, kv_commit=kv_commit, sp_axis=sp_axis,
-                t_real=t_real,
+                t_real=t_real, attend_fn=attend_fn,
+            )
+        if attend_fn is not None:
+            raise NotImplementedError(
+                "paged attend_fn is not threaded through mixed-segment scans"
             )
         dense = window_params.get("dense")
         moe = window_params.get("moe")

@@ -114,13 +114,22 @@ class _HistogramChild:
         self.count = 0
 
     def observe(self, v: float) -> None:
+        self.observe_n(v, 1)
+
+    def observe_n(self, v: float, n: int) -> None:
+        """n identical observations under ONE lock round-trip — the
+        amortization convention (a fused R-step chunk or verify block
+        records its per-token share tokens-served times) without n
+        acquire/release cycles per dispatch."""
+        if n <= 0:
+            return
         # bucket semantics match Prometheus: le is INCLUSIVE (v == edge
         # lands in that bucket), everything past the last edge is +Inf
         i = bisect.bisect_left(self._edges, v)
         with self._lock:
-            self.counts[i] += 1
-            self.sum += v
-            self.count += 1
+            self.counts[i] += n
+            self.sum += v * n
+            self.count += n
 
     def percentile(self, q: float) -> float:
         """Estimated q-quantile (0..1) by linear interpolation inside the
@@ -242,6 +251,9 @@ class MetricFamily:
 
     def observe(self, v: float) -> None:
         self._default().observe(v)
+
+    def observe_n(self, v: float, n: int) -> None:
+        self._default().observe_n(v, n)
 
     def percentile(self, q: float) -> float:
         return self._default().percentile(q)

@@ -33,6 +33,9 @@ JIT_FNS = (
     "batched_spec",         # BatchedEngine._spec_step (verify blocks)
     "kv_gather",            # BlockStore page-table gather
     "kv_scatter",           # BlockStore block write-back
+    "paged_attend",         # BatchedEngine ragged decode programs (step +
+                            # fused chunks) attending the pool in place
+    "kv_append",            # BlockStore per-step block-append of new K/V rows
 )
 
 # dnet_device_mem_bytes{kind=}: backend memory stats summed over local

@@ -1,0 +1,282 @@
+"""Ragged paged attention: decode attends the KV block pool IN PLACE.
+
+The paged subsystem (kv/) gave admission and sharing block granularity, but
+PR 3 kept the compute dense: every decode tick gathers each slot's page
+table into a contiguous per-slot view (`pool[:, ids]` at full width),
+steps the existing attention programs over it, and scatters the touched
+blocks back.  PR 7's phase attribution prices that round trip exactly —
+`dnet_step_phase_ms{phase=kv_gather|kv_scatter}` — and "Ragged Paged
+Attention" (PAPERS.md, arxiv 2604.15464) names the TPU-native fix this
+module implements: an attention program that consumes the pool-shaped
+`[N_blocks, bt, KVH, Hd]` arrays and the `[slots, nb]` int32 page tables
+DIRECTLY, so the per-slot view never exists.
+
+The kernel is the split-K online-softmax fold of `ops/flash_decode.py`
+with the page table as the scalar-prefetched block index map: grid
+(slots, kv_heads, nb) walks each slot's logical blocks, the index map
+resolves logical -> physical through the prefetched table, and indices
+past a slot's live length clamp to its last live block — Pallas elides
+the HBM->VMEM copy when the block index repeats, so a slot at pos=2K in
+a 128K-capacity pool reads ~2K slots (the same dead-tile trick, applied
+per-sequence instead of per-batch).  Ragged per-slot lengths cost
+nothing: length is just each slot's own clamp horizon.  GQA folds all G
+query heads of a kv head per tile, and the CURRENT token's k/v row —
+not yet in the pool; the block append happens after the launch — is
+folded analytically into the (m, l, acc) accumulator at the emit step,
+exactly like flash_decode's sink logits.
+
+Three implementations behind one dispatcher (`paged_attend`):
+
+- ``pallas``     — the real kernel (TPU).
+- ``interpret``  — the same kernel under pl.pallas_call(interpret=True),
+  so CPU tier-1 executes the actual kernel logic incl. the index-map
+  clamping (DNET_FLASH_INTERPRET=1, the flash_decode convention).
+- ``emulate``    — a plain-jnp twin for backends where interpret mode is
+  too slow to serve: gather the table's blocks (already width-bounded by
+  the caller's pow2 bucket), write the new row at `pos`, and run the
+  shared dense `attend` — the same operation order as the dense-gather
+  path, so greedy streams stay byte-identical, fused into the step
+  program with no separate gather dispatch and NO scatter at all.
+
+The caller (core/batch.py) owns eligibility via `ragged_refusal`: the
+llama-family attention stack (supports_paged_attend), unquantized pool
+leaves, and a flat block layout.  Everything else keeps the dense-gather
+fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dnet_tpu.ops.flash_attention import _interpret
+
+NEG_INF = -1e30
+
+#: static implementation choices for the dispatcher (trace-time constant)
+PAGED_IMPLS = ("pallas", "interpret", "emulate")
+
+
+def paged_attend_impl() -> str:
+    """Resolve the implementation for this process: the real kernel on
+    TPU, the interpret-mode kernel under the DNET_FLASH_INTERPRET test
+    override (CPU tier-1 executes the true kernel logic), the jnp twin
+    everywhere else (fast enough to SERVE on CPU fallback)."""
+    if _interpret():
+        return "interpret"
+    if jax.default_backend() == "tpu":
+        return "pallas"
+    return "emulate"
+
+
+def ragged_refusal(model, kv_quant_bits: int) -> Optional[str]:
+    """Why this engine cannot route decode through the ragged program
+    (None = eligible).  Mirrors BlockStore's session-layout refusals: the
+    dense-gather path stays correct for everything refused here."""
+    if not getattr(model, "supports_paged_attend", False):
+        return (
+            f"{model.config.model_type} attention stack has no paged-attend "
+            "hook (non-llama-family layers stay on dense gather)"
+        )
+    if kv_quant_bits:
+        return (
+            f"quantized KV cache (bits={kv_quant_bits}) dequantizes through "
+            "the dense gather path"
+        )
+    return None
+
+
+def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, kn_ref, vn_ref,
+                  o_ref, m_ref, l_ref, acc_ref, *, bt: int, scale: float,
+                  nb: int):
+    """One (slot, kv-head, logical-block) fold of the online softmax.
+
+    tbl_ref SMEM [slots, nb] page table, pos_ref SMEM [slots] live pool
+    rows per slot (the new token's row arrives via kn/vn, folded at emit).
+    q [G, Hd] is the slot's whole GQA group for this kv head — one block
+    read amortizes over all G query heads sharing it."""
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    live = pos_ref[b]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i * bt < live)
+    def _fold():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [G, Hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bt, Hd]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, bt]
+        # mid-block ragged edge: the last live block is only partially
+        # full — rows at absolute positions >= live are stale pool content
+        # (or a clamped repeat of an earlier block) and must not score
+        slot = i * bt + jax.lax.broadcasted_iota(jnp.int32, (1, bt), 1)
+        scores = jnp.where(slot < live, scores, NEG_INF)
+
+        m_prev = m_ref[:]  # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_ref[0, :, 0, :].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, Vd]
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = m_new
+
+    @pl.when(i == nb - 1)
+    def _emit():
+        # fold the CURRENT token's row (position == live, always attended
+        # under the causal predicate) analytically — it reaches the pool
+        # only after the launch, via the kv_append program
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [G, Hd]
+        kn = kn_ref[0, 0, 0, :].astype(jnp.float32)  # [Hd]
+        vn = vn_ref[0, 0, 0, :].astype(jnp.float32)  # [Vd]
+        s_new = jnp.sum(q * kn[None, :], axis=1, keepdims=True)  # [G, 1]
+        m_fin = jnp.maximum(m_ref[:], s_new)
+        corr = jnp.exp(m_ref[:] - m_fin)
+        p_new = jnp.exp(s_new - m_fin)  # [G, 1]
+        l_fin = l_ref[:] * corr + p_new
+        acc_fin = acc_ref[:] * corr + p_new * vn[None, :]
+        o_ref[0, 0, :, :] = (
+            acc_fin / jnp.maximum(l_fin, 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("G", "scale", "bt", "interpret"),
+)
+def _paged_pallas(q, k_pool, v_pool, tables, pos, k_new, v_new, *, G: int,
+                  scale: float, bt: int, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, Hd = q.shape
+    KVH = H // G
+    Vd = v_pool.shape[-1]
+    nb = tables.shape[1]
+    qg = q.reshape(B, KVH, G, Hd)
+    kn = k_new.reshape(B, KVH, 1, Hd)
+    vn = v_new.reshape(B, KVH, 1, Vd)
+
+    def live_block(b, tbl, pos):
+        """Last logical block holding any live row for slot b; dead grid
+        steps clamp here so the pipeline re-fetches (elides) one block
+        instead of streaming unallocated table entries."""
+        return jnp.clip((pos[b] - 1) // bt, 0, nb - 1)
+
+    def kv_map(b, kh, i, tbl, pos):
+        return (tbl[b, jnp.minimum(i, live_block(b, tbl, pos))], 0, kh, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KVH, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, Hd), lambda b, kh, i, tbl, pos: (b, kh, 0, 0)),
+            pl.BlockSpec((1, bt, 1, Hd), kv_map),
+            pl.BlockSpec((1, bt, 1, Vd), kv_map),
+            pl.BlockSpec((1, 1, 1, Hd), lambda b, kh, i, tbl, pos: (b, kh, 0, 0)),
+            pl.BlockSpec((1, 1, 1, Vd), lambda b, kh, i, tbl, pos: (b, kh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, Vd), lambda b, kh, i, tbl, pos: (b, kh, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, Vd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, bt=bt, scale=scale, nb=nb)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, Vd), q.dtype),
+        interpret=interpret,
+    )(tables, pos, qg, k_pool, v_pool, kn, vn)
+    return out.reshape(B, T, H, Vd)
+
+
+def _paged_emulate(q, k_pool, v_pool, tables, pos, k_new, v_new,
+                   scale: float):
+    """Plain-jnp twin: gather each slot's blocks to a contiguous view
+    (width already bounded by the caller's pow2 table bucket), write the
+    new row at `pos` exactly like the dense path's write_kv, and attend
+    with the causal-at-pos mask through the SAME dense `attend` the
+    gather path bottoms out in — one fused program, no separate gather
+    dispatch, no scatter.  Serving CPU fallbacks run this; interpret mode
+    and TPU run the kernel."""
+    from dnet_tpu.ops.attention import attend
+
+    B, T, H, Hd = q.shape
+    nb = tables.shape[1]
+    bt = k_pool.shape[1]
+    KVH = k_pool.shape[2]
+    S = nb * bt
+
+    def view(pool):
+        g = pool[tables]  # [B, nb, bt, KVH, D]
+        return g.reshape(B, S, KVH, pool.shape[-1])
+
+    kc = view(k_pool)
+    vc = view(v_pool)
+    write = jax.vmap(
+        lambda c, r, p: jax.lax.dynamic_update_slice(c, r[None], (p, 0, 0))
+    )
+    kc = write(kc, k_new.astype(kc.dtype), pos)
+    vc = write(vc, v_new.astype(vc.dtype), pos)
+    mask = (jnp.arange(S)[None, :] <= pos[:, None])[:, None, :]  # [B, 1, S]
+    return attend(q, kc, vc, mask=mask, scale=scale)
+
+
+def paged_attend(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,
+    pos: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    scale: Optional[float] = None,
+    impl: str = "emulate",
+) -> jnp.ndarray:
+    """Single-token decode attention against the block pool, in place.
+
+    q [B, 1, H, Hd]; k_pool/v_pool [N_blocks, bt, KVH, Hd/Vd] (ONE layer's
+    pool slices); tables [B, nb] int32 page tables (entries past a slot's
+    allocation are 0 — never read thanks to the live clamp); pos [B] int32
+    live pool rows per slot; k_new/v_new [B, KVH, Hd/Vd] the current
+    token's rows (position == pos, attended in-launch, appended to the
+    pool by the caller afterwards).  Equals dense write-then-attend with
+    the causal mask at pos.  `impl` is a trace-time constant — callers
+    resolve it once via paged_attend_impl()."""
+    B, T, H, Hd = q.shape
+    KVH = k_pool.shape[2]
+    G = H // KVH
+    bt = k_pool.shape[1]
+    scale = Hd**-0.5 if scale is None else float(scale)
+    tables = tables.astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+    if impl == "emulate":
+        return _paged_emulate(q, k_pool, v_pool, tables, pos, k_new, v_new,
+                              scale)
+    if impl not in PAGED_IMPLS:
+        raise ValueError(f"paged_attend impl {impl!r} not in {PAGED_IMPLS}")
+    return _paged_pallas(
+        q, k_pool, v_pool, tables, pos, k_new, v_new,
+        G=G, scale=scale, bt=bt, interpret=(impl == "interpret"),
+    )

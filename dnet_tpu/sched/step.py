@@ -8,7 +8,12 @@ tick is one mixed launch sequence:
   any prompt token burns — decode latency is what the per-token SLO
   measures), with block-starvation preemption resolved BEFORE the
   dispatch so a pool shortfall evicts the lowest-priority sequence
-  instead of erroring an arbitrary lane;
+  instead of erroring an arbitrary lane; under DNET_KV_RAGGED=1 the
+  dispatch attends the block pool in place through the page tables
+  (ops/paged_attention.py) — the gather/scatter round trip and its
+  kv_gather/kv_scatter phases stop existing, while this module's block
+  accounting (_decode_need, preemption) is unchanged because admission
+  was always a function of blocks, never of the dense view;
 - then the tick's chunked-prefill segments on the engine's B=1 bucket
   programs, each segment's KV commit riding the existing gather/scatter
   paths; a segment that completes its prompt is adopted into its batch

@@ -1,0 +1,356 @@
+"""Ragged paged attention end-to-end (DNET_KV_RAGGED=1): the interpret-mode
+kernel — the REAL kernel logic, index-map clamping included — must serve
+byte-identical greedy streams to the dense-gather path through the
+production stack, under both the legacy adapter and the DNET_SCHED=1
+scheduler, across the sharing edges the block pool makes interesting
+(COW mid-block divergence, preemption -> resume re-prefill, mid-block
+positions attended through clamped dead table entries)."""
+
+import asyncio
+import os
+import re
+
+import pytest
+
+from dnet_tpu.config import reset_settings_cache
+from dnet_tpu.core.types import DecodingParams
+from dnet_tpu.obs import metric
+
+pytestmark = pytest.mark.api
+
+
+@pytest.fixture
+def ragged_env(monkeypatch):
+    """Paged pool with small blocks + interpret-mode kernels: tier-1 CPU
+    executes the actual Pallas program logic, not just the jnp twin.  The
+    ragged flag itself is flipped per serving run by the helpers below."""
+    monkeypatch.setenv("DNET_KV_PAGED", "1")
+    monkeypatch.setenv("DNET_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("DNET_FLASH_INTERPRET", "1")
+    reset_settings_cache()
+    yield
+    reset_settings_cache()
+
+
+def _flip(ragged: bool, sched: bool) -> None:
+    """Per-run env for the A/B halves (monkeypatch can't scope a single
+    asyncio.run); callers pop both keys afterwards."""
+    if ragged:
+        os.environ["DNET_KV_RAGGED"] = "1"
+    else:
+        os.environ.pop("DNET_KV_RAGGED", None)
+    if sched:
+        os.environ["DNET_SCHED"] = "1"
+    else:
+        os.environ.pop("DNET_SCHED", None)
+    reset_settings_cache()
+
+
+def _unflip() -> None:
+    os.environ.pop("DNET_KV_RAGGED", None)
+    os.environ.pop("DNET_SCHED", None)
+    reset_settings_cache()
+
+
+def _normalize_sse(raw: str) -> str:
+    """Strip the only run-specific bytes an SSE stream carries: the
+    chatcmpl-<nonce> response id and the created wall-clock stamp."""
+    raw = re.sub(r'"id": ?"[^"]*"', '"id": "chatcmpl-X"', raw)
+    return re.sub(r'"created": ?\d+', '"created": 0', raw)
+
+
+async def _sse_burst(model_dir, prompts, max_tokens=6, slots=4):
+    """The real HTTP server: load the tiny model, stream every prompt
+    concurrently, return the raw SSE bytes per prompt."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from dnet_tpu.api.http import ApiHTTPServer
+    from dnet_tpu.api.inference import InferenceManager
+    from dnet_tpu.api.model_manager import LocalModelManager
+
+    inference = InferenceManager(
+        adapter=None, request_timeout_s=120.0, max_concurrent=slots
+    )
+    manager = LocalModelManager(
+        inference, max_seq=64, param_dtype="float32", batch_slots=slots
+    )
+    server = ApiHTTPServer(inference, manager)
+    client = TestClient(TestServer(server.app))
+    await client.start_server()
+    try:
+        r = await client.post("/v1/load_model", json={"model": str(model_dir)})
+        assert r.status == 200, await r.text()
+
+        async def one(p):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": p}],
+                    "max_tokens": max_tokens,
+                    "temperature": 0,
+                    "stream": True,
+                },
+            )
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            return (await resp.read()).decode()
+
+        return await asyncio.gather(*(one(p) for p in prompts))
+    finally:
+        await client.close()
+
+
+def _sse_ab(model_dir, prompts, sched: bool):
+    """Dense-gather vs ragged halves of one parity run (identical env but
+    for DNET_KV_RAGGED), normalized for comparison."""
+    try:
+        _flip(ragged=False, sched=sched)
+        dense = asyncio.run(_sse_burst(model_dir, prompts))
+        _flip(ragged=True, sched=sched)
+        ragged = asyncio.run(_sse_burst(model_dir, prompts))
+    finally:
+        _unflip()
+    return ([_normalize_sse(s) for s in dense],
+            [_normalize_sse(s) for s in ragged])
+
+
+@pytest.mark.http
+def test_ragged_legacy_sse_byte_parity(tiny_llama_dir, ragged_env):
+    """Legacy adapter, mixed burst: SSE byte streams identical after
+    normalizing id + created — chunk boundaries, deltas, finish reasons,
+    usage, framing.  Variable prompt lengths land mid-block on purpose so
+    the kernel's live-clamp (dead table entries past each slot's blocks)
+    is on the serving path, not just the unit tier."""
+    prompts = ["Hi", "Hello there", "A quick brown fox", "mid prompt here"]
+    dense, ragged = _sse_ab(tiny_llama_dir, prompts, sched=False)
+    assert ragged == dense
+    for s in ragged:  # real streams, not error shortcuts
+        events = [ln for ln in s.splitlines() if ln.startswith("data: ")]
+        assert events[-1] == "data: [DONE]" and len(events) > 2
+
+
+@pytest.mark.http
+def test_ragged_sched_sse_byte_parity(tiny_llama_dir, ragged_env):
+    """Same contract through the DNET_SCHED=1 scheduler: mixed
+    prefill+decode ticks dispatch the ragged program and the byte streams
+    still match the dense-gather scheduler run."""
+    prompts = ["Hi", "Hello there", "A quick brown fox", "tail"]
+    dense, ragged = _sse_ab(tiny_llama_dir, prompts, sched=True)
+    assert ragged == dense
+    for s in ragged:
+        events = [ln for ln in s.splitlines() if ln.startswith("data: ")]
+        assert events[-1] == "data: [DONE]" and len(events) > 2
+
+
+# ---------------------------------------------------------------------------
+# engine tier: the sharing edges, ragged vs the dense-gather fallback
+# ---------------------------------------------------------------------------
+
+
+def _engine(tiny_llama_dir, ragged: bool, **kw):
+    from dnet_tpu.core.batch import BatchedEngine
+
+    _flip(ragged=ragged, sched=False)
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("param_dtype", "float32")
+    return BatchedEngine(tiny_llama_dir, kv_paged=True, **kw)
+
+
+def _stream(eng, nonce, ids, steps, dec=DecodingParams(temperature=0.0)):
+    res = eng.prefill_and_sample(nonce, ids, dec)
+    toks = [int(res.token[0])]
+    for _ in range(steps - 1):
+        out, errs = eng.decode_batch({nonce: (toks[-1], dec)})
+        assert not errs
+        toks.append(int(out[nonce].token[0]))
+    return toks
+
+
+def test_ragged_engine_flag_and_phases(tiny_llama_dir, ragged_env, monkeypatch):
+    """The engine actually takes the ragged path (kv_ragged resolves True),
+    and the kv_gather/kv_scatter phases STOP EXISTING on it: with
+    attribution on, a decode dispatch moves the compute phase counter but
+    neither KV phase — the round trip is deleted, not just cheaper."""
+    monkeypatch.setenv("DNET_OBS_ENABLED", "1")
+    eng = _engine(tiny_llama_dir, ragged=True)
+    try:
+        assert eng.kv_ragged is True
+        fam = metric("dnet_step_phase_ms")
+        before = {
+            ph: fam.labels(phase=ph).count
+            for ph in ("kv_gather", "compute", "kv_scatter")
+        }
+        dec = DecodingParams(temperature=0.0)
+        res = eng.prefill_and_sample("ph", [256, 72, 101], dec)
+        eng.decode_batch({"ph": (int(res.token[0]), dec)})
+        fam = metric("dnet_step_phase_ms")
+        assert fam.labels(phase="compute").count > before["compute"]
+        assert fam.labels(phase="kv_gather").count == before["kv_gather"]
+        assert fam.labels(phase="kv_scatter").count == before["kv_scatter"]
+        eng.end_session("ph")
+    finally:
+        eng.close()
+        _unflip()
+
+
+def test_ragged_interleaved_mid_block_matches_dense(tiny_llama_dir, ragged_env):
+    """>= 3 concurrent variable-length sessions whose positions straddle
+    block boundaries (the clamped-dead-block masking edge, mid-block pos):
+    identical greedy streams to the dense-gather engine, single steps and
+    budget-driven fused chunks both."""
+    prompts = {
+        "va": [256, 72, 101],                                  # 1 block, mid
+        "vb": [256, 84, 104, 105, 110, 3, 9, 12, 44, 7, 81],   # 2 blocks
+        "vc": list(range(300, 318)),                           # 3 blocks, mid
+    }
+    dec = DecodingParams(temperature=0.0)
+
+    def interleaved(eng, steps=6):
+        last, got = {}, {}
+        for n, ids in prompts.items():
+            res = eng.prefill_and_sample(n, ids, dec)
+            last[n] = int(res.token[0])
+            got[n] = [last[n]]
+        for _ in range(steps - 1):
+            out, errs = eng.decode_batch({n: (last[n], dec) for n in prompts})
+            assert not errs
+            for n, res in out.items():
+                last[n] = int(res.token[0])
+                got[n].append(last[n])
+        for n in prompts:
+            eng.end_session(n)
+        return got
+
+    def chunked(eng):
+        toks = _stream(eng, "ck", prompts["vb"], 1)
+        while len(toks) < 12:
+            out, errs = eng.decode_batch(
+                {"ck": (toks[-1], dec)}, budgets={"ck": 12 - len(toks)}
+            )
+            assert not errs
+            toks.append(int(out["ck"].token[0]))
+        eng.end_session("ck")
+        return toks
+
+    eng = _engine(tiny_llama_dir, ragged=False)
+    try:
+        want, want_ck = interleaved(eng), chunked(eng)
+    finally:
+        eng.close()
+    eng = _engine(tiny_llama_dir, ragged=True)
+    try:
+        assert eng.kv_ragged is True
+        assert interleaved(eng) == want
+        assert chunked(eng) == want_ck
+        eng.kv_pool.check_conservation()
+    finally:
+        eng.close()
+        _unflip()
+
+
+def test_ragged_cow_mid_block_divergence(tiny_llama_dir, ragged_env):
+    """A prompt diverging INSIDE a shared block under the ragged path:
+    the sharer COWs the partial block, both streams match the dense-gather
+    engine's, and the original keeps decoding out of its UN-mutated
+    partial block (the kernel reads the pre-COW physical block through its
+    own table while the sharer's table points at the copy)."""
+    from dnet_tpu.obs import reset_obs
+
+    reset_obs()
+    base = list(range(260, 280))  # 20 tokens: 2 full blocks + 4 in a 3rd
+    grown = base + [7, 2]
+
+    def run(ragged: bool):
+        eng = _engine(tiny_llama_dir, ragged=ragged, prefix_cache_size=4)
+        try:
+            eng.paged_prefix.min_tokens = 8
+            got_base = [_stream(eng, "b", base, 1)[0]]
+            got_grown = _stream(eng, "g", grown, 6)
+            dec = DecodingParams(temperature=0.0)
+            for _ in range(5):
+                out, errs = eng.decode_batch({"b": (got_base[-1], dec)})
+                assert not errs
+                got_base.append(int(out["b"].token[0]))
+            eng.end_session("b")
+            eng.end_session("g")
+            eng.kv_pool.check_conservation()
+            return got_base, got_grown
+        finally:
+            eng.close()
+            _unflip()
+
+    want = run(ragged=False)
+    cow_before = metric("dnet_kv_cow_copies_total").value
+    got = run(ragged=True)
+    assert got == want
+    assert metric("dnet_kv_cow_copies_total").value > cow_before
+
+
+@pytest.mark.slow
+def test_ragged_preempt_resume_reprefill_parity(tiny_llama_dir, monkeypatch):
+    """Scheduler preemption -> resume under ragged: a pool too small for
+    both sequences' decode growth forces a block-starvation preemption;
+    the victim's prefix is aliased out, it resumes by RE-PREFILLING (the
+    ragged path serves both the re-prefill commit and the resumed decode),
+    and both final texts equal uncontended solo runs."""
+    monkeypatch.setenv("DNET_KV_PAGED", "1")
+    monkeypatch.setenv("DNET_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("DNET_FLASH_INTERPRET", "1")
+    # the chat-templated prompt is 45 tokens = 6 blocks: 13 admits BOTH
+    # residents (12 blocks) but cannot cover their decode growth to
+    # max_seq (8 blocks each), so the pool starves mid-decode
+    monkeypatch.setenv("DNET_KV_POOL_BLOCKS", "13")
+    monkeypatch.setenv("DNET_SCHED_SLOTS", "2")
+    reset_settings_cache()
+
+    from dnet_tpu.api.inference import InferenceManager
+    from dnet_tpu.api.model_manager import LocalModelManager
+    from dnet_tpu.api.schemas import ChatCompletionRequest
+
+    def req(content, deadline_s=None):
+        body = {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": content}],
+            "max_tokens": 28,
+            "temperature": 0.0,
+        }
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
+        return ChatCompletionRequest.model_validate(body)
+
+    async def serve(prompts, deadlines):
+        inference = InferenceManager(
+            adapter=None, request_timeout_s=120.0, max_concurrent=2
+        )
+        manager = LocalModelManager(
+            inference, max_seq=64, param_dtype="float32", batch_slots=2
+        )
+        await manager.load_model(str(tiny_llama_dir))
+        try:
+            outs = await asyncio.gather(*(
+                inference.generate(req(p, deadline_s=dl))
+                for p, dl in zip(prompts, deadlines)
+            ))
+            return [o.choices[0].message.content for o in outs]
+        finally:
+            await manager.unload_model()
+
+    prompts = ["a" * 20, "b" * 20]
+    try:
+        _flip(ragged=True, sched=True)
+        solo = [asyncio.run(serve([p], [None]))[0] for p in prompts]
+        before = metric("dnet_sched_preemptions_total").labels(
+            reason="block_starvation"
+        ).value
+        # the second request carries the tight deadline -> it out-ranks the
+        # first, which becomes the block-starvation victim mid-decode
+        got = asyncio.run(serve(prompts, [None, 30.0]))
+    finally:
+        _unflip()
+    assert got == solo
+    after = metric("dnet_sched_preemptions_total").labels(
+        reason="block_starvation"
+    ).value
+    assert after > before  # a preemption actually happened

@@ -53,6 +53,26 @@ def test_histogram_bucket_edges_inclusive():
     assert "dnet_test_ms_count 5" in text
 
 
+def test_histogram_observe_n_matches_n_observes():
+    """observe_n(v, n) == n observe(v) calls in every exposed number — the
+    amortization convention (per-token share recorded tokens-served times)
+    without n lock round-trips per dispatch."""
+    reg = MetricsRegistry()
+    h_loop = reg.histogram("dnet_test_loop_ms", "help", buckets=(1.0, 10.0))
+    h_bulk = reg.histogram("dnet_test_bulk_ms", "help", buckets=(1.0, 10.0))
+    for v, n in ((0.5, 3), (10.0, 4), (99.0, 2)):
+        for _ in range(n):
+            h_loop.observe(v)
+        h_bulk.observe_n(v, n)
+    assert h_bulk._default().counts == h_loop._default().counts
+    assert h_bulk.count == h_loop.count == 9
+    assert h_bulk.sum == pytest.approx(h_loop.sum)
+    # n <= 0 is a no-op, never a negative count
+    h_bulk.observe_n(5.0, 0)
+    h_bulk.observe_n(5.0, -3)
+    assert h_bulk.count == 9
+
+
 def test_histogram_percentile_interpolation():
     reg = MetricsRegistry()
     h = reg.histogram("dnet_test_ms", "help", buckets=(10.0, 20.0))

@@ -1,0 +1,148 @@
+"""Ragged paged attention (ops/paged_attention.py): the Pallas kernel (in
+interpret mode — the real kernel logic, index-map clamping included), the
+jnp emulate twin, and a dense write-then-attend reference must agree over
+ragged per-slot lengths, GQA folding, mid-block positions, and dead table
+entries."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dnet_tpu.ops.attention import attend  # noqa: E402
+from dnet_tpu.ops.paged_attention import (  # noqa: E402
+    PAGED_IMPLS,
+    paged_attend,
+    paged_attend_impl,
+    ragged_refusal,
+)
+
+pytestmark = pytest.mark.core
+
+BT = 8  # block tokens
+NB = 4  # table width (pool capacity allows more)
+N_BLOCKS = 16
+
+
+def _case(seed, B=3, H=4, KVH=2, Hd=16, pos=None):
+    """Random pool + per-slot tables with ragged live lengths."""
+    rng = np.random.default_rng(seed)
+    k_pool = rng.normal(size=(N_BLOCKS, BT, KVH, Hd)).astype(np.float32)
+    v_pool = rng.normal(size=(N_BLOCKS, BT, KVH, Hd)).astype(np.float32)
+    # distinct physical blocks per slot, deliberately non-contiguous
+    perm = rng.permutation(N_BLOCKS)[: B * NB].reshape(B, NB)
+    tables = np.zeros((B, NB), dtype=np.int32)
+    pos = np.asarray(pos if pos is not None else [1, BT * 2, BT * 3 - 3],
+                     dtype=np.int32)
+    for b in range(B):
+        nb_live = -(-int(pos[b] + 1) // BT)  # blocks covering pos+1 tokens
+        tables[b, :nb_live] = perm[b, :nb_live]
+    q = rng.normal(size=(B, 1, H, Hd)).astype(np.float32)
+    k_new = rng.normal(size=(B, KVH, Hd)).astype(np.float32)
+    v_new = rng.normal(size=(B, KVH, Hd)).astype(np.float32)
+    return (jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(k_new),
+            jnp.asarray(v_new))
+
+
+def _dense_reference(q, k_pool, v_pool, tables, pos, k_new, v_new):
+    """Gather + in-place write + masked dense attend, per slot — the exact
+    computation the dense-gather decode path performs."""
+    B = q.shape[0]
+    outs = []
+    for b in range(B):
+        kc = k_pool[tables[b]].reshape(NB * BT, *k_pool.shape[2:])
+        vc = v_pool[tables[b]].reshape(NB * BT, *v_pool.shape[2:])
+        p = int(pos[b])
+        kc = kc.at[p].set(k_new[b])
+        vc = vc.at[p].set(v_new[b])
+        mask = (jnp.arange(NB * BT) <= p)[None, :]
+        outs.append(attend(q[b : b + 1], kc[None], vc[None], mask=mask))
+    return jnp.concatenate(outs, axis=0)
+
+
+def test_emulate_matches_dense_reference():
+    case = _case(0)
+    ref = _dense_reference(*case)
+    out = paged_attend(*case, impl="emulate")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_interpret_kernel_matches_emulate_ragged_lengths():
+    """The actual kernel (interpret mode), across ragged lengths incl. the
+    mid-block edge (pos % bt != 0: the last live block is partially full
+    and its stale tail rows must not score)."""
+    for seed, pos in ((1, [0, 5, BT * NB - 1]), (2, [BT - 1, BT, BT + 1]),
+                      (3, [2 * BT - 5, 3 * BT - 1, 7])):
+        case = _case(seed, pos=pos)
+        ref = _dense_reference(*case)
+        out = paged_attend(*case, impl="interpret")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dead_table_entries_are_never_read():
+    """Entries past a slot's live blocks are clamped by the block index
+    map — pointing them at a DIFFERENT (garbage-filled) block must not
+    change the output by one bit."""
+    q, k_pool, v_pool, tables, pos, k_new, v_new = _case(4, pos=[3, 9, 12])
+    out1 = paged_attend(q, k_pool, v_pool, tables, pos, k_new, v_new,
+                        impl="interpret")
+    poisoned = np.asarray(tables).copy()
+    for b in range(poisoned.shape[0]):
+        nb_live = -(-int(pos[b] + 1) // BT)
+        poisoned[b, nb_live:] = (poisoned[b, 0] + 1) % N_BLOCKS
+    out2 = paged_attend(q, k_pool, v_pool, jnp.asarray(poisoned), pos,
+                        k_new, v_new, impl="interpret")
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_empty_pool_attends_only_new_row():
+    """pos == 0: nothing live in the pool, attention collapses onto the
+    current token's row — softmax of one element is 1, output == v_new."""
+    q, k_pool, v_pool, tables, _, k_new, v_new = _case(5)
+    pos = jnp.zeros(3, dtype=jnp.int32)
+    for impl in ("emulate", "interpret"):
+        out = paged_attend(q, k_pool, v_pool, tables, pos, k_new, v_new,
+                           impl=impl)
+        B, _, H, Hd = q.shape
+        G = H // k_new.shape[1]
+        expect = jnp.repeat(k_new * 0 + v_new, G, axis=1).reshape(B, 1, H, Hd)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_gqa_group_folding():
+    """H == KVH (G=1) and H = 4*KVH both agree with the reference."""
+    for H, KVH in ((2, 2), (8, 2)):
+        case = _case(6, H=H, KVH=KVH)
+        ref = _dense_reference(*case)
+        out = paged_attend(*case, impl="interpret")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_impl_resolution_and_validation():
+    assert paged_attend_impl() in PAGED_IMPLS
+    case = _case(7)
+    with pytest.raises(ValueError, match="impl"):
+        paged_attend(*case, impl="nope")
+
+
+def test_ragged_refusal_vocabulary():
+    class FakeCfg:
+        model_type = "fake"
+
+    class Dense:
+        config = FakeCfg()
+        supports_paged_attend = False
+
+    class Ok:
+        config = FakeCfg()
+        supports_paged_attend = True
+
+    assert "paged-attend" in ragged_refusal(Dense(), 0)
+    assert "quantized" in ragged_refusal(Ok(), 8)
+    assert ragged_refusal(Ok(), 0) is None

@@ -699,9 +699,11 @@ def test_dnetlint_self_run_clean(tmp_path):
     report = json.loads(out.read_text())
     assert report["clean"] is True
     assert report["files_scanned"] > 100
-    # every shipped check ran, including the folded metric passes and the
-    # dsan ownership-registry cross-check
-    for code in [f"DL00{i}" for i in range(1, 10)] + ["DL010", "DL017", "DL018"]:
+    # every shipped check ran, including the folded metric passes, the
+    # dsan ownership-registry cross-check, and the jit-coverage contract
+    for code in [f"DL00{i}" for i in range(1, 10)] + [
+        "DL010", "DL017", "DL018", "DL019", "DL020",
+    ]:
         assert code in report["checks_run"], code
     assert report["findings"] == []
     # the merged runtime-sanitizer section: the full DS catalog is always
