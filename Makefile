@@ -1,0 +1,25 @@
+# dnet-tpu developer targets.  Tier-1 is the pytest command ROADMAP.md
+# pins; the dnetlint targets wrap scripts/dnetlint.py (full run for CI,
+# diff run for the pre-commit hot path — lints only files changed vs
+# HEAD and exits non-zero on any new finding, in seconds not minutes).
+
+PY ?= python
+
+.PHONY: tier1 dnetlint dnetlint-diff dnetlint-report
+
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+dnetlint:
+	$(PY) scripts/dnetlint.py
+
+# pre-commit shape: `make dnetlint-diff` (or with REV=main) — AST-only,
+# changed files only, cross-file context still loaded so results agree
+# with the full run for those files
+REV ?= HEAD
+dnetlint-diff:
+	$(PY) scripts/dnetlint.py --diff $(REV)
+
+dnetlint-report:
+	$(PY) scripts/dnetlint.py --json

@@ -325,14 +325,35 @@ def discover_files(root: Path) -> List[SourceFile]:
     return out
 
 
+def _fingerprint_path(fp: str) -> str:
+    """The path component of a baseline fingerprint
+    (``'DL005 dnet_tpu/x.py:42 message'`` -> ``'dnet_tpu/x.py'``)."""
+    parts = fp.split(" ", 2)
+    if len(parts) < 2:
+        return ""
+    return parts[1].rsplit(":", 1)[0]
+
+
 def run_checks(
     project: Project,
     checks: Sequence[Check],
     baseline: Optional[Dict[str, str]] = None,
+    only_files: Optional[set] = None,
 ) -> Report:
+    """``only_files`` (a set of rel paths) is the ``--diff`` incremental
+    mode: per-file checks run only on those files, project-check findings
+    and baseline staleness are filtered to them — the whole project is
+    still loaded so cross-file checks keep their context, which is what
+    makes a diff run agree with the full run on the files it covers."""
     raw: List[Finding] = []
     meta = Check()  # DL000 emitter
+
+    def in_scope(rel: str) -> bool:
+        return only_files is None or rel in only_files
+
     for src in project.files:
+        if not in_scope(src.rel):
+            continue
         if src.parse_error:
             raw.append(meta.finding(src.rel, 1, src.parse_error))
         for line in src.bad_suppressions:
@@ -343,10 +364,12 @@ def run_checks(
             ))
     for check in checks:
         for src in project.files:
-            if src.tree is None:
+            if src.tree is None or not in_scope(src.rel):
                 continue
             raw.extend(check.run_file(src, project))
-        raw.extend(check.run_project(project))
+        raw.extend(
+            f for f in check.run_project(project) if in_scope(f.path)
+        )
 
     suppressed = 0
     kept: List[Finding] = []
@@ -367,12 +390,15 @@ def run_checks(
             grandfathered.append(f)
         else:
             new.append(f)
-    # staleness is judged only against the checks that actually ran: a
-    # partial run (--select / --ast-only) must not flag entries belonging
-    # to deliberately-skipped checks
+    # staleness is judged only against the checks that actually ran (a
+    # partial run — --select / --ast-only — must not flag entries
+    # belonging to deliberately-skipped checks) and, in diff mode, only
+    # against entries for the files that were actually linted
     run_codes = {c.code for c in checks} | {"DL000"}
     for fp in sorted(set(baseline) - matched_fps):
         if fp.split(" ", 1)[0] not in run_codes:
+            continue
+        if not in_scope(_fingerprint_path(fp)):
             continue
         new.append(meta.finding(
             "<baseline>", 0,
@@ -415,11 +441,13 @@ def run_analysis(
     include_runtime: bool = True,
     baseline_path: Optional[Path] = None,
     ignore_baseline: bool = False,
+    only_files: Optional[set] = None,
 ) -> Report:
     """Full-repo run: discover files under ``root``, apply the baseline.
     ``ignore_baseline=True`` reports every finding as new — the
     ``--write-baseline`` path, so still-firing grandfathered entries are
-    re-captured instead of dropped."""
+    re-captured instead of dropped.  ``only_files`` restricts linting to
+    those rel paths (the ``--diff`` mode; see :func:`run_checks`)."""
     from dnet_tpu.analysis import ALL_CHECKS
 
     selected = list(checks if checks is not None else ALL_CHECKS)
@@ -428,7 +456,39 @@ def run_analysis(
     project = Project(discover_files(root), root=root)
     bp = baseline_path if baseline_path is not None else root / DEFAULT_BASELINE
     baseline = {} if ignore_baseline else load_baseline(bp)
-    return run_checks(project, selected, baseline=baseline)
+    return run_checks(
+        project, selected, baseline=baseline, only_files=only_files
+    )
+
+
+def changed_files(root: Path, rev: str) -> Optional[set]:
+    """Rel paths of ``.py`` files changed vs ``rev`` (working tree diff
+    plus untracked), or None when git cannot answer (not a repo, bad
+    rev) — the caller falls back to a full run rather than linting
+    nothing."""
+    import subprocess
+
+    out: set = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", rev, "--", "*.py"],
+            capture_output=True, text=True, cwd=root, timeout=30,
+        )
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+            capture_output=True, text=True, cwd=root, timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    for line in diff.stdout.splitlines() + (
+        untracked.stdout.splitlines() if untracked.returncode == 0 else []
+    ):
+        rel = line.strip()
+        if rel:
+            out.add(rel)
+    return out
 
 
 def next_report_path(root: Path) -> Path:

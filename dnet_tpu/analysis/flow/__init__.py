@@ -1,0 +1,52 @@
+"""dnet_tpu.analysis.flow — the flow-sensitive dnetlint tier.
+
+An intraprocedural CFG builder (cfg.py), a generic worklist dataflow
+solver with reaching-definitions / liveness / definite-assignment
+instantiations (dataflow.py), a jitted-callable resolution model
+(jitmodel.py), and the five DL021-DL025 checks built on top (checks.py).
+See checks.py's module docstring for the check catalog and the README
+"Flow-sensitive analysis" section for how to read a DL021 trace.
+"""
+
+from dnet_tpu.analysis.flow.cfg import CFG, Node, build_cfg, function_cfgs
+from dnet_tpu.analysis.flow.checks import (
+    FLOW_CHECKS,
+    DonationAfterUse,
+    HostSyncInHotLoop,
+    RetraceHazard,
+    SequentialAwaitFanout,
+    WireDtypeDrift,
+)
+from dnet_tpu.analysis.flow.dataflow import (
+    definitely_assigned,
+    live_names,
+    node_defs,
+    node_uses,
+    reaching_definitions,
+    solve_backward,
+    solve_forward,
+)
+from dnet_tpu.analysis.flow.jitmodel import JitSpec, jit_bindings, resolve_jit_call
+
+__all__ = [
+    "CFG",
+    "Node",
+    "build_cfg",
+    "function_cfgs",
+    "FLOW_CHECKS",
+    "DonationAfterUse",
+    "RetraceHazard",
+    "HostSyncInHotLoop",
+    "SequentialAwaitFanout",
+    "WireDtypeDrift",
+    "JitSpec",
+    "jit_bindings",
+    "resolve_jit_call",
+    "definitely_assigned",
+    "live_names",
+    "node_defs",
+    "node_uses",
+    "reaching_definitions",
+    "solve_backward",
+    "solve_forward",
+]

@@ -1,0 +1,230 @@
+"""Intraprocedural control-flow graphs over stdlib ``ast``.
+
+One :class:`CFG` per function def: statement-granularity nodes connected
+by control edges, built for the flow-sensitive dnetlint passes (DL021+).
+The graph answers the two questions per-node pattern matching cannot:
+"what can execute AFTER this statement" (donation-after-use) and "is this
+statement INSIDE that loop" (hot-loop sync / sequential-await passes).
+
+Design points:
+
+- Nodes are single simple statements or branch anchors.  A compound
+  statement contributes its *header* as a node (``If``/``While`` -> the
+  test, ``For`` -> the iter+target bind) and its body statements as
+  their own nodes — so a finding anchors to a real source line.
+- Loop context is explicit: every node carries the node ids of its
+  enclosing loop headers (innermost last), and back edges are recorded,
+  so "reachable inside this loop" needs no dominator machinery.
+- ``try`` is conservative: every node of the try body gets an edge to
+  every handler entry (any statement may raise), and the ``finally``
+  suite is joined on the normal exit.  That over-approximates paths —
+  exactly what a may-analysis (reaching defs, reachable-use) wants.
+- ``return``/``raise`` edge to the synthetic exit; ``break``/``continue``
+  edge to the loop's after-node/header.  ``raise`` inside a ``try``
+  edges to the handlers instead.
+- Nested function/class defs are opaque single nodes (their bodies are
+  their own CFG's business — same scoping rule as ``scoped_walk``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["CFG", "Node", "build_cfg", "function_cfgs"]
+
+
+@dataclasses.dataclass
+class Node:
+    """One CFG node.  ``stmt`` anchors findings and feeds def/use
+    extraction; for branch anchors it is the governing expression's
+    statement (the ``If``/``While``/``For`` node itself)."""
+
+    idx: int
+    stmt: Optional[ast.AST]
+    kind: str  # 'entry' | 'exit' | 'stmt' | 'branch' | 'loop'
+    succs: List[int] = dataclasses.field(default_factory=list)
+    preds: List[int] = dataclasses.field(default_factory=list)
+    #: enclosing loop-header node ids, innermost last
+    loops: Tuple[int, ...] = ()
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+
+class CFG:
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.nodes: List[Node] = []
+        self.entry = self._new(None, "entry")
+        self.exit = self._new(None, "exit")
+        self.back_edges: Set[Tuple[int, int]] = set()
+
+    # ---- construction helpers ----------------------------------------
+    def _new(self, stmt: Optional[ast.AST], kind: str, loops: Tuple[int, ...] = ()) -> int:
+        node = Node(idx=len(self.nodes), stmt=stmt, kind=kind, loops=loops)
+        self.nodes.append(node)
+        return node.idx
+
+    def _edge(self, a: int, b: int) -> None:
+        if b not in self.nodes[a].succs:
+            self.nodes[a].succs.append(b)
+            self.nodes[b].preds.append(a)
+
+    # ---- queries ------------------------------------------------------
+    def node_for_stmt(self, stmt: ast.AST) -> Optional[Node]:
+        for n in self.nodes:
+            if n.stmt is stmt:
+                return n
+        return None
+
+    def nodes_in_loop(self, header_idx: int) -> List[Node]:
+        return [n for n in self.nodes if header_idx in n.loops]
+
+    def loop_headers(self) -> List[Node]:
+        return [n for n in self.nodes if n.kind == "loop"]
+
+    def reachable_from(self, idx: int) -> Iterable[Node]:
+        """Nodes reachable from ``idx`` (exclusive of it unless cyclic)."""
+        seen: Set[int] = set()
+        stack = list(self.nodes[idx].succs)
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            yield self.nodes[cur]
+            stack.extend(self.nodes[cur].succs)
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST) -> None:
+        self.cfg = CFG(fn)
+        #: headers of the enclosing loops, innermost last
+        self.loop_stack: List[int] = []
+        #: handler entry node ids for each enclosing try (innermost last)
+        self.try_stack: List[List[int]] = []
+        #: loop-header idx -> break-node idxs waiting for the after-loop join
+        self.breaks: Dict[int, List[int]] = {}
+
+    # `frontier` is the set of node ids whose control falls through to
+    # whatever comes next; an empty frontier means the path terminated.
+    def build(self) -> CFG:
+        body = getattr(self.cfg.fn, "body", [])
+        frontier = self._seq(body, [self.cfg.entry])
+        for idx in frontier:
+            self.cfg._edge(idx, self.cfg.exit)
+        return self.cfg
+
+    def _loops(self) -> Tuple[int, ...]:
+        return tuple(self.loop_stack)
+
+    def _stmt_node(self, stmt: ast.stmt, kind: str = "stmt") -> int:
+        idx = self.cfg._new(stmt, kind, self._loops())
+        # any statement under a try may transfer to its handlers
+        for handlers in self.try_stack:
+            for h in handlers:
+                self.cfg._edge(idx, h)
+        return idx
+
+    def _seq(self, stmts: List[ast.stmt], frontier: List[int]) -> List[int]:
+        for stmt in stmts:
+            if not frontier:
+                break  # unreachable code after return/raise/break
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _join(self, frontier: List[int], idx: int) -> None:
+        for f in frontier:
+            self.cfg._edge(f, idx)
+
+    def _stmt(self, stmt: ast.stmt, frontier: List[int]) -> List[int]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            head = self._stmt_node(stmt, "branch")
+            self._join(frontier, head)
+            out = self._seq(stmt.body, [head])
+            out += self._seq(stmt.orelse, [head]) if stmt.orelse else [head]
+            return out
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._stmt_node(stmt, "loop")
+            self._join(frontier, head)
+            self.loop_stack.append(head)
+            body_out = self._seq(stmt.body, [head])
+            self.loop_stack.pop()
+            for idx in body_out:
+                cfg._edge(idx, head)
+                cfg.back_edges.add((idx, head))
+            # the header falls through when the loop doesn't run (or its
+            # test goes false); `else:` runs on that normal exit only
+            normal = [head]
+            if stmt.orelse:
+                normal = self._seq(stmt.orelse, normal)
+            return normal + self.breaks.pop(head, [])
+        if isinstance(stmt, ast.Try):
+            handler_entries: List[int] = []
+            handler_anchors: List[Tuple[ast.ExceptHandler, int]] = []
+            for handler in stmt.handlers:
+                h = self.cfg._new(handler, "branch", self._loops())
+                handler_entries.append(h)
+                handler_anchors.append((handler, h))
+            # a statement can raise BEFORE its own bindings commit, so the
+            # handlers also join the state at the try's ENTRY (each body
+            # node's own handler edge covers mid-body raises; this edge
+            # covers the first statement failing before it binds anything)
+            for f in frontier:
+                for h in handler_entries:
+                    self.cfg._edge(f, h)
+            self.try_stack.append(handler_entries)
+            body_out = self._seq(stmt.body, frontier)
+            self.try_stack.pop()
+            out = self._seq(stmt.orelse, body_out) if stmt.orelse else body_out
+            for handler, h in handler_anchors:
+                out += self._seq(handler.body, [h])
+            if stmt.finalbody:
+                # finally runs on every path; join the normal exits on it
+                fin_in = out
+                out = self._seq(stmt.finalbody, fin_in)
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._stmt_node(stmt, "stmt")
+            self._join(frontier, head)
+            return self._seq(stmt.body, [head])
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            idx = self._stmt_node(stmt)
+            self._join(frontier, idx)
+            cfg._edge(idx, cfg.exit)
+            return []
+        if isinstance(stmt, ast.Break):
+            idx = self._stmt_node(stmt)
+            self._join(frontier, idx)
+            if self.loop_stack:
+                self.breaks.setdefault(self.loop_stack[-1], []).append(idx)
+            return []
+        if isinstance(stmt, ast.Continue):
+            idx = self._stmt_node(stmt)
+            self._join(frontier, idx)
+            if self.loop_stack:
+                head = self.loop_stack[-1]
+                cfg._edge(idx, head)
+                cfg.back_edges.add((idx, head))
+            return []
+        # simple statement (incl. nested def/class: opaque)
+        idx = self._stmt_node(stmt)
+        self._join(frontier, idx)
+        return [idx]
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one ``FunctionDef`` / ``AsyncFunctionDef``."""
+    return _Builder(fn).build()
+
+
+def function_cfgs(tree: ast.AST) -> Iterable[CFG]:
+    """A CFG per function def in the module (nested defs included — each
+    gets its own graph; bodies are opaque to the enclosing graph)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield build_cfg(node)

@@ -1,0 +1,288 @@
+"""Worklist dataflow over :mod:`dnet_tpu.analysis.flow.cfg` graphs.
+
+Three small, composable pieces:
+
+- :func:`node_defs` / :func:`node_uses` — dotted-name def/use extraction
+  for one CFG node, at the granularity the checks reason in (``x``,
+  ``self.kv_store.kv``); subscript/attribute stores on a tracked name
+  count as *uses* of the base object, not kills (mutating a donated
+  buffer is a read of freed memory, not a rebind).
+- :func:`solve_forward` / :func:`solve_backward` — generic worklist
+  solvers over set-valued facts with a pluggable join (union = may,
+  intersection = must).
+- :func:`reaching_definitions`, :func:`live_names`,
+  :func:`definitely_assigned` — the three instantiations the DL021-025
+  passes use, exposed for the CFG/solver unit tests.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from dnet_tpu.analysis.core import dotted
+from dnet_tpu.analysis.flow.cfg import CFG, Node
+
+__all__ = [
+    "node_defs",
+    "node_uses",
+    "solve_forward",
+    "solve_backward",
+    "reaching_definitions",
+    "live_names",
+    "definitely_assigned",
+]
+
+_OPAQUE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _walk_shallow(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk without descending into nested function/class scopes."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, _OPAQUE) and cur is not node:
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def anchor_roots(stmt: Optional[ast.AST]) -> List[ast.AST]:
+    """The expressions a node actually *evaluates*: a branch anchor
+    evaluates only its test/iter/context items, NOT its body — the body's
+    statements are their own CFG nodes, and double-scanning them here
+    would smear their defs/uses onto the header."""
+    if stmt is None:
+        return []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.ExceptHandler):
+        return [stmt.type] if stmt.type is not None else []
+    return [stmt]
+
+
+_COMPOUND = (
+    ast.If, ast.While, ast.For, ast.AsyncFor, ast.With, ast.AsyncWith,
+    ast.ExceptHandler,
+)
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    """Names *bound* (killed) by an assignment target.  Only plain names
+    and exact dotted chains rebind; ``x[i] = v`` / ``x.attr[i] = v``
+    mutate, which is a use of ``x``, not a kill."""
+    out: Set[str] = set()
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            out |= _target_names(elt)
+    elif isinstance(target, ast.Starred):
+        out |= _target_names(target.value)
+    elif isinstance(target, (ast.Name, ast.Attribute)):
+        d = dotted(target)
+        if d:
+            out.add(d)
+    return out
+
+
+def node_defs(node: Node) -> Set[str]:
+    """Dotted names this node (re)binds."""
+    stmt = node.stmt
+    out: Set[str] = set()
+    if stmt is None:
+        return out
+    if isinstance(stmt, _COMPOUND):
+        # only the header's own bindings: for-targets, with-as names, the
+        # except name, and walrus bindings inside the evaluated exprs —
+        # the body's assignments belong to the body's own nodes
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            out |= _target_names(stmt.target)
+        elif isinstance(stmt, ast.ExceptHandler) and stmt.name:
+            out.add(stmt.name)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    out |= _target_names(item.optional_vars)
+        for root in anchor_roots(stmt):
+            for sub in _walk_shallow(root):
+                if isinstance(sub, ast.NamedExpr):
+                    out |= _target_names(sub.target)
+        return out
+    for sub in _walk_shallow(stmt):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                out |= _target_names(t)
+        elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+            out |= _target_names(sub.target)
+        elif isinstance(sub, ast.NamedExpr):
+            out |= _target_names(sub.target)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            out.add(sub.name)
+        elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+            for alias in sub.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+    return out
+
+
+def node_uses(node: Node) -> Set[str]:
+    """Dotted names this node reads.  Every prefix of a read chain counts
+    (``self.kv_store.kv`` uses ``self.kv_store.kv`` AND ``self.kv_store``)
+    so a taint on either level is seen; AugAssign targets and
+    subscript/attribute stores read their base."""
+    out: Set[str] = set()
+
+    def add_chain(d: str) -> None:
+        parts = d.split(".")
+        for i in range(1, len(parts) + 1):
+            out.add(".".join(parts[:i]))
+
+    for root in anchor_roots(node.stmt):
+        for sub in _walk_shallow(root):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                add_chain(sub.id)
+            elif isinstance(sub, ast.Attribute) and isinstance(
+                sub.ctx, ast.Load
+            ):
+                d = dotted(sub)
+                if d:
+                    add_chain(d)
+            elif isinstance(sub, ast.AugAssign):
+                d = dotted(sub.target)
+                if d:
+                    add_chain(d)
+            elif isinstance(sub, (ast.Subscript, ast.Attribute)) and isinstance(
+                sub.ctx, ast.Store
+            ):
+                d = dotted(sub.value)
+                if d:
+                    add_chain(d)  # mutating store: reads the base object
+    return out
+
+
+Fact = FrozenSet
+_Transfer = Callable[[Node, FrozenSet], FrozenSet]
+_Join = Callable[[List[FrozenSet]], FrozenSet]
+
+
+def _solve(
+    cfg: CFG,
+    transfer: _Transfer,
+    join: _Join,
+    init: FrozenSet,
+    boundary: FrozenSet,
+    forward: bool,
+) -> Tuple[Dict[int, FrozenSet], Dict[int, FrozenSet]]:
+    """Generic worklist fixpoint.  Returns ``(in_facts, out_facts)`` by
+    node idx (for backward problems "in" is still the pre-transfer side,
+    i.e. facts at node exit)."""
+    if forward:
+        start, edges_in = cfg.entry, lambda n: n.preds
+    else:
+        start, edges_in = cfg.exit, lambda n: n.succs
+    in_f: Dict[int, FrozenSet] = {n.idx: init for n in cfg.nodes}
+    out_f: Dict[int, FrozenSet] = {n.idx: init for n in cfg.nodes}
+    in_f[start] = boundary
+    out_f[start] = transfer(cfg.nodes[start], boundary)
+    work = [n.idx for n in cfg.nodes]
+    while work:
+        idx = work.pop(0)
+        node = cfg.nodes[idx]
+        preds = edges_in(node)
+        if preds:
+            in_f[idx] = join([out_f[p] for p in preds])
+        elif idx != start:
+            in_f[idx] = join([])
+        new_out = transfer(node, in_f[idx])
+        if new_out != out_f[idx]:
+            out_f[idx] = new_out
+            nxt = node.succs if forward else node.preds
+            for s in nxt:
+                if s not in work:
+                    work.append(s)
+    return in_f, out_f
+
+
+def solve_forward(cfg, transfer, join, init=frozenset(), boundary=frozenset()):
+    return _solve(cfg, transfer, join, init, boundary, forward=True)
+
+
+def solve_backward(cfg, transfer, join, init=frozenset(), boundary=frozenset()):
+    return _solve(cfg, transfer, join, init, boundary, forward=False)
+
+
+def _union(facts: List[FrozenSet]) -> FrozenSet:
+    out: Set = set()
+    for f in facts:
+        out |= f
+    return frozenset(out)
+
+
+def reaching_definitions(cfg: CFG) -> Dict[int, FrozenSet]:
+    """May-analysis: ``in[n]`` = set of ``(name, def_node_idx)`` pairs
+    that can reach node ``n``.  A def of ``x`` kills every other def of
+    ``x`` (exact-name kill — see :func:`_target_names`)."""
+
+    def transfer(node: Node, facts: FrozenSet) -> FrozenSet:
+        defs = node_defs(node)
+        if not defs:
+            return facts
+        kept = {(n, d) for (n, d) in facts if n not in defs}
+        kept |= {(n, node.idx) for n in defs}
+        return frozenset(kept)
+
+    in_f, _ = solve_forward(cfg, transfer, _union)
+    return in_f
+
+
+def live_names(cfg: CFG) -> Dict[int, FrozenSet]:
+    """Backward may-analysis: names live (read later on some path) at
+    each node's exit."""
+
+    def transfer(node: Node, facts: FrozenSet) -> FrozenSet:
+        return frozenset((facts - node_defs(node)) | node_uses(node))
+
+    in_f, _ = solve_backward(cfg, transfer, _union)
+    return in_f
+
+
+def definitely_assigned(
+    cfg: CFG, within: Optional[Set[int]] = None, start: Optional[int] = None
+) -> Dict[int, FrozenSet]:
+    """Must-analysis: names assigned on EVERY path from ``start``
+    (default: entry) to each node's entry.  With ``within`` (a node-id
+    region, e.g. one loop body), paths are confined to the region — the
+    loop-carried-dependency test for DL024: a name NOT definitely
+    assigned before its use inside the body may flow in from a previous
+    iteration."""
+    region = within if within is not None else {n.idx for n in cfg.nodes}
+    start = start if start is not None else cfg.entry
+    universe = frozenset().union(*(node_defs(n) for n in cfg.nodes)) or frozenset()
+
+    def inter(facts: List[FrozenSet]) -> FrozenSet:
+        if not facts:
+            return universe  # unreached: vacuously all-assigned
+        out = facts[0]
+        for f in facts[1:]:
+            out &= f
+        return out
+
+    in_f: Dict[int, FrozenSet] = {n.idx: universe for n in cfg.nodes}
+    out_f: Dict[int, FrozenSet] = {n.idx: universe for n in cfg.nodes}
+    in_f[start] = frozenset()
+    out_f[start] = frozenset(node_defs(cfg.nodes[start]))
+    work = [i for i in region if i != start]
+    while work:
+        idx = work.pop(0)
+        node = cfg.nodes[idx]
+        preds = [p for p in node.preds if p in region]
+        in_f[idx] = inter([out_f[p] for p in preds]) if preds else universe
+        new_out = frozenset(in_f[idx] | node_defs(node))
+        if new_out != out_f[idx]:
+            out_f[idx] = new_out
+            for s in node.succs:
+                if s in region and s not in work:
+                    work.append(s)
+    return in_f

@@ -123,10 +123,13 @@ class RingFailureMonitor:
             except Exception:
                 log.exception("failure monitor task died during stop")
         clients, self._clients = self._clients, {}
-        for c in clients.values():
-            try:
-                await c.close()
-            except Exception as exc:
+        # independent channel closes: one slow/broken channel must not
+        # serialize the rest of shutdown behind its close handshake
+        outcomes = await asyncio.gather(
+            *(c.close() for c in clients.values()), return_exceptions=True
+        )
+        for exc in outcomes:
+            if isinstance(exc, Exception):
                 log.debug("channel close failed during stop: %s", exc)
 
     # ---- state ----------------------------------------------------------
@@ -217,11 +220,15 @@ class RingFailureMonitor:
         await self._probe_quarantine()
 
     async def _prune_clients(self, keep: set) -> None:
-        for addr in set(self._clients) - keep:
-            client = self._clients.pop(addr)
-            try:
-                await client.close()
-            except Exception as exc:
+        stale = [
+            (addr, self._clients.pop(addr))
+            for addr in set(self._clients) - keep
+        ]
+        outcomes = await asyncio.gather(
+            *(client.close() for _, client in stale), return_exceptions=True
+        )
+        for (addr, _), exc in zip(stale, outcomes):
+            if isinstance(exc, Exception):
                 log.debug("pruned channel close failed for %s: %s", addr, exc)
 
     # ---- failure handling -------------------------------------------------

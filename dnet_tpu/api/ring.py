@@ -139,9 +139,16 @@ class RingApiAdapter(ApiAdapterBase):
         if self._streams:
             await self._streams.shutdown()
             self._streams = None
-        for client in self._shard_clients.values():
-            await client.close()
+        # per-shard channels close concurrently (independent teardown);
+        # a failed close still surfaces, after every close was attempted
+        outcomes = await asyncio.gather(
+            *(c.close() for c in self._shard_clients.values()),
+            return_exceptions=True,
+        )
         self._shard_clients = {}
+        for exc in outcomes:
+            if isinstance(exc, Exception):
+                raise exc
         if self._head_client is not None:
             await self._head_client.close()
             self._head_client = None

@@ -201,14 +201,17 @@ class RingModelManager:
             changed, unchanged = dict(bodies), {}
 
         async with httpx.AsyncClient(timeout=self.request_timeout_s) as client:
-            for a in topo.assignments:
+
+            async def ship(a) -> None:
+                """One shard's load leg: cheap delta first where eligible,
+                full /load_model otherwise."""
                 dev = by_instance[a.instance]
                 body = bodies[a.instance]
                 if a.instance in unchanged:
                     if await self._update_topology(client, dev, body):
                         # stored signature already equals this body's (that
                         # is what `unchanged` means) — nothing to re-store
-                        continue
+                        return
                     # the shard could not prove it still holds the
                     # weights (restart while quarantined, different
                     # model): full load for this shard alone
@@ -226,6 +229,19 @@ class RingModelManager:
                         f"shard {a.instance} load failed ({r.status_code}): {r.text}"
                     )
                 self._last_load[a.instance] = body_signature(body)
+
+            # shards load concurrently: weight reads are the dominant cost
+            # and are independent per shard, so wall time is the slowest
+            # shard instead of the sum.  Every leg runs to completion
+            # (return_exceptions) so one failed shard cannot strand its
+            # peers' signature bookkeeping mid-flight; the first failure
+            # then surfaces exactly like the old sequential loop's raise.
+            outcomes = await asyncio.gather(
+                *(ship(a) for a in topo.assignments), return_exceptions=True
+            )
+            for exc in outcomes:
+                if isinstance(exc, BaseException):
+                    raise exc
 
         # tokenizer API-side (reference model_manager.py:169-182)
         tokenizer = load_tokenizer(model_dir)
@@ -418,9 +434,13 @@ class RingModelManager:
             return
         by_instance = {d.instance: d for d in topo.devices}
         async with httpx.AsyncClient(timeout=60.0) as client:
-            for a in topo.assignments:
+
+            async def drop(a) -> None:
                 dev = by_instance[a.instance]
                 try:
                     await client.post(f"http://{dev.host}:{dev.http_port}/unload_model")
                 except httpx.HTTPError as exc:
                     log.warning("unload on %s failed: %s", a.instance, exc)
+
+            # independent per-shard unloads: fan out, don't serialize
+            await asyncio.gather(*(drop(a) for a in topo.assignments))

@@ -96,9 +96,16 @@ class RingAdapter:
         if self._next_client is not None:
             await self._next_client.close()
             self._next_client = None
-        for client in self._cb_clients.values():
-            await client.close()
+        # callback channels are independent: close them all at once so a
+        # wedged channel cannot stall the topology reset behind it
+        outcomes = await asyncio.gather(
+            *(c.close() for c in self._cb_clients.values()),
+            return_exceptions=True,
+        )
         self._cb_clients.clear()
+        for exc in outcomes:
+            if isinstance(exc, Exception):
+                raise exc
         self._seen.clear()
         self.next_addr = ""
 
@@ -305,6 +312,7 @@ class RingAdapter:
         # a verify block's additionally accepted tokens (ring speculation):
         # one callback per step, in step order behind the primary
         for step, token_id in msg.extra_finals or ():
+            # dnetlint: disable=DL024 spec finals are one token stream: arrival in step order is the driver contract, not an independent fan-out
             await self._cb_send(
                 client,
                 TokenPayload(

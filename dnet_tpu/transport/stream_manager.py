@@ -184,10 +184,13 @@ class StreamManager:
             for n, c in self._streams.items()
             if now - c.last_used > self._idle_timeout_s
         ]
-        for nonce in stale:
-            await self.end_stream(nonce)
+        # stale streams are independent: half-close them all concurrently
+        # (end_stream pops under the lock per nonce, so parallel ends on
+        # distinct nonces cannot race each other)
+        await asyncio.gather(*(self.end_stream(n) for n in stale))
         return len(stale)
 
     async def shutdown(self) -> None:
-        for nonce in list(self._streams):
-            await self.end_stream(nonce)
+        await asyncio.gather(
+            *(self.end_stream(n) for n in list(self._streams))
+        )

@@ -7,6 +7,9 @@ Usage::
     python scripts/dnetlint.py                  # full run, exit 1 on findings
     python scripts/dnetlint.py --ast-only       # skip runtime metric passes
     python scripts/dnetlint.py --select DL006   # one check
+    python scripts/dnetlint.py --diff HEAD      # only files changed vs HEAD
+                                                # (pre-commit mode: AST-only,
+                                                # exit 1 on new findings)
     python scripts/dnetlint.py --json           # also write ANALYSIS_r<NN>.json
     python scripts/dnetlint.py --json out.json  # ...to an explicit path
     python scripts/dnetlint.py --write-baseline # grandfather current findings
@@ -41,6 +44,7 @@ def main(argv=None) -> int:
         write_baseline,
         write_report_json,
     )
+    from dnet_tpu.analysis.core import changed_files
 
     ap = argparse.ArgumentParser(
         prog="dnetlint", description=__doc__,
@@ -49,7 +53,14 @@ def main(argv=None) -> int:
     ap.add_argument("--ast-only", action="store_true",
                     help="skip runtime passes (DL010+); pure-AST run")
     ap.add_argument("--select", default="",
-                    help="comma-separated DL codes to run (default: all)")
+                    help="comma-separated DL codes to run (default: all); "
+                         "unknown codes are an error (exit 2)")
+    ap.add_argument("--diff", metavar="REV", default=None,
+                    help="lint only .py files changed vs REV (working tree "
+                         "+ untracked, via git); implies --ast-only — the "
+                         "fast pre-commit mode.  Cross-file checks still "
+                         "see the whole tree, so diff findings agree with "
+                         "a full run's for the same files")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline path (default: {DEFAULT_BASELINE})")
     ap.add_argument("--write-baseline", action="store_true",
@@ -77,8 +88,17 @@ def main(argv=None) -> int:
     checks = ALL_CHECKS
     if args.select:
         wanted = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+        known = {c.code for c in ALL_CHECKS}
+        unknown = sorted(wanted - known)
+        if unknown:
+            print(
+                f"dnetlint: unknown check code(s) {', '.join(unknown)}; "
+                f"known codes: {', '.join(sorted(known))}",
+                file=sys.stderr,
+            )
+            return 2
         checks = [c for c in ALL_CHECKS if c.code in wanted]
-    if args.ast_only:
+    if args.ast_only or args.diff is not None:
         checks = [c for c in checks if not c.requires_runtime]
     if not checks:
         print(f"dnetlint: no checks left to run (--select {args.select!r}"
@@ -86,15 +106,36 @@ def main(argv=None) -> int:
               f"a green no-op", file=sys.stderr)
         return 2
 
+    if args.diff is not None and args.write_baseline:
+        # a diff run sees only the changed files' findings (and no
+        # runtime passes); writing that partial set would silently
+        # truncate every other file's grandfathered entries
+        print("dnetlint: --write-baseline needs a full run; drop --diff",
+              file=sys.stderr)
+        return 2
+
+    only_files = None
+    if args.diff is not None:
+        only_files = changed_files(REPO, args.diff)
+        if only_files is None:
+            print(
+                f"dnetlint: git diff vs {args.diff!r} failed; falling back "
+                f"to a full run", file=sys.stderr,
+            )
+        elif not only_files:
+            print(f"dnetlint: no .py changes vs {args.diff} — nothing to lint")
+            return 0
+
     baseline_path = (
         Path(args.baseline) if args.baseline else REPO / DEFAULT_BASELINE
     )
     report = run_analysis(
         REPO,
         checks=checks,
-        include_runtime=not args.ast_only,
+        include_runtime=not (args.ast_only or args.diff is not None),
         baseline_path=baseline_path,
         ignore_baseline=args.write_baseline,
+        only_files=only_files,
     )
 
     if args.write_baseline:
@@ -117,10 +158,14 @@ def main(argv=None) -> int:
         write_report_json(report, out, extra={"runtime": runtime_section(REPO)})
         if not args.quiet:
             print(f"dnetlint: report written to {out}")
+    scope = (
+        f" ({len(only_files)} changed file(s) vs {args.diff})"
+        if only_files is not None else ""
+    )
     summary = (
         f"dnetlint: {len(report.findings)} finding(s) "
         f"({len(report.baselined)} baselined, {report.suppressed} "
-        f"suppressed) over {report.files_scanned} files, "
+        f"suppressed) over {report.files_scanned} files{scope}, "
         f"{len(report.checks_run)} checks"
     )
     print(summary)

@@ -1,19 +1,25 @@
 """Tier-1 hook + fixture suite for the static-analysis framework
 (dnet_tpu/analysis/, CLI scripts/dnetlint.py).
 
-Three layers:
+Four layers:
 
-1. **Per-check fixtures** — for every AST check DL001-DL009, a known-bad
-   snippet must fire with the right code and line, and a known-good
-   snippet must stay quiet.  Fixtures run through the same
-   ``analyze_texts`` entry the full runner uses (suppressions applied,
-   runtime checks excluded).
-2. **Framework mechanics** — suppression syntax (trailing, standalone,
+1. **Per-check fixtures** — for every AST check DL001-DL009 and the
+   flow-sensitive tier DL021-DL025, a known-bad snippet must fire with
+   the right code and line, and a known-good snippet must stay quiet.
+   Fixtures run through the same ``analyze_texts`` entry the full runner
+   uses (suppressions applied, runtime checks excluded).
+2. **CFG / dataflow mechanics** — branch join, loop back-edge, and
+   try/except edges in the flow tier's graphs and solvers
+   (dnet_tpu/analysis/flow/).
+3. **Framework mechanics** — suppression syntax (trailing, standalone,
    reason-mandatory), baseline round trip (write -> rerun clean -> stale
-   entry fails), deterministic finding order.
-3. **Self-run wrapper** — ``python scripts/dnetlint.py --json`` over THIS
+   entry fails), deterministic finding order, ``--select`` validation,
+   ``--diff`` incremental mode.
+4. **Self-run wrapper** — ``python scripts/dnetlint.py --json`` over THIS
    repo must exit 0 (empty-or-justified baseline is an acceptance
-   criterion), which also folds the metric passes (DL010+) into tier-1.
+   criterion), which also folds the metric passes (DL010+) into tier-1 —
+   plus seeded negative controls that inject one violation into the real
+   hot files and demand exactly the expected DL021/DL022/DL023 finding.
 """
 
 from __future__ import annotations
@@ -679,8 +685,9 @@ def test_check_codes_unique_and_documented():
         assert c.code not in seen, f"duplicate check code {c.code}"
         seen.add(c.code)
         assert c.description, f"{c.code} has no description"
-    for required in [f"DL00{i}" for i in range(1, 9)]:
-        assert required in seen
+    # the full 25-check catalog: DL001-DL009 (AST), DL010-DL020 (runtime
+    # metric passes), DL021-DL025 (flow-sensitive tier)
+    assert seen == {f"DL{i:03d}" for i in range(1, 26)}
 
 
 # ---- tier-1 self-run wrapper ----------------------------------------------
@@ -699,12 +706,12 @@ def test_dnetlint_self_run_clean(tmp_path):
     report = json.loads(out.read_text())
     assert report["clean"] is True
     assert report["files_scanned"] > 100
-    # every shipped check ran, including the folded metric passes, the
-    # dsan ownership-registry cross-check, and the jit-coverage contract
-    for code in [f"DL00{i}" for i in range(1, 10)] + [
-        "DL010", "DL017", "DL018", "DL019", "DL020",
-    ]:
-        assert code in report["checks_run"], code
+    # the FULL 25-check catalog ran: DL001-DL009 AST, DL010-DL020 runtime
+    # metric passes, DL021-DL025 flow-sensitive tier — a check cannot
+    # silently fall out of the suite
+    assert sorted(report["checks_run"]) == [
+        f"DL{i:03d}" for i in range(1, 26)
+    ]
     assert report["findings"] == []
     # the merged runtime-sanitizer section: the full DS catalog is always
     # present (dashboards rely on the shape) and this unsanitized run
@@ -757,3 +764,677 @@ def test_dnetlint_detects_seeded_violation(tmp_path):
     report = run_analysis(root, include_runtime=False)
     assert not report.clean
     assert codes(report.findings) == ["DL001"]
+
+
+# ---- CFG / dataflow mechanics (flow tier) ----------------------------------
+
+import ast  # noqa: E402
+
+from dnet_tpu.analysis.flow import (  # noqa: E402
+    FLOW_CHECKS,
+    build_cfg,
+    definitely_assigned,
+    jit_bindings,
+    live_names,
+    reaching_definitions,
+)
+
+
+def _cfg_of(src_text: str):
+    fn = ast.parse(src_text).body[0]
+    return build_cfg(fn)
+
+
+def _node_at(cfg, line: int):
+    hits = [n for n in cfg.nodes if n.line == line]
+    assert hits, f"no CFG node at line {line}"
+    return hits[0]
+
+
+def test_cfg_branch_join_reaching_defs():
+    """Both arms' defs of x reach the statement after the join."""
+    cfg = _cfg_of(
+        "def f(c):\n"
+        "    if c:\n"       # 2
+        "        x = 1\n"   # 3
+        "    else:\n"
+        "        x = 2\n"   # 5
+        "    return x\n"    # 6
+    )
+    reach = reaching_definitions(cfg)
+    use = _node_at(cfg, 6)
+    def_lines = {
+        cfg.nodes[i].line for (name, i) in reach[use.idx] if name == "x"
+    }
+    assert def_lines == {3, 5}
+    # and x is definitely assigned at the join (both arms bind it)
+    assert "x" in definitely_assigned(cfg)[use.idx]
+
+
+def test_cfg_branch_without_else_not_definite():
+    cfg = _cfg_of(
+        "def f(c):\n"
+        "    if c:\n"
+        "        x = 1\n"
+        "    return x\n"  # 4
+    )
+    assert "x" not in definitely_assigned(cfg)[_node_at(cfg, 4).idx]
+
+
+def test_cfg_loop_back_edge():
+    """A def at the loop bottom reaches a use at the loop top via the
+    back edge — the edge per-node AST matching cannot see."""
+    cfg = _cfg_of(
+        "def f(xs):\n"
+        "    acc = 0\n"          # 2
+        "    for x in xs:\n"     # 3
+        "        use(acc)\n"     # 4
+        "        acc = step(x)\n"  # 5
+        "    return acc\n"       # 6
+    )
+    assert cfg.back_edges, "loop produced no back edge"
+    reach = reaching_definitions(cfg)
+    use = _node_at(cfg, 4)
+    def_lines = {
+        cfg.nodes[i].line for (name, i) in reach[use.idx] if name == "acc"
+    }
+    assert def_lines == {2, 5}  # initial def AND the previous iteration's
+    # liveness: acc is live at the loop header's exit (read at line 4)
+    live = live_names(cfg)
+    assert "acc" in live[_node_at(cfg, 3).idx]
+
+
+def test_cfg_try_except_edges():
+    """Any statement of a try body may raise: its IN-facts flow to the
+    handler, so a def before the failing point reaches the except."""
+    cfg = _cfg_of(
+        "def f():\n"
+        "    try:\n"
+        "        x = open()\n"   # 3
+        "        y = x.read()\n"  # 4
+        "    except Exception:\n"  # 5
+        "        return x\n"     # 6
+        "    return y\n"         # 7
+    )
+    reach = reaching_definitions(cfg)
+    handler_use = _node_at(cfg, 6)
+    names = {name for (name, _) in reach[handler_use.idx]}
+    assert "x" in names
+    # but x is NOT definitely assigned in the handler (line 3 itself may
+    # have raised before binding)
+    assert "x" not in definitely_assigned(cfg)[handler_use.idx]
+    # normal exit: y is definitely assigned at line 7
+    assert "y" in definitely_assigned(cfg)[_node_at(cfg, 7).idx]
+
+
+def test_cfg_break_terminates_path():
+    cfg = _cfg_of(
+        "def f(xs):\n"
+        "    for x in xs:\n"   # 2
+        "        if x:\n"      # 3
+        "            y = 1\n"  # 4
+        "            break\n"  # 5
+        "    return y\n"       # 6
+    )
+    reach = reaching_definitions(cfg)
+    use = _node_at(cfg, 6)
+    assert any(name == "y" for (name, _) in reach[use.idx])
+    assert "y" not in definitely_assigned(cfg)[use.idx]
+
+
+def test_jit_bindings_resolution():
+    """The jit model resolves wrappers, factories, and scoped locals."""
+    from dnet_tpu.analysis import SourceFile as SF
+
+    src = SF("dnet_tpu/ops/m.py", (
+        "import jax\n"
+        "from functools import partial\n"
+        "def step(kv, x):\n"
+        "    return kv\n"
+        "class E:\n"
+        "    def build(self):\n"
+        "        self._step = instrument_jit(\n"
+        "            jax.jit(step, donate_argnums=(0,)), 'batched_step')\n"
+        "    def chunk_fn(self, R):\n"
+        "        fn = jax.jit(step, donate_argnums=(0, 1))\n"
+        "        return fn\n"
+        "def fac_a():\n"
+        "    jitted = jax.jit(step, donate_argnums=(0,))\n"
+        "    return jitted\n"
+        "def fac_b():\n"
+        "    jitted = jax.jit(step, donate_argnums=(1,))\n"
+        "    return jitted\n"
+    ))
+    b = jit_bindings(src)
+    assert b["self._step"].donate == (0,)
+    assert b["self._step"].label == "batched_step"
+    assert b["self.chunk_fn()"].donate == (0, 1)
+    # per-function scoping: the two factories' `jitted` locals don't collide
+    assert b["fac_a:jitted"].donate == (0,)
+    assert b["fac_b:jitted"].donate == (1,)
+
+
+# ---- DL021 donation-after-use ---------------------------------------------
+
+_OPS = "dnet_tpu/ops/fixture_mod.py"
+
+
+def test_dl021_fires_on_read_after_donation():
+    fs = findings_for(
+        "import jax\n"
+        "def step(kv, x):\n"
+        "    return kv\n"
+        "fn = jax.jit(step, donate_argnums=(0,))\n"
+        "def drive(self, x):\n"
+        "    out = fn(self.kv, x)\n"
+        "    return self.kv.sum() + out\n",  # line 7: stale read
+        rel=_OPS,
+    )
+    assert codes(fs) == ["DL021"] and fs[0].line == 7
+    assert "donated" in fs[0].message
+
+
+def test_dl021_fires_on_one_branch_only():
+    """Flow-sensitivity: only the path that reads without a rebind fires."""
+    fs = findings_for(
+        "import jax\n"
+        "def step(kv):\n"
+        "    return kv\n"
+        "fn = jax.jit(step, donate_argnums=(0,))\n"
+        "def drive(self, c):\n"
+        "    out = fn(self.kv)\n"
+        "    if c:\n"
+        "        self.kv = out\n"
+        "    return self.kv\n",  # reachable with the stale name when not c
+        rel=_OPS,
+    )
+    assert codes(fs) == ["DL021"] and fs[0].line == 9
+
+
+def test_dl021_fires_on_loop_without_rebind():
+    fs = findings_for(
+        "import jax\n"
+        "def step(kv):\n"
+        "    return kv\n"
+        "fn = jax.jit(step, donate_argnums=(0,))\n"
+        "def drive(self, xs):\n"
+        "    for x in xs:\n"
+        "        out = fn(self.kv)\n"  # next iteration re-reads the corpse
+        "    return out\n",
+        rel=_OPS,
+    )
+    assert codes(fs) == ["DL021"] and fs[0].line == 7
+
+
+def test_dl021_quiet_on_donate_and_rebind():
+    """The sanctioned idiom: the calling statement rebinds the donated
+    name — every subsequent read sees the fresh buffer."""
+    fs = findings_for(
+        "import jax\n"
+        "def step(kv, x):\n"
+        "    return kv, x\n"
+        "fn = jax.jit(step, donate_argnums=(0,))\n"
+        "def drive(self, x):\n"
+        "    self.kv, y = fn(self.kv, x)\n"
+        "    out = fn(self.kv, y)\n"
+        "    self.kv = out[0]\n"
+        "    return self.kv\n",
+        rel=_OPS,
+    )
+    assert fs == []
+
+
+def test_dl021_quiet_on_starred_args_rebind():
+    """The *args idiom from core/batch.py: the donated position resolves
+    through the local tuple, and the same-statement rebind stays quiet."""
+    fs = findings_for(
+        "import jax\n"
+        "def step(wp, kv, keys):\n"
+        "    return kv, keys\n"
+        "fn = jax.jit(step, donate_argnums=(1, 2))\n"
+        "def drive(self, wp):\n"
+        "    args = (wp, self.kv_store.kv, self.keys)\n"
+        "    pool, self.keys = fn(*args)\n"
+        "    self.kv_store.kv = pool\n"
+        "    return self.kv_store.kv\n",
+        rel=_OPS,
+    )
+    assert fs == []
+
+
+def test_dl021_real_batch_engine_rebind_idiom_is_quiet():
+    """The live donate-and-rebind sites in core/batch.py (the ragged
+    chunk's donated pool rebound via `self.kv_store.kv = pool`) must stay
+    quiet — they are the sanctioned pattern the check's message points
+    at."""
+    text = (REPO / "dnet_tpu" / "core" / "batch.py").read_text()
+    fs = analyze_texts({"dnet_tpu/core/batch.py": text}, checks=FLOW_CHECKS)
+    assert [f for f in fs if f.code == "DL021"] == []
+
+
+# ---- DL022 retrace hazards ------------------------------------------------
+
+
+def test_dl022_fires_on_shape_scalar_and_literal():
+    fs = findings_for(
+        "import jax\n"
+        "def step(x, n, w):\n"
+        "    return x * n * w\n"
+        "fn = jax.jit(step)\n"
+        "def drive(x):\n"
+        "    return fn(x, x.shape[0], 4)\n",
+        rel=_OPS,
+    )
+    assert codes(fs) == ["DL022", "DL022"]
+    assert ".shape-derived" in fs[0].message
+    assert "Python literal" in fs[1].message
+
+
+def test_dl022_quiet_on_static_position_and_wrapped_scalar():
+    fs = findings_for(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def step(x, n, w):\n"
+        "    return x * n * w\n"
+        "fn = jax.jit(step, static_argnums=(2,))\n"
+        "def drive(x):\n"
+        "    return fn(x, jnp.int32(x.shape[0]), 4)\n",  # static: fine
+        rel=_OPS,
+    )
+    assert fs == []
+
+
+def test_dl022_fires_on_kwarg_drift():
+    fs = findings_for(
+        "import jax\n"
+        "fn = jax.jit(external_step)\n"
+        "def a(x):\n"
+        "    return fn(x)\n"
+        "def b(x, m):\n"
+        "    return fn(x, mode=m)\n",  # line 6: kwarg set differs
+        rel=_OPS,
+    )
+    assert codes(fs) == ["DL022"] and fs[0].line == 6
+    assert "drifts" in fs[0].message
+
+
+def test_dl022_nested_scope_resolves_inner_args_tuple():
+    """Regression: a call inside a nested def must resolve its *args
+    splat against the NESTED scope's tuple (an outer tuple of the same
+    name must not shadow it into unresolvability)."""
+    fs = findings_for(
+        "import jax\n"
+        "fn = jax.jit(external_step)\n"
+        "def outer(x):\n"
+        "    args = (x, 1)\n"
+        "    def inner(y):\n"
+        "        args = (y, y.shape[0])\n"
+        "        return fn(*args)\n"
+        "    return inner\n",
+        rel=_OPS,
+    )
+    assert codes(fs) == ["DL022"]
+    assert ".shape-derived" in fs[0].message
+
+
+def test_dl022_kwarg_drift_does_not_taint_absorbed_arity():
+    """Regression: one kwarg-drifting site must not make a
+    default-absorbed arity difference at ANOTHER site a finding."""
+    fs = findings_for(
+        "import jax\n"
+        "def step(x, y, kinds=None):\n"
+        "    return x\n"
+        "fn = jax.jit(step)\n"
+        "def a(x, y):\n"
+        "    return fn(x, y)\n"
+        "def b(x, y, k):\n"
+        "    return fn(x, y, k)\n"       # absorbed by the default: quiet
+        "def c(x, y, m):\n"
+        "    return fn(x, y, mode=m)\n",  # line 10: kwarg drift fires
+        rel=_OPS,
+    )
+    assert codes(fs) == ["DL022"] and fs[0].line == 10
+    assert "keywords" in fs[0].message
+
+
+def test_cli_rejects_diff_with_write_baseline():
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--diff", "HEAD", "--write-baseline"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "needs a full run" in proc.stderr
+
+
+def test_dl022_quiet_when_optional_param_absorbs_arity():
+    """core/engine.py's _hidden pattern: 5- and 6-arg sites of a callee
+    with a defaulted trailing param are one contract, not drift."""
+    fs = findings_for(
+        "import jax\n"
+        "def step(wp, x, kv, pos, t, kinds=None):\n"
+        "    return x\n"
+        "fn = jax.jit(step, donate_argnums=(2,))\n"
+        "def a(self, wp, x, pos, t):\n"
+        "    self.kv = fn(wp, x, self.kv, pos, t)\n"
+        "def b(self, wp, x, pos, t, kinds):\n"
+        "    self.kv = fn(wp, x, self.kv, pos, t, kinds)\n",
+        rel=_OPS,
+    )
+    assert fs == []
+
+
+# ---- DL023 host sync in hot loop ------------------------------------------
+
+_SCHED = "dnet_tpu/sched/fixture_mod.py"
+
+
+def test_dl023_fires_on_item_in_tick_loop():
+    fs = findings_for(
+        "def run(engine, plan):\n"
+        "    for req in plan:\n"
+        "        v = engine.score(req).item()\n",
+        rel=_SCHED,
+    )
+    mine = [f for f in fs if f.code == "DL023"]
+    assert len(mine) == 1 and mine[0].line == 3
+    assert "loop" in mine[0].message
+
+
+def test_dl023_fires_on_asarray_in_while_loop():
+    fs = findings_for(
+        "import numpy as np\n"
+        "def drain(engine):\n"
+        "    while engine.pending:\n"
+        "        toks = np.asarray(engine.step())\n",
+        rel=_SCHED,
+    )
+    assert [f.code for f in fs if f.code == "DL023"] == ["DL023"]
+
+
+def test_dl023_quiet_outside_loop_and_gated_and_cold_files():
+    # the sanctioned shape: ONE packed readback per dispatch, after the
+    # loop that builds the batch — no sync per iteration
+    fs = findings_for(
+        "import numpy as np\n"
+        "from dnet_tpu.obs import obs_enabled\n"
+        "def run(engine, plan):\n"
+        "    for req in plan:\n"
+        "        engine.enqueue(req)\n"
+        "        if obs_enabled():\n"
+        "            engine.probe().item()\n"  # obs-gated fence: sanctioned
+        "    toks = np.asarray(engine.flush())\n"  # packed readback: fine
+        "    return toks\n",
+        rel=_SCHED,
+    )
+    assert [f for f in fs if f.code == "DL023"] == []
+    # the same loop sync in a NON-hot-loop module is DL005's business
+    fs = findings_for(
+        "def run(engine, plan):\n"
+        "    for req in plan:\n"
+        "        v = engine.score(req).item()\n",
+        rel="dnet_tpu/membership/fixture_mod.py",
+    )
+    assert [f for f in fs if f.code == "DL023"] == []
+
+
+# ---- DL024 sequential awaits in a loop ------------------------------------
+
+
+def test_dl024_fires_on_independent_fanout():
+    fs = findings_for(
+        "async def fan(clients):\n"
+        "    for c in clients:\n"
+        "        await c.ping()\n"
+    )
+    assert codes(fs) == ["DL024"] and fs[0].line == 3
+    assert "gather" in fs[0].message
+
+
+def test_dl024_fires_with_per_iteration_temps():
+    """Names assigned earlier in the SAME iteration are not loop-carried
+    (the ring_manager load-body shape)."""
+    fs = findings_for(
+        "async def fan(client, devs):\n"
+        "    for d in devs:\n"
+        "        url = make_url(d)\n"
+        "        r = await client.post(url)\n"
+        "        if r.status != 200:\n"
+        "            raise RuntimeError(url)\n"
+    )
+    assert codes(fs) == ["DL024"] and fs[0].line == 4
+
+
+def test_dl024_quiet_on_loop_carried_dependency():
+    fs = findings_for(
+        "async def drain(fetch, pages):\n"
+        "    cursor = None\n"
+        "    for p in pages:\n"
+        "        cursor = await fetch(p, cursor)\n"  # feeds next iteration
+        "    return cursor\n"
+    )
+    assert fs == []
+
+
+def test_dl024_quiet_on_exempt_shapes():
+    fs = findings_for(
+        "import asyncio, time\n"
+        "async def f(resp, chunks, loop, fn, items, q):\n"
+        "    for c in chunks:\n"
+        "        await resp.write(c)\n"          # ordered sink
+        "    for it in items:\n"
+        "        await loop.run_in_executor(None, fn, it)\n"  # owned executor
+        "    for it in items:\n"
+        "        await asyncio.sleep(0.1)\n"     # pacing
+        "    for it in items:\n"
+        "        t0 = time.perf_counter()\n"     # measurement loop
+        "        await q.probe(it)\n"
+        "        record(time.perf_counter() - t0)\n"
+        "    for it in items:\n"
+        "        r = await q.get(it)\n"          # early exit: sequencing
+        "        if r:\n"
+        "            break\n"
+    )
+    assert fs == []
+
+
+def test_dl024_quiet_off_serving_path_and_async_for():
+    fs = findings_for(
+        "async def fan(clients):\n"
+        "    for c in clients:\n"
+        "        await c.ping()\n",
+        rel="dnet_tpu/cli/fixture_mod.py",
+    )
+    assert fs == []
+    fs = findings_for(
+        "async def pump(stream, sink):\n"
+        "    async for item in stream:\n"
+        "        await sink.handle(item)\n"
+    )
+    assert fs == []
+
+
+# ---- DL025 wire dtype drift -----------------------------------------------
+
+_SHARD = "dnet_tpu/shard/fixture_mod.py"
+
+
+def test_dl025_fires_on_literal_dtype_serialize_and_parse():
+    fs = findings_for(
+        "import numpy as np\n"
+        "from dnet_tpu.utils.serialization import tensor_to_bytes, bytes_to_tensor\n"
+        "def send(x):\n"
+        "    return tensor_to_bytes(np.asarray(x, dtype=np.float32))\n"
+        "def send2(x):\n"
+        "    return tensor_to_bytes(x, 'bfloat16')\n"
+        "def recv(payload, shape):\n"
+        "    return bytes_to_tensor(payload, 'float32', shape)\n",
+        rel=_SHARD,
+    )
+    assert codes(fs) == ["DL025", "DL025", "DL025"]
+    assert [f.line for f in fs] == [4, 6, 8]
+
+
+def test_dl025_quiet_on_derived_dtype_and_token_frames():
+    fs = findings_for(
+        "import numpy as np\n"
+        "from dnet_tpu.utils.serialization import tensor_to_bytes, bytes_to_tensor\n"
+        "def send(self, x):\n"
+        "    return tensor_to_bytes(\n"
+        "        np.zeros((1, 4), np.float32), self.wire_dtype\n"  # cast wins
+        "    )\n"
+        "def send_tokens(ids):\n"
+        "    return tensor_to_bytes(np.asarray(ids, dtype=np.int32))\n"  # int
+        "def recv(payload, frame, shape):\n"
+        "    return bytes_to_tensor(payload, frame.dtype, shape)\n",
+        rel=_SHARD,
+    )
+    assert fs == []
+    # outside the wire modules the check does not apply
+    fs = findings_for(
+        "from dnet_tpu.utils.serialization import tensor_to_bytes\n"
+        "import numpy as np\n"
+        "def embed(v):\n"
+        "    return tensor_to_bytes(np.asarray(v, dtype=np.float32))\n",
+        rel="dnet_tpu/loadgen/fixture_mod.py",
+    )
+    assert fs == []
+
+
+# ---- seeded negative controls over the REAL hot files ----------------------
+
+
+def _inject(rel: str, anchor: str, inserted: str, before: bool = True):
+    """Insert a line (at the anchor's indentation) into the real file's
+    text; returns (texts, injected_lineno)."""
+    text = (REPO / rel).read_text()
+    lines = text.splitlines(keepends=True)
+    idx = next(i for i, l in enumerate(lines) if anchor in l)
+    indent = lines[idx][: len(lines[idx]) - len(lines[idx].lstrip())]
+    at = idx if before else idx + 1
+    lines.insert(at, f"{indent}{inserted}\n")
+    return {rel: "".join(lines)}, at + 1
+
+
+def _flow_findings(texts):
+    return analyze_texts(texts, checks=FLOW_CHECKS)
+
+
+def test_seeded_dl021_donated_pool_read_after_ragged_step():
+    """Injecting a read of the donated pool between the ragged chunk call
+    and its sanctioned rebind produces exactly one DL021 at that line;
+    the clean file produces none."""
+    rel = "dnet_tpu/core/batch.py"
+    assert _flow_findings({rel: (REPO / rel).read_text()}) == []
+    texts, line = _inject(
+        rel, "self.kv_store.kv = pool",
+        "probe = jax.tree.map(jnp.shape, self.kv_store.kv)",
+    )
+    fs = _flow_findings(texts)
+    assert codes(fs) == ["DL021"], fs
+    assert fs[0].line == line and "self.kv_store.kv" in fs[0].message
+
+
+def test_seeded_dl022_python_scalar_jit_argument():
+    """Injecting a .shape-derived host scalar into a kv_gather dispatch
+    produces exactly one DL022 at that line."""
+    rel = "dnet_tpu/kv/store.py"
+    assert _flow_findings({rel: (REPO / rel).read_text()}) == []
+    texts, line = _inject(
+        rel, "return self._gather(self.kv, jnp.asarray(ids",
+        "self._gather(self.kv, ids.shape[0])",
+    )
+    fs = _flow_findings(texts)
+    assert codes(fs) == ["DL022"], fs
+    assert fs[0].line == line and "non-static" in fs[0].message
+
+
+def test_seeded_dl023_item_in_sched_tick_loop():
+    """Injecting an .item() into the tick executor's prefill loop
+    produces exactly one DL023 at that line."""
+    rel = "dnet_tpu/sched/step.py"
+    assert _flow_findings({rel: (REPO / rel).read_text()}) == []
+    texts, line = _inject(
+        rel, "if chunk.nonce in res.preempted:",
+        "depth = plan.budgets.get(chunk.nonce).item()",
+    )
+    fs = _flow_findings(texts)
+    assert codes(fs) == ["DL023"], fs
+    assert fs[0].line == line and "item()" in fs[0].message
+
+
+# ---- --select validation and --diff incremental mode -----------------------
+
+
+def test_cli_rejects_unknown_select_codes():
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--select", "DL021,DL999"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unknown check code(s) DL999" in proc.stderr
+    assert "DL001" in proc.stderr  # the known-code list is printed
+
+
+def _git(root, *argv):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+        capture_output=True, text=True, cwd=root, timeout=60, check=True,
+    )
+
+
+def test_diff_mode_lints_only_changed_files_and_agrees(tmp_path):
+    """--diff semantics, library-level: a one-file change lints only that
+    file, and the findings for it match the full run's."""
+    from dnet_tpu.analysis import run_analysis
+    from dnet_tpu.analysis.core import changed_files
+
+    root = tmp_path / "repo"
+    api = root / "dnet_tpu" / "api"
+    api.mkdir(parents=True)
+    clean = "async def ok():\n    return 1\n"
+    (api / "good.py").write_text(
+        "import time\n"
+        "async def h():\n"
+        "    time.sleep(1)\n"  # pre-existing violation in an UNCHANGED file
+    )
+    (api / "touched.py").write_text(clean)
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+    (api / "touched.py").write_text(
+        clean + "async def fan(cs):\n    for c in cs:\n        await c.ping()\n"
+    )
+    changed = changed_files(root, "HEAD")
+    assert changed == {"dnet_tpu/api/touched.py"}
+    diff_report = run_analysis(
+        root, include_runtime=False, only_files=changed
+    )
+    # only the changed file's findings — good.py's DL001 is out of scope
+    assert {f.path for f in diff_report.findings} == {"dnet_tpu/api/touched.py"}
+    assert codes(diff_report.findings) == ["DL024"]
+    full_report = run_analysis(root, include_runtime=False)
+    assert [
+        f for f in full_report.findings if f.path == "dnet_tpu/api/touched.py"
+    ] == diff_report.findings
+
+
+def test_cli_diff_head_is_fast_and_clean():
+    """The pre-commit target: `dnetlint --diff HEAD` on this repo exits
+    0 quickly (budget well under the full runtime-pass run)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--diff", "HEAD"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    elapsed = _time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # target is <5s on a one-file change; allow slack for loaded CI hosts
+    assert elapsed < 30, f"--diff HEAD took {elapsed:.1f}s"
+
+
+def test_makefile_has_dnetlint_diff_target():
+    text = (REPO / "Makefile").read_text()
+    assert "dnetlint-diff:" in text
+    assert "--diff $(REV)" in text
