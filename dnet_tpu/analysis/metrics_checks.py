@@ -33,6 +33,9 @@ Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
   point cannot ship uninstrumented under a stray label, and a declared
   name cannot outlive its last call site (a stale series on the compile
   dashboards)
+- DL026 wire          — wire-pipeline dir labels <-> obs/phases.py
+  WIRE_DIRS both directions + the dnet_wire_* families required
+  (pass 12; DL021-DL025 are the flow-sensitive tier, analysis/flow/)
 """
 
 from __future__ import annotations
@@ -178,6 +181,13 @@ _REQUIRED_FAMILIES = (
     "dnet_sched_batch_tokens",
     "dnet_sched_preemptions_total",
     "dnet_sched_queue_depth",
+    # overlapped wire pipeline (transport/wire_pipeline.py) — the per-hop
+    # codec dashboards, the overlap gauge the BENCH_SERVE reports embed,
+    # and the label cross-check (pass 12) depend on these
+    "dnet_wire_encode_ms",
+    "dnet_wire_decode_ms",
+    "dnet_wire_bytes_total",
+    "dnet_wire_overlap_ratio",
 )
 
 
@@ -531,6 +541,29 @@ def check_jit_instrumentation(errors: list) -> int:
     return n
 
 
+def check_wire_labels(errors: list) -> int:
+    """Pass 12: the wire pipeline's labeled family must agree with the
+    declared dir enum (dnet_tpu/obs/phases.py WIRE_DIRS) both ways, and
+    the dnet_wire_* families must exist — a renamed direction cannot
+    strand a stale label, and a refactor cannot silently drop the series
+    the BENCH_SERVE wire meta and overlap dashboards read."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs.phases import WIRE_DIRS
+
+    text = get_registry().expose()
+    n = _cross_check_labels(
+        errors, text, "dnet_wire_bytes_total", "dir",
+        WIRE_DIRS, "obs.phases.WIRE_DIRS",
+    )
+    fams = get_registry().families()
+    for req in ("dnet_wire_encode_ms", "dnet_wire_decode_ms",
+                "dnet_wire_overlap_ratio"):
+        n += 1
+        if req not in fams:
+            errors.append(f"wire: required family {req} not registered")
+    return n
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -547,6 +580,7 @@ def main() -> int:
     n_san = check_san_labels(errors)
     n_sched = check_sched_labels(errors)
     n_jit = check_jit_instrumentation(errors)
+    n_wire = check_wire_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -556,7 +590,7 @@ def main() -> int:
           f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
           f"{n_member} membership labels, {n_attr} attribution labels, "
           f"{n_san} sanitizer labels, {n_sched} scheduler labels, "
-          f"{n_jit} jit call sites, all conform")
+          f"{n_jit} jit call sites, {n_wire} wire labels, all conform")
     return 0
 
 
@@ -662,6 +696,14 @@ class JitInstrumentationContract(_MetricsCheck):
     pass_name = "check_jit_instrumentation"
 
 
+class WireLabelContract(_MetricsCheck):
+    # DL021-DL025 belong to the flow-sensitive tier (analysis/flow/)
+    code = "DL026"
+    name = "wire-label-contract"
+    description = "wire dir labels <-> WIRE_DIRS + dnet_wire_* families exist"
+    pass_name = "check_wire_labels"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -674,4 +716,5 @@ METRICS_CHECKS = [
     SanLabelContract(),
     SchedLabelContract(),
     JitInstrumentationContract(),
+    WireLabelContract(),
 ]

@@ -86,6 +86,12 @@ OWNERSHIP_DOMAINS = (
     # ever sees plain snapshots inside a TickPlan
     ("dnet_tpu/sched/queue.py", "SchedQueue", "_reqs", "loop", ""),
     ("dnet_tpu/sched/engine.py", "SchedulerAdapter", "_deadlines", "loop", ""),
+    # overlapped wire pipeline (transport/wire_pipeline.py): the encode
+    # ring's in-flight count is touched from the compute thread (acquire)
+    # AND the tx executor (release) — guarded-by lock; the tx stage's
+    # pending map is egress-worker-only (loop)
+    ("dnet_tpu/transport/wire_pipeline.py", "EncodeRing", "_inflight", "lock", "_lock"),
+    ("dnet_tpu/transport/wire_pipeline.py", "WireTxStage", "_pending", "loop", ""),
 )
 
 #: Modules sanctioned to cross the thread->loop boundary via
@@ -97,6 +103,10 @@ BRIDGE_MODULES = (
     "dnet_tpu/shard/runtime.py",
     "dnet_tpu/api/strategies.py",
     "dnet_tpu/analysis/runtime/loop_monitor.py",
+    # wire-pipeline tick dispatch: the scheduler's compute-thread tick
+    # hands each decode result back to the loop as it is produced
+    # (call_soon_threadsafe) instead of barriering on the full tick
+    "dnet_tpu/sched/engine.py",
 )
 
 #: Label set of dnet_san_zombie_threads_total: worker threads that can

@@ -7,6 +7,7 @@ post-processing in src/dnet/api/http_api.py:305-403 / api/utils.py:62-131.
 from __future__ import annotations
 
 import asyncio
+import functools
 import time
 from typing import List, Optional
 
@@ -19,6 +20,16 @@ from dnet_tpu.utils.logger import get_logger
 from dnet_tpu.utils.tokenizer import load_tokenizer
 
 log = get_logger()
+
+
+@functools.lru_cache(maxsize=256)
+def _resolve_host_cached(host: str) -> str:
+    import socket
+
+    try:
+        return socket.gethostbyname(host)
+    except OSError:
+        return host
 
 
 def _contiguous_runs(layers: List[int]) -> List[List[int]]:
@@ -158,6 +169,7 @@ class RingModelManager:
         bodies: dict = {}
         for a in topo.assignments:
             nxt = by_instance.get(a.next_instance)
+            dev = by_instance[a.instance]
             bodies[a.instance] = {
                 "model_path": model_id,
                 "layers": a.layers,
@@ -194,6 +206,12 @@ class RingModelManager:
                 # membership epoch (dnet_tpu/membership/): the shard pins
                 # it and fences frames/RPCs from any other epoch
                 "epoch": topo.epoch,
+                # hop codec: DNET_WIRE_CODEC=auto makes qsparse8 the
+                # default for hops that actually CROSS hosts (~4x fewer
+                # DCN bytes) while same-host/loopback hops — and every
+                # single-shard "ring" — stay lossless, so greedy SSE
+                # parity holds out of the box (transport/wire_pipeline.py)
+                "wire_codec": self._hop_codec(dev, nxt, len(topo.assignments)),
             }
         if delta:
             changed, unchanged = split_delta(self._last_load, bodies)
@@ -409,6 +427,47 @@ class RingModelManager:
             log.warning("ring speculation off (model probe failed: %s)", exc)
             return 0
         return L
+
+    _LOOPBACK_HOSTS = ("127.0.0.1", "::1", "localhost")
+
+    @classmethod
+    def _canonical_host(cls, host: str) -> str:
+        """Best-effort canonical address for same-host comparison: a
+        machine registered once by hostname and once by LAN IP must not
+        be classified as two hosts (that would silently put the lossy
+        codec on a hop that pays no DCN).  Resolution failures fall back
+        to the raw name.  Load-time control plane only, never the serving
+        path — and cached per host so repeated (delta) loads pay one
+        resolver round trip per name, not one per hop per load."""
+        if host in cls._LOOPBACK_HOSTS:
+            return "127.0.0.1"
+        return _resolve_host_cached(host)
+
+    @classmethod
+    def _hop_codec(cls, dev, nxt, n_shards: int) -> str:
+        """Resolve this shard's hop codec (DNET_WIRE_CODEC).  ``auto``
+        picks qsparse8_v1 (~7x byte reduction, BENCH_r03) only for hops
+        that cross hosts — a same-host/loopback hop pays no DCN and keeps
+        the exact lossless cast, and a single-shard ring has no hidden
+        hops at all (its one "hop" is the tail->head continuation stream,
+        token frames the codec never touches)."""
+        from dnet_tpu.config import get_settings
+
+        codec = get_settings().wire.codec
+        if codec != "auto":
+            return codec
+        if n_shards <= 1 or nxt is None:
+            return "lossless"
+        same_host = dev.host == nxt.host or (
+            cls._canonical_host(dev.host) == cls._canonical_host(nxt.host)
+        )
+        codec = "lossless" if same_host else "qsparse8"
+        log.info(
+            "hop codec %s -> %s: %s (%s)",
+            dev.instance, nxt.instance, codec,
+            "same host" if same_host else "crosses hosts",
+        )
+        return codec
 
     @staticmethod
     def _check_sp(a, max_seq: int) -> int:

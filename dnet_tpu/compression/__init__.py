@@ -14,10 +14,13 @@ from dnet_tpu.compression.ops import (
     scatter_columns,
 )
 from dnet_tpu.compression.wire import (
+    DeviceEncode,
+    codec_name,
     compress_tensor,
     decompress_tensor,
     decompress_tensor_device,
     is_compressed_dtype,
+    launch_encode,
 )
 
 __all__ = [
@@ -25,8 +28,11 @@ __all__ = [
     "column_sparsify",
     "gather_columns",
     "scatter_columns",
+    "DeviceEncode",
+    "codec_name",
     "compress_tensor",
     "decompress_tensor",
     "decompress_tensor_device",
     "is_compressed_dtype",
+    "launch_encode",
 ]

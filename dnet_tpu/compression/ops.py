@@ -179,6 +179,100 @@ def _topk_column_mask(norms: jnp.ndarray, keep: int) -> jnp.ndarray:
     return jnp.zeros((C,), dtype=bool).at[idx].set(True)
 
 
+# ---- wire-pipeline encode entry points ------------------------------------
+#
+# One jitted launch per hop codec, with the ACTIVATION BUFFER DONATED: the
+# sliced hop activation is dead after the encode, so XLA reuses its buffer
+# for the outputs and the compute thread's only serial cost is the dispatch.
+# Every output stays on device — transport/wire_pipeline.py reads them back
+# on the tx stage, off the compute thread (the overlap the wire pipeline
+# exists for).  Kept columns come out in ascending column order (the wire
+# bitmask convention decompress relies on).
+
+
+def _wire_cast_impl(x2, wire_np_dtype):
+    """Lossless hop codec: cast to the wire dtype on device."""
+    return x2.astype(wire_np_dtype)
+
+
+def _wire_sparse_impl(x2, keep):
+    """sparse_v1 device half: (mask bool[D], kept [R, keep]) — top-k
+    column selection by L2 norm, gathered in ascending column order."""
+    norms = column_l2_norms(x2)
+    _, idx = jax.lax.top_k(norms, keep)
+    idx = jnp.sort(idx)
+    mask = jnp.zeros(norms.shape, dtype=bool).at[idx].set(True)
+    return mask, gather_columns(x2, idx)
+
+
+def quantize_q8(kept: jnp.ndarray, gs: int):
+    """THE affine-uint8 quant math, shared by the synchronous encoder
+    (wire.compress_tensor) and the jitted wire-pipeline launch — one
+    definition of the scale epsilon / clip bounds / padding scheme.
+
+    kept [R, K] -> (codes uint8 [R, K], scale f32, bias f32).  gs > 0:
+    per-(row, group-of-kept-columns) params, zero padding included (note
+    jit-compiled reductions may differ from eager by 1 ulp in a scale, so
+    the two paths are value-equivalent, not byte-identical).  gs == 0:
+    ONE per-tensor scale/bias pair — the fallback for frames too small
+    for group quant."""
+    R, K = kept.shape
+    if gs == 0:
+        kf = kept.astype(jnp.float32)
+        mn = jnp.min(kf)
+        scale = jnp.maximum((jnp.max(kf) - mn) / 255.0, 1e-12)
+        codes = jnp.clip(jnp.round((kf - mn) / scale), 0, 255).astype(jnp.uint8)
+        return codes, scale.reshape(1), mn.reshape(1)
+    G = -(-K // gs)
+    pad = G * gs - K
+    kf = jnp.pad(kept.astype(jnp.float32), ((0, 0), (0, pad))).reshape(R, G, gs)
+    mn = jnp.min(kf, axis=-1)
+    mx = jnp.max(kf, axis=-1)
+    scale = jnp.maximum((mx - mn) / 255.0, 1e-12)
+    codes = jnp.clip(
+        jnp.round((kf - mn[..., None]) / scale[..., None]), 0, 255
+    ).astype(jnp.uint8)
+    return codes.reshape(R, G * gs)[:, :K], scale, mn
+
+
+def _wire_q8_impl(x2, keep, gs, wire_np_dtype):
+    """qsparse8_v1 device half: (mask, codes u8, scale f32, bias f32) —
+    top-k column selection + the shared quantize_q8 math.
+    wire_np_dtype only tags the dequantized output; it is threaded as a
+    static arg so the (dtype-bearing) tag string can be built host-side
+    without reading anything back."""
+    del wire_np_dtype  # static: part of the cache key / dtype tag only
+    norms = column_l2_norms(x2)
+    _, idx = jax.lax.top_k(norms, keep)
+    idx = jnp.sort(idx)
+    mask = jnp.zeros(norms.shape, dtype=bool).at[idx].set(True)
+    kept = gather_columns(x2, idx)
+    codes, scale, bias = quantize_q8(kept, gs)
+    return mask, codes, scale, bias
+
+
+def _jitted_wire_encode(fn, *static):
+    """Cached jit of one encode impl with the activation donated; wrapped
+    by instrument_jit so a shape leak shows up on the compile dashboards
+    instead of as a mystery per-hop latency cliff."""
+
+    @functools.cache
+    def build():
+        from dnet_tpu.obs.jit import instrument_jit
+
+        return instrument_jit(
+            jax.jit(fn, static_argnames=static, donate_argnums=(0,)),
+            "wire_encode",
+        )
+
+    return build
+
+
+wire_cast = _jitted_wire_encode(_wire_cast_impl, "wire_np_dtype")
+wire_sparse = _jitted_wire_encode(_wire_sparse_impl, "keep")
+wire_q8 = _jitted_wire_encode(_wire_q8_impl, "keep", "gs", "wire_np_dtype")
+
+
 def column_sparsify(x: jnp.ndarray, drop_frac: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Zero the `drop_frac` fraction of columns with smallest L2 norm.
 

@@ -15,10 +15,21 @@ with metadata smuggled through the frame's dtype string:
     (the analog of the reference's uint8 codes + compact scales/biases,
     wire.py:112-171; scales stay f32 because the KEPT columns are exactly
     the large-norm activations that can overflow fp16; <base> is the
-    dequantized output dtype).
+    dequantized output dtype).  ``gs=0`` marks the PER-TENSOR fallback for
+    frames too small for group quant (fewer kept columns than one group):
+    payload carries exactly one f32 scale + one f32 bias for the whole
+    tensor instead of the R*G grids.  (gs=0 extends the v1 format in
+    place; a pre-PR-14 decoder would div-by-zero on it — but the frame
+    schema itself is versionless and PR 14 also grew ActivationFrame, so
+    mixed-version rings were never a supported deployment: the load
+    fan-out ships one version to every shard.)
 
 Column selection and the gather run on device (compression.ops Pallas
 kernels); the byte packing is host-side — the wire is host-bound anyway.
+Under the overlapped wire pipeline (transport/wire_pipeline.py) the device
+half LAUNCHES through :func:`launch_encode` (donated activation, outputs
+left on device) and the byte packing happens later on the tx stage via
+:meth:`DeviceEncode.finalize` — same formats, same bytes, different thread.
 """
 
 from __future__ import annotations
@@ -77,27 +88,32 @@ def compress_tensor(
     if quant_bits != 8:
         raise NotImplementedError(f"compress quant_bits={quant_bits} (0 or 8)")
 
-    # qsparse8_v1: per-(row, group) affine uint8 over the KEPT columns
-    R, K = kept_dev.shape
-    gs = max(int(group_size), 1)
-    G = -(-K // gs)
-    pad = G * gs - K
-    kf = jnp.pad(kept_dev.astype(jnp.float32), ((0, 0), (0, pad))).reshape(R, G, gs)
-    mn = jnp.min(kf, axis=-1)
-    mx = jnp.max(kf, axis=-1)
-    scale = jnp.maximum((mx - mn) / 255.0, 1e-12)
-    codes = jnp.clip(
-        jnp.round((kf - mn[..., None]) / scale[..., None]), 0, 255
-    ).astype(jnp.uint8)
-    codes_np = np.asarray(codes).reshape(R, G * gs)[:, :K]
+    # qsparse8_v1: affine uint8 over the KEPT columns via the shared
+    # quantize_q8 math (compression/ops.py — the one definition of the
+    # scale epsilon / clip / padding).  A frame too small for group quant
+    # (fewer kept columns than one group) falls back to ONE per-tensor
+    # scale/bias pair (gs=0 tag) — zero-padding a mostly-empty group
+    # would skew its min/max.
+    from dnet_tpu.compression.ops import quantize_q8
+
+    K = kept_dev.shape[1]
+    gs = _effective_group(K, group_size)
+    codes, scale, bias = quantize_q8(kept_dev, gs)
     payload = (
         bitmask.tobytes()
-        + np.ascontiguousarray(codes_np).tobytes()
+        + np.ascontiguousarray(np.asarray(codes)).tobytes()
         + np.asarray(scale, dtype=np.float32).tobytes()
-        + np.asarray(mn, dtype=np.float32).tobytes()
+        + np.asarray(bias, dtype=np.float32).tobytes()
     )
     dtype = f"{wire_dtype}|{QFMT_TAG}|pct={drop_frac:g}|orig={D}|gs={gs}"
     return payload, dtype, orig_shape
+
+
+def _effective_group(K: int, group_size: int) -> int:
+    """The group size a K-kept-column frame actually quantizes with:
+    0 (per-tensor scales) when the frame cannot fill one group."""
+    gs = max(int(group_size), 0)
+    return 0 if K < gs or gs == 0 else gs
 
 
 def _parse_header(payload: bytes, dtype: str, shape: Tuple[int, ...]):
@@ -128,22 +144,27 @@ def decompress_tensor(payload: bytes, dtype: str, shape: Tuple[int, ...]) -> np.
 
     if QFMT_TAG in dtype:
         gs = int(fields["gs"])
-        G = -(-K // gs)
         codes_end = mask_bytes + R * K
-        scales_end = codes_end + R * G * 4
         codes = np.frombuffer(
             payload[mask_bytes:codes_end], dtype=np.uint8
         ).reshape(R, K)
-        scale = np.frombuffer(
-            payload[codes_end:scales_end], dtype=np.float32
-        ).reshape(R, G)
-        bias = np.frombuffer(
-            payload[scales_end:], dtype=np.float32
-        ).reshape(R, G)
-        pad = G * gs - K
-        cf = np.pad(codes.astype(np.float32), ((0, 0), (0, pad))).reshape(R, G, gs)
-        kept = (cf * scale[..., None] + bias[..., None]).reshape(R, G * gs)[:, :K]
-        kept = kept.astype(nd)
+        if gs == 0:  # per-tensor fallback: one f32 scale + one f32 bias
+            scale = np.frombuffer(payload[codes_end:codes_end + 4], np.float32)[0]
+            bias = np.frombuffer(payload[codes_end + 4:codes_end + 8], np.float32)[0]
+            kept = (codes.astype(np.float32) * scale + bias).astype(nd)
+        else:
+            G = -(-K // gs)
+            scales_end = codes_end + R * G * 4
+            scale = np.frombuffer(
+                payload[codes_end:scales_end], dtype=np.float32
+            ).reshape(R, G)
+            bias = np.frombuffer(
+                payload[scales_end:], dtype=np.float32
+            ).reshape(R, G)
+            pad = G * gs - K
+            cf = np.pad(codes.astype(np.float32), ((0, 0), (0, pad))).reshape(R, G, gs)
+            kept = (cf * scale[..., None] + bias[..., None]).reshape(R, G * gs)[:, :K]
+            kept = kept.astype(nd)
     else:
         kept = np.frombuffer(payload[mask_bytes:], dtype=nd).reshape(R, K)
     out = np.zeros((R, D), dtype=nd)
@@ -163,12 +184,17 @@ def _dequant_scatter_impl(codes, scale, bias, idx, D: int, gs: int):
     On TPU the scatter is the Pallas MXU one-hot matmul and XLA fuses the
     elementwise dequant into its operand read (the analog of the
     reference's fused k_dequant_scatter_q8, compression/kernels.py:164-225).
+    gs == 0 is the per-tensor fallback: scale/bias are 1-element arrays
+    broadcast over the whole code grid.
     """
     import jax.numpy as jnp
 
     from dnet_tpu.compression.ops import scatter_columns
 
     R, K = codes.shape
+    if gs == 0:
+        kept = codes.astype(jnp.float32) * scale[0] + bias[0]
+        return scatter_columns(kept, idx, D)
     G = scale.shape[1]
     pad = G * gs - K
     cf = jnp.pad(codes.astype(jnp.float32), ((0, 0), (0, pad))).reshape(R, G, gs)
@@ -203,18 +229,26 @@ def decompress_tensor_device(payload: bytes, dtype: str, shape: Tuple[int, ...])
 
     if QFMT_TAG in dtype:
         gs = int(fields["gs"])
-        G = -(-K // gs)
         codes_end = mask_bytes + R * K
-        scales_end = codes_end + R * G * 4
         codes = jnp.asarray(
             np.frombuffer(payload[mask_bytes:codes_end], dtype=np.uint8).reshape(R, K)
         )
-        scale = jnp.asarray(
-            np.frombuffer(payload[codes_end:scales_end], dtype=np.float32).reshape(R, G)
-        )
-        bias = jnp.asarray(
-            np.frombuffer(payload[scales_end:], dtype=np.float32).reshape(R, G)
-        )
+        if gs == 0:  # per-tensor fallback: single f32 scale + bias
+            scale = jnp.asarray(
+                np.frombuffer(payload[codes_end:codes_end + 4], np.float32)
+            )
+            bias = jnp.asarray(
+                np.frombuffer(payload[codes_end + 4:codes_end + 8], np.float32)
+            )
+        else:
+            G = -(-K // gs)
+            scales_end = codes_end + R * G * 4
+            scale = jnp.asarray(
+                np.frombuffer(payload[codes_end:scales_end], dtype=np.float32).reshape(R, G)
+            )
+            bias = jnp.asarray(
+                np.frombuffer(payload[scales_end:], dtype=np.float32).reshape(R, G)
+            )
         out = _dequant_scatter()(codes, scale, bias, idx, D=D, gs=gs)
     else:
         kept = jnp.asarray(
@@ -222,3 +256,103 @@ def decompress_tensor_device(payload: bytes, dtype: str, shape: Tuple[int, ...])
         )
         out = _scatter()(kept, idx, D=D)
     return out.astype(out_dtype).reshape(shape)
+
+
+# ---- overlapped encode (wire pipeline) ------------------------------------
+
+
+def codec_name(dtype: str) -> str:
+    """Human/metrics name of the hop codec a frame's dtype tag selects."""
+    if QFMT_TAG in dtype:
+        return "qsparse8_v1"
+    if FMT_TAG in dtype:
+        return "sparse_v1"
+    return dtype  # plain wire dtype = the lossless codec
+
+
+class DeviceEncode:
+    """A LAUNCHED on-device hop encode whose bytes are not host-side yet.
+
+    Construction (on the compute thread, via :func:`launch_encode`) only
+    dispatches the jitted encode — the activation buffer is donated and
+    the outputs stay on device.  :meth:`finalize` (on the transport tx
+    stage, any thread) blocks on the device results, packs the payload
+    bytes, and is the ONLY point that pays D2H time.  ``dtype`` and
+    ``shape`` are known at launch, so the frame header can be built before
+    the bytes exist."""
+
+    __slots__ = ("kind", "bufs", "dtype", "shape")
+
+    def __init__(self, kind: str, bufs: tuple, dtype: str, shape: tuple) -> None:
+        self.kind = kind  # "cast" | "sparse" | "q8"
+        self.bufs = bufs
+        self.dtype = dtype
+        self.shape = tuple(shape)
+
+    def finalize(self) -> bytes:
+        """D2H readback + byte packing.  cast/sparse payloads match the
+        synchronous encoders (tensor_to_bytes / compress_tensor) byte for
+        byte; q8 scales may differ from compress_tensor's by 1 ulp (jit
+        vs eager reduction order) — DECODED values agree, but do not
+        assert byte equality across the two encode paths."""
+        if self.kind == "cast":
+            (arr,) = self.bufs
+            return np.ascontiguousarray(np.asarray(arr)).tobytes()
+        if self.kind == "sparse":
+            mask, kept = self.bufs
+            return (
+                np.packbits(np.asarray(mask)).tobytes()
+                + np.ascontiguousarray(np.asarray(kept)).tobytes()
+            )
+        mask, codes, scale, bias = self.bufs
+        return (
+            np.packbits(np.asarray(mask)).tobytes()
+            + np.ascontiguousarray(np.asarray(codes)).tobytes()
+            + np.asarray(scale, dtype=np.float32).tobytes()
+            + np.asarray(bias, dtype=np.float32).tobytes()
+        )
+
+
+def launch_encode(
+    x,
+    drop_frac: float,
+    wire_dtype: str = "bfloat16",
+    quant_bits: int = 0,
+    group_size: int = 64,
+) -> DeviceEncode:
+    """Dispatch the on-device half of the hop codec and return the pending
+    encode.  ``x`` ([B, T, D] or [R, D] device array) is DONATED to the
+    jitted encode — callers must treat it as dead afterwards (the DL021
+    contract).  Codec selection mirrors compress_tensor: quant_bits=8 ->
+    qsparse8_v1 (drop_frac may be 0.0: pure int8 over every column),
+    drop_frac>0 with quant_bits=0 -> sparse_v1, else the lossless
+    wire-dtype cast."""
+    import jax.numpy as jnp
+
+    from dnet_tpu.compression.ops import wire_cast, wire_q8, wire_sparse
+
+    orig_shape = tuple(x.shape)
+    nd = numpy_dtype(wire_dtype)
+    D = orig_shape[-1]
+    # every branch traces on the flattened [R, D] view so the compiled
+    # programs key on row count alone — (1, T, D) and (T, 1, D) frames
+    # share one program and the shard's load-time warm covers both (the
+    # payload bytes are unchanged: the reshape is contiguous and the
+    # frame header carries orig_shape)
+    x2 = jnp.reshape(jnp.asarray(x), (-1, D))
+    if drop_frac <= 0 and quant_bits == 0:
+        arr = wire_cast()(x2, wire_np_dtype=nd)
+        return DeviceEncode("cast", (arr,), wire_dtype, orig_shape)
+    keep = max(int(round(D * (1.0 - drop_frac))), 1)
+    if quant_bits == 0:
+        mask, kept = wire_sparse()(x2, keep=keep)
+        dtype = f"{wire_dtype}|{FMT_TAG}|pct={drop_frac:g}|orig={D}"
+        return DeviceEncode(
+            "sparse", (mask, kept.astype(jnp.dtype(nd))), dtype, orig_shape
+        )
+    if quant_bits != 8:
+        raise NotImplementedError(f"wire quant_bits={quant_bits} (0 or 8)")
+    gs = _effective_group(keep, group_size)
+    mask, codes, scale, bias = wire_q8()(x2, keep=keep, gs=gs, wire_np_dtype=nd)
+    dtype = f"{wire_dtype}|{QFMT_TAG}|pct={drop_frac:g}|orig={D}|gs={gs}"
+    return DeviceEncode("q8", (mask, codes, scale, bias), dtype, orig_shape)

@@ -236,6 +236,47 @@ class TransportSettings(_EnvGroup):
 
 
 @dataclass
+class WireSettings(_EnvGroup):
+    """Overlapped quantized wire pipeline (transport/wire_pipeline.py).
+
+    ``DNET_WIRE_PIPELINE=1`` takes the hop codec off the serial send path:
+    the shard compute thread only LAUNCHES the on-device encode (jitted
+    quant/sparsify with a donated activation buffer) and hands the pending
+    device buffers to the transport tx stage, which finishes the D2H
+    readback + byte packing off-thread while the next frame computes; the
+    receive side symmetrically launches H2D upload + on-device dequant at
+    ingress so the dequant of frame N+1 overlaps frame N's compute.  A
+    bounded ``DEPTH``-slot ring of encode buffers provides backpressure.
+    ``CODEC`` picks the hop codec: ``auto`` (the default — the ring
+    manager resolves per hop: lossy ``qsparse8`` for hops that CROSS
+    hosts, ``lossless`` for same-host/loopback hops and single-shard
+    rings, so greedy SSE streams stay byte-identical wherever no DCN is
+    paid), ``lossless`` (wire-dtype cast, exact, everywhere), or
+    ``qsparse8`` (int8-affine kept columns, ~4x fewer bytes, lossy,
+    everywhere).
+    The gate is also honored as a raw env flip via
+    ``env_flag("DNET_WIRE_PIPELINE")`` so post-cache toggles (tests,
+    operators) still see it.
+    """
+
+    env_prefix = "DNET_WIRE_"
+    # master switch: double-buffered encode/decode overlap on shard hops
+    pipeline: bool = False
+    # hop codec default: auto | lossless | qsparse8 (auto = inter-host
+    # hops ride qsparse8, same-host/loopback hops stay lossless)
+    codec: str = "auto"
+    # column drop fraction the qsparse8 hop codec uses when transport
+    # compression is not separately configured
+    qsparse_pct: float = 0.5
+    # int8 quant group along kept columns; frames with fewer kept columns
+    # than one group fall back to per-tensor fp32 scales (gs=0 tag)
+    group_size: int = 64
+    # encode-buffer ring depth: how many launched-but-unsent frames the
+    # compute thread may run ahead of the tx readback (backpressure bound)
+    depth: int = 2
+
+
+@dataclass
 class ResilienceSettings(_EnvGroup):
     """Request survival: retry/backoff policy + transparent decode resume.
 
@@ -541,6 +582,7 @@ class Settings:
     kv: KVSettings = field(default_factory=KVSettings.from_env)
     compute: ComputeSettings = field(default_factory=ComputeSettings.from_env)
     transport: TransportSettings = field(default_factory=TransportSettings.from_env)
+    wire: WireSettings = field(default_factory=WireSettings.from_env)
     resilience: ResilienceSettings = field(default_factory=ResilienceSettings.from_env)
     admission: AdmissionSettings = field(default_factory=AdmissionSettings.from_env)
     loadgen: LoadgenSettings = field(default_factory=LoadgenSettings.from_env)
@@ -561,6 +603,7 @@ for _cls in (
     KVSettings,
     ComputeSettings,
     TransportSettings,
+    WireSettings,
     ResilienceSettings,
     AdmissionSettings,
     LoadgenSettings,

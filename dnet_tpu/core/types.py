@@ -108,6 +108,12 @@ class ActivationMessage:
     # carried across hops and stamped into the final token callback so the
     # epoch fence holds end to end.  0 = unfenced.
     epoch: int = 0
+    # wire pipeline rx half (transport/wire_pipeline.py): the ingress path
+    # launches H2D upload + on-device dequant for a QUEUED frame and
+    # stashes the resulting device array here, so the compute thread finds
+    # the payload already decoded (overlapped with the previous step's
+    # compute).  Process-local only — never serialized onto the wire.
+    device_data: Any = None
     # profiling timestamps (perf_counter seconds), reference messages.py:28-32
     t_recv: float = 0.0
     t_enq: float = 0.0

@@ -346,6 +346,37 @@ def _register_core(reg: MetricsRegistry) -> None:
     )
     for kind in DEVICE_MEM_KINDS:
         mem.labels(kind=kind)  # pre-touch: expose at 0 from the start
+    # overlapped wire pipeline (transport/wire_pipeline.py,
+    # DNET_WIRE_PIPELINE=1).  The dir label set is DECLARED in
+    # obs/phases.py (leaf) and cross-checked both ways by the metrics
+    # lint (pass 12).
+    from dnet_tpu.obs.phases import WIRE_DIRS
+
+    reg.histogram(
+        "dnet_wire_encode_ms",
+        "Hop-codec encode wall time per frame (D2H readback + byte "
+        "packing; tx-stage time under the wire pipeline, compute-thread "
+        "time without it)",
+    )
+    reg.histogram(
+        "dnet_wire_decode_ms",
+        "Hop-codec decode wall time per frame (H2D upload + on-device "
+        "dequant dispatch; ingress time under the wire pipeline, "
+        "compute-thread time without it)",
+    )
+    wire_bytes = reg.counter(
+        "dnet_wire_bytes_total",
+        "Activation/token frame payload bytes by wire direction "
+        "(obs/phases.py WIRE_DIRS)",
+        labelnames=("dir",),
+    )
+    for d in WIRE_DIRS:
+        wire_bytes.labels(dir=d)  # pre-touch: the lint checks these
+    reg.gauge(
+        "dnet_wire_overlap_ratio",
+        "Fraction of cumulative hop-codec time hidden off the compute "
+        "thread (1.0 = codec fully overlapped with compute)",
+    )
     # runtime concurrency sanitizer (dnet_tpu/analysis/runtime/, DNET_SAN=1).
     # Check-code / thread label sets are DECLARED in
     # analysis/runtime/domains.py (a leaf module) and cross-checked both

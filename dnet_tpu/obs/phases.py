@@ -36,7 +36,15 @@ JIT_FNS = (
     "paged_attend",         # BatchedEngine ragged decode programs (step +
                             # fused chunks) attending the pool in place
     "kv_append",            # BlockStore per-step block-append of new K/V rows
+    "wire_encode",          # wire-pipeline hop encode launches (lossless
+                            # cast / sparse / qsparse8 — compression/ops.py)
 )
+
+# dnet_wire_bytes_total{dir=}: activation/token payload bytes by wire
+# direction (tx = written to outbound streams, rx = admitted at ingress).
+# The metrics lint (pass 12) cross-checks these against the exposed label
+# set both ways, the established leaf-enum pattern.
+WIRE_DIRS = ("tx", "rx")
 
 # dnet_device_mem_bytes{kind=}: backend memory stats summed over local
 # devices, where the PJRT backend reports them (TPU/GPU; CPU returns none)

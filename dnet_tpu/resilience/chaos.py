@@ -57,6 +57,15 @@ INJECTION_POINTS: Tuple[str, ...] = (
     "rejoin",           # failure monitor's rejoin attempt: an injected
                         # error aborts the attempt (the shard re-earns its
                         # stability window), exercising rejoin retry
+    "wire_encode",      # hop-codec encode (PendingWirePayload.finalize /
+                        # the synchronous encode seam): a delay here wedges
+                        # the tx stage deterministically — the encode ring
+                        # fills and compute blocks on backpressure
+    "wire_decode",      # hop-codec decode: an error fails the frame's
+                        # decode exactly like a corrupt payload would.
+                        # Fires ASYNC at ingress before predecode (a delay
+                        # parks that frame's admission, not the loop) and
+                        # sync on the compute thread's fallback decode
 )
 
 _KINDS = ("error", "error_at", "delay")

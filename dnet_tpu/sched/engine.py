@@ -38,6 +38,7 @@ from dnet_tpu.sched.kinds import STATE_DECODING
 from dnet_tpu.sched.policy import SchedulerPolicy, TickPlan
 from dnet_tpu.sched.queue import SchedQueue
 from dnet_tpu.sched.step import MAX_STARVED_REQUEUES, TickResult, execute_tick
+from dnet_tpu.transport.wire_pipeline import wire_pipeline_enabled
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
@@ -249,8 +250,21 @@ class SchedulerAdapter(ApiAdapterBase):
                 if plan.empty():
                     continue
                 t0 = time.perf_counter()
+                on_decode = None
+                if plan.prefills and wire_pipeline_enabled():
+                    # wire-pipeline tick dispatch: decode results leave the
+                    # compute thread the moment the batched dispatch lands,
+                    # so their futures resolve while this tick's prefill
+                    # chunks are still burning — decode TPOT stops paying
+                    # for co-scheduled prompt work.  call_soon_threadsafe
+                    # is the sanctioned bridge (domains.BRIDGE_MODULES);
+                    # FIFO loop ordering guarantees every early resolve
+                    # runs before the executor future resumes _apply.
+                    on_decode = lambda nonce, sample: loop.call_soon_threadsafe(  # noqa: E731
+                        self._dispatch_decode, plan, nonce, sample
+                    )
                 result = await loop.run_in_executor(
-                    self._executor, execute_tick, self.engine, plan
+                    self._executor, execute_tick, self.engine, plan, on_decode
                 )
                 _TICK_MS.observe((time.perf_counter() - t0) * 1000.0)
                 _BATCH_TOKENS.labels(kind="prefill").observe(
@@ -274,6 +288,16 @@ class SchedulerAdapter(ApiAdapterBase):
                     # futures instead of wedging them to their timeouts
                     self._futures.fail_all(str(exc))
                 continue
+
+    def _dispatch_decode(self, plan: TickPlan, nonce: str, sample) -> None:
+        """Early decode resolution (wire-pipeline tick dispatch): runs on
+        the loop via call_soon_threadsafe while the tick's prefill chunks
+        are still executing.  _apply later skips nonces listed in
+        TickResult.dispatched, so a result resolves exactly once."""
+        step = plan.steps.get(nonce)
+        if step is None:
+            return
+        self._resolve_step(nonce, step, sample=sample)
 
     def _fail_plan(self, plan: TickPlan, error: str) -> None:
         """A tick that died wholesale (executor torn down mid-flight):
@@ -333,7 +357,10 @@ class SchedulerAdapter(ApiAdapterBase):
             req.starved = 0
             step = req.pending_step if req.pending_step is not None else 0
             self._resolve_step(nonce, step, sample=sample)
+        dispatched = set(result.dispatched)
         for nonce, sample in result.decode_results.items():
+            if nonce in dispatched:
+                continue  # already resolved mid-tick (wire-pipeline path)
             step = plan.steps.get(nonce)
             if step is None:
                 continue

@@ -64,6 +64,10 @@ class TickResult:
     #: PREFILLING requests that gave their staged work back (starved /
     #: lost the slot race) and should retry from WAITING
     requeued: List[str] = field(default_factory=list)
+    #: nonces whose decode result was already handed off mid-tick through
+    #: the wire-pipeline dispatch seam (execute_tick's on_decode) — the
+    #: loop-side apply must not resolve these a second time
+    dispatched: List[str] = field(default_factory=list)
     prefill_tokens: int = 0
     decode_lanes: int = 0
 
@@ -215,7 +219,15 @@ def _handle_prefill_starvation(
     res.errors[chunk.nonce] = str(exc)
 
 
-def execute_tick(engine, plan: TickPlan) -> TickResult:
+def execute_tick(engine, plan: TickPlan, on_decode=None) -> TickResult:
+    """One tick on the compute thread.  ``on_decode`` is the wire-pipeline
+    dispatch seam (DNET_WIRE_PIPELINE=1): when set, each decode result is
+    handed off the moment the batched dispatch lands — BEFORE this tick's
+    prefill chunks run — so decode futures resolve (and, on a ring, the
+    next hop's frames launch) while prompt tokens are still burning,
+    instead of barriering the whole tick behind its slowest segment.
+    Results dispatched this way are also recorded in ``dispatched`` so the
+    loop-side apply doesn't resolve them twice."""
     res = TickResult()
     reqs = dict(plan.decode)
     if reqs and getattr(engine, "kv_pool", None) is not None:
@@ -226,6 +238,15 @@ def execute_tick(engine, plan: TickPlan) -> TickResult:
         res.decode_results.update(out)
         res.errors.update(errs)
         res.decode_lanes = len(reqs)
+        if on_decode is not None:
+            for nonce, sample in out.items():
+                try:
+                    on_decode(nonce, sample)
+                    res.dispatched.append(nonce)
+                except Exception:
+                    # a failed early dispatch falls back to the barriered
+                    # apply path — the result is still in decode_results
+                    log.exception("early decode dispatch failed for %s", nonce)
     for chunk in plan.prefills:
         if chunk.nonce in res.preempted:
             continue

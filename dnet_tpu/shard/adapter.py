@@ -23,11 +23,13 @@ from dnet_tpu.resilience import chaos
 from dnet_tpu.resilience.policy import call_with_retry
 from dnet_tpu.transport.protocol import ActivationFrame, TokenPayload
 from dnet_tpu.transport.stream_manager import StreamManager
+from dnet_tpu.transport.wire_pipeline import PendingWirePayload, WireTxStage
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
 
 _RX_BYTES = metric("dnet_transport_rx_bytes_total")
+_WIRE_BYTES = metric("dnet_wire_bytes_total")
 _TOKEN_RPC_MS = metric("dnet_token_rpc_ms")
 
 
@@ -62,6 +64,10 @@ class RingAdapter:
         self._tasks: list[asyncio.Task] = []
         self._stream_idle_s = stream_idle_s
         self._backoff_s = backoff_s
+        # wire-pipeline tx stage (transport/wire_pipeline.py): finalizes
+        # pending device encodes on its own executor thread so the egress
+        # worker's D2H readback overlaps the compute thread's next step
+        self._wire_tx = WireTxStage()
         # ingress dedup: a sender whose stream broke re-opens and re-sends
         # the in-flight frame; if the first copy already made it into the
         # compute queue the duplicate must be ACKed, not re-computed.  Key
@@ -80,6 +86,7 @@ class RingAdapter:
         for t in self._tasks:
             t.cancel()
         self._tasks = []
+        self._wire_tx.shutdown()
         await self.reset_topology()
 
     # ---- topology -------------------------------------------------------
@@ -129,6 +136,7 @@ class RingAdapter:
         Returns (ok, message) for the ACK."""
         n_bytes = len(getattr(frame, "payload", b"") or b"")
         _RX_BYTES.inc(n_bytes)
+        _WIRE_BYTES.labels(dir="rx").inc(n_bytes)
         # t_sent (the SENDER's wall clock) rides into the span so the
         # cluster-stitched timeline can show per-hop wire time once both
         # endpoints' clock offsets are known (obs/clock.py)
@@ -165,6 +173,21 @@ class RingAdapter:
                 return True, "duplicate"
             msg = frame.to_message()
             msg.t_recv = time.perf_counter()
+            if compute.will_predecode(msg, self.runtime.queue_depth):
+                # rx half of the wire pipeline: launch H2D + dequant NOW
+                # (async dispatch) so this frame's decode overlaps the
+                # step the compute thread is currently inside.  The chaos
+                # gate is the ASYNC flavor — a delay injection parks this
+                # frame's admission, not the whole event loop.
+                try:
+                    await chaos.inject_async("wire_decode")
+                    compute.predecode(msg)
+                except Exception as exc:
+                    log.error(
+                        "wire decode failed for %s seq=%d: %s",
+                        frame.nonce, frame.seq, exc,
+                    )
+                    return False, f"wire decode failed: {exc}"
             if not self.runtime.submit(msg, timeout=0.0 if self.runtime.queue_depth else 5.0):
                 return False, "backpressure"
             self._seen[key] = True
@@ -196,7 +219,23 @@ class RingAdapter:
 
     async def _send_activation(self, msg: ActivationMessage) -> None:
         t0 = time.perf_counter()
+        if isinstance(msg.data, PendingWirePayload):
+            # pipelined hop: the compute thread only launched the encode;
+            # the tx stage pays the D2H readback + byte packing HERE, on
+            # its own executor, while compute is already in the next step.
+            # The frame that goes on the wire is fully encoded — a stream
+            # re-open re-sends these exact bytes with this seq (the PR 4
+            # dedup/resume contract needs the re-send to be identical).
+            pending = msg.data
+            msg.data = await self._wire_tx.finalize(pending)
+            get_recorder().span(
+                msg.nonce, "wire_encode",
+                (time.perf_counter() - t0) * 1000.0,
+                seq=msg.seq, bytes=len(msg.data),
+            )
         streams = self._ensure_next()
+        from dnet_tpu.compression.wire import codec_name
+
         frame = ActivationFrame(
             nonce=msg.nonce,
             seq=msg.seq,
@@ -205,6 +244,7 @@ class RingAdapter:
             dtype=msg.dtype,
             shape=tuple(msg.shape),
             payload=msg.data if isinstance(msg.data, bytes) else bytes(msg.data),
+            codec=codec_name(msg.dtype),
             callback_url=msg.callback_url,
             decoding=_decoding_dict(msg),
             t_sent=time.time(),

@@ -49,6 +49,8 @@ class ShardCompute:
         spec_lookahead: int = 0,
         lanes: int = 0,
         prefix_cache: int = 0,
+        wire_codec: str = "",
+        wire_pipeline: Optional[bool] = None,
     ) -> None:
         from dnet_tpu.core.kvcache import resolve_kv_bits
 
@@ -119,6 +121,37 @@ class ShardCompute:
         self.compress_frac = compress_frac
         # 8 -> qsparse8_v1 (int8-affine kept columns), 0 -> sparse_v1
         self.compress_quant_bits = t.compress_quant_bits
+        # hop codec + overlapped wire pipeline (transport/wire_pipeline.py).
+        # The codec is resolved by the API's load fan-out per hop ("auto" ->
+        # qsparse8 for inter-host hops, lossless for same-host/loopback);
+        # a shard loaded without one keeps the safe lossless default so
+        # greedy SSE parity holds out of the box.  With the pipeline on,
+        # _encode_activation only LAUNCHES the device encode and the tx
+        # stage finishes it off-thread; the depth-bounded encode ring is
+        # the backpressure coupling compute to wire drain.
+        from dnet_tpu.transport.wire_pipeline import (
+            EncodeRing,
+            wire_pipeline_enabled,
+        )
+
+        w = get_settings().wire
+        if not wire_codec:
+            wire_codec = "lossless" if w.codec == "auto" else w.codec
+        if wire_codec not in ("lossless", "qsparse8"):
+            raise ValueError(
+                f"unknown wire codec {wire_codec!r} (lossless | qsparse8)"
+            )
+        self.wire_codec = wire_codec
+        self.wire_pipeline = (
+            wire_pipeline_enabled() if wire_pipeline is None
+            else bool(wire_pipeline)
+        )
+        self._wire_pct = w.qsparse_pct
+        self._wire_gs = w.group_size
+        self._encode_ring = EncodeRing(w.depth) if self.wire_pipeline else None
+        # rx pre-decode depth: same knob as the tx ring — each pre-decoded
+        # frame pins a fully-expanded activation on device (will_predecode)
+        self._rx_depth = max(int(w.depth), 1)
         # ring speculation (composed with decode grants): the HEAD widens
         # granted continuation entries into [tok, drafts] verify blocks
         # (prompt-lookup against a host-side history), the TAIL verifies
@@ -166,6 +199,58 @@ class ShardCompute:
             from dnet_tpu.core.prefix_cache import SnapshotStore
 
             self.prefix_snaps = SnapshotStore(prefix_cache)
+        # jit-launched wire encode covers the closed, warmable frame-width
+        # set this shard's hot loop emits: single decode (1), lane widths
+        # (2..lanes), and spec verify blocks (1+lookahead) — prompt frames
+        # carry their REAL token count and encode synchronously instead
+        # (a per-prompt-length compile would be a worse stall than the
+        # encode it hides).  Decided HERE, after _spec_ok/lane_pool exist.
+        self._wire_jit_rows = max(
+            int(lanes),
+            1 + self.spec_lookahead if self._spec_ok else 1,
+            1,
+        )
+        if self.wire_pipeline:
+            self._warm_wire()
+
+    def _warm_wire(self) -> None:
+        """Pre-compile the jitted hop encode for every frame shape the
+        pipeline launches (decode R=1, plus each lane width when lanes are
+        pooled): the wire pipeline's whole point is a ~0 serial launch,
+        and a mid-flight trace+compile on the compute thread would be
+        exactly the stall it exists to remove.  The jits are
+        process-cached (compression/ops), so repeated loads re-use the
+        compiled programs."""
+        frac, qbits = self._wire_params()
+        D = self.engine.config.hidden_size
+        nd = self.engine.param_dtype
+        from dnet_tpu.compression import (
+            decompress_tensor_device,
+            is_compressed_dtype,
+            launch_encode,
+        )
+
+        t0 = time.perf_counter()
+        for rows in range(1, self._wire_jit_rows + 1):
+            x = jnp.zeros((rows, 1, D), dtype=nd)
+            # straight DeviceEncode: no ring slot, no chaos, no metrics —
+            # this is load-time warmup, not a served frame
+            enc = launch_encode(
+                x, frac, wire_dtype=self.wire_dtype, quant_bits=qbits,
+                group_size=self._wire_gs,
+            )
+            payload = enc.finalize()
+            # warm the DECODE program for the same shape too: ingress
+            # predecode runs on the event loop, and a first-frame
+            # trace+compile there would stall every stream on this shard
+            if is_compressed_dtype(enc.dtype):
+                decompress_tensor_device(payload, enc.dtype, enc.shape)
+        log.info(
+            "wire encode warmed for %d frame shapes (codec=%s) in %.2fs",
+            self._wire_jit_rows,
+            self.wire_codec if (frac or qbits) else "lossless",
+            time.perf_counter() - t0,
+        )
 
     @property
     def max_layer(self) -> int:
@@ -189,20 +274,70 @@ class ShardCompute:
             if self.prefix_snaps is not None:
                 self.prefix_snaps.clear()
 
-    def _decode_payload(self, msg: ActivationMessage, pos: int):
-        """Incoming hidden frame -> padded device array + real length.
+    def _payload_to_device(self, msg: ActivationMessage):
+        """Hidden payload bytes -> device array, THE shared rx decode seam
+        (single frames, verify blocks, lane batches).  A frame the wire
+        pipeline pre-decoded at ingress (predecode) already carries the
+        device array — zero work here, the dequant overlapped the previous
+        step's compute.  Compressed frames decompress ON DEVICE (Pallas
+        dequant+scatter on TPU): only the compact codes/scales upload, and
+        the single-threaded Python receive path never touches per-element
+        data (the host-detour gap VERDICT r2 flagged)."""
+        if msg.device_data is not None:
+            return msg.device_data
+        from dnet_tpu.resilience import chaos
 
-        Compressed frames decompress ON DEVICE (Pallas dequant+scatter on
-        TPU): only the compact codes/scales upload, and the single-threaded
-        Python receive path never touches per-element data (the host-detour
-        gap VERDICT r2 flagged)."""
+        # rx codec fault point, compute-thread flavor (the ingress flavor
+        # is the adapter's async inject before predecode — one injection
+        # per frame either way)
+        chaos.inject("wire_decode")
+        return self._decode_to_device(msg, hidden=False)
+
+    def _decode_to_device(self, msg: ActivationMessage, hidden: bool):
+        """The ONE rx decode body (device dequant/upload + attribution):
+        `hidden` says whether this ran at ingress (overlapped with the
+        current step) or on the compute thread."""
         from dnet_tpu.compression import decompress_tensor_device, is_compressed_dtype
+        from dnet_tpu.transport.wire_pipeline import observe_decode
 
-        eng = self.engine
+        t0 = time.perf_counter()
         if is_compressed_dtype(msg.dtype):
-            hidden = decompress_tensor_device(msg.data, msg.dtype, msg.shape)
+            out = decompress_tensor_device(msg.data, msg.dtype, msg.shape)
         else:
-            hidden = bytes_to_device(msg.data, msg.dtype, msg.shape)
+            out = bytes_to_device(msg.data, msg.dtype, msg.shape)
+        observe_decode((time.perf_counter() - t0) * 1000.0, hidden=hidden)
+        return out
+
+    def will_predecode(self, msg: ActivationMessage, backlog: int) -> bool:
+        """Should ingress pre-decode this frame?  Only with the pipeline
+        on, for hidden payloads, and only while the compute queue is
+        SHALLOW: each pre-decoded frame pins a fully-expanded activation
+        on device, so the rx side is depth-bounded exactly like the tx
+        encode ring — a backlogged queue keeps compact wire bytes and
+        lets the compute thread decode frames as it reaches them."""
+        return (
+            self.wire_pipeline
+            and not msg.is_tokens
+            and not msg.is_final
+            and bool(msg.data)
+            and msg.device_data is None
+            and backlog < self._rx_depth
+        )
+
+    def predecode(self, msg: ActivationMessage) -> None:
+        """rx half of the wire pipeline: launch H2D upload + on-device
+        dequant for a frame that is about to be QUEUED, so its decode
+        overlaps the step currently computing.  Called at adapter ingress
+        (event-loop thread; jax dispatch is async, so this never blocks
+        the loop past the dispatch itself) after a `will_predecode`
+        check — the chaos gate lives at the call site (async, so a delay
+        injection parks only this frame, not the whole loop)."""
+        msg.device_data = self._decode_to_device(msg, hidden=True)
+
+    def _decode_payload(self, msg: ActivationMessage, pos: int):
+        """Incoming hidden frame -> padded device array + real length."""
+        eng = self.engine
+        hidden = self._payload_to_device(msg)
         T = hidden.shape[1]
         if pos + T > eng.max_seq:
             raise ValueError(f"sequence {pos + T} exceeds max_seq {eng.max_seq}")
@@ -380,15 +515,7 @@ class ShardCompute:
             tokens = msg.tokens().reshape(n, 1).astype(np.int32)
             out = pool.step_entry(msg, tokens, self.is_last)
         else:
-            from dnet_tpu.compression import (
-                decompress_tensor_device,
-                is_compressed_dtype,
-            )
-
-            if is_compressed_dtype(msg.dtype):
-                hidden = decompress_tensor_device(msg.data, msg.dtype, msg.shape)
-            else:
-                hidden = bytes_to_device(msg.data, msg.dtype, msg.shape)
+            hidden = self._payload_to_device(msg)
             if hidden.shape[0] != n or hidden.shape[1] != 1:
                 raise ValueError(
                     f"batch frame payload {hidden.shape} does not match "
@@ -399,18 +526,92 @@ class ShardCompute:
             return self._lane_finals_message(msg, out)
         return self._emit_lanes(msg, out)
 
-    def _emit_lanes(self, msg: ActivationMessage, x) -> ActivationMessage:
-        """Hidden hop of a batch frame: member rows stacked [n, 1, H]."""
-        out = np.asarray(x)
+    # ---- wire encode (the single egress seam) --------------------------
+    def _wire_params(self) -> tuple:
+        """(drop_frac, quant_bits) the hop codec resolves to: the qsparse8
+        hop codec is int8 group quant over the kept columns (column drop
+        from the transport compression settings when configured, else the
+        wire default); the lossless codec keeps the legacy behavior —
+        plain wire-dtype cast, or the old sparsify path when transport
+        compression is explicitly on."""
+        if self.wire_codec == "qsparse8":
+            frac = self.compress_frac if self.compress_frac > 0 else self._wire_pct
+            return frac, 8
         if self.compress_frac > 0:
+            return self.compress_frac, self.compress_quant_bits
+        return 0.0, 0
+
+    def _encode_activation(self, x, T: Optional[int] = None,
+                           force_sync: bool = False):
+        """THE hop-encode seam: every outgoing hidden payload (single
+        frames, lane batches, calibration probes) serializes here.
+        Returns (data, dtype, shape) — data is payload bytes on the
+        synchronous path, or a PendingWirePayload the transport tx stage
+        finalizes when the wire pipeline is on (the compute thread only
+        pays the jitted encode DISPATCH; D2H readback + byte packing
+        overlap the next step's compute).  ``x`` may be a device array;
+        with ``T`` the padded tail is sliced off first.  The sliced
+        activation is DONATED to the device encode — dead after this call
+        (the DL021 contract)."""
+        if T is not None:
+            x = x[:, :T]
+        frac, qbits = self._wire_params()
+        # the jitted launch compiles one program per ROW count; decode and
+        # lane frames draw from a tiny warmable set (1..lanes), but prompt
+        # frames carry their REAL token count — jit-launching those would
+        # compile per distinct prompt length, a worse stall than the
+        # encode it hides.  The per-token hot loop rides the pipeline;
+        # one-per-request prompt frames encode synchronously.
+        rows = int(np.prod(x.shape[:-1]))
+        if self.wire_pipeline and not force_sync and rows <= self._wire_jit_rows:
+            from dnet_tpu.compression import launch_encode
+            from dnet_tpu.transport.wire_pipeline import (
+                PendingWirePayload,
+                overlap,
+            )
+
+            t_acq = time.perf_counter()
+            acquired = self._encode_ring.acquire()
+            t0 = time.perf_counter()
+            enc = launch_encode(
+                x, frac, wire_dtype=self.wire_dtype, quant_bits=qbits,
+                group_size=self._wire_gs,
+            )
+            pending = PendingWirePayload(
+                enc, ring=self._encode_ring if acquired else None
+            )
+            # serial = the launch dispatch only; a blocked acquire is the
+            # depth bound exerting backpressure, booked as stall instead
+            overlap.add(
+                serial_ms=(time.perf_counter() - t0) * 1000.0,
+                stall_ms=(t0 - t_acq) * 1000.0,
+            )
+            if not acquired:
+                # ring wedged past its wait budget (tx stage stuck): pay
+                # the readback here rather than deadlock — slower, bounded
+                return pending.finalize_sync(), enc.dtype, enc.shape
+            return pending, enc.dtype, enc.shape
+        from dnet_tpu.resilience import chaos
+        from dnet_tpu.transport.wire_pipeline import observe_encode
+
+        out = np.asarray(x)
+        t0 = time.perf_counter()
+        chaos.inject("wire_encode")
+        if frac > 0 or qbits:
             from dnet_tpu.compression import compress_tensor
 
             payload, dtype, shape = compress_tensor(
-                out, self.compress_frac, wire_dtype=self.wire_dtype,
-                quant_bits=self.compress_quant_bits,
+                out, frac, wire_dtype=self.wire_dtype, quant_bits=qbits,
+                group_size=self._wire_gs,
             )
         else:
             payload, dtype, shape = tensor_to_bytes(out, wire_dtype=self.wire_dtype)
+        observe_encode((time.perf_counter() - t0) * 1000.0, hidden=False)
+        return payload, dtype, shape
+
+    def _emit_lanes(self, msg: ActivationMessage, x) -> ActivationMessage:
+        """Hidden hop of a batch frame: member rows stacked [n, 1, H]."""
+        payload, dtype, shape = self._encode_activation(x)
         return ActivationMessage(
             nonce=msg.nonce,
             layer_id=self.max_layer,
@@ -576,17 +777,9 @@ class ShardCompute:
             sess.counts = sess.counts.at[:, int(res.token[0])].add(1)
             return self._final_message(msg, res, sess)
 
-        # hidden hop to the next shard: slice off the padding, cast to wire
-        out = np.asarray(x[:, :T])
-        if self.compress_frac > 0:
-            from dnet_tpu.compression import compress_tensor
-
-            payload, dtype, shape = compress_tensor(
-                out, self.compress_frac, wire_dtype=self.wire_dtype,
-                quant_bits=self.compress_quant_bits,
-            )
-        else:
-            payload, dtype, shape = tensor_to_bytes(out, wire_dtype=self.wire_dtype)
+        # hidden hop to the next shard: slice off the padding, encode for
+        # the wire (pipelined: launch-only here, tx stage finishes it)
+        payload, dtype, shape = self._encode_activation(x, T=T)
         return ActivationMessage(
             nonce=msg.nonce,
             layer_id=out_layer,
@@ -667,9 +860,10 @@ class ShardCompute:
         (first step discarded: it pays compile).  Feeds the solver
         calibration loop (parallel/calibrate.py) — the counterpart of the
         solve-time `predicted_stage_s`.  Multi-round assignments time every
-        round a token pass visits."""
-        from dnet_tpu.utils.serialization import tensor_to_bytes
-
+        round a token pass visits.  Synthetic hidden frames ride the same
+        _encode_activation seam the real egress uses (sync-forced: the
+        probe needs concrete bytes), so the probe measures the true hop
+        shape — hop codec and decompress included."""
         nonce = "__calibrate__"
         self.reset(nonce)
         eng = self.engine
@@ -688,15 +882,24 @@ class ShardCompute:
                         hidden = np.zeros(
                             (1, 1, eng.config.hidden_size), np.float32
                         )
-                        data, dtype, shape = tensor_to_bytes(
-                            hidden, self.wire_dtype
+                        data, dtype, shape = self._encode_activation(
+                            hidden, force_sync=True
                         )
                         msg = ActivationMessage(
                             nonce=nonce, layer_id=run[0] - 1, seq=i,
                             dtype=dtype, shape=shape, data=data, pos=i,
                         )
                     out = self.process(msg)
-                    if out.data is not None and hasattr(out.data, "block_until_ready"):
+                    from dnet_tpu.transport.wire_pipeline import (
+                        PendingWirePayload,
+                    )
+
+                    if isinstance(out.data, PendingWirePayload):
+                        # the probe IS the consumer: pay the readback here
+                        # (and free the ring slot) so the timing covers
+                        # the full hop encode, pipeline or not
+                        out.data.finalize_sync()
+                    elif out.data is not None and hasattr(out.data, "block_until_ready"):
                         out.data.block_until_ready()  # dnetlint: disable=DL005 latency calibration probe: the sync IS the measurement
                 durations.append(time.perf_counter() - t0)
         finally:

@@ -41,6 +41,11 @@ class ShardLoadModelRequest(BaseModel):
     api_callback_address: str = ""
     param_dtype: str = "bfloat16"
     wire_dtype: str = "bfloat16"
+    # hop codec for this shard's outgoing hidden frames ("lossless" |
+    # "qsparse8"; "" = the shard's own DNET_WIRE_CODEC default).  The API
+    # resolves "auto" per hop: qsparse8 when the next shard is on another
+    # host, lossless for same-host/loopback hops (greedy SSE parity).
+    wire_codec: str = ""
     weight_quant_bits: int = 0
     # host-local mesh axes for this shard's window (parallel/shard_mesh.py):
     # 0 = use the shard's own DNET_SHARD_MESH_* defaults; -1 tp = all chips

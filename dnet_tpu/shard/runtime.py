@@ -116,6 +116,21 @@ class ShardRuntime:
             self.recv_q.put_nowait(None)  # wake the worker; full queue is fine,
         except queue.Full:  # the worker exits on the next timeout poll
             pass
+        # frames parked in out_q may hold wire-pipeline encode-ring slots;
+        # with the egress worker already gone nobody will finalize them,
+        # and a compute thread blocked in EncodeRing.acquire would ride
+        # out its full wait budget and blow the join below — release the
+        # slots (no readback) so the worker can reach the stop flag
+        from dnet_tpu.transport.wire_pipeline import PendingWirePayload
+
+        if self.out_q is not None:
+            try:
+                while True:
+                    out = self.out_q.get_nowait()
+                    if isinstance(out.data, PendingWirePayload):
+                        out.data.discard()
+            except asyncio.QueueEmpty:
+                pass
         if self._thread:
             self._thread.join(timeout=5)
             if self._thread.is_alive():
@@ -150,6 +165,7 @@ class ShardRuntime:
         lanes: int = 0,
         prefix_cache: int = 0,
         epoch: int = 0,
+        wire_codec: str = "",
     ) -> None:
         """Blocking (call from an executor)."""
         with self._model_lock:
@@ -174,6 +190,7 @@ class ShardRuntime:
                 spec_lookahead=spec_lookahead,
                 lanes=lanes,
                 prefix_cache=prefix_cache,
+                wire_codec=wire_codec,
             )
             self.model_path = str(model_dir)
             self._set_epoch_locked(epoch)
@@ -342,6 +359,13 @@ class ShardRuntime:
                 "output queue full; dropping frame for %s seq=%d "
                 "(error surfaced upstream)", out.nonce, out.seq,
             )
+            # a dropped pipelined frame still holds an encode-ring slot:
+            # release it (no readback) or the compute thread wedges behind
+            # a payload nobody will ever finalize
+            from dnet_tpu.transport.wire_pipeline import PendingWirePayload
+
+            if isinstance(out.data, PendingWirePayload):
+                out.data.discard()
             # a dropped batch frame must fail every member driver (a
             # dropped lane-finals message names its members by `step`)
             members = out.lanes or [

@@ -54,6 +54,7 @@ class Shard:
                 max_seq=req.max_seq_len,
                 param_dtype=req.param_dtype,
                 wire_dtype=req.wire_dtype,
+                wire_codec=req.wire_codec,
                 window_size=req.window_size,
                 residency_size=req.residency_size,
                 kv_bits=req.kv_bits,

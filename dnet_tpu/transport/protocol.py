@@ -91,6 +91,11 @@ class ActivationFrame:
     # 0 = unfenced.  Shards pin their epoch at load and NACK any frame
     # from a different epoch — the zombie/split-brain fence.
     epoch: int = 0
+    # resolved hop-codec name ("bfloat16" lossless cast, "sparse_v1",
+    # "qsparse8_v1" — compression.wire.codec_name): first-class so
+    # receivers/benches can attribute per-hop bytes without re-parsing the
+    # dtype tag.  "" on frames from senders predating the wire pipeline.
+    codec: str = ""
 
     def to_bytes(self) -> bytes:
         d = asdict(self)

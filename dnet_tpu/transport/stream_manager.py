@@ -27,6 +27,7 @@ _TX_BYTES = metric("dnet_transport_tx_bytes_total")
 _TX_FRAMES = metric("dnet_transport_tx_frames_total")
 _BACKPRESSURE = metric("dnet_transport_backpressure_total")
 _REOPENS = metric("dnet_stream_reopens_total")
+_WIRE_BYTES = metric("dnet_wire_bytes_total")
 
 
 @dataclass
@@ -121,6 +122,7 @@ class StreamManager:
         ctx.last_used = time.monotonic()
         n_bytes = len(getattr(frame, "payload", b"") or b"")
         _TX_BYTES.inc(n_bytes)
+        _WIRE_BYTES.labels(dir="tx").inc(n_bytes)
         _TX_FRAMES.inc()
         get_recorder().span(
             nonce, "transport_send", (time.perf_counter() - t0) * 1000,

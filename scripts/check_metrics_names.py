@@ -40,6 +40,7 @@ from dnet_tpu.analysis.metrics_checks import (  # noqa: E402,F401 — re-exporte
     check_san_labels,
     check_sched_labels,
     check_sources,
+    check_wire_labels,
     main,
 )
 
