@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="in-process: serve through the iteration-level "
                    "scheduler (DNET_SCHED=1, dnet_tpu/sched/) instead of "
                    "the legacy kick-coalescing engine path")
+    p.add_argument("--ring-tp", action="store_true",
+                   help="drive the workload over the in-process two-shard "
+                   "ring THREE times — tp=1 baseline (r04's pipelined wire "
+                   "config), tensor-parallel lossless, and q8 quantized "
+                   "collectives — and emit one composite report with "
+                   "meta.tp and collective-byte books per leg "
+                   "(parallel/tp.py)")
     p.add_argument("--ring-inproc", action="store_true",
                    help="drive the workload over an in-process two-shard "
                    "ring TWICE — legacy serial wire vs the overlapped "
@@ -68,6 +75,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--wire-pct", type=float, default=0.75,
                    help="ring-inproc: qsparse8 column-drop fraction for "
                    "the pipelined leg (DNET_WIRE_QSPARSE_PCT)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="in-process ring legs: NamedSharding tensor-"
+                   "parallel degree per shard (parallel/tp.py; 0 = the "
+                   "DNET_TP default, 1 = single-chip).  Forced-host CPU "
+                   "devices emulate the chips under tier-1.")
+    p.add_argument("--tp-collective", default="",
+                   help="ring-inproc: TP collective mode for every shard "
+                   "(auto|lossless|q8; '' = DNET_TP_COLLECTIVE default)")
     p.add_argument("--max-seq", type=int, default=1024)
     p.add_argument("--param-dtype", default="bfloat16")
     p.add_argument("--out", default="", help="report path (default: next "
@@ -127,6 +142,17 @@ def _kv_mode(engine) -> str:
     if getattr(engine, "kv_pool", None) is not None:
         return "paged"
     return "dense"
+
+
+def _tp_mode(engine) -> dict:
+    """meta.tp: the RESOLVED tensor-parallel shape of one engine (the
+    meta.kv discipline — a clamped DNET_TP must stamp what actually
+    served).  degree 1 = the pre-TP single-chip behavior."""
+    from dnet_tpu.parallel.tp import TpEngine
+
+    if isinstance(engine, TpEngine):
+        return {"degree": engine.tp, "collective": engine.collective_mode}
+    return {"degree": 1, "collective": "lossless"}
 
 
 async def _run_remote(args, spec) -> dict:
@@ -201,6 +227,7 @@ async def _run_inprocess(args, spec) -> dict:
                     "mode": "in-process",
                     "engine": "sched" if args.sched else "legacy",
                     "kv": _kv_mode(manager.engine),
+                    "tp": _tp_mode(manager.engine),
                     "slots": args.slots,
                     "max_seq": args.max_seq,
                     "param_dtype": args.param_dtype,
@@ -212,7 +239,8 @@ async def _run_inprocess(args, spec) -> dict:
     return result.report
 
 
-async def _ring_leg(args, spec, *, pipeline: bool, codec: str) -> dict:
+async def _ring_leg(args, spec, *, pipeline: bool, codec: str,
+                    tp: int = None, tp_collective: str = None) -> dict:
     """One ring run: fresh two-shard in-process ring, fresh obs books,
     the full loadgen client over a real loopback HTTP port.  Returns the
     loadgen report extended with the harness's per-hop wire accounting
@@ -248,6 +276,10 @@ async def _ring_leg(args, spec, *, pipeline: bool, codec: str) -> dict:
         max_seq=args.max_seq,
         param_dtype=args.param_dtype,
         wire_codec=codec,
+        tp=args.tp if tp is None else tp,
+        tp_collective=(
+            args.tp_collective if tp_collective is None else tp_collective
+        ),
     )
     await ring.start()
     port = _free_port()
@@ -267,14 +299,33 @@ async def _ring_leg(args, spec, *, pipeline: bool, codec: str) -> dict:
                     "qsparse_pct": args.wire_pct if codec == "qsparse8" else None,
                     "shards": 2,
                     "layers": [list(ring.layers0), list(ring.layers1)],
+                    # the RESOLVED per-shard TP shape (parallel/tp.py):
+                    # what actually served, not what --tp asked for
+                    "tp": _tp_mode(ring.s0.compute.engine),
                     "max_seq": args.max_seq,
                     "param_dtype": args.param_dtype,
                 },
             )
+            # resolved TP shape, read while the engines are still alive
+            # (ring.stop() frees them)
+            tp_meta = _tp_mode(ring.s0.compute.engine)
     finally:
         await ring.server.stop()
         await ring.stop()
     report = result.report
+    # TP collective books for this leg (obs was reset at leg start, so the
+    # absolute values ARE the leg totals): the analytic per-dispatch
+    # interconnect bytes plus the load-time latency probe medians
+    coll_ms = metric("dnet_tp_collective_ms").labels(op="all_reduce")
+    report["tp"] = {
+        **tp_meta,
+        "collective_bytes_all_reduce": metric(
+            "dnet_tp_collective_bytes_total"
+        ).labels(op="all_reduce").value,
+        "collective_probe_ms_all_reduce": round(
+            coll_ms.sum / coll_ms.count, 3
+        ) if coll_ms.count else None,
+    }
     wire = ring.stats.as_dict()
     ov = overlap.snapshot()
     hidden_frames = sum(wire["hidden_frames"].values()) or 1
@@ -374,6 +425,85 @@ async def _run_ring_inproc(args, spec) -> dict:
     }
 
 
+async def _run_ring_tp(args, spec) -> dict:
+    """Hybrid TP x PP legs over the SAME seeded workload and the SAME
+    two-shard in-process ring as r04: the tp=1 baseline (directly
+    comparable to r04's pipelined leg — identical wire config), the
+    tensor-parallel lossless leg (byte-identical streams, TP speedup
+    bounded here by CPU chip emulation), and the q8 quantized-collective
+    leg (strictly fewer interconnect bytes).  One composite record with
+    meta.tp stamped per leg."""
+    import os
+
+    from dnet_tpu.config import reset_settings_cache
+
+    tp = args.tp if args.tp > 0 else 4  # 0 = unset; an explicit 1 is honored
+    admit_depth = str(spec.requests)
+    admit_timeout = str(spec.timeout_s)
+    os.environ["DNET_ADMIT_QUEUE_DEPTH"] = admit_depth
+    os.environ["DNET_ADMIT_QUEUE_TIMEOUT_S"] = admit_timeout
+    try:
+        base = await _ring_leg(
+            args, spec, pipeline=True, codec="qsparse8", tp=1,
+            tp_collective="lossless",
+        )
+        tp_lossless = await _ring_leg(
+            args, spec, pipeline=True, codec="qsparse8", tp=tp,
+            tp_collective="lossless",
+        )
+        tp_q8 = await _ring_leg(
+            args, spec, pipeline=True, codec="qsparse8", tp=tp,
+            tp_collective="q8",
+        )
+    finally:
+        os.environ.pop("DNET_WIRE_PIPELINE", None)
+        os.environ.pop("DNET_WIRE_QSPARSE_PCT", None)
+        os.environ.pop("DNET_ADMIT_QUEUE_DEPTH", None)
+        os.environ.pop("DNET_ADMIT_QUEUE_TIMEOUT_S", None)
+        reset_settings_cache()
+    return {
+        "kind": "bench_serve_ring_tp",
+        "spec": base["spec"],
+        "meta": {
+            "mode": "ring-tp",
+            "model": args.model,
+            "tp": tp,
+            "admit_queue_depth": admit_depth,
+            "admit_queue_timeout_s": admit_timeout,
+        },
+        "tp1": base,
+        "tp_lossless": tp_lossless,
+        "tp_q8": tp_q8,
+        "comparison": {
+            "goodput_tok_s_tp1": base["goodput"]["tok_s"],
+            "goodput_tok_s_tp_lossless": tp_lossless["goodput"]["tok_s"],
+            "goodput_tok_s_tp_q8": tp_q8["goodput"]["tok_s"],
+            "completed_tp1": base["requests"]["completed"],
+            "completed_tp_lossless": tp_lossless["requests"]["completed"],
+            "completed_tp_q8": tp_q8["requests"]["completed"],
+            "collective_bytes_lossless": tp_lossless["tp"][
+                "collective_bytes_all_reduce"
+            ],
+            "collective_bytes_q8": tp_q8["tp"][
+                "collective_bytes_all_reduce"
+            ],
+        },
+    }
+
+
+def _summarize_ring_tp(report: dict) -> str:
+    c = report["comparison"]
+    return "\n".join([
+        f"ring tp legs (tp={report['meta']['tp']}): goodput "
+        f"{c['goodput_tok_s_tp1']}/{c['goodput_tok_s_tp_lossless']}/"
+        f"{c['goodput_tok_s_tp_q8']} tok/s (tp1/lossless/q8), completed "
+        f"{c['completed_tp1']}/{c['completed_tp_lossless']}/"
+        f"{c['completed_tp_q8']}",
+        f"collective bytes: lossless {c['collective_bytes_lossless']:.0f} "
+        f"-> q8 {c['collective_bytes_q8']:.0f}",
+    ])
+
+
 def _summarize_ring(report: dict) -> str:
     c = report["comparison"]
     return "\n".join([
@@ -395,6 +525,8 @@ def _summarize_ring(report: dict) -> str:
 
 
 def _summarize(report: dict) -> str:
+    if report.get("kind") == "bench_serve_ring_tp":
+        return _summarize_ring_tp(report)
     if report.get("kind") == "bench_serve_ring":
         return _summarize_ring(report)
     r = report["requests"]
@@ -450,12 +582,12 @@ def main(argv=None) -> int:
 
     reset_settings_cache()
     spec = _spec_from(args)
-    if args.ring_inproc:
+    if args.ring_inproc or args.ring_tp:
         if args.base_url:
-            print("error: --ring-inproc is an in-process mode",
+            print("error: --ring-inproc/--ring-tp are in-process modes",
                   file=sys.stderr)
             return 2
-        runner = _run_ring_inproc
+        runner = _run_ring_tp if args.ring_tp else _run_ring_inproc
     else:
         runner = _run_remote if args.base_url else _run_inprocess
     report = asyncio.run(runner(args, spec))

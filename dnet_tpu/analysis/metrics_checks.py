@@ -36,6 +36,8 @@ Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
 - DL026 wire          — wire-pipeline dir labels <-> obs/phases.py
   WIRE_DIRS both directions + the dnet_wire_* families required
   (pass 12; DL021-DL025 are the flow-sensitive tier, analysis/flow/)
+- the TP collective op labels cross-checked against obs/phases.py TP_OPS
+  both directions + the dnet_tp_* families required (pass 13)
 """
 
 from __future__ import annotations
@@ -564,6 +566,44 @@ def check_wire_labels(errors: list) -> int:
     return n
 
 
+def check_tp_labels(errors: list) -> int:
+    """Pass 13: the TP collective families must agree with the declared
+    op enum (dnet_tpu/obs/phases.py TP_OPS) both ways — a renamed or new
+    collective op cannot strand a stale label or ship without its series
+    — and the dnet_tp_* families the TP parity tests and BENCH_SERVE
+    meta.tp read must exist."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs.phases import TP_OPS
+
+    text = get_registry().expose()
+    n = 0
+    for op in TP_OPS:  # histogram children expose _bucket/_sum/_count
+        n += 1
+        if f'dnet_tp_collective_ms_count{{op="{op}"}}' not in text:
+            errors.append(
+                f"obs: obs.phases.TP_OPS value {op!r} has no "
+                f"dnet_tp_collective_ms series (pre-touch it in "
+                f"dnet_tpu.obs._register_core)"
+            )
+    for m in re.finditer(
+        r'dnet_tp_collective_ms(?:_bucket|_sum|_count)\{op="([^"]+)"', text
+    ):
+        if m.group(1) not in TP_OPS:
+            errors.append(
+                f"obs: exposed dnet_tp_collective_ms op label "
+                f"{m.group(1)!r} is not declared in obs.phases.TP_OPS"
+            )
+    n += _cross_check_labels(
+        errors, text, "dnet_tp_collective_bytes_total", "op",
+        TP_OPS, "obs.phases.TP_OPS",
+    )
+    fams = get_registry().families()
+    n += 1
+    if "dnet_tp_degree" not in fams:
+        errors.append("tp: required family dnet_tp_degree not registered")
+    return n
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -581,6 +621,7 @@ def main() -> int:
     n_sched = check_sched_labels(errors)
     n_jit = check_jit_instrumentation(errors)
     n_wire = check_wire_labels(errors)
+    n_tp = check_tp_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -590,7 +631,8 @@ def main() -> int:
           f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
           f"{n_member} membership labels, {n_attr} attribution labels, "
           f"{n_san} sanitizer labels, {n_sched} scheduler labels, "
-          f"{n_jit} jit call sites, {n_wire} wire labels, all conform")
+          f"{n_jit} jit call sites, {n_wire} wire labels, "
+          f"{n_tp} tp labels, all conform")
     return 0
 
 
@@ -704,6 +746,13 @@ class WireLabelContract(_MetricsCheck):
     pass_name = "check_wire_labels"
 
 
+class TpLabelContract(_MetricsCheck):
+    code = "DL027"
+    name = "tp-label-contract"
+    description = "tp collective op labels <-> TP_OPS + dnet_tp_* families exist"
+    pass_name = "check_tp_labels"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -717,4 +766,5 @@ METRICS_CHECKS = [
     SchedLabelContract(),
     JitInstrumentationContract(),
     WireLabelContract(),
+    TpLabelContract(),
 ]

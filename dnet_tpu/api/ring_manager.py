@@ -66,6 +66,7 @@ def build_manual_topology(
                 residency_size=a.get("residency_size", 0),
                 mesh_tp=a.get("mesh_tp", 0),
                 mesh_sp=a.get("mesh_sp", 0),
+                tp_degree=a.get("tp_degree", 0),
             )
         )
     las.sort(key=lambda a: a.min_layer)
@@ -193,6 +194,10 @@ class RingModelManager:
                 # rather than failing every shard load.
                 "mesh_tp": a.mesh_tp,
                 "mesh_sp": self._check_sp(a, max_seq),
+                # NamedSharding TP (parallel/tp.py): the solver's
+                # mesh-slice placement pins pure-TP shards here; 1 keeps
+                # a shard single-chip even when its DNET_TP says otherwise
+                "tp_degree": a.tp_degree,
                 # ring speculation: head drafts, tail verifies
                 # (0 when the topology/model can't rewind — see
                 # _spec_lookahead_for)

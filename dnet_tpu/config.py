@@ -457,6 +457,35 @@ class ChaosSettings(_EnvGroup):
 
 
 @dataclass
+class TpSettings(_EnvGroup):
+    """Intra-shard tensor parallelism (parallel/tp.py, parallel/
+    tp_collectives.py).
+
+    ``DNET_TP=N`` makes a ring shard run its layer window tensor-parallel
+    over N host-local chips on a ("batch", "model") NamedSharding mesh:
+    weights load pre-sharded (per-chip slices, never a full tensor on one
+    chip), the KV cache shards on the head axis, and each layer pays two
+    collectives — attention out-proj and MLP down-proj all-reduces —
+    routed through the quantizable seam.  ``TP_COLLECTIVE`` picks their
+    wire format: ``lossless`` (exact psum — greedy SSE byte-identical to
+    tp=1), ``q8`` (EQuARX-style grouped-int8: 1-byte codes + per-group
+    scale/bias instead of 2-4 byte floats), or ``auto`` (q8 on real
+    accelerator meshes, lossless on CPU).  A solver-placed topology
+    overrides the env default per shard via the load body's
+    ``tp_degree``.  1 = off, today's single-chip behavior.
+    """
+
+    env_prefix = "DNET_"
+    # tensor-parallel degree for shards loaded without an explicit
+    # tp_degree (1 = off); must divide the model's attention/KV head counts
+    tp: int = 1
+    # collective wire format: auto | lossless | q8
+    tp_collective: str = "auto"
+    # int8 quant group along the flattened activation for q8 collectives
+    tp_group_size: int = 64
+
+
+@dataclass
 class GrpcSettings(_EnvGroup):
     """gRPC channel tuning (reference: src/dnet/utils/grpc_config.py:29-53)."""
 
@@ -589,6 +618,7 @@ class Settings:
     membership: MembershipSettings = field(default_factory=MembershipSettings.from_env)
     sched: SchedSettings = field(default_factory=SchedSettings.from_env)
     san: SanSettings = field(default_factory=SanSettings.from_env)
+    tp: TpSettings = field(default_factory=TpSettings.from_env)
     chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
     grpc: GrpcSettings = field(default_factory=GrpcSettings.from_env)
     api: ApiSettings = field(default_factory=ApiSettings.from_env)
@@ -610,6 +640,7 @@ for _cls in (
     MembershipSettings,
     SchedSettings,
     SanSettings,
+    TpSettings,
     ChaosSettings,
     GrpcSettings,
     ApiSettings,

@@ -172,6 +172,11 @@ class DeviceInfo:
     hbm_bw: float = 0.0  # bytes/s
     host_to_hbm_bw: float = 0.0  # bytes/s (device_put rate)
     t_comm: float = 0.0  # median seconds to next device for solver payloads
+    # intra-host interconnect bandwidth (bytes/s per ICI link): what a
+    # tensor-parallel all-reduce inside this node's mesh slice pays per
+    # hop.  0 = unknown — the solver then neither merges this device into
+    # a mesh slice nor charges TP collective cost (today's behavior).
+    ici_bw: float = 0.0
 
     def ici_adjacent(self, other: "DeviceInfo") -> bool:
         """ICI adjacency = same host and same slice (the reference's
@@ -199,6 +204,11 @@ class LayerAssignment:
     # 0 = the shard's own DNET_SHARD_MESH_* default; 1 = single chip.
     mesh_tp: int = 0
     mesh_sp: int = 0
+    # NamedSharding tensor parallelism (parallel/tp.py): set by the
+    # solver's mesh-slice placement for pure-TP shards (no sp, resident
+    # weights); rides the load body into shard/compute.py.  0 = unset
+    # (the shard's DNET_TP default decides), 1 = pinned single-chip.
+    tp_degree: int = 0
 
     @property
     def min_layer(self) -> int:

@@ -177,6 +177,8 @@ class InprocRing:
         auto_steps: int = 16,
         max_concurrent: int = 8,
         request_timeout_s: float = 120.0,
+        tp: int = 0,
+        tp_collective: str = "",
     ) -> None:
         from dnet_tpu.shard.adapter import RingAdapter
         from dnet_tpu.shard.runtime import ShardRuntime
@@ -186,6 +188,13 @@ class InprocRing:
         self.max_seq = max_seq
         self.param_dtype = param_dtype
         self.wire_codec = wire_codec
+        # NamedSharding TP per shard (parallel/tp.py): each ShardCompute
+        # drives `tp` forced-host devices; 1 pins today's single-chip
+        # shards, 0 defers to the DNET_TP shard default.  tp_collective
+        # pins the collective mode for BOTH shards ("" = the
+        # DNET_TP_COLLECTIVE default resolution).
+        self.tp = max(int(tp), 0)
+        self.tp_collective = tp_collective
         self.auto_steps = auto_steps
         self.max_concurrent = max_concurrent
         self.request_timeout_s = request_timeout_s
@@ -236,6 +245,7 @@ class InprocRing:
                 lambda: self.s0.load_model_core(
                     self.model_dir, self.layers0, max_seq=self.max_seq,
                     param_dtype=self.param_dtype, wire_codec=self.wire_codec,
+                    tp_degree=self.tp, tp_collective=self.tp_collective,
                 ),
             ),
             loop.run_in_executor(
@@ -243,6 +253,7 @@ class InprocRing:
                 lambda: self.s1.load_model_core(
                     self.model_dir, self.layers1, max_seq=self.max_seq,
                     param_dtype=self.param_dtype, wire_codec=self.wire_codec,
+                    tp_degree=self.tp, tp_collective=self.tp_collective,
                 ),
             ),
         )

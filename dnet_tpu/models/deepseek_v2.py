@@ -37,6 +37,7 @@ from jax import lax
 from dnet_tpu.core.kvcache import KVConfig
 from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.models.segments import TwoSegmentStackMixin
+from dnet_tpu.parallel.tp_collectives import tp_all_reduce
 from dnet_tpu.ops.attention import cached_attend
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq
@@ -160,7 +161,8 @@ class DeepseekV2RingModel(TwoSegmentStackMixin, RingModel):
         )
         out = attn.reshape(B, T, H * vd) @ dq(p["wo"])
         if tp_axis is not None:
-            out = lax.psum(out, tp_axis)
+            # out-proj all-reduce through the quantizable TP seam
+            out = tp_all_reduce(out, tp_axis)
         return x + out, kvs
 
     def _dense_mlp(self, p_prefix: dict, h: jnp.ndarray) -> jnp.ndarray:
@@ -212,9 +214,9 @@ class DeepseekV2RingModel(TwoSegmentStackMixin, RingModel):
         )
         if tp_axis is not None:
             if routed_partial:
-                out = lax.psum(routed.astype(flat.dtype) + shared, tp_axis)
+                out = tp_all_reduce(routed.astype(flat.dtype) + shared, tp_axis)
             else:
-                out = routed.astype(flat.dtype) + lax.psum(shared, tp_axis)
+                out = routed.astype(flat.dtype) + tp_all_reduce(shared, tp_axis)
         else:
             out = routed.astype(flat.dtype) + shared
         return x + out.reshape(B, T, D)
@@ -230,7 +232,8 @@ class DeepseekV2RingModel(TwoSegmentStackMixin, RingModel):
             h = rms_norm(x, p["mlp_norm"], self.config.rms_norm_eps)
             out = self._dense_mlp(p, h)
             if tp_axis is not None:
-                out = lax.psum(out, tp_axis)
+                # down-proj all-reduce through the quantizable TP seam
+                out = tp_all_reduce(out, tp_axis)
             x = x + out
         return x, kvs
 

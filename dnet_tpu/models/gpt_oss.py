@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dnet_tpu.parallel.tp_collectives import tp_all_reduce
+
 from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.ops.attention import (
     cached_attend,
@@ -100,7 +102,8 @@ class GptOssRingModel(RingModel):
             )
         out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
-            out = lax.psum(out, tp_axis)
+            # out-proj all-reduce through the quantizable TP seam
+            out = tp_all_reduce(out, tp_axis)
         out = out + p["bo"]  # bias replicated: add once, after the psum
         return x + out, kvs
 
@@ -151,7 +154,8 @@ class GptOssRingModel(RingModel):
             self.moe_capacity_factor, k, tp_axis, dense,
         )
         if partial:
-            out = lax.psum(out, tp_axis)
+            # expert-combine all-reduce through the quantizable TP seam
+            out = tp_all_reduce(out, tp_axis)
         return x + out.reshape(B, T, D)
 
     def _kind_mask(self, kind: int, T: int, S: int, pos, sp_axis, mask):

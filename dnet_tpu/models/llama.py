@@ -19,6 +19,7 @@ from jax import lax
 
 from dnet_tpu.models.base import ModelConfig, RingModel
 from dnet_tpu.ops.attention import cached_attend
+from dnet_tpu.parallel.tp_collectives import tp_all_reduce
 from dnet_tpu.ops.norms import rms_norm
 from dnet_tpu.ops.quant import dq, out_dim
 from dnet_tpu.ops.rope import apply_rope, rope_frequencies
@@ -90,7 +91,10 @@ class LlamaRingModel(RingModel):
             )
         attn_out = attn.reshape(B, T, H * Hd) @ dq(p["wo"])
         if tp_axis is not None:
-            attn_out = lax.psum(attn_out, tp_axis)
+            # out-proj all-reduce: THE first of the two per-layer TP
+            # collectives, routed through the quantizable seam (exact
+            # psum for plain string axes, parallel/tp_collectives.py)
+            attn_out = tp_all_reduce(attn_out, tp_axis)
         x = x + attn_out
 
         x = self._mlp_block(p, x, tp_axis)
@@ -104,7 +108,8 @@ class LlamaRingModel(RingModel):
         up = h @ dq(p["w_up"])
         mlp_out = (jax.nn.silu(gate) * up) @ dq(p["w_down"])
         if tp_axis is not None:
-            mlp_out = lax.psum(mlp_out, tp_axis)
+            # down-proj all-reduce: the second per-layer TP collective
+            mlp_out = tp_all_reduce(mlp_out, tp_axis)
         return x + mlp_out
 
     def apply_window(

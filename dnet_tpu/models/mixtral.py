@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dnet_tpu.parallel.tp_collectives import tp_all_reduce
 from dnet_tpu.models.llama import LlamaRingModel
 from dnet_tpu.ops.norms import rms_norm
 
@@ -66,7 +67,9 @@ class MixtralRingModel(LlamaRingModel):
         )
         out = routed.astype(flat.dtype)
         if tp_axis is not None and routed_partial:
-            out = lax.psum(out, tp_axis)
+            # expert-combine all-reduce: the MoE twin of the dense
+            # down-proj collective, routed through the quantizable seam
+            out = tp_all_reduce(out, tp_axis)
         return x + out.reshape(B, T, D)
 
     # ---- weight mapping ----------------------------------------------

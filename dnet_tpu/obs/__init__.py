@@ -377,6 +377,32 @@ def _register_core(reg: MetricsRegistry) -> None:
         "Fraction of cumulative hop-codec time hidden off the compute "
         "thread (1.0 = codec fully overlapped with compute)",
     )
+    # intra-shard tensor parallelism (parallel/tp.py, DNET_TP=N).  The op
+    # label set is DECLARED in obs/phases.py TP_OPS (leaf) and
+    # cross-checked both ways by the metrics lint (pass 13).
+    from dnet_tpu.obs.phases import TP_OPS
+
+    tp_ms = reg.histogram(
+        "dnet_tp_collective_ms",
+        "Intra-shard TP collective latency from the load-time calibration "
+        "probe (per-op timing cannot be carved out of the fused layer "
+        "programs at serving time)",
+        labelnames=("op",),
+    )
+    tp_bytes = reg.counter(
+        "dnet_tp_collective_bytes_total",
+        "Analytic interconnect bytes dispatched per TP collective "
+        "(ring-algorithm accounting, parallel/tp_collectives.py)",
+        labelnames=("op",),
+    )
+    for op in TP_OPS:
+        tp_ms.labels(op=op)  # pre-touch: the lint checks these
+        tp_bytes.labels(op=op)  # pre-touch: the lint checks these
+    reg.gauge(
+        "dnet_tp_degree",
+        "Resolved tensor-parallel degree of this process's serving engine "
+        "(1 = single-chip, the pre-TP behavior)",
+    )
     # runtime concurrency sanitizer (dnet_tpu/analysis/runtime/, DNET_SAN=1).
     # Check-code / thread label sets are DECLARED in
     # analysis/runtime/domains.py (a leaf module) and cross-checked both

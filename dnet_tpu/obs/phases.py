@@ -38,6 +38,10 @@ JIT_FNS = (
     "kv_append",            # BlockStore per-step block-append of new K/V rows
     "wire_encode",          # wire-pipeline hop encode launches (lossless
                             # cast / sparse / qsparse8 — compression/ops.py)
+    "tp_window",            # TpEngine shard_map window/step programs over
+                            # the ("batch", "model") mesh (parallel/tp.py)
+    "tp_collective",        # standalone collective calibration probes
+                            # (parallel/tp_collectives.py probe_collective_ms)
 )
 
 # dnet_wire_bytes_total{dir=}: activation/token payload bytes by wire
@@ -49,3 +53,9 @@ WIRE_DIRS = ("tx", "rx")
 # dnet_device_mem_bytes{kind=}: backend memory stats summed over local
 # devices, where the PJRT backend reports them (TPU/GPU; CPU returns none)
 DEVICE_MEM_KINDS = ("in_use", "peak", "limit")
+
+# dnet_tp_collective_ms{op=} / dnet_tp_collective_bytes_total{op=}: the two
+# intra-shard tensor-parallel collective shapes the TP seam dispatches
+# (parallel/tp_collectives.py).  The metrics lint (pass 13) cross-checks
+# these against the exposed label sets both ways.
+TP_OPS = ("all_reduce", "all_gather")

@@ -37,13 +37,14 @@ from jax.sharding import PartitionSpec as P
 from dnet_tpu.core.engine import LocalEngine, Session
 from dnet_tpu.core.sampler import pack_chunk_results, sample
 from dnet_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_PP,
     AXIS_SP,
     AXIS_TP,
     build_mesh,
     kv_spec,
     window_param_specs,
 )
-from dnet_tpu.parallel.ring import place_ring_state
 from dnet_tpu.utils.jax_compat import pcast_varying, shard_map
 from dnet_tpu.utils.logger import get_logger
 
@@ -105,11 +106,66 @@ class MeshShardEngine(LocalEngine):
     _check_quant_sharding = _ME._check_quant_sharding
     del _ME
 
+    # ---- substrate hooks ----------------------------------------------
+    # The mesh-specific choices — axis names, param/KV specs, placement —
+    # are isolated here so parallel/tp.py's TpEngine (NamedSharding over a
+    # ("batch", "model") mesh with the quantizable collective seam) can
+    # subclass this engine and override ONLY these; every program builder
+    # below is substrate-agnostic.
+
+    def _tp_axis(self):
+        """Axis object handed to apply_window's tp seam (a plain string =
+        exact psum; parallel/tp_collectives.TpAxis = quantizable).  Kept
+        even at tp=1: the size-1 psum certifies x over the axis for the
+        replicated out_spec."""
+        return AXIS_TP
+
+    def _sp_axis(self):
+        return AXIS_SP if self.sp > 1 else None
+
+    def _certify_axes(self):
+        """Size-1 mesh axes the window output must be marked varying over
+        (and psum-certified back) so the scan carry types line up."""
+        return (AXIS_PP, AXIS_DP)
+
+    def _window_specs_of(self, tree):
+        return window_param_specs(tree)
+
+    def _kv_pspec(self):
+        return kv_spec(self._sp_axis() is not None)
+
+    def _place_window(self, host_tree):
+        """Window params host -> mesh, PRE-SHARDED: each chip's slice is
+        cast and uploaded individually (parallel/tp.py place_presharded),
+        so neither the host cast buffer nor any device ever materializes
+        the full stacked tensor — load peak is 1/tp per chip."""
+        from dnet_tpu.parallel.tp import place_presharded
+
+        return place_presharded(
+            host_tree, self.mesh, self._window_specs_of(host_tree),
+            cast=self._np_cast,
+        )
+
+    def _place_edge(self, host_edge):
+        from dnet_tpu.parallel.mesh import replicate
+
+        return replicate(jax.tree.map(self._np_cast, host_edge), self.mesh)
+
+    def _place_kv(self, kv):
+        from jax.sharding import NamedSharding
+
+        spec = self._kv_pspec()
+        return jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(self.mesh, spec)), kv
+        )
+
     # ---- loading ------------------------------------------------------
     def _np_cast(self, a):
         """Cast on HOST (numpy + ml_dtypes): the stacked window must not
         transit a single device's HBM before mesh placement — the whole
-        point of a mesh shard is a window larger than one chip."""
+        point of a mesh shard is a window larger than one chip.  Called
+        per SLICE by the pre-sharded placement path, so the cast copy is
+        slice-sized too."""
         arr = np.asarray(a)
         if np.issubdtype(arr.dtype, np.floating):
             import ml_dtypes
@@ -138,7 +194,6 @@ class MeshShardEngine(LocalEngine):
             # Ref prefetch pipeline analog:
             # /root/reference/src/dnet/shard/policies/offload.py:395-421
             from dnet_tpu.core.weights import HostLayerStore, WeightCache
-            from dnet_tpu.parallel.mesh import shard_window_params
 
             store = HostLayerStore(
                 self.ckpt,
@@ -151,11 +206,11 @@ class MeshShardEngine(LocalEngine):
             probe = store.layer_host(m.layers[0])
             if self.weight_quant_bits:
                 self._check_quant_sharding(probe)
-            self._window_specs = window_param_specs(probe)
+            self._window_specs = self._window_specs_of(probe)
             self.weight_cache = WeightCache(
                 store,
                 max_resident=self.plan.residency,
-                put_fn=lambda host: shard_window_params(host, self.mesh),
+                put_fn=self._place_window,
             )
             w = self.plan.window_size
             self._windows = [
@@ -173,9 +228,11 @@ class MeshShardEngine(LocalEngine):
                 group_size=self.weight_quant_group,
             )
             self._check_quant_sharding(stacked)
-        host_window = jax.tree.map(self._np_cast, stacked)
-        self._window_specs = window_param_specs(host_window)
-        self.window_params, _, _ = place_ring_state(host_window, {}, {}, self.mesh)
+        # pre-sharded placement: cast + upload happen per chip-slice, so
+        # the full stacked window is never materialized post-cast on host
+        # nor on any single chip (satellite fix: load peak 1/tp per chip)
+        self._window_specs = self._window_specs_of(stacked)
+        self.window_params = self._place_window(stacked)
         self._load_edge(t0)
 
     def _load_edge(self, t0: float) -> None:
@@ -194,8 +251,7 @@ class MeshShardEngine(LocalEngine):
                 edge_raw, self.weight_quant_bits, scale_dtype=self.param_dtype,
                 group_size=self.weight_quant_group,
             )
-        edge = jax.tree.map(self._np_cast, edge_raw)
-        _, self.edge_params, _ = place_ring_state({}, edge, {}, self.mesh)
+        self.edge_params = self._place_edge(edge_raw)
         log.info(
             "[PROFILE] mesh-shard %s %d layers over tp=%d sp=%d in %.2fs",
             "streams" if self.plan.streams_weights else "placed",
@@ -205,29 +261,31 @@ class MeshShardEngine(LocalEngine):
     # ---- jitted step functions ---------------------------------------
     def _build_fns(self) -> None:
         model, mesh = self.model, self.mesh
-        sp_axis = AXIS_SP if self.sp > 1 else None
+        tp_axis = self._tp_axis()
+        sp_axis = self._sp_axis()
+        certify = self._certify_axes()
         has_kinds = getattr(model, "layer_kinds", None) is not None
         kinds_arr = model.layer_kinds if has_kinds else jnp.zeros((), jnp.int32)
-        kvs = kv_spec(sp_axis is not None)
+        kvs = self._kv_pspec()
         in_specs = (self._window_specs, P(), kvs, P(), P(), P())
         out_specs = (P(), kvs)
 
         def window_core(wp, x, kv, pos, t_real, kinds):
-            # tp psum seams + sp flash-decoding combines live in the models
-            # (same seams the in-slice ring uses, parallel/ring.py:65-95);
+            # tp collective seams + sp flash-decoding combines live in the
+            # models (same seams the in-slice ring uses, parallel/ring.py);
             # pp=1 here — the PIPELINE is the gRPC ring outside this program.
-            # x becomes device-varying over pp/dp once the pp-sharded params
-            # and dp-sharded kv touch it (both axes are size 1 here); mark it
-            # up front so the layer scan's carry types line up.
-            x = pcast_varying(x, ("pp", "dp"))
+            # x becomes device-varying over the size-1 certify axes once the
+            # sharded params/kv touch it; mark it up front so the layer
+            # scan's carry types line up.
+            x = pcast_varying(x, certify)
             x, kv = model.apply_window(
                 wp, x, kv, pos,
                 layer_kinds=kinds if has_kinds else None,
-                tp_axis=AXIS_TP, sp_axis=sp_axis, t_real=t_real,
+                tp_axis=tp_axis, sp_axis=sp_axis, t_real=t_real,
             )
-            # both axes are size 1, so the psum is an identity that just
-            # certifies x as replicated again for the P() out_spec
-            x = jax.lax.psum(x, ("pp", "dp"))
+            # the certify axes are size 1, so the psum is an identity that
+            # just certifies x as replicated again for the P() out_spec
+            x = jax.lax.psum(x, certify)
             return x, kv
 
         core = shard_map(
@@ -257,7 +315,7 @@ class MeshShardEngine(LocalEngine):
                     seg_core = shard_map(
                         window_core, mesh=mesh,
                         in_specs=(
-                            window_param_specs(window_params),
+                            self._window_specs_of(window_params),
                             P(), kvs, P(), P(), P(),
                         ),
                         out_specs=out_specs,
@@ -373,8 +431,7 @@ class MeshShardEngine(LocalEngine):
         """Lane-pool cache placement: [L, slots, S, KVH, Hd] with the same
         axis meanings as the B=1 cache — slots ride the (size-1) dp axis,
         heads shard over tp, sequence over sp."""
-        _, _, kv = place_ring_state({}, {}, kv, self.mesh)
-        return kv
+        return self._place_kv(kv)
 
     def build_lane_programs(self, kv_template) -> dict:
         """shard_map(vmap(...)) lane step programs: the per-lane window
@@ -387,10 +444,12 @@ class MeshShardEngine(LocalEngine):
         from dnet_tpu.shard.lanes import lane_sampler
 
         model, mesh = self.model, self.mesh
-        sp_axis = AXIS_SP if self.sp > 1 else None
+        tp_axis = self._tp_axis()
+        sp_axis = self._sp_axis()
+        certify = self._certify_axes()
         has_kinds = getattr(model, "layer_kinds", None) is not None
         kinds_arr = model.layer_kinds if has_kinds else jnp.zeros((), jnp.int32)
-        kvs = kv_spec(sp_axis is not None)
+        kvs = self._kv_pspec()
         kv_axes = jax.tree.map(lambda _: 1, kv_template)
         sample_one = lane_sampler(model)
         sp_axes = SampleParams(0, 0, 0, 0, 0, 0, 0, 0)
@@ -398,13 +457,13 @@ class MeshShardEngine(LocalEngine):
         def window_lanes(wp, x, kv, pos, active, kinds):
             def one(x_row, kv_row, p, a):
                 kv1 = jax.tree.map(lambda t: t[:, None], kv_row)
-                xo = pcast_varying(x_row[None], ("pp", "dp"))
+                xo = pcast_varying(x_row[None], certify)
                 xo, kv1 = model.apply_window(
                     wp, xo, kv1, p,
                     layer_kinds=kinds if has_kinds else None,
-                    tp_axis=AXIS_TP, sp_axis=sp_axis, kv_commit=a,
+                    tp_axis=tp_axis, sp_axis=sp_axis, kv_commit=a,
                 )
-                xo = jax.lax.psum(xo, ("pp", "dp"))
+                xo = jax.lax.psum(xo, certify)
                 return xo[0], jax.tree.map(lambda t: t[:, 0], kv1)
 
             return jax.vmap(
@@ -471,15 +530,14 @@ class MeshShardEngine(LocalEngine):
                             quant_bits=self.kv_quant_bits,
                         )
                     )
-                    _, _, kv0 = place_ring_state({}, {}, kv0, self.mesh)
-                    kv_list.append(kv0)
+                    kv_list.append(self._place_kv(kv0))
             else:
                 kv0 = self.model.init_kv(
                     len(self.model.layers), self.batch, self.max_seq,
                     self.kv_dtype, quant_bits=self.kv_quant_bits,
                     rotating=(self.sp == 1),
                 )
-                _, _, kv = place_ring_state({}, {}, kv0, self.mesh)
+                kv = self._place_kv(kv0)
         sess = Session(
             nonce=nonce,
             kv=kv,

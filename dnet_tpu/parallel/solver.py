@@ -56,6 +56,10 @@ class ModelProfile:
     # (parallel/mesh.py kv_spec), so a node's mesh_tp must divide this.
     # 0 = unknown: leave mesh_tp unclamped.
     tp_heads: int = 0
+    # bytes of one hidden-state row (hidden_size x serving elem size):
+    # what each of the two per-layer TP collectives moves per token.
+    # 0 = unknown: TP collective cost is not charged.
+    hidden_bytes: int = 0
 
 
 @dataclass
@@ -137,11 +141,20 @@ def solve_greedy(devices: List[DeviceInfo], m: ModelProfile) -> SolveResult:
 
 
 def predict_stage_time(d: DeviceInfo, m: ModelProfile, w_i: int, n_i: int) -> float:
-    """Predicted per-token seconds for one device's stage: window compute +
+    """Predicted per-token seconds for one device's stage: window compute
+    (TP speedup is already in device_throughput — FLOPs and HBM bandwidth
+    scale with chip_count) MINUS nothing, PLUS what TP costs: two ring
+    all-reduces per layer over the hidden row, 2(c-1)/c x hidden_bytes
+    per link each (parallel/tp_collectives.py collective_bytes), plus
     host->HBM streaming of non-resident layers.  Excludes the activation
     hop (t_comm) so it is directly comparable to an on-device stage probe
-    (parallel/calibrate.py)."""
+    (parallel/calibrate.py).  Devices with unknown ici_bw (0) charge no
+    collective cost — identical predictions to the pre-TP solver."""
     t = w_i * device_throughput(d, m)
+    c = max(d.chip_count, 1)
+    if c > 1 and d.ici_bw > 0 and m.hidden_bytes > 0:
+        per_collective = 2.0 * (c - 1) / c * m.hidden_bytes / d.ici_bw
+        t += w_i * 2 * per_collective
     t += max(0, w_i - n_i) * m.layer_bytes / max(d.host_to_hbm_bw, 1e9)
     return t
 
@@ -296,6 +309,47 @@ def deal_rounds(w: List[int], k: int) -> List[List[List[int]]]:
     return rounds
 
 
+def merge_mesh_slices(
+    devices: List[DeviceInfo],
+) -> tuple[List[DeviceInfo], dict]:
+    """Mesh-slice candidates: ICI-adjacent devices (same host, same slice)
+    with a KNOWN interconnect bandwidth collapse into ONE multi-chip
+    DeviceInfo — a v5litepod-4 host registered as four 1-chip shards
+    becomes one 4-chip mesh slice whose window runs tensor-parallel
+    (parallel/tp.py).  Returns (merged device list, {surviving instance:
+    [absorbed instances]}); callers adopt the merge only when the solved
+    ring latency actually improves (fewer hops + TP speedup vs the new
+    collective cost — predict_stage_time models both sides).  Devices
+    with ici_bw == 0 never merge: the collective cost would be a guess.
+    """
+    groups: dict = {}
+    order: list = []
+    for d in devices:
+        key = (d.host, d.slice_id)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(d)
+    merged: List[DeviceInfo] = []
+    members: dict = {}
+    from dataclasses import replace as _dc_replace
+
+    for key in order:
+        g = groups[key]
+        if len(g) < 2 or any(d.ici_bw <= 0 for d in g):
+            merged.extend(g)
+            continue
+        head = _dc_replace(
+            g[0],
+            chip_count=sum(max(d.chip_count, 1) for d in g),
+            # members share one ICI fabric; cost with the slowest link
+            ici_bw=min(d.ici_bw for d in g),
+        )
+        members[head.instance] = [d.instance for d in g[1:]]
+        merged.append(head)
+    return merged, members
+
+
 def solve_topology(
     devices: List[DeviceInfo],
     m: ModelProfile,
@@ -304,31 +358,59 @@ def solve_topology(
     mip_gap: float = 1e-4,
     max_rounds: int = 4,
 ) -> TopologyInfo:
-    """Full solve: order -> (w, n) -> merge -> k rounds -> assignments."""
+    """Full solve: slice-merge -> order -> (w, n) -> merge -> k rounds ->
+    assignments."""
     if not devices:
         raise ValueError("no devices")
-    # clamp each node's usable chip count BEFORE costing: mesh-backed
-    # shards shard KV heads over tp (kv_spec), so a 4-chip host serving a
-    # 2-kv-head model runs tp=2 — sizing its layer share with 4-chip pooled
-    # HBM would overcommit the 2 chips that actually serve
     from dataclasses import replace as _dc_replace
 
-    clamped = []
-    orig_chips = {}  # instance -> physical chip count (pre-clamp)
-    for d in devices:
-        orig_chips[d.instance] = max(d.chip_count, 1)
-        c = max(d.chip_count, 1)
-        while c > 1 and m.tp_heads > 0 and m.tp_heads % c != 0:
-            c -= 1
-        clamped.append(_dc_replace(d, chip_count=c) if c != d.chip_count else d)
-    devices = order_devices(clamped)
-    heterogeneous = len(
-        {(d.chip_kind, d.chip_count, round(d.flops_bf16 / 1e12, 1)) for d in devices}
-    ) > 1
-    use_milp = solver == "milp" or (solver == "auto" and heterogeneous)
-    result = (
-        solve_milp(devices, m, mip_gap) if use_milp else solve_greedy(devices, m)
-    )
+    def _clamp_and_solve(devs_in: List[DeviceInfo]):
+        # clamp each node's usable chip count BEFORE costing: mesh-backed
+        # shards shard KV heads over tp (kv_spec), so a 4-chip host
+        # serving a 2-kv-head model runs tp=2 — sizing its layer share
+        # with 4-chip pooled HBM would overcommit the 2 chips that
+        # actually serve
+        clamped = []
+        chips = {}  # instance -> physical chip count (pre-clamp)
+        for d in devs_in:
+            chips[d.instance] = max(d.chip_count, 1)
+            c = max(d.chip_count, 1)
+            while c > 1 and m.tp_heads > 0 and m.tp_heads % c != 0:
+                c -= 1
+            clamped.append(
+                _dc_replace(d, chip_count=c) if c != d.chip_count else d
+            )
+        ordered = order_devices(clamped)
+        heterogeneous = len(
+            {(d.chip_kind, d.chip_count, round(d.flops_bf16 / 1e12, 1))
+             for d in ordered}
+        ) > 1
+        use_milp = solver == "milp" or (solver == "auto" and heterogeneous)
+        res = (
+            solve_milp(ordered, m, mip_gap) if use_milp
+            else solve_greedy(ordered, m)
+        )
+        return ordered, chips, res
+
+    # mesh-slice placement (ROADMAP item 3): when ICI-adjacent devices can
+    # pool into one multi-chip hop, solve BOTH layouts and keep the one
+    # with the lower predicted ring latency — one 4-chip tp hop beats four
+    # 1-chip hops exactly when the interconnect outruns the ring wire
+    # (t_comm), which is what the objective compares.
+    slice_members: dict = {}
+    slice_candidates, candidate_members = merge_mesh_slices(devices)
+    devices_ordered, orig_chips, result = _clamp_and_solve(devices)
+    if candidate_members:
+        base_obj = result.obj_value
+        m_devs, m_chips, m_res = _clamp_and_solve(slice_candidates)
+        if m_res.obj_value < base_obj:
+            devices_ordered, orig_chips, result = m_devs, m_chips, m_res
+            slice_members = candidate_members
+            log.info(
+                "mesh-slice placement: merged %s (ring latency %.4fs -> "
+                "%.4fs)", candidate_members, base_obj, m_res.obj_value,
+            )
+    devices = devices_ordered
     devs = list(devices)
     w, n = list(result.w), list(result.n)
     devs, w, n = postprocess_merge_singletons(devs, w, n, m)
@@ -370,6 +452,12 @@ def solve_topology(
         # streams host->mesh as tp/sp-sharded device_puts, so the window
         # lives in the slice's POOLED HBM — exactly the capacity n[i] was
         # sized against.  No single-chip fallback, no re-derivation.
+        # NamedSharding TP (parallel/tp.py): a pure-TP shard — multi-chip,
+        # no sp axis, fully resident window — gets an explicit tp_degree
+        # that rides the load body into ShardCompute and selects the TP
+        # substrate with the quantizable collectives.  sp/streaming combos
+        # pin tp_degree=1 and stay on the shard_map mesh substrate.
+        tp_degree = mesh_tp if (mesh_sp == 1 and window == 0) else 1
         assignments.append(
             LayerAssignment(
                 instance=d.instance,
@@ -382,29 +470,37 @@ def solve_topology(
                 # override a solve that decided against the mesh
                 mesh_tp=mesh_tp,
                 mesh_sp=mesh_sp,
+                tp_degree=tp_degree,
             )
         )
     for i, a in enumerate(assignments):
         a.next_instance = assignments[(i + 1) % len(assignments)].instance
+    solution = {
+        "k": k,
+        "w": w,
+        "n": n,
+        "obj_value": result.obj_value,
+        "solver": result.solver,
+        # per-stage predictions recorded at solve time so the
+        # calibration loop (parallel/calibrate.py) can compare them
+        # against measured probes without re-deriving the model profile
+        "predicted_stage_s": [
+            predict_stage_time(d, m, w[i], n[i]) for i, d in enumerate(devs)
+        ],
+        "tp_degree": [a.tp_degree for a in assignments],
+    }
+    if slice_members:
+        # surviving instance -> the ICI-adjacent instances it absorbed
+        # (those shards receive no layers; their chips serve inside the
+        # surviving shard's mesh slice)
+        solution["mesh_slices"] = slice_members
     return TopologyInfo(
         model=m.model_id,
         num_layers=m.num_layers,
         kv_bits=kv_bits,
         devices=devs,
         assignments=assignments,
-        solution={
-            "k": k,
-            "w": w,
-            "n": n,
-            "obj_value": result.obj_value,
-            "solver": result.solver,
-            # per-stage predictions recorded at solve time so the
-            # calibration loop (parallel/calibrate.py) can compare them
-            # against measured probes without re-deriving the model profile
-            "predicted_stage_s": [
-                predict_stage_time(d, m, w[i], n[i]) for i, d in enumerate(devs)
-            ],
-        },
+        solution=solution,
     )
 
 
@@ -456,6 +552,7 @@ def model_profile_from_checkpoint(
     return ModelProfile(
         model_id=str(model_dir),
         tp_heads=cfg.num_key_value_heads or cfg.num_attention_heads or 0,
+        hidden_bytes=D * 2,  # serving bf16 activations
         multi_round_ok=cfg.model_type not in ("gpt_oss", "deepseek_v2"),
         num_layers=cfg.num_hidden_layers,
         layer_bytes=layer_bytes,

@@ -46,6 +46,8 @@ class ShardCompute:
         mesh_tp: int = 1,
         mesh_sp: int = 1,
         mesh_devices: Optional[Sequence] = None,
+        tp_degree: int = 0,
+        tp_collective: str = "",
         spec_lookahead: int = 0,
         lanes: int = 0,
         prefix_cache: int = 0,
@@ -60,7 +62,47 @@ class ShardCompute:
             n = len(mesh_devices) if mesh_devices is not None else jax.local_device_count()
             mesh_tp = n // mesh_sp
         mesh_tp = max(mesh_tp, 1)
-        if mesh_tp * mesh_sp > 1:
+        # NamedSharding tensor parallelism (parallel/tp.py, ROADMAP item
+        # 3's TP half): 0 = this shard's DNET_TP default, 1 = pinned off.
+        # Precedence: an EXPLICIT tp_degree (solver mesh-slice placement)
+        # selects the TP substrate; an explicit mesh request without one
+        # keeps the shard_map substrate (the env default must not hijack
+        # a caller that asked for mesh_tp/mesh_sp); sequence parallelism
+        # always needs the shard_map substrate.
+        if tp_degree == 0 and mesh_tp * mesh_sp == 1:
+            from dnet_tpu.parallel.tp import tp_enabled_degree
+
+            tp_degree = tp_enabled_degree()
+        tp_degree = max(int(tp_degree), 1)
+        if tp_degree > 1 and mesh_sp > 1:
+            log.warning(
+                "tp_degree=%d ignored: sequence parallelism (mesh_sp=%d) "
+                "runs on the shard_map mesh substrate", tp_degree, mesh_sp,
+            )
+            tp_degree = 1
+        if tp_degree > 1:
+            tp_degree = self._clamp_tp(tp_degree, model_dir, mesh_devices)
+        if tp_degree > 1:
+            from dnet_tpu.parallel.tp import TpEngine
+
+            self.engine = TpEngine(
+                model_dir,
+                layers=layers,
+                tp=tp_degree,
+                collective=tp_collective,
+                devices=mesh_devices,
+                max_seq=max_seq,
+                param_dtype=param_dtype,
+                kv_dtype=kv_dtype,
+                kv_ttl_s=kv_ttl_s,
+                kv_quant_bits=kv_quant_bits,
+                weight_quant_bits=weight_quant_bits,
+                window_size=window_size,
+                residency_size=residency_size,
+                repack_dir=repack_dir,
+                spec_lookahead=spec_lookahead,
+            )
+        elif mesh_tp * mesh_sp > 1:
             # mesh-backed shard (VERDICT r3 next #1): this ring node's layer
             # window runs SPMD over the host's local chips; a window/
             # residency plan streams each layer as tp/sp-sharded device_puts
@@ -212,6 +254,43 @@ class ShardCompute:
         )
         if self.wire_pipeline:
             self._warm_wire()
+
+    @staticmethod
+    def _clamp_tp(tp: int, model_dir, mesh_devices) -> int:
+        """Degrade an over-asked tp_degree instead of bricking the load:
+        clamp to the local device count and to the largest value <= tp
+        dividing the model's attention AND kv head counts (the solver's
+        own clamp rule, parallel/solver.py) — a DNET_TP=8 env default on
+        a 2-kv-head model serves tp=2 with a warning, not a 500."""
+        n_dev = (
+            len(mesh_devices) if mesh_devices is not None
+            else jax.local_device_count()
+        )
+        want = tp
+        tp = min(tp, max(n_dev, 1))
+        from dnet_tpu.models.base import ModelConfig
+        from dnet_tpu.utils.checkpoint import Checkpoint
+
+        cfg = ModelConfig.from_hf(Checkpoint(model_dir).config)
+        heads = cfg.num_attention_heads or 0
+        kv_heads = cfg.num_key_value_heads or heads
+        while tp > 1 and (
+            (heads and heads % tp) or (kv_heads and kv_heads % tp)
+        ):
+            tp -= 1
+        if tp != want:
+            log.warning(
+                "tp_degree=%d clamped to %d (%d local devices, %d/%d "
+                "attention/kv heads)", want, tp, n_dev, heads, kv_heads,
+            )
+        return tp
+
+    def _book_tp_frame(self, tokens: int) -> None:
+        """Analytic TP collective byte accounting for one processed frame
+        (parallel/tp_collectives.py; host-side shape math, no syncs)."""
+        observe = getattr(self.engine, "observe_step_collectives", None)
+        if observe is not None:
+            observe(tokens)
 
     def _warm_wire(self) -> None:
         """Pre-compile the jitted hop encode for every frame shape the
@@ -384,6 +463,20 @@ class ShardCompute:
     def process(self, msg: ActivationMessage) -> ActivationMessage:
         """Run this shard's window; returns the outgoing message
         (hidden-state hop or final sampled token)."""
+        # frame token count for the TP collective byte books (hidden
+        # frames are [B, T, D]; token frames carry their id count); read
+        # BEFORE dispatch — _spec_widen mutates the shape
+        if msg.lanes:
+            tokens = len(msg.lanes)
+        elif msg.is_tokens:
+            tokens = int(np.prod(msg.shape))
+        else:
+            tokens = int(msg.shape[1]) if len(msg.shape) > 1 else 1
+        out = self._process_frame(msg)
+        self._book_tp_frame(tokens)
+        return out
+
+    def _process_frame(self, msg: ActivationMessage) -> ActivationMessage:
         if msg.lanes:
             return self._process_lane_frame(msg)
         eng = self.engine

@@ -51,6 +51,11 @@ class ShardLoadModelRequest(BaseModel):
     # 0 = use the shard's own DNET_SHARD_MESH_* defaults; -1 tp = all chips
     mesh_tp: int = 0
     mesh_sp: int = 0
+    # NamedSharding tensor parallelism (parallel/tp.py): the solver's
+    # mesh-slice placement ships the shard's tp degree here; 0 = the
+    # shard's own DNET_TP default, 1 = single-chip.  Mutually exclusive
+    # with a >1 mesh_tp/mesh_sp (one TP substrate per shard).
+    tp_degree: int = 0
     # ring speculation (head drafts / tail verifies, shard/compute.py);
     # the API only sets this on single-round rewind-safe rings
     spec_lookahead: int = 0
@@ -145,6 +150,13 @@ class ShardHTTPServer:
         if compute is not None:
             eng = compute.engine
             mesh = {"mesh_tp": getattr(eng, "tp", 1), "mesh_sp": getattr(eng, "sp", 1)}
+            from dnet_tpu.parallel.tp import TpEngine
+
+            if isinstance(eng, TpEngine):
+                mesh = {
+                    "tp_degree": eng.tp,
+                    "tp_collective": eng.collective_mode,
+                }
             if compute.prefix_snaps is not None:
                 mesh["prefix_cache"] = dict(compute.prefix_snaps.stats)
         return web.json_response(

@@ -161,6 +161,8 @@ class ShardRuntime:
         weight_quant_bits: int = 0,
         mesh_tp: int = 1,
         mesh_sp: int = 1,
+        tp_degree: int = 0,
+        tp_collective: str = "",
         spec_lookahead: int = 0,
         lanes: int = 0,
         prefix_cache: int = 0,
@@ -187,6 +189,8 @@ class ShardRuntime:
                 weight_quant_bits=weight_quant_bits,
                 mesh_tp=mesh_tp,
                 mesh_sp=mesh_sp,
+                tp_degree=tp_degree,
+                tp_collective=tp_collective,
                 spec_lookahead=spec_lookahead,
                 lanes=lanes,
                 prefix_cache=prefix_cache,

@@ -64,6 +64,9 @@ class Shard:
                 # compose with either resolution (r5)
                 mesh_tp=req.mesh_tp or get_settings().shard.mesh_tp,
                 mesh_sp=req.mesh_sp or get_settings().shard.mesh_sp,
+                # 0 = this shard's own DNET_TP default (ShardCompute
+                # resolves); the solver's mesh-slice placement overrides
+                tp_degree=req.tp_degree,
                 spec_lookahead=req.spec_lookahead,
                 lanes=req.lanes,
                 prefix_cache=req.prefix_cache,

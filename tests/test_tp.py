@@ -1,0 +1,451 @@
+"""Intra-shard tensor parallelism units (parallel/tp.py, tp_collectives.py).
+
+Covers the quantizable collective seam (lossless == exact psum; EQuARX-
+style grouped-int8 within tolerance at strictly fewer analytic bytes),
+pre-sharded parameter placement (per-chip slices, never a full tensor on
+one device), the head-sharded KV pool running the PR 12 ragged kernel
+per chip unchanged, TpEngine greedy parity vs LocalEngine, and the
+solver's mesh-slice placement (one 4-chip hop vs four 1-chip hops).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.parallel]
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from dnet_tpu.parallel.tp_collectives import (  # noqa: E402
+    TpAxis,
+    collective_bytes,
+    resolve_collective_mode,
+    tp_all_gather,
+    tp_all_reduce,
+)
+from dnet_tpu.utils.jax_compat import shard_map  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tp4_mesh():
+    devs = np.array(jax.devices()[:4]).reshape(1, 4)
+    return Mesh(devs, ("batch", "model"))
+
+
+@pytest.fixture(scope="module")
+def tiny_llama4_dir(tmp_path_factory):
+    """Tiny llama with 4 kv heads so tp=4 divides both head counts."""
+    from tests.fakes.checkpoints import make_tiny_llama
+
+    d = tmp_path_factory.mktemp("tiny_llama_tp4")
+    make_tiny_llama(d, config={"num_key_value_heads": 4})
+    return d
+
+
+# ---- collective seam -------------------------------------------------------
+
+
+def test_tp_axis_is_a_string_axis_name():
+    ax = TpAxis("model", mode="q8", group_size=32)
+    assert isinstance(ax, str) and ax == "model"
+    assert ax.mode == "q8" and ax.group_size == 32
+    with pytest.raises(ValueError):
+        TpAxis("model", mode="auto")  # must be resolved first
+    with pytest.raises(ValueError):
+        TpAxis("model", mode="nope")
+
+
+def test_all_reduce_lossless_is_exact_psum(tp4_mesh):
+    rng = np.random.default_rng(0)
+    parts = jnp.asarray(rng.normal(size=(4, 2, 3, 64)).astype(np.float32))
+
+    def body(p):
+        return tp_all_reduce(p[0], TpAxis("model"))
+
+    def ref_body(p):
+        return jax.lax.psum(p[0], "model")
+
+    fn = jax.jit(shard_map(body, mesh=tp4_mesh, in_specs=(P("model"),),
+                           out_specs=P()))
+    ref = jax.jit(shard_map(ref_body, mesh=tp4_mesh, in_specs=(P("model"),),
+                            out_specs=P()))
+    np.testing.assert_array_equal(np.asarray(fn(parts)), np.asarray(ref(parts)))
+
+
+def test_all_reduce_q8_within_tolerance(tp4_mesh):
+    rng = np.random.default_rng(1)
+    parts = jnp.asarray(rng.normal(size=(4, 2, 3, 64)).astype(np.float32))
+    ax = TpAxis("model", mode="q8", group_size=32)
+    fn = jax.jit(shard_map(lambda p: tp_all_reduce(p[0], ax),
+                           mesh=tp4_mesh, in_specs=(P("model"),),
+                           out_specs=P()))
+    out = np.asarray(fn(parts))
+    ref = np.asarray(parts.sum(axis=0))
+    rel = np.max(np.abs(out - ref)) / (np.max(np.abs(ref)) + 1e-9)
+    assert rel < 0.05, rel  # two 8-bit quant passes, not garbage
+
+
+def test_all_reduce_q8_odd_sizes_pad_correctly(tp4_mesh):
+    """Element counts that divide neither tp nor the group size round-trip
+    through the pad/chunk path without corruption."""
+    rng = np.random.default_rng(2)
+    parts = jnp.asarray(rng.normal(size=(4, 5, 13)).astype(np.float32))
+    ax = TpAxis("model", mode="q8", group_size=64)
+    fn = jax.jit(shard_map(lambda p: tp_all_reduce(p[0], ax),
+                           mesh=tp4_mesh, in_specs=(P("model"),),
+                           out_specs=P()))
+    out = np.asarray(fn(parts))
+    ref = np.asarray(parts.sum(axis=0))
+    assert out.shape == ref.shape
+    rel = np.max(np.abs(out - ref)) / (np.max(np.abs(ref)) + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_all_gather_both_modes(tp4_mesh):
+    rng = np.random.default_rng(3)
+    parts = jnp.asarray(rng.normal(size=(4, 2, 16)).astype(np.float32))
+    for mode, tol in (("lossless", 0.0), ("q8", 0.02)):
+        ax = TpAxis("model", mode=mode, group_size=16)
+        fn = jax.jit(shard_map(lambda p: tp_all_gather(p[0], ax),
+                               mesh=tp4_mesh, in_specs=(P("model"),),
+                               out_specs=P(None)))
+        out = np.asarray(fn(parts))
+        assert out.shape == (4, 2, 16)
+        err = np.max(np.abs(out - np.asarray(parts)))
+        scale = np.max(np.abs(np.asarray(parts)))
+        assert err <= tol * scale + 1e-12, (mode, err)
+
+
+def test_collective_bytes_q8_strictly_fewer():
+    n, eb = 4096, 2  # a bf16 hidden row
+    for tp in (2, 4, 8):
+        lossless = collective_bytes("all_reduce", "lossless", tp, n, eb)
+        q8 = collective_bytes("all_reduce", "q8", tp, n, eb, 64)
+        assert 0 < q8 < lossless, (tp, q8, lossless)
+    assert collective_bytes("all_reduce", "lossless", 1, n, eb) == 0
+    assert collective_bytes("all_gather", "q8", 4, n, eb) < collective_bytes(
+        "all_gather", "lossless", 4, n, eb
+    )
+    with pytest.raises(ValueError):
+        collective_bytes("reduce_scatter", "lossless", 4, n, eb)
+
+
+def test_resolve_collective_mode():
+    # CPU devices: auto stays lossless (greedy SSE parity out of the box)
+    assert resolve_collective_mode("auto") == "lossless"
+    assert resolve_collective_mode("q8") == "q8"
+    assert resolve_collective_mode("lossless") == "lossless"
+    with pytest.raises(ValueError):
+        resolve_collective_mode("int4")
+
+
+# ---- pre-sharded placement -------------------------------------------------
+
+
+def test_place_presharded_values_and_slices(tp4_mesh):
+    from dnet_tpu.parallel.tp import place_presharded, tp_param_spec
+
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(2, 8, 16)).astype(np.float32)  # col-parallel
+    norm = rng.normal(size=(2, 8)).astype(np.float32)  # replicated
+
+    placed = place_presharded(
+        {"wq": w, "attn_norm": norm}, tp4_mesh,
+        {"wq": tp_param_spec("wq"), "attn_norm": tp_param_spec("attn_norm")},
+    )
+    np.testing.assert_array_equal(np.asarray(placed["wq"]), w)
+    np.testing.assert_array_equal(np.asarray(placed["attn_norm"]), norm)
+    # each chip holds exactly 1/4 of the output dim — never the full tensor
+    shapes = {s.data.shape for s in placed["wq"].addressable_shards}
+    assert shapes == {(2, 8, 4)}
+    assert {s.data.shape for s in placed["attn_norm"].addressable_shards} == {
+        (2, 8)
+    }
+
+
+def test_place_presharded_cast_per_slice(tp4_mesh):
+    from dnet_tpu.parallel.tp import place_presharded
+
+    calls = []
+
+    def cast(a):
+        calls.append(a.shape)
+        return a.astype(np.float16)
+
+    w = np.ones((4, 8), dtype=np.float32)
+    placed = place_presharded(w, tp4_mesh, P(None, "model"), cast=cast)
+    assert placed.dtype == jnp.float16
+    # the cast ran per SLICE (4 x [4, 2]), never on the full [4, 8] tensor
+    assert calls == [(4, 2)] * 4
+
+
+def test_place_presharded_subtree_spec_broadcast(tp4_mesh):
+    """A quant-style subtree ({codes, scales} under one name) inherits its
+    tensor's split from the single name-level spec."""
+    from dnet_tpu.parallel.tp import place_presharded
+
+    sub = {"q": np.ones((4, 8), np.int8), "s": np.ones((1, 8), np.float32)}
+    placed = place_presharded({"wq": sub}, tp4_mesh, {"wq": P(None, "model")})
+    assert {s.data.shape for s in placed["wq"]["q"].addressable_shards} == {
+        (4, 2)
+    }
+    assert {s.data.shape for s in placed["wq"]["s"].addressable_shards} == {
+        (1, 2)
+    }
+
+
+# ---- head-sharded pool x ragged kernel ------------------------------------
+
+
+def test_ragged_kernel_runs_per_chip_on_head_sharded_pool(tp4_mesh):
+    """The PR 12 paged_attend program applied inside shard_map to a
+    head-sharded pool slice equals the unsharded reference: the kernel is
+    oblivious to tp — each chip attends its own KVH/tp heads against its
+    own pool shard, exactly the tp.py tp_kv_spec() layout."""
+    from dnet_tpu.ops.paged_attention import paged_attend
+
+    rng = np.random.default_rng(5)
+    B, H, KVH, Hd, N, bt, nb = 2, 4, 4, 8, 6, 4, 3
+    q = jnp.asarray(rng.normal(size=(B, 1, H, Hd)).astype(np.float32))
+    k_pool = jnp.asarray(rng.normal(size=(N, bt, KVH, Hd)).astype(np.float32))
+    v_pool = jnp.asarray(rng.normal(size=(N, bt, KVH, Hd)).astype(np.float32))
+    tables = jnp.asarray([[0, 2, 4], [1, 3, 5]], dtype=jnp.int32)
+    pos = jnp.asarray([7, 9], dtype=jnp.int32)
+    k_new = jnp.asarray(rng.normal(size=(B, KVH, Hd)).astype(np.float32))
+    v_new = jnp.asarray(rng.normal(size=(B, KVH, Hd)).astype(np.float32))
+
+    ref = paged_attend(q, k_pool, v_pool, tables, pos, k_new, v_new)
+
+    def per_chip(q_, kp, vp, kn, vn):
+        return paged_attend(q_, kp, vp, tables, pos, kn, vn)
+
+    head = P(None, None, "model", None)  # q / output: H over "model"
+    pool = P(None, None, "model", None)  # pool: KVH over "model"
+    new = P(None, "model", None)  # k_new/v_new: KVH over "model"
+    fn = jax.jit(shard_map(
+        per_chip, mesh=tp4_mesh,
+        in_specs=(head, pool, pool, new, new), out_specs=head,
+    ))
+    out = fn(q, k_pool, v_pool, k_new, v_new)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-6)
+
+
+# ---- TpEngine --------------------------------------------------------------
+
+
+def test_tp_engine_greedy_parity_and_presharded_load(tiny_llama4_dir):
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+    from dnet_tpu.parallel.tp import TpEngine
+
+    ids = [256, 72, 101, 108, 108, 111]
+    ref = LocalEngine(tiny_llama4_dir, max_seq=64, param_dtype="float32")
+    ref_toks = [
+        r.token_id
+        for r in ref.generate(ids, DecodingParams(temperature=0.0),
+                              max_tokens=8)
+    ]
+    ref.close()
+
+    eng = TpEngine(tiny_llama4_dir, layers=list(range(4)), tp=4, max_seq=64,
+                   param_dtype="float32")
+    assert eng.collective_mode == "lossless"  # auto on CPU
+    toks = [
+        r.token_id
+        for r in eng.generate(ids, DecodingParams(temperature=0.0),
+                              max_tokens=8)
+    ]
+    assert toks == ref_toks
+    # weights really are pre-sharded: every chip holds 1/4 of wq, and the
+    # KV cache shards on the head axis
+    assert {s.data.shape[-1] for s in eng.window_params["wq"].addressable_shards} == {
+        eng.window_params["wq"].shape[-1] // 4
+    }
+    sess = eng.new_session("kv-probe")
+    kvh = eng.config.num_key_value_heads
+    k_leaf = jax.tree.leaves(sess.kv)[0]
+    assert {s.data.shape[3] for s in k_leaf.addressable_shards} == {kvh // 4}
+    eng.close()
+
+
+def test_tp_engine_q8_token_tolerance_and_fewer_bytes(tiny_llama4_dir):
+    from dnet_tpu.core.engine import LocalEngine
+    from dnet_tpu.core.types import DecodingParams
+    from dnet_tpu.obs import metric
+    from dnet_tpu.parallel.tp import TpEngine
+
+    ids = [256, 72, 101, 108, 108, 111]
+    ref = LocalEngine(tiny_llama4_dir, max_seq=64, param_dtype="float32")
+    ref_toks = [
+        r.token_id
+        for r in ref.generate(ids, DecodingParams(temperature=0.0),
+                              max_tokens=8)
+    ]
+    ref.close()
+
+    fam = metric("dnet_tp_collective_bytes_total").labels(op="all_reduce")
+    # gs=16: the 64-dim fixture's per-chip chunk (16 floats) must not pad
+    # to a full default-sized group, or the group meta would swamp the
+    # 1-byte codes at toy scale (real hidden sizes keep the default)
+    eng = TpEngine(tiny_llama4_dir, layers=list(range(4)), tp=4, max_seq=64,
+                   param_dtype="float32", collective="q8",
+                   collective_group_size=16)
+    toks = [
+        r.token_id
+        for r in eng.generate(ids, DecodingParams(temperature=0.0),
+                              max_tokens=8)
+    ]
+    agree = sum(a == b for a, b in zip(toks, ref_toks))
+    assert agree >= 6, (toks, ref_toks)  # 8-bit collectives, not garbage
+    # analytic byte books: one decode step under q8 is strictly cheaper
+    before = fam.value
+    eng.observe_step_collectives(1)
+    q8_step = fam.value - before
+    eng.close()
+    eng2 = TpEngine(tiny_llama4_dir, layers=list(range(4)), tp=4, max_seq=64,
+                    param_dtype="float32", collective="lossless")
+    before = fam.value
+    eng2.observe_step_collectives(1)
+    lossless_step = fam.value - before
+    eng2.close()
+    assert 0 < q8_step < lossless_step
+
+
+def test_tp_engine_head_divisibility_raises(tiny_llama_dir):
+    from dnet_tpu.parallel.tp import TpEngine
+
+    with pytest.raises(ValueError, match="does not divide"):
+        TpEngine(tiny_llama_dir, layers=list(range(4)), tp=4, max_seq=64,
+                 param_dtype="float32")  # fixture has 2 kv heads
+
+
+def test_shard_compute_clamps_env_tp(tiny_llama_dir):
+    """DNET_TP over-asking (tp=4 on the 2-kv-head fixture) serves a
+    clamped tp=2 TpEngine instead of failing the load."""
+    from dnet_tpu.parallel.tp import TpEngine
+    from dnet_tpu.shard.compute import ShardCompute
+
+    sc = ShardCompute(
+        tiny_llama_dir, list(range(4)), max_seq=64, param_dtype="float32",
+        wire_dtype="float32", tp_degree=4,
+    )
+    assert isinstance(sc.engine, TpEngine) and sc.engine.tp == 2
+    sc.engine.close()
+
+
+def test_shard_compute_sp_keeps_mesh_substrate(tiny_llama_dir, eight_devices):
+    """tp_degree defers to the shard_map substrate when sp is requested."""
+    from dnet_tpu.parallel.shard_mesh import MeshShardEngine
+    from dnet_tpu.parallel.tp import TpEngine
+    from dnet_tpu.shard.compute import ShardCompute
+
+    sc = ShardCompute(
+        tiny_llama_dir, list(range(4)), max_seq=64, param_dtype="float32",
+        wire_dtype="float32", tp_degree=2, mesh_sp=2,
+        mesh_devices=eight_devices[:2],
+    )
+    assert isinstance(sc.engine, MeshShardEngine)
+    assert not isinstance(sc.engine, TpEngine)
+    sc.engine.close()
+
+
+# ---- solver mesh-slice placement ------------------------------------------
+
+
+def _dev(i, ici=4e10, t_comm=0.01, chips=1, host="h0", slice_id=0):
+    from dnet_tpu.core.types import DeviceInfo
+
+    return DeviceInfo(
+        instance=f"s{i}", host=host, http_port=1, grpc_port=2,
+        chip_count=chips, flops_bf16=1e12, hbm_bw=1e11, host_to_hbm_bw=1e10,
+        hbm_bytes=16 << 30, host_ram_bytes=64 << 30, t_comm=t_comm,
+        slice_id=slice_id, ici_bw=ici,
+    )
+
+
+def _profile(**kw):
+    from dnet_tpu.parallel.solver import ModelProfile
+
+    base = dict(
+        model_id="m", num_layers=8, layer_bytes=50 << 20,
+        layer_flops_per_token=1e8, kv_bytes_per_token_per_layer=1024,
+        seq_len=4096, tp_heads=4, hidden_bytes=8192,
+    )
+    base.update(kw)
+    return ModelProfile(**base)
+
+
+def test_solver_prefers_one_mesh_slice_over_four_hops():
+    """ACCEPTANCE: four ICI-adjacent 1-chip shards with interconnect >>
+    ring wire collapse into ONE 4-chip hop with tp_degree=4."""
+    from dnet_tpu.parallel.solver import solve_topology
+
+    topo = solve_topology([_dev(i) for i in range(4)], _profile())
+    assert len(topo.assignments) == 1
+    a = topo.assignments[0]
+    assert a.tp_degree == 4 and len(a.layers) == 8
+    assert topo.solution["mesh_slices"] == {"s0": ["s1", "s2", "s3"]}
+
+
+def test_solver_keeps_hops_when_interconnect_unknown_or_remote():
+    from dnet_tpu.parallel.solver import solve_topology
+
+    # unknown ici_bw: the collective cost would be a guess — never merge
+    topo = solve_topology([_dev(i, ici=0.0) for i in range(4)], _profile())
+    assert len(topo.assignments) == 4
+    assert all(a.tp_degree == 1 for a in topo.assignments)
+    # different hosts: no shared ICI to merge over
+    topo2 = solve_topology(
+        [_dev(i, host=f"h{i}") for i in range(4)], _profile()
+    )
+    assert len(topo2.assignments) == 4
+
+
+def test_solver_keeps_hops_when_ring_wire_beats_interconnect():
+    """A glacial interconnect makes the merged slice's collective cost
+    dominate — the solver keeps today's four 1-chip hops."""
+    from dnet_tpu.parallel.solver import solve_topology
+
+    topo = solve_topology(
+        [_dev(i, ici=1e4, t_comm=1e-6) for i in range(4)], _profile()
+    )
+    assert len(topo.assignments) == 4
+    assert all(a.tp_degree == 1 for a in topo.assignments)
+
+
+def test_solver_tp_degree_1_is_byte_identical_regression():
+    """Single-chip devices (or unknown ICI) must produce exactly the
+    pre-TP solve: same w/n/k, same objective, same assignments — the new
+    fields pinned to their off values."""
+    from dnet_tpu.parallel.solver import solve_topology
+
+    devs = [_dev(i, ici=0.0, host=f"h{i}") for i in range(3)]
+    topo = solve_topology(devs, _profile(tp_heads=0))
+    assert topo.solution["w"] == [3, 3, 2] or sum(topo.solution["w"]) == 8
+    assert topo.solution["k"] == 1
+    assert "mesh_slices" not in topo.solution
+    for a in topo.assignments:
+        assert a.tp_degree == 1 and a.mesh_tp == 1 and a.mesh_sp == 1
+    # the prediction model charges ZERO collective cost at chip_count 1
+    from dnet_tpu.parallel.solver import predict_stage_time
+
+    d = _dev(0, ici=4e10)
+    m = _profile()
+    assert predict_stage_time(d, m, 4, 4) == predict_stage_time(
+        _dev(0, ici=0.0), m, 4, 4
+    )
+
+
+def test_predict_stage_time_charges_collective_cost():
+    from dnet_tpu.parallel.solver import predict_stage_time
+
+    m = _profile()
+    fast = _dev(0, ici=4e10, chips=4)
+    slow = _dev(0, ici=1e6, chips=4)
+    none = _dev(0, ici=0.0, chips=4)
+    t_fast = predict_stage_time(fast, m, 4, 4)
+    t_slow = predict_stage_time(slow, m, 4, 4)
+    t_none = predict_stage_time(none, m, 4, 4)
+    assert t_none < t_fast < t_slow
