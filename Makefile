@@ -5,11 +5,17 @@
 
 PY ?= python
 
-.PHONY: tier1 dnetlint dnetlint-diff dnetlint-report
+.PHONY: tier1 dnetlint dnetlint-diff dnetlint-report bench-compare
 
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# regression diff of two BENCH_SERVE records:
+#   make bench-compare OLD=BENCH_SERVE_r04.json NEW=BENCH_SERVE_r05.json \
+#        FAIL_ON='--fail-on goodput.tok_s=-5%'
+bench-compare:
+	$(PY) scripts/bench_compare.py $(OLD) $(NEW) $(FAIL_ON)
 
 dnetlint:
 	$(PY) scripts/dnetlint.py

@@ -38,6 +38,11 @@ Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
   (pass 12; DL021-DL025 are the flow-sensitive tier, analysis/flow/)
 - the TP collective op labels cross-checked against obs/phases.py TP_OPS
   both directions + the dnet_tp_* families required (pass 13)
+- DL028 critical-path — request-segment labels <-> obs/phases.py
+  REQUEST_SEGMENTS both directions, the attribution map + trace track
+  routing (obs/critical_path.py, obs/trace.py) consistent with the
+  declared segment enum, and the segment-histogram + tick-record
+  families required (pass 14)
 """
 
 from __future__ import annotations
@@ -190,6 +195,13 @@ _REQUIRED_FAMILIES = (
     "dnet_wire_decode_ms",
     "dnet_wire_bytes_total",
     "dnet_wire_overlap_ratio",
+    # critical-path attribution + scheduler tick flight recorder
+    # (obs/critical_path.py, sched/flight.py) — the per-request segment
+    # ledgers, /v1/debug/sched, and the label cross-check (pass 14)
+    # depend on these
+    "dnet_request_segment_ms",
+    "dnet_sched_tick_records_total",
+    "dnet_sched_tick_budget_used_ratio",
 )
 
 
@@ -604,6 +616,96 @@ def check_tp_labels(errors: list) -> int:
     return n
 
 
+def check_request_segment_labels(errors: list) -> int:
+    """Pass 14: the critical-path surface must stay self-consistent with
+    the declared segment enum (obs/phases.py REQUEST_SEGMENTS), both
+    directions:
+
+    - every declared segment has a pre-touched dnet_request_segment_ms
+      series, and no exposed segment label is undeclared;
+    - every obs/critical_path.py SPAN_SEGMENTS target is a declared
+      segment, and every declared segment except `other` (the residual
+      bucket) is reachable from at least one span mapping — a segment no
+      span can feed is a stale ledger row;
+    - the Perfetto track routing (obs/trace.py) only names spans the
+      attribution map knows (plus the instant-only flow-rx marker), its
+      compute/tx sets are disjoint, and flow arrows only leave tx spans;
+    - the tick flight recorder's queue-depth keys are exactly
+      sched/kinds.py QUEUE_STATES, so /v1/debug/sched and the
+      dnet_sched_queue_depth gauges tell the same story."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs import trace as obs_trace
+    from dnet_tpu.obs.critical_path import SPAN_SEGMENTS
+    from dnet_tpu.obs.phases import REQUEST_SEGMENTS, SEG_OTHER
+    from dnet_tpu.sched.flight import TickFlightRecorder
+    from dnet_tpu.sched.kinds import QUEUE_STATES
+
+    text = get_registry().expose()
+    n = 0
+    for seg in REQUEST_SEGMENTS:
+        n += 1
+        if f'dnet_request_segment_ms_count{{segment="{seg}"}}' not in text:
+            errors.append(
+                f"critical-path: obs.phases.REQUEST_SEGMENTS value {seg!r} "
+                f"has no dnet_request_segment_ms series (pre-touch it in "
+                f"dnet_tpu.obs._register_core)"
+            )
+    for m in re.finditer(
+        r'dnet_request_segment_ms(?:_bucket|_sum|_count)\{segment="([^"]+)"',
+        text,
+    ):
+        if m.group(1) not in REQUEST_SEGMENTS:
+            errors.append(
+                f"critical-path: exposed dnet_request_segment_ms segment "
+                f"label {m.group(1)!r} is not declared in "
+                f"obs.phases.REQUEST_SEGMENTS"
+            )
+
+    mapped_targets = {seg for seg, _prio in SPAN_SEGMENTS.values()}
+    for seg in mapped_targets:
+        n += 1
+        if seg not in REQUEST_SEGMENTS:
+            errors.append(
+                f"critical-path: SPAN_SEGMENTS maps to {seg!r}, which is "
+                f"not declared in obs.phases.REQUEST_SEGMENTS"
+            )
+    for seg in REQUEST_SEGMENTS:
+        if seg != SEG_OTHER and seg not in mapped_targets:
+            errors.append(
+                f"critical-path: declared segment {seg!r} is unreachable — "
+                f"no obs/critical_path.py SPAN_SEGMENTS entry feeds it"
+            )
+
+    routed = obs_trace.COMPUTE_SPANS | obs_trace.TX_SPANS
+    overlap_names = obs_trace.COMPUTE_SPANS & obs_trace.TX_SPANS
+    if overlap_names:
+        errors.append(
+            f"critical-path: trace track sets overlap: {sorted(overlap_names)}"
+        )
+    known = set(SPAN_SEGMENTS) | {obs_trace.FLOW_RX_SPAN}
+    for name in sorted(routed - known):
+        errors.append(
+            f"critical-path: obs/trace.py routes span {name!r} to a thread "
+            f"track but obs/critical_path.py SPAN_SEGMENTS does not "
+            f"attribute it"
+        )
+    n += len(routed)
+    for name in sorted(obs_trace.FLOW_TX_SPANS - obs_trace.TX_SPANS):
+        errors.append(
+            f"critical-path: flow arrow source {name!r} is not on the "
+            f"tx-stage track"
+        )
+
+    states = TickFlightRecorder().snapshot()["states"]
+    n += 1
+    if tuple(states) != tuple(QUEUE_STATES):
+        errors.append(
+            f"critical-path: tick-record states {states!r} != "
+            f"sched.kinds.QUEUE_STATES {tuple(QUEUE_STATES)!r}"
+        )
+    return n
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -622,6 +724,7 @@ def main() -> int:
     n_jit = check_jit_instrumentation(errors)
     n_wire = check_wire_labels(errors)
     n_tp = check_tp_labels(errors)
+    n_seg = check_request_segment_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -632,7 +735,7 @@ def main() -> int:
           f"{n_member} membership labels, {n_attr} attribution labels, "
           f"{n_san} sanitizer labels, {n_sched} scheduler labels, "
           f"{n_jit} jit call sites, {n_wire} wire labels, "
-          f"{n_tp} tp labels, all conform")
+          f"{n_tp} tp labels, {n_seg} critical-path labels, all conform")
     return 0
 
 
@@ -753,6 +856,15 @@ class TpLabelContract(_MetricsCheck):
     pass_name = "check_tp_labels"
 
 
+class RequestSegmentContract(_MetricsCheck):
+    code = "DL028"
+    name = "request-segment-contract"
+    description = (
+        "segment labels <-> REQUEST_SEGMENTS + trace tracks consistent"
+    )
+    pass_name = "check_request_segment_labels"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -767,4 +879,5 @@ METRICS_CHECKS = [
     JitInstrumentationContract(),
     WireLabelContract(),
     TpLabelContract(),
+    RequestSegmentContract(),
 ]

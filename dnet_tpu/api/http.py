@@ -12,7 +12,15 @@ Routes (reference: src/dnet/api/http_api.py:75-93):
   GET  /v1/cluster/metrics     — every node's /metrics federated (node labels)
   GET  /v1/debug/timeline/{rid} — one request's flight-recorder spans;
                                   ?cluster=1 stitches every shard's spans
-                                  into one skew-corrected timeline
+                                  into one skew-corrected timeline; the
+                                  response embeds the request's
+                                  critical-path segment ledger
+  GET  /v1/debug/sched          — scheduler tick flight-recorder ring
+                                  (sched/flight.py; DNET_SCHED mode)
+  GET  /v1/debug/trace/{rid}    — one request as Chrome trace-event /
+                                  Perfetto JSON (?cluster=1 stitches)
+  GET  /v1/debug/trace?last_s=N — serving-window Perfetto dump (every
+                                  retained timeline + tick records)
 FastAPI is not available in this image; aiohttp's request handling + a thin
 pydantic validation shim cover the same surface.
 """
@@ -97,6 +105,9 @@ class ApiHTTPServer:
         self.app.router.add_get(
             "/v1/debug/timeline/{rid}", self.debug_timeline
         )
+        self.app.router.add_get("/v1/debug/sched", self.debug_sched)
+        self.app.router.add_get("/v1/debug/trace", self.debug_trace_window)
+        self.app.router.add_get("/v1/debug/trace/{rid}", self.debug_trace)
         self._runner: Optional[web.AppRunner] = None
         # peers seen by earlier /v1/cluster/metrics scrapes: a peer that
         # leaves discovery must drop to scrape_ok 0, not freeze at 1
@@ -170,13 +181,27 @@ class ApiHTTPServer:
                 },
             )
             await resp.prepare(request)
+
+            async def write_chunk(chunk) -> None:
+                # serialize + flush, timed as the request's sse_flush
+                # segment (obs/critical_path.py): the one leg of a
+                # request's story that happens after the driver hands a
+                # chunk back
+                from dnet_tpu.obs import get_recorder
+
+                t_w = time.perf_counter()
+                for payload in reshape(chunk):
+                    await resp.write(f"data: {payload}\n\n".encode())
+                get_recorder().span(
+                    chunk.id, "sse_flush",
+                    (time.perf_counter() - t_w) * 1000.0,
+                )
+
             try:
                 if first is not None:
-                    for payload in reshape(first):
-                        await resp.write(f"data: {payload}\n\n".encode())
+                    await write_chunk(first)
                     async for chunk in gen:
-                        for payload in reshape(chunk):
-                            await resp.write(f"data: {payload}\n\n".encode())
+                        await write_chunk(chunk)
                 await resp.write(b"data: [DONE]\n\n")
             except PromptTooLongError as exc:
                 err = json.dumps(
@@ -735,22 +760,33 @@ class ApiHTTPServer:
         response is the MERGED cluster timeline: every shard's spans for
         the rid are fetched over their HTTP servers, skew-corrected onto
         this node's clock, and interleaved with the API's own spans."""
+        from dnet_tpu.obs.critical_path import critical_path_section
         from dnet_tpu.obs.http import find_timeline
 
         rid = request.match_info["rid"]
         timeline = find_timeline(rid)
         cluster = request.query.get("cluster", "").strip().lower()
         if cluster in ("1", "true", "yes", "on"):
-            return await self._cluster_timeline(rid, timeline)
+            stitched = await self._stitched_timeline(rid, timeline)
+            if stitched is None:
+                return _json_error(
+                    404, f"no recorded timeline for {rid!r} on any node",
+                    "not_found",
+                )
+            stitched["critical_path"] = critical_path_section(stitched)
+            return web.json_response(stitched)
         if timeline is None:
             return _json_error(404, f"no recorded timeline for {rid!r}",
                                "not_found")
-        return web.json_response(timeline)
+        payload = dict(timeline)
+        payload["critical_path"] = critical_path_section(timeline)
+        return web.json_response(payload)
 
-    async def _cluster_timeline(
+    async def _stitched_timeline(
         self, rid: str, local: Optional[dict]
-    ) -> web.Response:
-        """Fetch + stitch the shard halves of one request's timeline.
+    ) -> Optional[dict]:
+        """Fetch + stitch the shard halves of one request's timeline
+        (None when no node recorded anything for the rid).
 
         Each shard fetch doubles as the clock probe correcting it: the
         response's `t_wall` bracketed by this node's wall clock yields an
@@ -802,10 +838,81 @@ class ApiHTTPServer:
 
             _devices, remotes = await self._fan_out_shards(fetch)
         if local is None and not remotes:
-            return _json_error(
-                404, f"no recorded timeline for {rid!r} on any node",
-                "not_found",
-            )
+            return None
+        return stitch_timelines(local, remotes, rid=internal)
+
+    async def debug_sched(self, request: web.Request) -> web.Response:
+        """Scheduler tick flight-recorder ring (sched/flight.py): per-tick
+        token-budget use/waste, prefill/decode split, queue depths by
+        state, preemptions, and KV block-pool occupancy.  `?last=N` trims
+        the record list to the most recent N ticks."""
+        from dnet_tpu.sched.flight import get_tick_recorder
+
+        snap = get_tick_recorder().snapshot()
+        last = request.query.get("last", "").strip()
+        if last:
+            try:
+                n = max(0, int(last))
+            except ValueError:
+                return _json_error(400, "last must be an integer")
+            snap["records"] = snap["records"][-n:] if n else []
+        return web.json_response(snap)
+
+    async def debug_trace(self, request: web.Request) -> web.Response:
+        """One request as Chrome trace-event / Perfetto JSON
+        (obs/trace.py).  `?cluster=1` stitches every shard's spans in
+        first, so the export carries one process track per node with flow
+        arrows following the rid across hops.  `?format=` accepts only
+        `perfetto` (the sole format) — anything else is a 400 so a typo'd
+        format is loud, not silently perfetto."""
+        from dnet_tpu.obs.http import find_timeline
+        from dnet_tpu.obs.trace import export_trace
+        from dnet_tpu.sched.flight import get_tick_recorder
+
+        fmt = request.query.get("format", "perfetto").strip().lower()
+        if fmt not in ("perfetto", "chrome"):
+            return _json_error(400, f"unknown trace format {fmt!r}")
+        rid = request.match_info["rid"]
+        timeline = find_timeline(rid)
+        cluster = request.query.get("cluster", "").strip().lower()
+        if cluster in ("1", "true", "yes", "on"):
+            timeline = await self._stitched_timeline(rid, timeline)
+        if timeline is None:
+            return _json_error(404, f"no recorded timeline for {rid!r}",
+                               "not_found")
         return web.json_response(
-            stitch_timelines(local, remotes, rid=internal)
+            export_trace(
+                [timeline],
+                tick_records=get_tick_recorder().snapshot()["records"],
+            )
+        )
+
+    async def debug_trace_window(self, request: web.Request) -> web.Response:
+        """Serving-window Perfetto dump: every timeline the recorder still
+        retains whose request began in the last `last_s` seconds (default
+        DNET_OBS_TRACE_WINDOW_S), plus the tick-record counter tracks."""
+        from dnet_tpu.config import get_settings
+        from dnet_tpu.obs import get_recorder
+        from dnet_tpu.obs.trace import export_trace
+        from dnet_tpu.sched.flight import get_tick_recorder
+
+        last_raw = request.query.get("last_s", "").strip()
+        try:
+            last_s = (
+                float(last_raw) if last_raw
+                else get_settings().obs.trace_window_s
+            )
+        except ValueError:
+            return _json_error(400, "last_s must be a number")
+        recorder = get_recorder()
+        timelines = [
+            tl
+            for rid in recorder.request_ids_since(time.time() - last_s)
+            if (tl := recorder.timeline(rid)) is not None
+        ]
+        return web.json_response(
+            export_trace(
+                timelines,
+                tick_records=get_tick_recorder().snapshot()["records"],
+            )
         )

@@ -34,7 +34,7 @@ from dnet_tpu.admission.controller import (
 )
 from dnet_tpu.api.strategies import ApiAdapterBase
 from dnet_tpu.core.types import DecodingParams
-from dnet_tpu.obs import get_recorder, get_slo_tracker, metric
+from dnet_tpu.obs import critical_path, get_recorder, get_slo_tracker, metric
 from dnet_tpu.resilience.checkpoint import ResumableDecode
 from dnet_tpu.resilience.policy import is_retryable
 from dnet_tpu.utils.logger import get_logger
@@ -228,12 +228,22 @@ class InferenceManager:
         if not self.ready:
             raise InferenceError("no model loaded")
         deadline = self._deadline_for(req)
+        t_admit = time.perf_counter()
         async with self.admission.slot(deadline):
-            async for chunk in self._run(req, deadline):
+            # queued-at-the-gate time, measured here because the rid does
+            # not exist yet: _run backdates it onto the timeline as the
+            # admission_wait segment (obs/critical_path.py)
+            admit_wait_ms = (time.perf_counter() - t_admit) * 1000.0
+            async for chunk in self._run(
+                req, deadline, admit_wait_ms=admit_wait_ms
+            ):
                 yield chunk
 
     async def _run(
-        self, req: ChatCompletionRequest, deadline: Optional[Deadline] = None
+        self,
+        req: ChatCompletionRequest,
+        deadline: Optional[Deadline] = None,
+        admit_wait_ms: float = 0.0,
     ) -> AsyncIterator[ChatCompletionChunk]:
         if self.failure_monitor is not None and self.failure_monitor.degraded:
             raise ServiceDegradedError(
@@ -265,6 +275,15 @@ class InferenceManager:
         finish_reason = "length"
         recorder = get_recorder()
         recorder.begin(rid)  # flight-recorder timeline (rid == nonce)
+        if admit_wait_ms > 0.0:
+            # the wait happened BEFORE this timeline's origin: a negative
+            # start offset keeps [0, e2e] the admitted window while the
+            # segment ledger still carries the queued time (and the sum
+            # still reconciles against the client-measured E2E)
+            recorder.span(
+                rid, "admission_wait", admit_wait_ms,
+                t_ms=-admit_wait_ms, force=True,
+            )
         slo = get_slo_tracker()  # rolling windows behind /health + dnet_slo_*
         _REQUESTS.inc()
         pending = ""  # emitted-text buffer held back for stop-seq matching
@@ -518,9 +537,16 @@ class InferenceManager:
                 tokens=generated, prompt_tokens=len(prompt_ids),
                 finish_reason=finish_reason, force=True,
             )
+            # the segment ledger feeds dnet_request_segment_ms for EVERY
+            # request (aggregate attribution is a serving concern, not a
+            # profile=true opt-in); the structured dict additionally rides
+            # the final chunk when the client asked to profile
+            ledger = critical_path.decompose(recorder.timeline(rid))
+            critical_path.observe(ledger)
             metrics = None
             if req.profile:
                 metrics = RequestMetrics.from_timeline(recorder.timeline(rid))
+                metrics.critical_path = ledger
             yield ChatCompletionChunk(
                 id=rid,
                 model=req.model,

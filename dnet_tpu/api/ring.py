@@ -483,6 +483,13 @@ class RingApiAdapter(ApiAdapterBase):
         if sent is not None:
             dt = time.monotonic() - sent
             _HOP_RTT_MS.observe(dt * 1000)
+            # the API-local half of critical-path attribution: everything
+            # between flush and resolve is ring time, which the stitched
+            # shard spans (compute/tx) carve into finer segments when a
+            # cluster timeline is available (obs/critical_path.py)
+            get_recorder().span(
+                result.nonce, "hop_rtt", dt * 1000, step=result.step
+            )
             self._step_ema = dt if self._step_ema <= 0 else (
                 0.8 * self._step_ema + 0.2 * dt
             )

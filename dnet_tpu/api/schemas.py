@@ -242,6 +242,10 @@ class RequestMetrics(BaseModel):
     tokens_generated: int = 0
     tps_overall: float = 0.0
     tps_decoding: float = 0.0
+    # the per-request segment ledger (obs/critical_path.py decompose):
+    # attached by the driver at request close so loadgen rows — and any
+    # profile=true client — carry WHERE the E2E went, not just how much
+    critical_path: Optional[dict] = None
 
     @classmethod
     def from_timeline(cls, timeline: Optional[dict]) -> "RequestMetrics":

@@ -168,6 +168,14 @@ class ObsSettings(_EnvGroup):
     # recorded for EVERY request regardless).  1 = record everything; N > 1
     # keeps a load run from thrashing the bounded timeline ring.
     trace_sample: int = 1
+    # Perfetto trace export (obs/trace.py, GET /v1/debug/trace):
+    # serving-window dump default horizon and a hard cap on emitted trace
+    # events (oldest timelines dropped first past the cap)
+    trace_window_s: float = 120.0
+    trace_max_events: int = 50000
+    # scheduler tick flight-recorder ring capacity (sched/flight.py,
+    # GET /v1/debug/sched); 0 disables capture entirely
+    tick_records: int = 256
 
     def sync_stride(self) -> int:
         """Normalized decode-step sync cadence: 0 = never fence, N >= 1 =

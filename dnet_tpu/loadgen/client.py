@@ -76,6 +76,10 @@ class RequestOutcome:
     prompt_tokens: int = 0
     retry_after_s: float = 0.0
     itl_ms: List[float] = field(default_factory=list)  # inter-token gaps
+    # per-request segment ledger from the final chunk's profile metrics
+    # (obs/critical_path.py decompose) — server-side attribution riding
+    # next to the client-side timings above
+    critical_path: Optional[dict] = None
 
     def as_dict(self) -> dict:
         d = {
@@ -98,6 +102,8 @@ class RequestOutcome:
             d["error"] = self.error[:200]
         if self.finish_reason:
             d["finish_reason"] = self.finish_reason
+        if self.critical_path:
+            d["critical_path"] = self.critical_path
         return d
 
 
@@ -108,6 +114,9 @@ def chat_body(planned: PlannedRequest, model: str) -> dict:
         "max_tokens": planned.max_tokens,
         "temperature": planned.temperature,
         "stream": True,
+        # final chunk carries RequestMetrics (incl. the critical-path
+        # segment ledger) for the report's attribution section
+        "profile": True,
     }
     if planned.temperature > 0:
         body["seed"] = planned.seed
@@ -206,6 +215,9 @@ async def _drive(session, planned, model, path, out: RequestOutcome) -> None:
             if usage:
                 out.tokens_out = int(usage.get("completion_tokens", 0))
                 out.prompt_tokens = int(usage.get("prompt_tokens", 0))
+            metrics = chunk.get("metrics")
+            if isinstance(metrics, dict) and metrics.get("critical_path"):
+                out.critical_path = metrics["critical_path"]
         out.e2e_ms = (time.perf_counter() - t_send) * 1000.0
         if out.ttft_ms == 0.0 and t_last is None and finished:
             # zero-content stream (immediate EOS): TTFT is the final-chunk

@@ -1,0 +1,244 @@
+"""BENCH_SERVE record comparison: two reports -> deltas + regression gate.
+
+The math behind ``scripts/bench_compare.py``.  A record is either FLAT
+(one ``build_report`` dict, r01–r03 shape) or MULTI-LEG (named legs each
+holding a report, r04/r05 shape: ``legacy``/``pipelined``/...); legs are
+matched by name across the two records and each matched pair yields a
+delta block covering goodput, client latency percentiles, shed/failure
+breakdowns, server-side phase attribution, and the aggregated
+critical-path segment ledger (this PR's ``critical_path`` section).
+
+Regression thresholds are DIRECTIONAL: ``--fail-on
+latency_ms.e2e.p95_ms=+10%`` fails when the dotted metric ROSE more than
+10% (a latency regression), ``--fail-on goodput.tok_s=-5%`` fails when
+it FELL more than 5% (a throughput regression).  Absolute limits drop
+the ``%`` (``+50`` = fail past a 50-unit rise).  The sign names the bad
+direction, so a gate never fires on an improvement.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+#: dotted paths diffed for every matched leg pair (present-in-both only)
+DELTA_PATHS = (
+    "goodput.tok_s",
+    "goodput.requests_per_s",
+    "goodput.tokens_out",
+    "availability",
+    "requests.completed",
+    "requests.shed",
+    "requests.failed",
+    "requests.shed_rate",
+    "latency_ms.ttft.p50_ms",
+    "latency_ms.ttft.p95_ms",
+    "latency_ms.ttft.p99_ms",
+    "latency_ms.tpot.p50_ms",
+    "latency_ms.tpot.p95_ms",
+    "latency_ms.tpot.p99_ms",
+    "latency_ms.e2e.p50_ms",
+    "latency_ms.e2e.p95_ms",
+    "latency_ms.e2e.p99_ms",
+)
+
+
+def lookup(record: dict, path: str) -> Optional[float]:
+    """Dotted-path numeric lookup (None when absent or non-numeric)."""
+    node = record
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def legs(record: dict) -> Dict[str, dict]:
+    """Extract comparable legs: a flat report is one unnamed leg; a
+    multi-leg record contributes every sub-dict that looks like a report
+    (has ``latency_ms``)."""
+    if "latency_ms" in record:
+        return {"": record}
+    return {
+        k: v
+        for k, v in record.items()
+        if isinstance(v, dict) and "latency_ms" in v
+    }
+
+
+@dataclass(frozen=True)
+class FailRule:
+    """One ``--fail-on path=<sign><limit>[%]`` regression gate."""
+
+    path: str
+    direction: int  # +1 = fail on rise, -1 = fail on fall
+    limit: float  # magnitude of the allowed change in the bad direction
+    relative: bool  # True when the limit is a fraction of the old value
+
+    def describe(self) -> str:
+        arrow = "rise" if self.direction > 0 else "fall"
+        lim = f"{self.limit * 100:g}%" if self.relative else f"{self.limit:g}"
+        return f"{self.path} may not {arrow} more than {lim}"
+
+
+_RULE_RE = re.compile(
+    r"^(?P<path>[A-Za-z0-9_.]+)=(?P<sign>[+-])(?P<limit>[0-9.]+)(?P<pct>%?)$"
+)
+
+
+def parse_fail_rule(spec: str) -> FailRule:
+    m = _RULE_RE.match(spec.strip())
+    if m is None:
+        raise ValueError(
+            f"bad --fail-on spec {spec!r} "
+            "(want path=+10% / path=-5% / path=+50)"
+        )
+    limit = float(m.group("limit"))
+    relative = bool(m.group("pct"))
+    if relative:
+        limit /= 100.0
+    return FailRule(
+        path=m.group("path"),
+        direction=1 if m.group("sign") == "+" else -1,
+        limit=limit,
+        relative=relative,
+    )
+
+
+def rule_violation(
+    rule: FailRule, old: dict, new: dict
+) -> Optional[str]:
+    """None when the gate holds; a human-readable violation otherwise.
+    A path missing from either leg is a violation too — a silently
+    ungated metric is how regressions sneak past CI."""
+    ov, nv = lookup(old, rule.path), lookup(new, rule.path)
+    if ov is None or nv is None:
+        missing = "old" if ov is None else "new"
+        return f"{rule.path}: missing from {missing} record"
+    change = nv - ov
+    if rule.relative:
+        if abs(ov) < 1e-12:
+            # no baseline to scale by: only a change in the bad
+            # direction at all can violate a relative rule
+            bad = change * rule.direction > 0
+            frac = float("inf") if bad else 0.0
+        else:
+            frac = change / abs(ov)
+            bad = frac * rule.direction > rule.limit
+        if bad:
+            return (
+                f"{rule.path}: {ov:g} -> {nv:g} "
+                f"({frac * 100:+.1f}% vs limit "
+                f"{rule.direction * rule.limit * 100:+g}%)"
+            )
+        return None
+    if change * rule.direction > rule.limit:
+        return (
+            f"{rule.path}: {ov:g} -> {nv:g} "
+            f"({change:+g} vs limit {rule.direction * rule.limit:+g})"
+        )
+    return None
+
+
+def _delta_entry(ov: float, nv: float) -> dict:
+    entry = {"old": ov, "new": nv, "delta": round(nv - ov, 4)}
+    if abs(ov) > 1e-12:
+        entry["rel"] = round((nv - ov) / abs(ov), 4)
+    return entry
+
+
+def diff_leg(old: dict, new: dict) -> dict:
+    """Structured delta for one matched leg pair."""
+    out: dict = {"metrics": {}}
+    for path in DELTA_PATHS:
+        ov, nv = lookup(old, path), lookup(new, path)
+        if ov is None or nv is None:
+            continue
+        out["metrics"][path] = _delta_entry(ov, nv)
+
+    # shed-reason breakdown: union of reasons, absent reads as 0
+    o_shed = (old.get("requests") or {}).get("shed_by_reason") or {}
+    n_shed = (new.get("requests") or {}).get("shed_by_reason") or {}
+    reasons = sorted(set(o_shed) | set(n_shed))
+    if reasons:
+        out["shed_by_reason"] = {
+            r: _delta_entry(float(o_shed.get(r, 0)), float(n_shed.get(r, 0)))
+            for r in reasons
+        }
+
+    # phase attribution: per-phase mean_ms movement
+    o_ph = ((old.get("phase_attribution") or {}).get("phases")) or {}
+    n_ph = ((new.get("phase_attribution") or {}).get("phases")) or {}
+    phases = sorted(set(o_ph) & set(n_ph))
+    if phases:
+        out["phase_mean_ms"] = {
+            ph: _delta_entry(
+                float(o_ph[ph].get("mean_ms", 0.0)),
+                float(n_ph[ph].get("mean_ms", 0.0)),
+            )
+            for ph in phases
+        }
+
+    # critical-path segment ledger: per-segment mean movement + the
+    # dominant-segment population shift
+    o_cp = (old.get("critical_path") or {}).get("segments") or {}
+    n_cp = (new.get("critical_path") or {}).get("segments") or {}
+    segs = sorted(set(o_cp) & set(n_cp))
+    if segs:
+        out["critical_path_mean_ms"] = {
+            seg: _delta_entry(
+                float(o_cp[seg].get("mean_ms", 0.0)),
+                float(n_cp[seg].get("mean_ms", 0.0)),
+            )
+            for seg in segs
+        }
+        o_dom = (old.get("critical_path") or {}).get("dominant") or {}
+        n_dom = (new.get("critical_path") or {}).get("dominant") or {}
+        out["dominant"] = {
+            seg: _delta_entry(
+                float(o_dom.get(seg, 0)), float(n_dom.get(seg, 0))
+            )
+            for seg in sorted(set(o_dom) | set(n_dom))
+        }
+    return out
+
+
+def compare_records(
+    old: dict,
+    new: dict,
+    rules: Tuple[FailRule, ...] = (),
+    leg: Optional[str] = None,
+) -> dict:
+    """Full comparison: match legs, diff each pair, evaluate the gates.
+
+    ``leg`` restricts to one named leg (must exist in both).  Gates run
+    against every matched leg — a regression in ANY leg fails."""
+    o_legs, n_legs = legs(old), legs(new)
+    if leg is not None:
+        if leg not in o_legs or leg not in n_legs:
+            raise ValueError(
+                f"leg {leg!r} not present in both records "
+                f"(old has {sorted(o_legs)}, new has {sorted(n_legs)})"
+            )
+        o_legs = {leg: o_legs[leg]}
+        n_legs = {leg: n_legs[leg]}
+    matched = sorted(set(o_legs) & set(n_legs))
+    violations: List[str] = []
+    legs_out = {}
+    for name in matched:
+        d = diff_leg(o_legs[name], n_legs[name])
+        for rule in rules:
+            v = rule_violation(rule, o_legs[name], n_legs[name])
+            if v is not None:
+                violations.append(f"[{name or 'report'}] {v}")
+        legs_out[name or "report"] = d
+    return {
+        "legs": legs_out,
+        "unmatched_old": sorted(set(o_legs) - set(n_legs)),
+        "unmatched_new": sorted(set(n_legs) - set(o_legs)),
+        "violations": violations,
+        "ok": not violations and bool(matched),
+    }

@@ -26,7 +26,7 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 from dnet_tpu.loadgen.client import RequestOutcome
 from dnet_tpu.loadgen.workload import WorkloadSpec
-from dnet_tpu.obs.phases import DEVICE_MEM_KINDS, STEP_PHASES
+from dnet_tpu.obs.phases import DEVICE_MEM_KINDS, REQUEST_SEGMENTS, STEP_PHASES
 from dnet_tpu.obs.slo import nearest_rank
 
 # one Prometheus v0.0.4 sample line: name{labels} value  (labels optional)
@@ -144,6 +144,36 @@ def _device_mem(after: Dict[str, float]) -> dict:
     }
 
 
+def _critical_path_summary(completed: List[RequestOutcome]) -> dict:
+    """Aggregate the per-request segment ledgers (obs/critical_path.py)
+    carried by profile=true final chunks: per-segment mean/p95 over the
+    completed rows, plus which segment DOMINATED each request — the
+    run-level answer to "where did the latency go"."""
+    ledgers = [o.critical_path for o in completed if o.critical_path]
+    segments = {}
+    for seg in REQUEST_SEGMENTS:
+        vals = [float((lg.get("segments_ms") or {}).get(seg, 0.0))
+                for lg in ledgers]
+        segments[seg] = {
+            "mean_ms": round(sum(vals) / len(vals), 3) if vals else 0.0,
+            "p95_ms": round(percentile(vals, 0.95), 3),
+            "sum_ms": round(sum(vals), 3),
+        }
+    dominant: Dict[str, int] = {}
+    for lg in ledgers:
+        seg = lg.get("dominant") or "other"
+        dominant[seg] = dominant.get(seg, 0) + 1
+    coverages = [float(lg.get("coverage", 0.0)) for lg in ledgers]
+    return {
+        "requests": len(ledgers),
+        "segments": segments,
+        "dominant": dominant,
+        "coverage_mean": (
+            round(sum(coverages) / len(coverages), 4) if coverages else 0.0
+        ),
+    }
+
+
 def _rel_gap(report_v: float, live_v: float) -> float:
     base = max(abs(live_v), 1e-9)
     return round((report_v - live_v) / base, 4)
@@ -208,6 +238,7 @@ def build_report(
             "tpot": _latency_summary(itls),
             "e2e": _latency_summary(e2es),
         },
+        "critical_path": _critical_path_summary(completed),
     }
     # client-observed availability over requests that were ADMITTED (shed
     # rows never enter the server's availability window either — admission

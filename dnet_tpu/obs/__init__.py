@@ -464,6 +464,34 @@ def _register_core(reg: MetricsRegistry) -> None:
     )
     for state in QUEUE_STATES:
         depth.labels(state=state)  # pre-touch: the lint checks these
+    # critical-path attribution (obs/critical_path.py): the exhaustive
+    # per-request segment ledger.  The segment label set is DECLARED in
+    # obs/phases.py REQUEST_SEGMENTS (leaf) and cross-checked both ways by
+    # the metrics lint (pass DL028).
+    from dnet_tpu.obs.phases import REQUEST_SEGMENTS
+
+    seg_fam = reg.histogram(
+        "dnet_request_segment_ms",
+        "Per-request critical-path segment ledger: exhaustive, "
+        "non-overlapping wall-time attribution of one request's recorded "
+        "spans (obs/phases.py REQUEST_SEGMENTS; obs/critical_path.py)",
+        labelnames=("segment",),
+    )
+    for seg in REQUEST_SEGMENTS:
+        seg_fam.labels(segment=seg)  # pre-touch: the lint checks these
+    # scheduler tick flight-recorder (sched/flight.py): the bounded
+    # TickRecord ring behind GET /v1/debug/sched
+    reg.counter(
+        "dnet_sched_tick_records_total",
+        "Scheduler ticks captured into the tick flight-recorder ring "
+        "(sched/flight.py; bounded by DNET_OBS_TICK_RECORDS)",
+    )
+    reg.histogram(
+        "dnet_sched_tick_budget_used_ratio",
+        "Fraction of the per-tick token budget the planned batch consumed "
+        "(1.0 = saturated tick; sched/flight.py)",
+        buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+    )
 
 
 def _ensure_core() -> None:
@@ -543,5 +571,12 @@ def reset_obs() -> None:
     _ensure_core()
     _registry.reset()
     _recorder.clear()
+    # the scheduler tick ring is obs state too (captured under
+    # obs_enabled, dumped by /v1/debug/sched): a test that resets the
+    # books must not inherit a previous run's ticks.  Imported here, not
+    # at module top: sched.flight itself imports dnet_tpu.obs.
+    from dnet_tpu.sched.flight import get_tick_recorder
+
+    get_tick_recorder().clear()
     with _slo_lock:
         _slo_tracker = None

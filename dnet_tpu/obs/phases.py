@@ -44,6 +44,51 @@ JIT_FNS = (
                             # (parallel/tp_collectives.py probe_collective_ms)
 )
 
+# dnet_request_segment_ms{segment=}: the exhaustive, non-overlapping
+# critical-path segment ledger one request's recorded spans decompose into
+# (obs/critical_path.py).  Every wall-clock millisecond between admission
+# and the closing request span is attributed to EXACTLY one segment, so the
+# per-request sums reconcile against measured E2E and the histogram's
+# per-segment totals explain a serving window's p99 without hand-joining
+# span families.  The metrics lint (pass DL028) cross-checks these against
+# the exposed label set both ways.
+#   admission_wait  — queued at the admission gate before a slot opened
+#   sched_queue     — admitted but waiting on a scheduler/lane grant
+#   prefill_compute — prompt prefill (local engine or replayed prefix)
+#   decode_compute  — driver decode-step residual not claimed by a more
+#                     specific segment below
+#   wire_encode     — activation codec encode on the wire path
+#   wire_tx         — writing frames to outbound streams
+#   hop_rtt         — in-flight between nodes (send..ingress gap)
+#   shard_compute   — shard-side layer compute
+#   sample          — on-device sampling + token readback
+#   sse_flush       — serializing/flushing SSE chunks to the client
+#   other           — recorded wall clock no span claims (gaps)
+SEG_ADMISSION_WAIT = "admission_wait"
+SEG_SCHED_QUEUE = "sched_queue"
+SEG_PREFILL_COMPUTE = "prefill_compute"
+SEG_DECODE_COMPUTE = "decode_compute"
+SEG_WIRE_ENCODE = "wire_encode"
+SEG_WIRE_TX = "wire_tx"
+SEG_HOP_RTT = "hop_rtt"
+SEG_SHARD_COMPUTE = "shard_compute"
+SEG_SAMPLE = "sample"
+SEG_SSE_FLUSH = "sse_flush"
+SEG_OTHER = "other"
+REQUEST_SEGMENTS = (
+    SEG_ADMISSION_WAIT,
+    SEG_SCHED_QUEUE,
+    SEG_PREFILL_COMPUTE,
+    SEG_DECODE_COMPUTE,
+    SEG_WIRE_ENCODE,
+    SEG_WIRE_TX,
+    SEG_HOP_RTT,
+    SEG_SHARD_COMPUTE,
+    SEG_SAMPLE,
+    SEG_SSE_FLUSH,
+    SEG_OTHER,
+)
+
 # dnet_wire_bytes_total{dir=}: activation/token payload bytes by wire
 # direction (tx = written to outbound streams, rx = admitted at ingress).
 # The metrics lint (pass 12) cross-checks these against the exposed label

@@ -26,10 +26,25 @@ RequestMetrics its per-request summary for every response.
 from __future__ import annotations
 
 import contextlib
+import re
 import threading
 import time
 from collections import OrderedDict
 from typing import Iterator, List, Optional
+
+# Resume replays run under a fresh wire nonce `rid#rN`
+# (resilience/checkpoint.py RequestCheckpoint.next_nonce) so shard-side
+# dedup and stream identity stay per-segment — but the STORY is one
+# request.  The recorder aliases every segment nonce back to the base rid
+# at write time, so `/v1/debug/timeline/{rid}` (and the trace export)
+# shows admission -> failure -> resume -> finish as one timeline instead
+# of fragments keyed by nonces no client ever saw.
+_RESUME_NONCE_RE = re.compile(r"#r\d+$")
+
+
+def base_rid(rid: str) -> str:
+    """Strip a resume-segment suffix (`rid#rN` -> `rid`)."""
+    return _RESUME_NONCE_RE.sub("", rid)
 
 
 class FlightRecorder:
@@ -66,7 +81,7 @@ class FlightRecorder:
     def begin(self, rid: str) -> None:
         """Open (or re-open at the back of the ring) a request timeline."""
         with self._lock:
-            self._begin_locked(rid)
+            self._begin_locked(base_rid(rid))
 
     def _begin_locked(self, rid: str) -> dict:
         entry = self._requests.get(rid)
@@ -106,8 +121,10 @@ class FlightRecorder:
         `force` bypasses the per-request span cap — for the few summary
         spans (ttft, the closing request span) that downstream consumers
         (RequestMetrics.from_timeline) must find even on generations long
-        enough to out-span the cap."""
+        enough to out-span the cap.  Resume-segment nonces (`rid#rN`)
+        alias to the base rid so a resumed request stays one timeline."""
         now = time.perf_counter()
+        rid = base_rid(rid)
         with self._lock:
             entry = self._requests.get(rid)
             if entry is None:
@@ -145,6 +162,7 @@ class FlightRecorder:
 
     def timeline(self, rid: str) -> Optional[dict]:
         """JSON-ready snapshot of one request's spans, or None."""
+        rid = base_rid(rid)
         with self._lock:
             entry = self._requests.get(rid)
             if entry is None:
@@ -160,6 +178,16 @@ class FlightRecorder:
     def request_ids(self) -> List[str]:
         with self._lock:
             return list(self._requests)
+
+    def request_ids_since(self, t_unix: float) -> List[str]:
+        """Rids whose timelines opened at or after `t_unix` (wall clock) —
+        the serving-window selector behind `GET /v1/debug/trace?last_s=N`."""
+        with self._lock:
+            return [
+                rid
+                for rid, entry in self._requests.items()
+                if entry["t_unix"] >= t_unix
+            ]
 
     def clear(self) -> None:
         with self._lock:

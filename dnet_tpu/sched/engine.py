@@ -33,8 +33,9 @@ from dnet_tpu.api.strategies import (
     _TokenFutures,
 )
 from dnet_tpu.core.types import DecodingParams, TokenResult
-from dnet_tpu.obs import metric
-from dnet_tpu.sched.kinds import STATE_DECODING
+from dnet_tpu.obs import metric, obs_enabled
+from dnet_tpu.sched.flight import get_tick_recorder
+from dnet_tpu.sched.kinds import QUEUE_STATES, STATE_DECODING
 from dnet_tpu.sched.policy import SchedulerPolicy, TickPlan
 from dnet_tpu.sched.queue import SchedQueue
 from dnet_tpu.sched.step import MAX_STARVED_REQUEUES, TickResult, execute_tick
@@ -266,7 +267,8 @@ class SchedulerAdapter(ApiAdapterBase):
                 result = await loop.run_in_executor(
                     self._executor, execute_tick, self.engine, plan, on_decode
                 )
-                _TICK_MS.observe((time.perf_counter() - t0) * 1000.0)
+                tick_ms = (time.perf_counter() - t0) * 1000.0
+                _TICK_MS.observe(tick_ms)
                 _BATCH_TOKENS.labels(kind="prefill").observe(
                     float(result.prefill_tokens)
                 )
@@ -274,6 +276,8 @@ class SchedulerAdapter(ApiAdapterBase):
                     float(result.decode_lanes)
                 )
                 self._apply(plan, result)
+                if obs_enabled():
+                    self._record_tick(tick_ms, result)
                 if self.policy.has_work(self.queue, self.engine):
                     self._wake()
             except asyncio.CancelledError:
@@ -288,6 +292,28 @@ class SchedulerAdapter(ApiAdapterBase):
                     # futures instead of wedging them to their timeouts
                     self._futures.fail_all(str(exc))
                 continue
+
+    def _record_tick(self, tick_ms: float, result: TickResult) -> None:
+        """One TickRecord into the flight ring (sched/flight.py): the
+        black-box row GET /v1/debug/sched and the trace export replay.
+        Queue depths are read AFTER _apply so the record reflects the
+        state the tick left behind (matching the synced gauges)."""
+        get_tick_recorder().record(
+            tick_ms=tick_ms,
+            budget_tokens=self.policy.token_budget,
+            prefill_tokens=result.prefill_tokens,
+            decode_lanes=result.decode_lanes,
+            preempted=len(result.preempted),
+            requeued=len(result.requeued),
+            errors=len(result.errors),
+            queue_depths={
+                state: len(self.queue.by_state(state))
+                for state in QUEUE_STATES
+            },
+            kv_blocks_used=int(metric("dnet_kv_blocks_used").value),
+            kv_blocks_free=int(metric("dnet_kv_blocks_free").value),
+            kv_pool_blocks=int(metric("dnet_kv_pool_blocks").value),
+        )
 
     def _dispatch_decode(self, plan: TickPlan, nonce: str, sample) -> None:
         """Early decode resolution (wire-pipeline tick dispatch): runs on

@@ -227,7 +227,9 @@ class RingAdapter:
             # re-open re-sends these exact bytes with this seq (the PR 4
             # dedup/resume contract needs the re-send to be identical).
             pending = msg.data
-            msg.data = await self._wire_tx.finalize(pending)
+            msg.data = await self._wire_tx.finalize(
+                pending, nonce=msg.nonce, seq=msg.seq
+            )
             get_recorder().span(
                 msg.nonce, "wire_encode",
                 (time.perf_counter() - t0) * 1000.0,

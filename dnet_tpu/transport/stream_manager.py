@@ -124,9 +124,11 @@ class StreamManager:
         _TX_BYTES.inc(n_bytes)
         _WIRE_BYTES.labels(dir="tx").inc(n_bytes)
         _TX_FRAMES.inc()
+        # seq rides along so the Perfetto export (obs/trace.py) can pair
+        # this send with the receiving node's transport_recv flow arrow
         get_recorder().span(
             nonce, "transport_send", (time.perf_counter() - t0) * 1000,
-            bytes=n_bytes,
+            bytes=n_bytes, seq=getattr(frame, "seq", None),
         )
 
     async def _ack_reader(self, ctx: StreamContext) -> None:

@@ -255,15 +255,32 @@ class WireTxStage:
     def inflight(self) -> int:
         return len(self._pending)
 
-    async def finalize(self, pending: PendingWirePayload) -> bytes:
+    async def finalize(
+        self, pending: PendingWirePayload, nonce: str = "",
+        seq: int = -1,
+    ) -> bytes:
         import asyncio
 
         key = self._seq
         self._seq += 1
         self._pending[key] = pending
+        t0 = time.perf_counter()
         cfut = self._executor.submit(pending.finalize)
         try:
-            return await asyncio.wrap_future(cfut)
+            data = await asyncio.wrap_future(cfut)
+            if nonce:
+                # the tx-stage leg of the frame's story: executor queue
+                # wait + D2H readback + byte packing, rendered on the
+                # tx-stage thread track in the Perfetto export
+                # (obs/trace.py) under the egress wire_encode umbrella
+                from dnet_tpu.obs import get_recorder
+
+                get_recorder().span(
+                    nonce, "wire_tx_stage",
+                    (time.perf_counter() - t0) * 1000.0,
+                    seq=seq, bytes=len(data),
+                )
+            return data
         except asyncio.CancelledError:
             # egress task cancelled (shutdown) while the finalize was
             # still queued: it will never run, so the ring slot it holds

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""bench_compare: diff two BENCH_SERVE_r*.json records, gate regressions.
+
+Usage::
+
+    python scripts/bench_compare.py OLD.json NEW.json
+    python scripts/bench_compare.py OLD.json NEW.json --leg pipelined
+    python scripts/bench_compare.py OLD.json NEW.json \
+        --fail-on goodput.tok_s=-5% \
+        --fail-on latency_ms.e2e.p95_ms=+10%
+    python scripts/bench_compare.py OLD.json NEW.json --json
+
+Thresholds are DIRECTIONAL (dnet_tpu/loadgen/compare.py): the sign names
+the bad direction — ``+10%`` fails on a rise past 10% (latencies, shed),
+``-5%`` fails on a fall past 5% (goodput, availability); drop the ``%``
+for absolute limits.  Exit status: 0 clean, 1 any gate violated, 2 usage
+errors (unreadable record, bad spec, no matching legs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from dnet_tpu.loadgen.compare import (  # noqa: E402
+    compare_records,
+    parse_fail_rule,
+)
+
+
+def _load(path: str) -> dict:
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        raise SystemExit(f"cannot read {path}: {exc}")
+    if not isinstance(record, dict):
+        raise SystemExit(f"{path}: not a JSON object")
+    return record
+
+
+def _fmt(entry: dict) -> str:
+    rel = f" ({entry['rel'] * 100:+.1f}%)" if "rel" in entry else ""
+    return f"{entry['old']:g} -> {entry['new']:g}  [{entry['delta']:+g}]{rel}"
+
+
+def _print_text(result: dict, old_path: str, new_path: str) -> None:
+    print(f"bench_compare: {old_path} -> {new_path}")
+    for name, d in result["legs"].items():
+        print(f"\n== leg: {name} ==")
+        for path, entry in d["metrics"].items():
+            print(f"  {path:32s} {_fmt(entry)}")
+        for section in ("shed_by_reason", "phase_mean_ms",
+                        "critical_path_mean_ms", "dominant"):
+            block = d.get(section)
+            if not block:
+                continue
+            print(f"  -- {section} --")
+            for key, entry in block.items():
+                print(f"  {key:32s} {_fmt(entry)}")
+    for name in result["unmatched_old"]:
+        print(f"\nleg {name!r} only in OLD record (skipped)")
+    for name in result["unmatched_new"]:
+        print(f"\nleg {name!r} only in NEW record (skipped)")
+    if result["violations"]:
+        print("\nREGRESSIONS:")
+        for v in result["violations"]:
+            print(f"  FAIL {v}")
+    elif result["legs"]:
+        print("\nok: no gated regressions")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_compare", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("old", help="baseline BENCH_SERVE_r*.json")
+    ap.add_argument("new", help="candidate BENCH_SERVE_r*.json")
+    ap.add_argument(
+        "--leg", default=None,
+        help="compare one named leg only (multi-leg records)",
+    )
+    ap.add_argument(
+        "--fail-on", action="append", default=[], metavar="PATH=LIMIT",
+        help="regression gate, e.g. goodput.tok_s=-5% or "
+             "latency_ms.ttft.p95_ms=+10%% (repeatable)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the structured comparison instead of text",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        rules = tuple(parse_fail_rule(s) for s in args.fail_on)
+    except ValueError as exc:
+        ap.error(str(exc))
+    old, new = _load(args.old), _load(args.new)
+    try:
+        result = compare_records(old, new, rules=rules, leg=args.leg)
+    except ValueError as exc:
+        raise SystemExit(str(exc))
+    if not result["legs"]:
+        raise SystemExit(
+            "no comparable legs shared by the two records "
+            f"(old: {result['unmatched_old']}, new: {result['unmatched_new']})"
+        )
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        _print_text(result, args.old, args.new)
+    return 1 if result["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

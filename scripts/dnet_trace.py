@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""dnet_trace: fetch a Perfetto trace dump from a running API node.
+
+Usage::
+
+    python scripts/dnet_trace.py chatcmpl-abc123          # one request
+    python scripts/dnet_trace.py chatcmpl-abc123 --cluster # stitch shards
+    python scripts/dnet_trace.py --last-s 60               # serving window
+    python scripts/dnet_trace.py --last-s 60 -o window.json
+
+Writes Chrome trace-event / Perfetto JSON (api/http.py /v1/debug/trace
+routes, rendered by obs/trace.py) — open the file at ui.perfetto.dev or
+chrome://tracing.  Default output: ``dnet_trace_<rid>.json`` or
+``dnet_trace_window.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dnet_trace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "rid", nargs="?", default=None,
+        help="request id (chatcmpl-... / cmpl-...); omit with --last-s",
+    )
+    ap.add_argument(
+        "--base-url", default="http://127.0.0.1:8000",
+        help="API node base URL (default %(default)s)",
+    )
+    ap.add_argument(
+        "--cluster", action="store_true",
+        help="stitch every shard's spans into the trace (rid mode)",
+    )
+    ap.add_argument(
+        "--last-s", type=float, default=None,
+        help="serving-window dump: every retained request of the last N s",
+    )
+    ap.add_argument(
+        "-o", "--output", default=None,
+        help="output path (default dnet_trace_<rid|window>.json)",
+    )
+    ap.add_argument(
+        "--timeout", type=float, default=30.0,
+        help="HTTP timeout seconds (default %(default)s)",
+    )
+    args = ap.parse_args(argv)
+    if (args.rid is None) == (args.last_s is None):
+        ap.error("give exactly one of: a rid, or --last-s N")
+
+    import httpx
+
+    if args.rid is not None:
+        url = f"{args.base_url}/v1/debug/trace/{args.rid}"
+        params = {"cluster": "1"} if args.cluster else {}
+        default_out = f"dnet_trace_{args.rid}.json"
+    else:
+        url = f"{args.base_url}/v1/debug/trace"
+        params = {"last_s": str(args.last_s)}
+        default_out = "dnet_trace_window.json"
+
+    try:
+        resp = httpx.get(url, params=params, timeout=args.timeout)
+    except httpx.HTTPError as exc:
+        raise SystemExit(f"fetch failed: {exc}")
+    if resp.status_code == 404:
+        raise SystemExit(
+            f"no recorded timeline for {args.rid!r} — the flight recorder "
+            "keeps only recent requests (is DNET_OBS_ENABLED on?)"
+        )
+    if resp.status_code != 200:
+        raise SystemExit(f"HTTP {resp.status_code}: {resp.text[:200]}")
+    trace = resp.json()
+    n = len(trace.get("traceEvents", []))
+    out_path = Path(args.output or default_out)
+    out_path.write_text(json.dumps(trace))
+    other = trace.get("otherData", {})
+    print(
+        f"wrote {out_path} ({n} events, "
+        f"{other.get('timelines', '?')} timeline(s), "
+        f"{other.get('tick_records', '?')} tick record(s))"
+    )
+    if other.get("truncated_events"):
+        print(
+            f"warning: {other['truncated_events']} events truncated "
+            "(raise DNET_OBS_TRACE_MAX_EVENTS)"
+        )
+    print("open at https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -685,9 +685,9 @@ def test_check_codes_unique_and_documented():
         assert c.code not in seen, f"duplicate check code {c.code}"
         seen.add(c.code)
         assert c.description, f"{c.code} has no description"
-    # the full 27-check catalog: DL001-DL009 (AST), DL010-DL020 +
-    # DL026-DL027 (runtime metric passes), DL021-DL025 (flow-sensitive tier)
-    assert seen == {f"DL{i:03d}" for i in range(1, 28)}
+    # the full 28-check catalog: DL001-DL009 (AST), DL010-DL020 +
+    # DL026-DL028 (runtime metric passes), DL021-DL025 (flow-sensitive tier)
+    assert seen == {f"DL{i:03d}" for i in range(1, 29)}
 
 
 # ---- tier-1 self-run wrapper ----------------------------------------------
@@ -706,11 +706,11 @@ def test_dnetlint_self_run_clean(tmp_path):
     report = json.loads(out.read_text())
     assert report["clean"] is True
     assert report["files_scanned"] > 100
-    # the FULL 27-check catalog ran: DL001-DL009 AST, DL010-DL020 +
-    # DL026-DL027 runtime metric passes, DL021-DL025 flow-sensitive tier —
+    # the FULL 28-check catalog ran: DL001-DL009 AST, DL010-DL020 +
+    # DL026-DL028 runtime metric passes, DL021-DL025 flow-sensitive tier —
     # a check cannot silently fall out of the suite
     assert sorted(report["checks_run"]) == [
-        f"DL{i:03d}" for i in range(1, 28)
+        f"DL{i:03d}" for i in range(1, 29)
     ]
     assert report["findings"] == []
     # the merged runtime-sanitizer section: the full DS catalog is always
