@@ -14,7 +14,12 @@ tier1:
 # regression diff of two BENCH_SERVE records:
 #   make bench-compare OLD=BENCH_SERVE_r04.json NEW=BENCH_SERVE_r05.json \
 #        FAIL_ON='--fail-on goodput.tok_s=-5%'
+# the events sanity leg runs first: the wide-event vocabulary must agree
+# with the dnet_events_total exposition (metrics pass 15) before bench
+# numbers are compared — a drifted vocabulary invalidates event-based
+# postmortems of either record
 bench-compare:
+	JAX_PLATFORMS=cpu $(PY) scripts/check_metrics_names.py
 	$(PY) scripts/bench_compare.py $(OLD) $(NEW) $(FAIL_ON)
 
 dnetlint:

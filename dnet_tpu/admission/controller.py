@@ -42,6 +42,7 @@ from typing import Deque, Optional
 
 from dnet_tpu.admission.reasons import DEADLINE_STAGES, REJECT_REASONS
 from dnet_tpu.obs import metric
+from dnet_tpu.obs.events import log_event
 from dnet_tpu.resilience import chaos
 from dnet_tpu.utils.logger import get_logger
 
@@ -235,11 +236,21 @@ class AdmissionController:
     # ---- admission ------------------------------------------------------
     def _reject(self, reason: str, message: str) -> AdmissionRejected:
         _REJECTED.labels(reason=reason).inc()
-        return AdmissionRejected(reason, message, self.retry_after_s())
+        retry_after_s = self.retry_after_s()
+        log_event(
+            "shed", reason=reason,
+            retry_after_s=round(retry_after_s, 3),
+            queued=len(self._waiters), inflight=self._active,
+        )
+        return AdmissionRejected(reason, message, retry_after_s)
 
     def _admit(self, wait_s: float = 0.0) -> _Slot:
         _ADMITTED.inc()
         _WAIT_MS.observe(wait_s * 1000.0)
+        log_event(
+            "admitted", wait_ms=round(wait_s * 1000.0, 3),
+            queued=len(self._waiters), inflight=self._active,
+        )
         self._sync_gauges()
         return _Slot(self)
 
@@ -363,6 +374,10 @@ class AdmissionController:
             fut = self._waiters.popleft()
             if not fut.done():
                 _REJECTED.labels(reason="draining").inc()
+                log_event(
+                    "shed", reason="draining",
+                    queued=len(self._waiters), inflight=self._active,
+                )
                 fut.set_exception(
                     AdmissionRejected(
                         "draining",

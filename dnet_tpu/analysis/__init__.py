@@ -18,6 +18,7 @@ from dnet_tpu.analysis.checks_contract import (
 )
 from dnet_tpu.analysis.checks_dsan import OwnershipRegistryDrift
 from dnet_tpu.analysis.checks_jit import JitPurity, UngatedDeviceSync
+from dnet_tpu.analysis.checks_logging import LoggingHygiene
 from dnet_tpu.analysis.flow import FLOW_CHECKS
 from dnet_tpu.analysis.core import (
     DEFAULT_BASELINE,
@@ -46,6 +47,7 @@ ALL_CHECKS = [
     SilentExceptionSwallow(),
     ContractDrift(),
     OwnershipRegistryDrift(),
+    LoggingHygiene(),
     *METRICS_CHECKS,
     *FLOW_CHECKS,
 ]

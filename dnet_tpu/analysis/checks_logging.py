@@ -1,0 +1,119 @@
+"""Logging-hygiene check: the structured-logging contract, machine-checked.
+
+DL029 — two rules that keep the PR 17 wide-event layer trustworthy:
+
+(a) **Raw ``logging.getLogger(...)`` outside utils/logger.py and tui.py.**
+    Every module must log through ``dnet_tpu.utils.logger.get_logger()``:
+    a raw getLogger invents a parallel logger tree that misses the
+    ``ContextStampFilter`` (so its records carry no rid/node/epoch), the
+    ``[PROFILE]`` gating, and the per-process file handlers — the exact
+    drift ops/flash_attention.py shipped with (a ``"dnet"`` logger that
+    never existed).  utils/logger.py owns the tree; tui.py attaches its
+    live-feed handler to it by name.
+
+(b) **Eager interpolation in log calls on serving paths.**  An f-string,
+    ``.format(...)``, or ``"..." % ...`` argument renders even when the
+    level is filtered — on the per-token path that is real work thrown
+    away — and defeats rate-limit-by-template tooling.  Lazy ``%s`` args
+    only: ``log.info("sent %s", rid)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dnet_tpu.analysis.core import (
+    Check,
+    Finding,
+    Project,
+    SourceFile,
+    dotted,
+    is_serving_path,
+)
+
+#: rel-paths where raw logging.getLogger is the point, not a violation
+DL029_ALLOWLIST = (
+    "dnet_tpu/utils/logger.py",  # owns the "dnet_tpu" logger tree
+    "dnet_tpu/tui.py",  # attaches the live-feed handler to it by name
+)
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+
+#: receiver spellings that identify a logger object in this repo's idiom
+#: (``log = get_logger()`` at module scope; ``logger`` in older modules)
+_LOG_RECEIVERS = {"log", "logger", "get_logger()"}
+
+
+def _is_log_call(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr not in _LOG_METHODS:
+        return False
+    recv = node.func.value
+    if isinstance(recv, ast.Name) and recv.id in _LOG_RECEIVERS:
+        return True
+    if isinstance(recv, ast.Attribute) and recv.attr in ("log", "logger"):
+        return True  # self.log.info(...) / module.log.warning(...)
+    if isinstance(recv, ast.Call) and dotted(recv.func).endswith(
+        "get_logger"
+    ):
+        return True  # get_logger().warning(...)
+    return False
+
+
+def _eager_kind(arg: ast.expr) -> str:
+    """Why this message argument renders eagerly, or ''."""
+    if isinstance(arg, ast.JoinedStr) and any(
+        isinstance(v, ast.FormattedValue) for v in arg.values
+    ):
+        return "f-string"
+    if (
+        isinstance(arg, ast.Call)
+        and isinstance(arg.func, ast.Attribute)
+        and arg.func.attr == "format"
+    ):
+        return ".format()"
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod) and (
+        isinstance(arg.left, (ast.Constant, ast.JoinedStr))
+    ):
+        return "eager %-interpolation"
+    return ""
+
+
+class LoggingHygiene(Check):
+    code = "DL029"
+    name = "logging-hygiene"
+    description = (
+        "raw logging.getLogger outside utils/logger.py (misses the "
+        "context stamp + profile gate) and eager f-string/.format()/% "
+        "interpolation in log calls on serving paths (lazy %s only)"
+    )
+
+    def run_file(self, src: SourceFile, project: Project) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                dotted(node.func) == "logging.getLogger"
+                and src.rel not in DL029_ALLOWLIST
+            ):
+                yield self.finding(
+                    src.rel, node.lineno,
+                    "raw logging.getLogger() builds a logger outside the "
+                    "dnet_tpu tree — no rid/node context stamp, no "
+                    "[PROFILE] gate; use dnet_tpu.utils.logger.get_logger()",
+                    col=node.col_offset,
+                )
+                continue
+            if not is_serving_path(src.rel):
+                continue
+            if _is_log_call(node) and node.args:
+                kind = _eager_kind(node.args[0])
+                if kind:
+                    yield self.finding(
+                        src.rel, node.lineno,
+                        f"{kind} in a log call renders even when the level "
+                        f"is filtered — use lazy %s args",
+                        col=node.col_offset,
+                    )

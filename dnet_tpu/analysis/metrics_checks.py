@@ -43,6 +43,9 @@ Pass catalog (the original scripts/check_metrics_names.py passes 1-8):
   routing (obs/critical_path.py, obs/trace.py) consistent with the
   declared segment enum, and the segment-histogram + tick-record
   families required (pass 14)
+- DL030 events        — wide-event name labels <-> obs/phases.py
+  EVENT_NAMES both directions (pass 15; DL029 is the static
+  logging-hygiene check, checks_logging.py)
 """
 
 from __future__ import annotations
@@ -202,6 +205,9 @@ _REQUIRED_FAMILIES = (
     "dnet_request_segment_ms",
     "dnet_sched_tick_records_total",
     "dnet_sched_tick_budget_used_ratio",
+    # structured wide events (obs/events.py) — the event-rate dashboards
+    # and the vocabulary cross-check (pass 15) depend on this
+    "dnet_events_total",
 )
 
 
@@ -706,6 +712,23 @@ def check_request_segment_labels(errors: list) -> int:
     return n
 
 
+def check_event_labels(errors: list) -> int:
+    """Pass 15: the wide-event vocabulary (obs/phases.py EVENT_NAMES) must
+    agree with the dnet_events_total exposition both ways — a new event
+    cannot ship without its pre-touched counter series, and a renamed one
+    cannot strand a stale name label on dashboards.  log_event() itself
+    asserts membership at emit time; this pass catches the drift BEFORE a
+    process ever emits."""
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.obs.phases import EVENT_NAMES
+
+    text = get_registry().expose()
+    return _cross_check_labels(
+        errors, text, "dnet_events_total", "name",
+        EVENT_NAMES, "obs.phases.EVENT_NAMES",
+    )
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -725,6 +748,7 @@ def main() -> int:
     n_wire = check_wire_labels(errors)
     n_tp = check_tp_labels(errors)
     n_seg = check_request_segment_labels(errors)
+    n_evt = check_event_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -735,7 +759,8 @@ def main() -> int:
           f"{n_member} membership labels, {n_attr} attribution labels, "
           f"{n_san} sanitizer labels, {n_sched} scheduler labels, "
           f"{n_jit} jit call sites, {n_wire} wire labels, "
-          f"{n_tp} tp labels, {n_seg} critical-path labels, all conform")
+          f"{n_tp} tp labels, {n_seg} critical-path labels, "
+          f"{n_evt} event labels, all conform")
     return 0
 
 
@@ -865,6 +890,14 @@ class RequestSegmentContract(_MetricsCheck):
     pass_name = "check_request_segment_labels"
 
 
+class EventLabelContract(_MetricsCheck):
+    # DL029 is the static logging-hygiene check (checks_logging.py)
+    code = "DL030"
+    name = "event-label-contract"
+    description = "wide-event name labels <-> EVENT_NAMES, both ways"
+    pass_name = "check_event_labels"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -880,4 +913,5 @@ METRICS_CHECKS = [
     WireLabelContract(),
     TpLabelContract(),
     RequestSegmentContract(),
+    EventLabelContract(),
 ]

@@ -40,6 +40,7 @@ from typing import Callable, Dict, List, Optional
 from dnet_tpu.core.types import DeviceInfo
 from dnet_tpu.membership import QuarantineSet
 from dnet_tpu.obs import metric
+from dnet_tpu.obs.events import log_event
 from dnet_tpu.resilience import chaos
 from dnet_tpu.resilience.policy import call_with_retry
 from dnet_tpu.utils.logger import get_logger
@@ -275,6 +276,10 @@ class RingFailureMonitor:
                     outcome = "failed"
                 _RECOVERY.labels(outcome=outcome).inc()
                 _RECOVERY_S.observe(time.monotonic() - t0)
+                log_event(
+                    "recovery_round", outcome=outcome, round_no=round_no,
+                    duration_s=round(time.monotonic() - t0, 3),
+                )
                 if outcome != "recovered":
                     log.error(
                         "recovery round %d ended %s; staying degraded "
@@ -524,4 +529,8 @@ class RingFailureMonitor:
             if outcome is not None:
                 _RECOVERY.labels(outcome=outcome).inc()
                 _RECOVERY_S.observe(time.monotonic() - t0)
+                log_event(
+                    "recovery_round", outcome=outcome, kind="rejoin",
+                    duration_s=round(time.monotonic() - t0, 3),
+                )
             self._recovering = False

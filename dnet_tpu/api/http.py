@@ -20,7 +20,12 @@ Routes (reference: src/dnet/api/http_api.py:75-93):
   GET  /v1/debug/trace/{rid}    — one request as Chrome trace-event /
                                   Perfetto JSON (?cluster=1 stitches)
   GET  /v1/debug/trace?last_s=N — serving-window Perfetto dump (every
-                                  retained timeline + tick records)
+                                  retained timeline + tick records +
+                                  wide-event instants)
+  GET  /v1/debug/events         — structured wide-event ring
+                                  (obs/events.py); ?rid= / ?name= /
+                                  ?last_s= filter, ?cluster=1 merges every
+                                  shard's ring onto this node's clock
 FastAPI is not available in this image; aiohttp's request handling + a thin
 pydantic validation shim cover the same surface.
 """
@@ -108,6 +113,7 @@ class ApiHTTPServer:
         self.app.router.add_get("/v1/debug/sched", self.debug_sched)
         self.app.router.add_get("/v1/debug/trace", self.debug_trace_window)
         self.app.router.add_get("/v1/debug/trace/{rid}", self.debug_trace)
+        self.app.router.add_get("/v1/debug/events", self.debug_events)
         self._runner: Optional[web.AppRunner] = None
         # peers seen by earlier /v1/cluster/metrics scrapes: a peer that
         # leaves discovery must drop to scrape_ok 0, not freeze at 1
@@ -858,6 +864,73 @@ class ApiHTTPServer:
             snap["records"] = snap["records"][-n:] if n else []
         return web.json_response(snap)
 
+    async def debug_events(self, request: web.Request) -> web.Response:
+        """Query the structured wide-event ring (obs/events.py):
+        `?rid=` one request's events (resume segments join their base rid),
+        `?name=` one vocabulary entry (400 on an unknown name — typos must
+        be loud, not silently empty), `?last_s=N` a trailing window.
+        `?cluster=1` additionally fetches every shard's ring — each fetch
+        doubling as the clock probe that rebases the shard's `t_unix` onto
+        this node's clock — and returns the merged, time-ordered set."""
+        from dnet_tpu.obs.events import get_event_ring, merge_remote_events
+        from dnet_tpu.obs.phases import EVENT_NAMES
+
+        rid = request.query.get("rid", "").strip()
+        name = request.query.get("name", "").strip()
+        if name and name not in EVENT_NAMES:
+            return _json_error(
+                400,
+                f"unknown event name {name!r} (one of {sorted(EVENT_NAMES)})",
+            )
+        last_raw = request.query.get("last_s", "").strip()
+        try:
+            last_s = float(last_raw) if last_raw else 0.0
+        except ValueError:
+            return _json_error(400, "last_s must be a number")
+        ring = get_event_ring()
+        events = ring.query(rid=rid, name=name, last_s=last_s)
+        dropped = ring.dropped
+        cluster = request.query.get("cluster", "").strip().lower()
+        if cluster in ("1", "true", "yes", "on") and (
+            self.cluster_manager is not None
+        ):
+            import httpx
+
+            from dnet_tpu.obs.clock import offset_from_probe
+
+            async def fetch(client, d):
+                url = f"http://{d.host}:{d.http_port}/v1/debug/events"
+                params = {}
+                if rid:
+                    params["rid"] = rid
+                if name:
+                    params["name"] = name
+                if last_s:
+                    params["last_s"] = str(last_s)
+                t0 = time.time()
+                try:
+                    r = await client.get(url, params=params)
+                    t1 = time.time()
+                    r.raise_for_status()
+                    body = r.json()
+                    est = offset_from_probe(t0, float(body["t_wall"]), t1)
+                    remote = body["events"]
+                    assert isinstance(remote, list)
+                except (httpx.HTTPError, ValueError, KeyError,
+                        TypeError, AssertionError) as exc:
+                    log.warning(
+                        "cluster events fetch from %s failed: %s",
+                        d.instance, exc,
+                    )
+                    return None
+                return (d.instance, remote, est)
+
+            _devices, remotes = await self._fan_out_shards(fetch)
+            # shard drop counts stay shard-local (each ring reports its
+            # own loss); the merged view reports only this node's
+            events = merge_remote_events(events, remotes)
+        return web.json_response({"events": events, "dropped": dropped})
+
     async def debug_trace(self, request: web.Request) -> web.Response:
         """One request as Chrome trace-event / Perfetto JSON
         (obs/trace.py).  `?cluster=1` stitches every shard's spans in
@@ -865,6 +938,7 @@ class ApiHTTPServer:
         arrows following the rid across hops.  `?format=` accepts only
         `perfetto` (the sole format) — anything else is a 400 so a typo'd
         format is loud, not silently perfetto."""
+        from dnet_tpu.obs.events import get_event_ring
         from dnet_tpu.obs.http import find_timeline
         from dnet_tpu.obs.trace import export_trace
         from dnet_tpu.sched.flight import get_tick_recorder
@@ -880,10 +954,15 @@ class ApiHTTPServer:
         if timeline is None:
             return _json_error(404, f"no recorded timeline for {rid!r}",
                                "not_found")
+        # log<->trace correlation: the request's wide events render as
+        # instant markers on the same clock as its spans (resume-suffixed
+        # rids resolve through the same alias as the timeline lookup)
+        internal = timeline.get("rid") or rid
         return web.json_response(
             export_trace(
                 [timeline],
                 tick_records=get_tick_recorder().snapshot()["records"],
+                wide_events=get_event_ring().query(rid=internal),
             )
         )
 
@@ -910,9 +989,12 @@ class ApiHTTPServer:
             for rid in recorder.request_ids_since(time.time() - last_s)
             if (tl := recorder.timeline(rid)) is not None
         ]
+        from dnet_tpu.obs.events import get_event_ring
+
         return web.json_response(
             export_trace(
                 timelines,
                 tick_records=get_tick_recorder().snapshot()["records"],
+                wide_events=get_event_ring().query(last_s=last_s),
             )
         )

@@ -28,6 +28,7 @@ from dnet_tpu.api.schemas import (
 )
 from dnet_tpu.admission.controller import (
     AdmissionController,
+    AdmissionRejected,
     Deadline,
     deadline_expired,
     request_deadline,
@@ -35,6 +36,7 @@ from dnet_tpu.admission.controller import (
 from dnet_tpu.api.strategies import ApiAdapterBase
 from dnet_tpu.core.types import DecodingParams
 from dnet_tpu.obs import critical_path, get_recorder, get_slo_tracker, metric
+from dnet_tpu.obs.events import bind, log_event
 from dnet_tpu.resilience.checkpoint import ResumableDecode
 from dnet_tpu.resilience.policy import is_retryable
 from dnet_tpu.utils.logger import get_logger
@@ -101,6 +103,42 @@ def classify_result_error(error: str) -> InferenceError:
     if any(marker in error for marker in _BACKPRESSURE_MARKERS):
         return BackpressureError(error)
     return InferenceError(error)
+
+
+def _event_status(exc: BaseException) -> int:
+    """HTTP status a failed request's `request_complete` wide event will
+    carry — the same mapping api/http.py `_map_inference_errors` applies,
+    duplicated here because the event must be journaled where the request
+    FINISHES (the driver), not where the response serializes."""
+    if isinstance(exc, AdmissionRejected):
+        return 503 if exc.reason == "draining" else 429
+    if isinstance(exc, BackpressureError):
+        return 429
+    if isinstance(exc, DeadlineExceededError):
+        return 504
+    if isinstance(exc, PromptTooLongError):
+        return 400
+    if isinstance(exc, EngineCapabilityError):
+        return 422
+    if isinstance(exc, ServiceDegradedError):
+        return 503
+    return 500
+
+
+def _resolved_modes() -> dict:
+    """The serving-mode knobs a postmortem reader wants next to a
+    request's outcome: resolved wire codec, KV layout, TP degree, and
+    whether the continuous-batching scheduler served it."""
+    from dnet_tpu.config import get_settings
+
+    s = get_settings()
+    kv = "ragged" if s.kv.ragged else ("paged" if s.kv.paged else "dense")
+    return {
+        "codec": s.wire.codec,
+        "kv": kv,
+        "tp": int(s.tp.tp),
+        "sched": bool(s.sched.sched),
+    }
 
 
 def completion_logprobs(entries: list, offset0: int = 0):
@@ -229,15 +267,30 @@ class InferenceManager:
             raise InferenceError("no model loaded")
         deadline = self._deadline_for(req)
         t_admit = time.perf_counter()
-        async with self.admission.slot(deadline):
-            # queued-at-the-gate time, measured here because the rid does
-            # not exist yet: _run backdates it onto the timeline as the
-            # admission_wait segment (obs/critical_path.py)
-            admit_wait_ms = (time.perf_counter() - t_admit) * 1000.0
-            async for chunk in self._run(
-                req, deadline, admit_wait_ms=admit_wait_ms
-            ):
-                yield chunk
+        try:
+            async with self.admission.slot(deadline):
+                # queued-at-the-gate time, measured here because the rid
+                # does not exist yet: _run backdates it onto the timeline
+                # as the admission_wait segment (obs/critical_path.py)
+                admit_wait_ms = (time.perf_counter() - t_admit) * 1000.0
+                async for chunk in self._run(
+                    req, deadline, admit_wait_ms=admit_wait_ms
+                ):
+                    yield chunk
+        except AdmissionRejected as rej:
+            # shed at the gate, before a rid ever existed: still one
+            # finished request, so it still owes its request_complete —
+            # the only variant without a rid (nothing to correlate)
+            log_event(
+                "request_complete",
+                status=_event_status(rej),
+                finish_reason="shed",
+                shed=True,
+                shed_reason=rej.reason,
+                tokens=0,
+                total_ms=round((time.perf_counter() - t_admit) * 1000.0, 3),
+            )
+            raise
 
     async def _run(
         self,
@@ -245,73 +298,89 @@ class InferenceManager:
         deadline: Optional[Deadline] = None,
         admit_wait_ms: float = 0.0,
     ) -> AsyncIterator[ChatCompletionChunk]:
-        if self.failure_monitor is not None and self.failure_monitor.degraded:
-            raise ServiceDegradedError(
-                f"ring degraded: shard(s) {self.failure_monitor.down_shards()} down"
-            )
         rid = new_request_id()
         nonce = rid
-        tok = self.tokenizer
-        prompt = req.render_prompt(tok)  # chat template or raw (completions)
-        prompt_ids = tok.encode(prompt)
-        decoding = self._decoding(req)
-        stop_seqs = req.stop_sequences()
-        eos = tok.eos_token_ids
-        detok = Detokenizer(tok)
-        max_new = req.completion_tokens_limit
-
-        capacity = self.adapter.max_seq()
-        if capacity is not None:
-            if len(prompt_ids) >= capacity:
-                raise PromptTooLongError(
-                    f"prompt is {len(prompt_ids)} tokens but the serving "
-                    f"context is {capacity}"
-                )
-            max_new = min(max_new, capacity - len(prompt_ids))
-
+        # request-identity binding (obs/events.py): every log record and
+        # wide event in this request's dynamic extent carries the rid
+        # automatically.  Entered manually so the function stays flat; the
+        # finally below always exits it (bind guards the cross-Context
+        # reset a loop-finalized generator would otherwise trip).
+        ctx = bind(rid=rid, node="api")
+        ctx.__enter__()
         t_start = time.perf_counter()
         t_first: Optional[float] = None
         generated = 0
         finish_reason = "length"
         recorder = get_recorder()
-        recorder.begin(rid)  # flight-recorder timeline (rid == nonce)
-        if admit_wait_ms > 0.0:
-            # the wait happened BEFORE this timeline's origin: a negative
-            # start offset keeps [0, e2e] the admitted window while the
-            # segment ledger still carries the queued time (and the sum
-            # still reconciles against the client-measured E2E)
-            recorder.span(
-                rid, "admission_wait", admit_wait_ms,
-                t_ms=-admit_wait_ms, force=True,
-            )
         slo = get_slo_tracker()  # rolling windows behind /health + dnet_slo_*
-        _REQUESTS.inc()
-        pending = ""  # emitted-text buffer held back for stop-seq matching
-        held_entries: list = []  # logprob entries for held-back tokens
-        emitted_ahead = 0  # emitted chars owned by the oldest held entry
-        first_chunk = True  # first streamed delta carries role=assistant
-        stopped_by_seq = False
-
-        await self.adapter.reset_cache(nonce)
-        if deadline is not None:
-            # the deadline rides every activation frame header from here:
-            # shards shed expired frames at dequeue (zero compute), and
-            # the lane flusher sheds expired members (api/ring.py)
-            self.adapter.set_deadline(nonce, deadline.t_deadline)
-        # resume controller: owns the wire nonce + step mapping so a
-        # mid-decode shard failure can (behind DNET_RESILIENCE_RESUME=1)
-        # checkpoint, wait out recovery, and replay prompt+generated on the
-        # new topology without this generator — or the client — noticing.
-        # adapter is passed as a GETTER: auto-recovery swaps the instance.
-        resume = ResumableDecode(
-            lambda: self.adapter,
-            rid,
-            prompt_ids,
-            monitor=self.failure_monitor,
-            timeout_s=self.request_timeout_s,
-        )
+        completed = False  # guards the one-per-request request_complete
         cleanup_detached = False
+        resume = None  # built once the wire session is prepared
+        prompt_ids: list = []
         try:
+            if (
+                self.failure_monitor is not None
+                and self.failure_monitor.degraded
+            ):
+                raise ServiceDegradedError(
+                    f"ring degraded: shard(s) "
+                    f"{self.failure_monitor.down_shards()} down"
+                )
+            tok = self.tokenizer
+            prompt = req.render_prompt(tok)  # chat template or raw
+            prompt_ids = tok.encode(prompt)
+            decoding = self._decoding(req)
+            stop_seqs = req.stop_sequences()
+            eos = tok.eos_token_ids
+            detok = Detokenizer(tok)
+            max_new = req.completion_tokens_limit
+
+            capacity = self.adapter.max_seq()
+            if capacity is not None:
+                if len(prompt_ids) >= capacity:
+                    raise PromptTooLongError(
+                        f"prompt is {len(prompt_ids)} tokens but the serving "
+                        f"context is {capacity}"
+                    )
+                max_new = min(max_new, capacity - len(prompt_ids))
+
+            recorder.begin(rid)  # flight-recorder timeline (rid == nonce)
+            if admit_wait_ms > 0.0:
+                # the wait happened BEFORE this timeline's origin: a
+                # negative start offset keeps [0, e2e] the admitted window
+                # while the segment ledger still carries the queued time
+                # (and the sum still reconciles against the client-measured
+                # E2E)
+                recorder.span(
+                    rid, "admission_wait", admit_wait_ms,
+                    t_ms=-admit_wait_ms, force=True,
+                )
+            _REQUESTS.inc()
+            pending = ""  # emitted-text buffer held back for stop-seq match
+            held_entries: list = []  # logprob entries for held-back tokens
+            emitted_ahead = 0  # emitted chars owned by the oldest held entry
+            first_chunk = True  # first streamed delta carries role=assistant
+            stopped_by_seq = False
+
+            await self.adapter.reset_cache(nonce)
+            if deadline is not None:
+                # the deadline rides every activation frame header from
+                # here: shards shed expired frames at dequeue (zero
+                # compute), and the lane flusher sheds expired members
+                # (api/ring.py)
+                self.adapter.set_deadline(nonce, deadline.t_deadline)
+            # resume controller: owns the wire nonce + step mapping so a
+            # mid-decode shard failure can (behind DNET_RESILIENCE_RESUME=1)
+            # checkpoint, wait out recovery, and replay prompt+generated on
+            # the new topology without this generator — or the client —
+            # noticing.  adapter is a GETTER: auto-recovery swaps it.
+            resume = ResumableDecode(
+                lambda: self.adapter,
+                rid,
+                prompt_ids,
+                monitor=self.failure_monitor,
+                timeout_s=self.request_timeout_s,
+            )
             send_ids = list(prompt_ids)
             for step in range(max_new):
                 if deadline is not None:
@@ -543,6 +612,21 @@ class InferenceManager:
             # the final chunk when the client asked to profile
             ledger = critical_path.decompose(recorder.timeline(rid))
             critical_path.observe(ledger)
+            # the canonical wide event: exactly ONE per finished request,
+            # embedding the same ledger so status/tokens/total_ms reconcile
+            # with dnet_request_segment_ms by construction
+            log_event(
+                "request_complete",
+                status=200,
+                finish_reason=finish_reason,
+                shed=False,
+                tokens=generated,
+                prompt_tokens=len(prompt_ids),
+                total_ms=round((t_end - t_start) * 1000.0, 3),
+                modes=_resolved_modes(),
+                critical_path=ledger,
+            )
+            completed = True
             metrics = None
             if req.profile:
                 metrics = RequestMetrics.from_timeline(recorder.timeline(rid))
@@ -574,10 +658,27 @@ class InferenceManager:
             # admission slot itself frees in generate_stream's
             # `async with` as this exception keeps propagating.
             _CANCELS.inc()
+            if not completed:
+                # still a finished request from the server's side: 499 is
+                # the client-closed-request convention
+                log_event(
+                    "request_complete",
+                    status=499,
+                    finish_reason="cancelled",
+                    shed=False,
+                    tokens=generated,
+                    prompt_tokens=len(prompt_ids),
+                    total_ms=round(
+                        (time.perf_counter() - t_start) * 1000.0, 3
+                    ),
+                    modes=_resolved_modes(),
+                )
+                completed = True
             cleanup_detached = True
-            task = asyncio.ensure_future(resume.cleanup())
-            self._cancel_cleanups.add(task)
-            task.add_done_callback(self._cancel_cleanups.discard)
+            if resume is not None:
+                task = asyncio.ensure_future(resume.cleanup())
+                self._cancel_cleanups.add(task)
+                task.add_done_callback(self._cancel_cleanups.discard)
             raise
         except Exception as exc:
             # client disconnects / task cancels (BaseException) are not
@@ -590,17 +691,41 @@ class InferenceManager:
             # also excludes shed) could never cross-validate against the
             # live gauge.  Shed volume stays visible through
             # dnet_admit_rejected_total / dnet_deadline_exceeded_total.
-            if not isinstance(exc, (BackpressureError, DeadlineExceededError)):
+            shed = isinstance(exc, (BackpressureError, DeadlineExceededError))
+            if not shed:
                 _REQUEST_ERRORS.inc()
                 slo.record_request(ok=False)
+            if not completed:
+                log_event(
+                    "request_complete",
+                    status=_event_status(exc),
+                    finish_reason="shed" if shed else "error",
+                    shed=shed,
+                    shed_reason=(
+                        "deadline"
+                        if isinstance(exc, DeadlineExceededError)
+                        else "backpressure" if shed else ""
+                    ),
+                    error=str(exc)[:200],
+                    tokens=generated,
+                    prompt_tokens=len(prompt_ids),
+                    total_ms=round(
+                        (time.perf_counter() - t_start) * 1000.0, 3
+                    ),
+                    modes=_resolved_modes(),
+                )
+                completed = True
             raise
         finally:
             # guarded cleanup: reset_cache can itself raise when the ring
             # just died, which would mask the original error and crash the
             # SSE generator — the controller logs + swallows transport
             # errors on this path only
-            if not cleanup_detached:
-                await resume.cleanup()
+            try:
+                if resume is not None and not cleanup_detached:
+                    await resume.cleanup()
+            finally:
+                ctx.__exit__(None, None, None)
 
     async def embeddings(self, req) -> "EmbeddingsResponse":
         """Serve /v1/embeddings: mean-pooled final-hidden-state vectors

@@ -176,6 +176,11 @@ class ObsSettings(_EnvGroup):
     # scheduler tick flight-recorder ring capacity (sched/flight.py,
     # GET /v1/debug/sched); 0 disables capture entirely
     tick_records: int = 256
+    # structured wide-event journal (obs/events.py, GET /v1/debug/events):
+    # bounded in-memory ring capacity (oldest evicted past it, counted as
+    # dropped) and an optional JSONL file sink ("" disables the file)
+    events_records: int = 2048
+    events_path: str = ""
 
     def sync_stride(self) -> int:
         """Normalized decode-step sync cadence: 0 = never fence, N >= 1 =

@@ -75,6 +75,10 @@ class RequestOutcome:
     tokens_out: int = 0
     prompt_tokens: int = 0
     retry_after_s: float = 0.0
+    # server-assigned request id (first SSE chunk's `id`): the join key
+    # into /v1/debug/events?rid= and /v1/debug/timeline/{rid} — a failed
+    # row's rid is a one-hop postmortem lookup, not a log grep
+    rid: str = ""
     itl_ms: List[float] = field(default_factory=list)  # inter-token gaps
     # per-request segment ledger from the final chunk's profile metrics
     # (obs/critical_path.py decompose) — server-side attribution riding
@@ -98,6 +102,8 @@ class RequestOutcome:
             d["shed_reason"] = self.shed_reason
             if self.retry_after_s:
                 d["retry_after_s"] = self.retry_after_s
+        if self.rid:
+            d["rid"] = self.rid
         if self.error:
             d["error"] = self.error[:200]
         if self.finish_reason:
@@ -190,6 +196,8 @@ async def _drive(session, planned, model, path, out: RequestOutcome) -> None:
                 chunk = json.loads(payload)
             except json.JSONDecodeError:
                 continue
+            if not out.rid and chunk.get("id"):
+                out.rid = str(chunk["id"])
             err = chunk.get("error")
             if err:
                 # in-band mid-stream error event (post-commit shed/failure)

@@ -225,6 +225,10 @@ def build_report(
             "shed_by_status": shed_by_status,
             "shed_by_reason": shed_by_reason,
             "shed_rate": round(len(shed) / len(measured), 4) if measured else 0.0,
+            # server-assigned rids of failed rows: paste one into
+            # /v1/debug/events?rid= or /v1/debug/timeline/{rid} for the
+            # postmortem (shed-at-the-gate rows never got a rid)
+            "failed_rids": [o.rid for o in failed if o.rid],
         },
         # goodput: tokens delivered by COMPLETED requests only, over the
         # measured window — shed and failed rows contribute nothing

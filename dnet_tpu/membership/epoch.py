@@ -69,8 +69,10 @@ def reject(kind: str, held: int, got: int) -> StaleEpochError:
     ingress fence answers with a NACK message, the API token fence just
     drops — can use the same counted path as raising call sites."""
     from dnet_tpu.obs import metric  # lazy: keep this module a leaf
+    from dnet_tpu.obs.events import log_event
 
     metric("dnet_stale_epoch_rejected_total").labels(kind=kind).inc()
+    log_event("epoch_fenced", kind=kind, held=int(held), got=int(got))
     return StaleEpochError(kind, held, got)
 
 

@@ -486,6 +486,20 @@ def _register_core(reg: MetricsRegistry) -> None:
         "Scheduler ticks captured into the tick flight-recorder ring "
         "(sched/flight.py; bounded by DNET_OBS_TICK_RECORDS)",
     )
+    # structured wide events (obs/events.py): the canonical event journal
+    # behind GET /v1/debug/events.  The name vocabulary is DECLARED in
+    # obs/phases.py EVENT_NAMES (leaf) and cross-checked both ways by the
+    # metrics lint (pass DL030).
+    from dnet_tpu.obs.phases import EVENT_NAMES
+
+    events_fam = reg.counter(
+        "dnet_events_total",
+        "Structured wide events journaled by log_event "
+        "(obs/phases.py EVENT_NAMES; obs/events.py)",
+        labelnames=("name",),
+    )
+    for event_name in EVENT_NAMES:
+        events_fam.labels(name=event_name)  # pre-touch: the lint checks these
     reg.histogram(
         "dnet_sched_tick_budget_used_ratio",
         "Fraction of the per-tick token budget the planned batch consumed "
@@ -578,5 +592,11 @@ def reset_obs() -> None:
     from dnet_tpu.sched.flight import get_tick_recorder
 
     get_tick_recorder().clear()
+    # the wide-event journal is obs state too: drop ring + sink so the
+    # next log_event re-reads DNET_OBS_EVENTS_* from fresh settings.
+    # Late import: obs.events imports dnet_tpu.obs for metric().
+    from dnet_tpu.obs.events import reset_events
+
+    reset_events()
     with _slo_lock:
         _slo_tracker = None

@@ -104,3 +104,35 @@ DEVICE_MEM_KINDS = ("in_use", "peak", "limit")
 # (parallel/tp_collectives.py).  The metrics lint (pass 13) cross-checks
 # these against the exposed label sets both ways.
 TP_OPS = ("all_reduce", "all_gather")
+
+# dnet_events_total{name=}: the canonical wide-event vocabulary
+# (obs/events.py log_event).  Every structured event a node journals uses
+# one of these names — the metrics lint (pass DL030) cross-checks the
+# exposed label set against this tuple both ways, so an event cannot ship
+# without its counter series and a renamed one cannot strand a stale label.
+#   request_complete — EXACTLY one per finished request (any outcome):
+#                      status, shed/finish reason, token counts, resolved
+#                      codec/kv/tp modes, and the critical-path segment
+#                      ledger embedded
+#   admitted         — admission granted a slot (queue wait attached)
+#   shed             — admission rejected the request (reason attached)
+#   preempted        — scheduler evicted a running sequence to WAITING
+#   resumed          — a mid-decode failure was transparently replayed
+#   recovery_round   — one auto-recovery re-solve round ended (outcome)
+#   epoch_fenced     — a stale-epoch message was fenced out (kind)
+EVENT_REQUEST_COMPLETE = "request_complete"
+EVENT_ADMITTED = "admitted"
+EVENT_SHED = "shed"
+EVENT_PREEMPTED = "preempted"
+EVENT_RESUMED = "resumed"
+EVENT_RECOVERY_ROUND = "recovery_round"
+EVENT_EPOCH_FENCED = "epoch_fenced"
+EVENT_NAMES = (
+    EVENT_REQUEST_COMPLETE,
+    EVENT_ADMITTED,
+    EVENT_SHED,
+    EVENT_PREEMPTED,
+    EVENT_RESUMED,
+    EVENT_RECOVERY_ROUND,
+    EVENT_EPOCH_FENCED,
+)

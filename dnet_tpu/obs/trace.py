@@ -96,6 +96,7 @@ def export_trace(
     timelines: Iterable[dict],
     tick_records: Optional[List[dict]] = None,
     max_events: Optional[int] = None,
+    wide_events: Optional[List[dict]] = None,
 ) -> dict:
     """Render timelines (+ optional tick records) as trace-event JSON.
 
@@ -103,11 +104,15 @@ def export_trace(
     `stitch_timelines()` dicts — the only difference is that stitched
     spans carry a `node` key; bare spans land on the `api` process.
     `tick_records` are `TickRecord.as_dict()` rows and become counter
-    tracks on the api process."""
+    tracks on the api process.  `wide_events` are obs/events.py journal
+    rows (absolute `t_unix`, optional `node`) and render as `i` instants
+    (cat `event`) on the owning node's driver track — a `preempted`
+    marker lands visually inside the decode gap it caused."""
     from dnet_tpu.transport.wire_pipeline import overlap
 
     timelines = [tl for tl in timelines if tl]
     tick_records = list(tick_records or [])
+    wide_events = list(wide_events or [])
     if max_events is None:
         try:
             from dnet_tpu.config import get_settings
@@ -120,6 +125,7 @@ def export_trace(
     # so every ts is a small non-negative microsecond offset
     origins = [float(tl["t_unix"]) for tl in timelines]
     origins += [float(r["t_unix"]) for r in tick_records if "t_unix" in r]
+    origins += [float(e["t_unix"]) for e in wide_events if "t_unix" in e]
     base = min(origins) if origins else 0.0
 
     # pid per node: api is always 1; shard nodes take stable sorted slots
@@ -127,6 +133,8 @@ def export_trace(
     for tl in timelines:
         for span in tl["spans"]:
             nodes.add(span.get("node") or "api")
+    for e in wide_events:
+        nodes.add(e.get("node") or "api")
     pids = {"api": 1}
     for i, node in enumerate(sorted(nodes - {"api"}), start=2):
         pids[node] = i
@@ -209,6 +217,19 @@ def export_trace(
                 "id": flow_id, "ts": rx_ts, "pid": rx_pid, "tid": rx_tid,
             })
 
+    # wide events (obs/events.py): instants on the owning node's driver
+    # track, correlated to the surrounding spans by wall time + rid args
+    for e in wide_events:
+        if "t_unix" not in e:
+            continue
+        node = e.get("node") or "api"
+        args = {k: v for k, v in e.items() if k not in ("name", "t_unix")}
+        events.append({
+            "name": e["name"], "cat": "event", "ph": "i",
+            "ts": (float(e["t_unix"]) - base) * 1e6, "s": "t",
+            "pid": pids[node], "tid": TID_DRIVER, "args": args,
+        })
+
     for rec in tick_records:
         if "t_unix" not in rec:
             continue
@@ -242,6 +263,7 @@ def export_trace(
             "base_unix_s": base,
             "timelines": len(timelines),
             "tick_records": len(tick_records),
+            "wide_events": len(wide_events),
             "wire_overlap": overlap.snapshot(),
         },
     }

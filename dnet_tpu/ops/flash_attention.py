@@ -254,9 +254,11 @@ def _probe_failed(exc) -> None:
     global _PROBE_WARNED
     if not _PROBE_WARNED:
         _PROBE_WARNED = True
-        import logging
+        # lazy: this module must import without dragging in the logging
+        # setup (kernel code is imported from bare jax scripts too)
+        from dnet_tpu.utils.logger import get_logger
 
-        logging.getLogger("dnet").warning(
+        get_logger().warning(
             "manual-mesh probe failed (%s: %s); flash kernels disabled "
             "— dense attention serves everywhere", type(exc).__name__, exc
         )

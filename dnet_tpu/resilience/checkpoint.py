@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from dnet_tpu.obs import get_recorder, metric
+from dnet_tpu.obs.events import log_event
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
@@ -172,6 +173,10 @@ class ResumableDecode:
                 continue
             _RESUMED.inc()
             _REPLAY_TOKENS.inc(len(ids))
+            log_event(
+                "resumed", rid=self.ckpt.rid, step=step,
+                replay_tokens=len(ids), nonce=self.nonce,
+            )
             get_recorder().span(
                 self.ckpt.rid, "request_resumed", 0.0, step=step,
                 replay_tokens=len(ids), force=True,

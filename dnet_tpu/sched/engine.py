@@ -34,6 +34,7 @@ from dnet_tpu.api.strategies import (
 )
 from dnet_tpu.core.types import DecodingParams, TokenResult
 from dnet_tpu.obs import metric, obs_enabled
+from dnet_tpu.obs.events import log_event
 from dnet_tpu.sched.flight import get_tick_recorder
 from dnet_tpu.sched.kinds import QUEUE_STATES, STATE_DECODING
 from dnet_tpu.sched.policy import SchedulerPolicy, TickPlan
@@ -354,6 +355,7 @@ class SchedulerAdapter(ApiAdapterBase):
     def _apply(self, plan: TickPlan, result: TickResult) -> None:
         for nonce in result.preempted:
             self.queue.requeue(nonce, reason_preempt=True)
+            log_event("preempted", rid=nonce, reason="policy")
         for nonce in result.requeued:
             req = self.queue.get(nonce)
             if req is None:
@@ -370,6 +372,7 @@ class SchedulerAdapter(ApiAdapterBase):
                 continue
             self.queue.requeue(nonce, reason_preempt=False)
             _PREEMPTIONS.labels(reason="starved_requeue").inc()
+            log_event("preempted", rid=nonce, reason="starved_requeue")
         for nonce, pos in result.progress.items():
             req = self.queue.get(nonce)
             if req is not None and req.state not in (STATE_DECODING,):

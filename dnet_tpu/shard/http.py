@@ -98,6 +98,7 @@ class ShardHTTPServer:
         self.app.router.add_get(
             "/v1/debug/timeline/{rid}", self.debug_timeline
         )
+        self.app.router.add_get("/v1/debug/events", self.debug_events)
         self.app.router.add_post("/load_model", self.load_model)
         self.app.router.add_post("/update_topology", self.update_topology)
         self.app.router.add_post("/unload_model", self.unload_model)
@@ -142,6 +143,35 @@ class ShardHTTPServer:
                 status=404,
             )
         return web.json_response(timeline)
+
+    async def debug_events(self, request: web.Request) -> web.Response:
+        """This shard's wide-event ring (obs/events.py), filtered by
+        ?rid= / ?name= / ?last_s=.  `t_wall` is stamped at response build
+        so the API's `?cluster=1` fetch doubles as the clock probe that
+        rebases these events onto the driver's clock — the same trick the
+        cluster timeline fetch uses."""
+        import time as _time
+
+        from dnet_tpu.obs.events import get_event_ring
+
+        try:
+            last_s = float(request.query.get("last_s", "") or 0.0)
+        except ValueError:
+            return web.json_response(
+                {"status": "error", "message": "last_s must be a number"},
+                status=400,
+            )
+        ring = get_event_ring()
+        events = ring.query(
+            rid=request.query.get("rid", "").strip(),
+            name=request.query.get("name", "").strip(),
+            last_s=last_s,
+        )
+        return web.json_response({
+            "events": events,
+            "dropped": ring.dropped,
+            "t_wall": _time.time(),
+        })
 
     async def health(self, request: web.Request) -> web.Response:
         rt = self.shard.runtime

@@ -9,6 +9,7 @@ asyncio-side output queue, per-nonce KV sessions expire by TTL.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import queue
 import threading
 import time
@@ -17,6 +18,7 @@ from typing import Optional
 from dnet_tpu.analysis.runtime import ownership as dsan
 from dnet_tpu.core.types import ActivationMessage
 from dnet_tpu.obs import get_recorder, metric
+from dnet_tpu.obs.events import bind, log_event
 from dnet_tpu.resilience import chaos
 from dnet_tpu.shard.compute import ShardCompute
 from dnet_tpu.utils.logger import get_logger
@@ -281,55 +283,76 @@ class ShardRuntime:
             if compute is None:
                 log.warning("dropping frame for %s: no model loaded", msg.nonce)
                 continue
-            if msg.deadline and time.time() >= msg.deadline:
-                # the request's end-to-end deadline expired while this frame
-                # sat in the ingress queue: nobody is waiting for the result,
-                # so drop it BEFORE spending compute.  A tiny error final
-                # still flows upstream so the driver fails fast instead of
-                # burning its await timeout on a token that will never come.
-                self._drop_expired(msg)
-                continue
-            try:
-                # per-hop trace spans, keyed by the request id (== nonce):
-                # dequeue (ingress -> compute thread pickup, the queue
-                # wait) and compute (this shard's window).  tx is recorded
-                # by the adapter's egress worker — together they are the
-                # shard half of the cluster-stitched timeline
-                # (GET /v1/debug/timeline/{rid}?cluster=1).
-                t_deq = time.perf_counter()
-                msg.t_enq = t_deq
-                rec = get_recorder()
-                if msg.t_recv:
-                    rec.span(
-                        msg.nonce, "shard_dequeue",
-                        (t_deq - msg.t_recv) * 1000.0, seq=msg.seq,
-                    )
-                # chaos point: an injected ChaosError here takes the exact
-                # path a real compute failure takes (error final -> driver)
-                chaos.inject("shard_compute")
-                out = compute.process(msg)
-                # the deadline and epoch ride every downstream hop (compute
-                # builds fresh messages; stamping here covers all of them)
-                out.deadline = msg.deadline
-                out.epoch = msg.epoch
+            # request identity for everything this frame touches on the
+            # compute thread: rid (== nonce) + epoch arrive ON the frame,
+            # node is this shard — every log line and event below carries
+            # them without plumbing (obs/events.py); _emit snapshots the
+            # context so the loop-side half keeps the binding too
+            with bind(
+                rid=msg.nonce,
+                node=self.shard_id,
+                epoch=(msg.epoch or None),
+            ):
+                self._process_frame(msg)
+
+    def _process_frame(self, msg: ActivationMessage) -> None:
+        """One frame, on the compute thread, inside its bind() scope."""
+        compute = self.compute
+        if compute is None:
+            return
+        if msg.deadline and time.time() >= msg.deadline:
+            # the request's end-to-end deadline expired while this frame
+            # sat in the ingress queue: nobody is waiting for the result,
+            # so drop it BEFORE spending compute.  A tiny error final
+            # still flows upstream so the driver fails fast instead of
+            # burning its await timeout on a token that will never come.
+            self._drop_expired(msg)
+            return
+        try:
+            # per-hop trace spans, keyed by the request id (== nonce):
+            # dequeue (ingress -> compute thread pickup, the queue
+            # wait) and compute (this shard's window).  tx is recorded
+            # by the adapter's egress worker — together they are the
+            # shard half of the cluster-stitched timeline
+            # (GET /v1/debug/timeline/{rid}?cluster=1).
+            t_deq = time.perf_counter()
+            msg.t_enq = t_deq
+            rec = get_recorder()
+            if msg.t_recv:
                 rec.span(
-                    msg.nonce, "shard_compute",
-                    (time.perf_counter() - t_deq) * 1000.0,
-                    seq=msg.seq, layer_id=msg.layer_id,
+                    msg.nonce, "shard_dequeue",
+                    (t_deq - msg.t_recv) * 1000.0, seq=msg.seq,
                 )
-                self._emit(out)
-            except Exception as exc:
-                log.exception("compute failed for nonce %s", msg.nonce)
-                # a batch frame's carrier nonce has no future API-side:
-                # fail every MEMBER so their drivers surface the error
-                # instead of blocking the full request timeout
-                self._emit(_error_final(msg, str(exc), msg.lanes))
+            # chaos point: an injected ChaosError here takes the exact
+            # path a real compute failure takes (error final -> driver)
+            chaos.inject("shard_compute")
+            out = compute.process(msg)
+            # the deadline and epoch ride every downstream hop (compute
+            # builds fresh messages; stamping here covers all of them)
+            out.deadline = msg.deadline
+            out.epoch = msg.epoch
+            rec.span(
+                msg.nonce, "shard_compute",
+                (time.perf_counter() - t_deq) * 1000.0,
+                seq=msg.seq, layer_id=msg.layer_id,
+            )
+            self._emit(out)
+        except Exception as exc:
+            log.exception("compute failed for nonce %s", msg.nonce)
+            # a batch frame's carrier nonce has no future API-side:
+            # fail every MEMBER so their drivers surface the error
+            # instead of blocking the full request timeout
+            self._emit(_error_final(msg, str(exc), msg.lanes))
 
     def _drop_expired(self, msg: ActivationMessage) -> None:
         """Shed one deadline-expired frame at dequeue: zero compute spent,
         counted per stage, and an error final surfaced upstream (batch
         frames fail every member so each driver sees it)."""
         _DEADLINE_EXCEEDED.labels(stage="shard_dequeue").inc()
+        # the shard half of the request's event story: rid/node/epoch come
+        # from the enclosing bind() — the journal row joins the API's
+        # request_complete on rid across /v1/debug/events
+        log_event("shed", reason="deadline", stage="shard_dequeue", seq=msg.seq)
         get_recorder().span(
             msg.nonce, "deadline_drop", 0.0, seq=msg.seq,
             deadline=msg.deadline,
@@ -346,7 +369,11 @@ class ShardRuntime:
         if self._loop is None or self.out_q is None:
             return
         out.t_tx_enq = time.perf_counter()
-        self._loop.call_soon_threadsafe(self._put_out, out)
+        # carry the compute thread's bind() scope across the thread->loop
+        # hop: _put_out's log lines (outq overflow) keep the rid/node
+        # stamp even though they run on the event loop
+        ctx = contextvars.copy_context()
+        self._loop.call_soon_threadsafe(ctx.run, self._put_out, out)
 
     def _put_out(self, out: ActivationMessage) -> None:
         try:

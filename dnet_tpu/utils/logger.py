@@ -3,6 +3,18 @@
 Reference behavior: src/dnet/utils/logger.py:53-112 — a single "dnet" logger,
 env-driven level, `[PROFILE]`-tagged lines filtered out unless profiling is
 enabled, and per-process file handlers (api vs shard-PID names).
+
+Two contracts this module owns:
+
+- **Foreign handlers survive reconfiguration.**  setup_logger only removes
+  handlers it installed (tagged `_dnet_owned`) — the TUI's live-feed
+  handler (tui.py) and any test-attached capture handler stay wired when a
+  CLI later calls `setup_logger(role=...)`.
+- **Request context on every line.**  The `ContextStampFilter` from
+  obs/events.py is installed at the LOGGER level, so every record emitted
+  inside a `bind(rid=..., node=..., epoch=...)` scope carries the bound
+  identity — through every handler, including foreign ones — and the
+  console/file format renders it as a ` [rid=... node=...]` suffix.
 """
 
 from __future__ import annotations
@@ -18,17 +30,21 @@ _configured = False
 
 
 class ProfileFilter(logging.Filter):
-    """Drop `[PROFILE]` lines unless profiling is enabled."""
+    """Drop `[PROFILE]` lines unless profiling is enabled.
 
-    def __init__(self, enabled: bool) -> None:
-        super().__init__()
-        self.enabled = enabled
+    Gating is resolved PER RECORD via `obs_enabled()` — not frozen at
+    setup time — so an env flip mid-process (config.env_flag reads
+    through the settings cache) can never desync this filter from the
+    metrics registry's own gate.
+    """
 
     def filter(self, record: logging.LogRecord) -> bool:
-        if self.enabled:
-            return True
         msg = record.getMessage()
-        return "[PROFILE]" not in msg
+        if "[PROFILE]" not in msg:
+            return True
+        from dnet_tpu.obs import obs_enabled
+
+        return obs_enabled()
 
 
 def setup_logger(
@@ -48,28 +64,34 @@ def setup_logger(
     explicit = role is not None or level is not None
     if _configured and not explicit:
         return logger
+    # remove only the handlers THIS function installed; foreign handlers
+    # (TUI live feed, test capture) survive reconfiguration
     for h in list(logger.handlers):
-        logger.removeHandler(h)
+        if getattr(h, "_dnet_owned", False):
+            logger.removeHandler(h)
 
     from dnet_tpu.config import get_settings
-    from dnet_tpu.obs import obs_enabled
+    from dnet_tpu.obs.events import ContextStampFilter
 
     s = get_settings()
     level = level or s.log.level
     log_dir = log_dir or s.log.dir
     to_file = s.log.to_file if to_file is None else to_file
-    # one gating truth shared with the metrics/recorder layer (dnet_tpu.obs):
-    # the [PROFILE] filter and the registry can never disagree
-    profile_on = obs_enabled()
 
     logger.setLevel(level.upper())
     logger.propagate = False
+    # logger-level stamp: every record through any handler carries the
+    # bound rid/node/epoch/tick (obs/events.py bind), exactly once
+    if not any(isinstance(f, ContextStampFilter) for f in logger.filters):
+        logger.addFilter(ContextStampFilter())
     fmt = logging.Formatter(
-        "%(asctime)s %(levelname)-7s %(name)s %(message)s", datefmt="%H:%M:%S"
+        "%(asctime)s %(levelname)-7s %(name)s%(ctx)s %(message)s",
+        datefmt="%H:%M:%S",
     )
     console = logging.StreamHandler(sys.stderr)
     console.setFormatter(fmt)
-    console.addFilter(ProfileFilter(profile_on))
+    console.addFilter(ProfileFilter())
+    console._dnet_owned = True  # type: ignore[attr-defined]
     logger.addHandler(console)
 
     if to_file and role:
@@ -80,7 +102,8 @@ def setup_logger(
             )
             fh = logging.FileHandler(log_dir / name)
             fh.setFormatter(fmt)
-            fh.addFilter(ProfileFilter(profile_on))
+            fh.addFilter(ProfileFilter())
+            fh._dnet_owned = True  # type: ignore[attr-defined]
             logger.addHandler(fh)
         except OSError:
             logger.warning("could not open log file in %s", log_dir)

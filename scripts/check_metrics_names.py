@@ -37,6 +37,7 @@ from dnet_tpu.analysis.metrics_checks import (  # noqa: E402,F401 — re-exporte
     check_membership_labels,
     check_paged_conservation,
     check_registry,
+    check_event_labels,
     check_san_labels,
     check_sched_labels,
     check_sources,

@@ -337,3 +337,134 @@ def test_cluster_timeline_local_only_without_cluster_manager():
         await client.close()
 
     run(go())
+
+
+# ---- wide-event ring: /v1/debug/events?cluster=1 ---------------------------
+
+
+def _skewed_events_app(events, skew_s: float) -> web.Application:
+    """A fake shard whose clock runs `skew_s` ahead: both its journal rows'
+    t_unix and the t_wall stamp the fetch-probe reads shift together,
+    exactly as a real shard with a skewed wall clock reports them."""
+
+    async def handler(request):
+        return web.json_response({
+            "events": [dict(e) for e in events],
+            "dropped": 0,
+            "t_wall": time.time() + skew_s,
+        })
+
+    app = web.Application()
+    app.router.add_get("/v1/debug/events", handler)
+    return app
+
+
+def test_cluster_events_merge_rebases_and_tags_nodes():
+    """`GET /v1/debug/events?rid=&cluster=1` returns ONE merged journal:
+    shard rows rebased onto the API clock via the fetch probe (under
+    +30s/-45s injected skews) and tagged with their owning node."""
+
+    async def go():
+        from dnet_tpu.obs.events import bind, log_event, reset_events
+
+        reset_events()
+        rid = "chatcmpl-events-cluster"
+        with bind(rid=rid, node="api"):
+            log_event("admitted", wait_ms=0.1)
+        now = time.time()
+        skew0, skew1 = 30.0, -45.0
+        s0_events = [{"name": "shed", "t_unix": now + skew0 + 0.2,
+                      "rid": rid, "reason": "deadline"}]
+        s1_events = [{"name": "resumed", "t_unix": now + skew1 + 0.4,
+                      "rid": rid, "step": 3}]
+        s0 = TestServer(_skewed_events_app(s0_events, skew0))
+        s1 = TestServer(_skewed_events_app(s1_events, skew1))
+        await s0.start_server()
+        await s1.start_server()
+        api = make_api(
+            FakeClusterManager([_device("s0", s0.port), _device("s1", s1.port)])
+        )
+        client = await client_for(api.app)
+        r = await client.get(f"/v1/debug/events?rid={rid}&cluster=1")
+        assert r.status == 200
+        events = (await r.json())["events"]
+        by_name = {e["name"]: e for e in events}
+        assert set(by_name) == {"admitted", "shed", "resumed"}
+        assert by_name["admitted"]["node"] == "api"
+        assert by_name["shed"]["node"] == "s0"
+        assert by_name["resumed"]["node"] == "s1"
+        # rebased onto the API clock: within the loopback probe error,
+        # not +-30/45 SECONDS off
+        assert abs(by_name["shed"]["t_unix"] - (now + 0.2)) < 1.0
+        assert abs(by_name["resumed"]["t_unix"] - (now + 0.4)) < 1.0
+        # one time-ordered journal on the corrected axis
+        times = [e["t_unix"] for e in events]
+        assert times == sorted(times)
+        # non-clock fields ride through the rebase untouched
+        assert by_name["resumed"]["step"] == 3
+        await client.close()
+        await s0.close()
+        await s1.close()
+        reset_events()
+
+    run(go())
+
+
+def test_cluster_events_skips_unreachable_shard():
+    async def go():
+        from dnet_tpu.obs.events import reset_events
+
+        reset_events()
+        with __import__("socket").socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            dead_port = sock.getsockname()[1]  # bound, never listening
+        api = make_api(FakeClusterManager([_device("dead", dead_port)]))
+        client = await client_for(api.app)
+        r = await client.get("/v1/debug/events?cluster=1")
+        assert r.status == 200  # merged view degrades, never 500s
+        body = await r.json()
+        assert body["events"] == []
+        await client.close()
+
+    run(go())
+
+
+def test_shard_debug_events_serves_ring_and_probe_stamp():
+    """The shard's /v1/debug/events reply carries `t_wall` — the clock
+    probe the API-side cluster fetch rebases with — plus its local ring
+    slice and drop counter."""
+
+    async def go():
+        from dnet_tpu.shard.http import ShardHTTPServer
+        from dnet_tpu.obs.events import bind, log_event, reset_events
+
+        reset_events()
+        with bind(rid="chatcmpl-shard-ev", node="s0"):
+            log_event("shed", reason="deadline", stage="shard_dequeue")
+        s0 = TestServer(ShardHTTPServer(shard=object()).app)
+        await s0.start_server()
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            t0 = time.time()
+            async with session.get(
+                f"http://127.0.0.1:{s0.port}/v1/debug/events"
+                "?rid=chatcmpl-shard-ev"
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert abs(body["t_wall"] - t0) < 5.0
+            assert body["dropped"] == 0
+            [evt] = body["events"]
+            assert evt["name"] == "shed"
+            assert evt["rid"] == "chatcmpl-shard-ev"
+            assert evt["node"] == "s0"
+            # malformed window is a loud 400, shard error shape
+            async with session.get(
+                f"http://127.0.0.1:{s0.port}/v1/debug/events?last_s=soon"
+            ) as r:
+                assert r.status == 400
+        await s0.close()
+        reset_events()
+
+    run(go())

@@ -1,0 +1,244 @@
+"""Unit surface of the structured wide-event layer (obs/events.py):
+
+- bind() nesting/merging semantics and contextvar propagation — across
+  await chains for free and across an explicit thread hop via
+  ``contextvars.copy_context()`` (the shard runtime's _emit bridge);
+- ContextStampFilter stamping bound identity onto every log record
+  through the dnet_tpu logger (the ~45 get_logger() sites upgrade
+  without call-site changes);
+- EventRing capacity eviction (dropped counter) and query filters (rid
+  incl. resume-suffix joins, name, last_s windowing);
+- log_event: vocabulary assertion, dnet_events_total increment, JSONL
+  sink;
+- merge_remote_events clock rebasing + node tagging.
+"""
+
+import contextvars
+import json
+import logging
+import threading
+
+import pytest
+
+from dnet_tpu.obs import metric, reset_obs
+from dnet_tpu.obs.events import (
+    EventRing,
+    ContextStampFilter,
+    bind,
+    bound_fields,
+    get_event_ring,
+    log_event,
+    merge_remote_events,
+    reset_events,
+)
+
+pytestmark = pytest.mark.core
+
+
+@pytest.fixture(autouse=True)
+def _fresh_events(monkeypatch):
+    reset_events()
+    yield
+    reset_events()
+
+
+# ---- bind / context ------------------------------------------------------
+
+
+def test_bind_merges_and_restores():
+    assert bound_fields() == {}
+    with bind(node="api"):
+        assert bound_fields() == {"node": "api"}
+        with bind(rid="chatcmpl-1", epoch=3):
+            assert bound_fields() == {
+                "node": "api", "rid": "chatcmpl-1", "epoch": 3,
+            }
+            with bind(rid="chatcmpl-2"):  # inner shadows
+                assert bound_fields()["rid"] == "chatcmpl-2"
+            assert bound_fields()["rid"] == "chatcmpl-1"
+        assert bound_fields() == {"node": "api"}
+    assert bound_fields() == {}
+
+
+def test_bind_crosses_thread_hop_via_copy_context():
+    """The shard runtime's _emit bridge: a context copied on the compute
+    thread carries the binding into work run on another thread."""
+    seen = {}
+
+    def loop_side():
+        seen.update(bound_fields())
+
+    with bind(rid="r-77", node="s0"):
+        ctx = contextvars.copy_context()
+    t = threading.Thread(target=lambda: ctx.run(loop_side))
+    t.start()
+    t.join()
+    assert seen == {"rid": "r-77", "node": "s0"}
+    # a bare thread (no copied context) sees nothing
+    seen.clear()
+    t = threading.Thread(target=loop_side)
+    t.start()
+    t.join()
+    assert seen == {}
+
+
+def test_context_stamp_filter_on_log_records():
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("dnet_tpu_test_stamp")
+    logger.addHandler(Capture())
+    logger.addFilter(ContextStampFilter())
+    logger.setLevel(logging.INFO)
+    with bind(rid="chatcmpl-9", node="api", epoch=2):
+        logger.info("inside")
+    logger.info("outside")
+    inside, outside = records
+    assert inside.rid == "chatcmpl-9"
+    assert inside.node == "api"
+    assert inside.epoch == 2
+    assert "rid=chatcmpl-9" in inside.ctx and "node=api" in inside.ctx
+    assert outside.rid == "" and outside.ctx == ""
+    # explicit extra= wins over the bound value
+    records.clear()
+    with bind(rid="bound-rid"):
+        logger.info("x", extra={"rid": "explicit-rid"})
+    assert records[0].rid == "explicit-rid"
+
+
+def test_setup_logger_preserves_foreign_handlers():
+    """The TUI live-feed contract: reconfiguration removes only handlers
+    setup_logger itself installed (_dnet_owned), never foreign ones."""
+    from dnet_tpu.utils.logger import setup_logger
+
+    logger = setup_logger()
+    foreign = logging.NullHandler()
+    logger.addHandler(foreign)
+    owned_before = [
+        h for h in logger.handlers if getattr(h, "_dnet_owned", False)
+    ]
+    assert owned_before, "setup_logger installed no owned handler"
+    logger = setup_logger(role="api", to_file=False)
+    assert foreign in logger.handlers
+    for h in owned_before:
+        assert h not in logger.handlers  # owned ones were replaced
+    logger.removeHandler(foreign)
+    assert any(
+        isinstance(f, ContextStampFilter) for f in logger.filters
+    ), "logger-level context stamp missing"
+
+
+# ---- ring ----------------------------------------------------------------
+
+
+def test_ring_eviction_counts_dropped():
+    ring = EventRing(capacity=3)
+    for i in range(5):
+        ring.append({"name": "admitted", "t_unix": float(i), "i": i})
+    assert len(ring) == 3
+    assert ring.dropped == 2
+    assert [e["i"] for e in ring.query()] == [2, 3, 4]  # oldest first
+    ring.clear()
+    assert len(ring) == 0 and ring.dropped == 0
+
+
+def test_ring_query_filters():
+    ring = EventRing(capacity=16)
+    ring.append({"name": "admitted", "t_unix": 100.0, "rid": "chatcmpl-a"})
+    ring.append(
+        {"name": "request_complete", "t_unix": 101.0, "rid": "chatcmpl-a"}
+    )
+    ring.append({"name": "admitted", "t_unix": 102.0, "rid": "chatcmpl-b"})
+    # resume segments join their base rid
+    ring.append(
+        {"name": "resumed", "t_unix": 103.0, "rid": "chatcmpl-a#r1"}
+    )
+    by_rid = ring.query(rid="chatcmpl-a")
+    assert [e["t_unix"] for e in by_rid] == [100.0, 101.0, 103.0]
+    assert [e["name"] for e in ring.query(name="admitted")] == [
+        "admitted", "admitted",
+    ]
+    # last_s windowing against an explicit now
+    recent = ring.query(last_s=1.5, now=103.0)
+    assert [e["t_unix"] for e in recent] == [102.0, 103.0]
+    both = ring.query(rid="chatcmpl-a", name="admitted")
+    assert [e["t_unix"] for e in both] == [100.0]
+
+
+# ---- log_event -----------------------------------------------------------
+
+
+def test_log_event_requires_vocabulary_name():
+    with pytest.raises(AssertionError):
+        log_event("not_a_declared_event")
+
+
+def test_log_event_binds_context_counts_and_journals():
+    reset_obs()
+    before = metric("dnet_events_total").labels(name="admitted").value
+    with bind(rid="chatcmpl-7", node="api"):
+        rec = log_event("admitted", wait_ms=1.5)
+    assert rec["rid"] == "chatcmpl-7"
+    assert rec["node"] == "api"
+    assert rec["wait_ms"] == 1.5
+    assert "t_unix" in rec
+    # explicit kwargs shadow the bound context
+    with bind(rid="bound"):
+        rec2 = log_event("admitted", rid="explicit")
+    assert rec2["rid"] == "explicit"
+    ring = get_event_ring()
+    assert [e["rid"] for e in ring.query(name="admitted")] == [
+        "chatcmpl-7", "explicit",
+    ]
+    after = metric("dnet_events_total").labels(name="admitted").value
+    assert after == before + 2.0
+
+
+def test_log_event_jsonl_sink(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("DNET_OBS_EVENTS_PATH", str(path))
+    from dnet_tpu.config import reset_settings_cache
+
+    reset_settings_cache()
+    reset_events()
+    try:
+        log_event("shed", reason="queue_full")
+        log_event("shed", reason="draining")
+        rows = [
+            json.loads(line) for line in path.read_text().splitlines()
+        ]
+        assert [r["reason"] for r in rows] == ["queue_full", "draining"]
+        assert all(r["name"] == "shed" for r in rows)
+    finally:
+        monkeypatch.delenv("DNET_OBS_EVENTS_PATH")
+        reset_settings_cache()
+        reset_events()
+
+
+# ---- cluster merge -------------------------------------------------------
+
+
+def test_merge_remote_events_rebases_and_tags():
+    class Est:
+        def __init__(self, offset_s):
+            self.offset_s = offset_s
+
+    local = [{"name": "request_complete", "t_unix": 1000.5, "rid": "r1"}]
+    s0 = [{"name": "admitted", "t_unix": 1030.0, "rid": "r1"}]  # +30s skew
+    s1 = [{"name": "shed", "t_unix": 955.0, "rid": "r2"}]  # -45s skew
+    merged = merge_remote_events(
+        local, [("s0", s0, Est(30.0)), ("s1", s1, Est(-45.0))]
+    )
+    by_name = {e["name"]: e for e in merged}
+    assert by_name["request_complete"]["node"] == "api"
+    assert by_name["admitted"]["node"] == "s0"
+    assert by_name["admitted"]["t_unix"] == pytest.approx(1000.0)
+    assert by_name["shed"]["node"] == "s1"
+    assert by_name["shed"]["t_unix"] == pytest.approx(1000.0)
+    # sorted on the rebased clock
+    assert [e["t_unix"] for e in merged] == sorted(
+        e["t_unix"] for e in merged
+    )

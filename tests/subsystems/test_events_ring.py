@@ -1,0 +1,210 @@
+"""Cross-hop wide-event correlation ACCEPTANCE (in-process two-shard ring).
+
+One request through the real serving stack (ApiHTTPServer -> InferenceManager
+-> RingApiAdapter -> two ShardRuntimes with real compute threads) must:
+
+- emit exactly ONE `request_complete` wide event on the api node whose
+  status/tokens/total_ms reconcile with the embedded PR 16 segment ledger,
+- be retrievable by rid via `GET /v1/debug/events?rid=`,
+- render as `cat="event"` instants in the Perfetto export.
+
+A second, deadline-shed request additionally proves the shard half: its
+frame expires in s0's ingress queue, the dequeue drop journals a `shed`
+event BOUND at the frame (rid + node come from the compute thread's
+bind() scope), and `/v1/debug/events?rid=` returns the merged api+shard
+set for that one rid.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from dnet_tpu.obs import get_recorder
+from dnet_tpu.obs.events import get_event_ring, reset_events
+
+pytestmark = [pytest.mark.ring, pytest.mark.shard, pytest.mark.http]
+
+
+def _body(prompt, max_tokens=6, **extra):
+    b = {
+        "model": "inproc-ring",
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "temperature": 0,
+        "stream": True,
+    }
+    b.update(extra)
+    return b
+
+
+async def _wait_shard_shed(rid, timeout=10.0):
+    """The shard drop happens AFTER the driver's 504 (the frame is still
+    queued behind the slow compute when the response returns) — poll the
+    journal until the compute thread reaches and sheds it."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        sheds = [
+            e
+            for e in get_event_ring().query(rid=rid, name="shed")
+            if e.get("node") in ("s0", "s1")
+        ]
+        if sheds:
+            return sheds
+        await asyncio.sleep(0.05)
+    raise TimeoutError(f"no shard-side shed event for {rid}")
+
+
+async def _events_acceptance(model_dir):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from dnet_tpu.loadgen.ring_harness import InprocRing
+
+    reset_events()
+    get_recorder().clear()
+    ring = InprocRing(str(model_dir))
+    await ring.start()
+    try:
+        client = TestClient(TestServer(ring.app))
+        await client.start_server()
+        try:
+            # warmup absorbs JIT compiles so the deadline knobs below are
+            # timing-sane
+            warm = await client.post(
+                "/v1/chat/completions", json=_body("warm up", 4)
+            )
+            assert warm.status == 200, await warm.text()
+            await warm.read()
+
+            # ---- success: exactly one request_complete, reconciling ----
+            resp = await client.post(
+                "/v1/chat/completions", json=_body("A quick brown")
+            )
+            assert resp.status == 200, await resp.text()
+            raw = (await resp.read()).decode()
+            chunks = [
+                json.loads(ln[len("data: "):])
+                for ln in raw.splitlines()
+                if ln.startswith("data: ") and ln != "data: [DONE]"
+            ]
+            rid = chunks[0]["id"]
+            usage = chunks[-1]["usage"]
+
+            r = await client.get("/v1/debug/events", params={"rid": rid})
+            assert r.status == 200
+            events = (await r.json())["events"]
+            done = [e for e in events if e["name"] == "request_complete"]
+            assert len(done) == 1, done  # exactly once
+            evt = done[0]
+            assert evt["status"] == 200
+            assert evt["node"] == "api"
+            assert evt["shed"] is False
+            assert evt["finish_reason"] in ("stop", "length")
+            assert evt["tokens"] == usage["completion_tokens"]
+            assert evt["prompt_tokens"] == usage["prompt_tokens"]
+            assert set(evt["modes"]) == {"codec", "kv", "tp", "sched"}
+            # reconciles with the segment ledger it embeds: total_ms IS the
+            # ledger's e2e window (both measure the same request span)
+            led = evt["critical_path"]
+            assert evt["total_ms"] == pytest.approx(led["e2e_ms"], abs=5.0)
+            assert sum(led["segments_ms"].values()) == pytest.approx(
+                led["total_ms"], abs=0.05
+            )
+
+            # name filter + unknown-name validation on the query surface
+            r = await client.get(
+                "/v1/debug/events", params={"name": "request_complete"}
+            )
+            assert r.status == 200
+            assert {e["name"] for e in (await r.json())["events"]} == {
+                "request_complete"
+            }
+            r = await client.get(
+                "/v1/debug/events", params={"name": "not_an_event"}
+            )
+            assert r.status == 400
+
+            # ---- Perfetto: the journal rows render as instants ----
+            tr = await client.get(f"/v1/debug/trace/{rid}?format=perfetto")
+            assert tr.status == 200
+            trace = await tr.json()
+            instants = [
+                e
+                for e in trace["traceEvents"]
+                if e.get("cat") == "event" and e["ph"] == "i"
+            ]
+            assert any(
+                e["name"] == "request_complete" and e["args"]["rid"] == rid
+                for e in instants
+            ), instants
+            assert trace["otherData"]["wide_events"] >= 1
+
+            # ---- deadline shed: the shard half joins on the rid ----
+            # s0's compute sleeps, so the occupy request parks the compute
+            # thread while the late request's frame waits in the ingress
+            # queue past its deadline — the drop at dequeue is the
+            # deterministic shard-side shed
+            orig = ring.s0.compute.process
+
+            def slow(msg):
+                time.sleep(0.6)
+                return orig(msg)
+
+            ring.s0.compute.process = slow
+            try:
+                occupy = asyncio.ensure_future(
+                    client.post(
+                        "/v1/chat/completions", json=_body("occupy", 1)
+                    )
+                )
+                await asyncio.sleep(0.2)  # its frame now sleeps in compute
+                late = await client.post(
+                    "/v1/chat/completions",
+                    json=_body("late", 2, deadline_s=0.1),
+                )
+                assert late.status == 504, await late.text()
+                occ = await occupy
+                assert occ.status == 200, await occ.text()
+                await occ.read()
+            finally:
+                ring.s0.compute.process = orig
+
+            comp504 = [
+                e
+                for e in get_event_ring().query(name="request_complete")
+                if e["status"] == 504
+            ]
+            assert len(comp504) == 1, comp504  # exactly once, again
+            late_evt = comp504[0]
+            late_rid = late_evt["rid"]
+            assert late_evt["shed"] is True
+            assert late_evt["shed_reason"] == "deadline"
+            assert late_evt["finish_reason"] == "shed"
+            assert late_evt["tokens"] == 0
+
+            sheds = await _wait_shard_shed(late_rid)
+            shed = sheds[0]
+            assert shed["node"] == "s0"  # bound at frame dequeue
+            assert shed["rid"] == late_rid
+            assert shed["reason"] == "deadline"
+            assert shed["stage"] == "shard_dequeue"
+
+            # the query surface returns the merged api+shard story for
+            # the one rid — both nodes of the in-process ring
+            r = await client.get(
+                "/v1/debug/events", params={"rid": late_rid}
+            )
+            assert r.status == 200
+            late_events = (await r.json())["events"]
+            assert {e["node"] for e in late_events} >= {"api", "s0"}
+            times = [e["t_unix"] for e in late_events]
+            assert times == sorted(times)  # oldest first
+        finally:
+            await client.close()
+    finally:
+        await ring.stop()
+
+
+def test_ring_wide_event_acceptance(tiny_llama_dir):
+    asyncio.run(_events_acceptance(tiny_llama_dir))

@@ -451,6 +451,66 @@ def test_dl008_quiet_on_stamped_frame_and_protocol_module():
     assert fs == []
 
 
+# ---- DL029 logging hygiene ------------------------------------------------
+
+
+def test_dl029_fires_on_raw_getlogger():
+    fs = findings_for(
+        "import logging\n"
+        "log = logging.getLogger('dnet')\n"
+    )
+    assert codes(fs) == ["DL029"]
+    assert fs[0].line == 2
+    # repo-wide rule: fires off the serving path too (the ops/ drift)
+    fs = findings_for(
+        "import logging\n"
+        "logging.getLogger('x').warning('%s', 1)\n",
+        rel="dnet_tpu/ops/fixture_mod.py",
+    )
+    assert codes(fs) == ["DL029"]
+
+
+def test_dl029_fires_on_eager_interpolation():
+    fs = findings_for(
+        "from dnet_tpu.utils.logger import get_logger\n"
+        "log = get_logger()\n"
+        "def f(rid):\n"
+        "    log.info(f'sent {rid}')\n"
+        "    log.warning('sent {}'.format(rid))\n"
+        "    log.error('sent %s' % rid)\n"
+    )
+    assert codes(fs) == ["DL029", "DL029", "DL029"]
+    assert [f.line for f in fs] == [4, 5, 6]
+
+
+def test_dl029_quiet_on_lazy_args_allowlist_and_nonserving():
+    fs = findings_for(
+        "from dnet_tpu.utils.logger import get_logger\n"
+        "log = get_logger()\n"
+        "def f(rid, exc):\n"
+        "    log.info('sent %s', rid)\n"
+        "    log.exception('compute failed for %s', rid)\n"
+        "    get_logger().warning('probe failed (%s)', exc)\n"
+    )
+    assert fs == []
+    # the logger tree owners may call logging.getLogger
+    fs = findings_for(
+        "import logging\n"
+        "logger = logging.getLogger('dnet_tpu')\n",
+        rel="dnet_tpu/utils/logger.py",
+    )
+    assert fs == []
+    # eager interpolation off the serving path is tolerated (CLI glue)
+    fs = findings_for(
+        "from dnet_tpu.utils.logger import get_logger\n"
+        "log = get_logger()\n"
+        "def f(x):\n"
+        "    log.info(f'loaded {x}')\n",
+        rel="dnet_tpu/cli/fixture_mod.py",
+    )
+    assert fs == []
+
+
 # ---- DL009 ownership-registry drift + bridge discipline -------------------
 
 _DOMAINS_REL = "dnet_tpu/analysis/runtime/domains.py"
@@ -685,9 +745,10 @@ def test_check_codes_unique_and_documented():
         assert c.code not in seen, f"duplicate check code {c.code}"
         seen.add(c.code)
         assert c.description, f"{c.code} has no description"
-    # the full 28-check catalog: DL001-DL009 (AST), DL010-DL020 +
-    # DL026-DL028 (runtime metric passes), DL021-DL025 (flow-sensitive tier)
-    assert seen == {f"DL{i:03d}" for i in range(1, 29)}
+    # the full 30-check catalog: DL001-DL009 + DL029 (AST), DL010-DL020 +
+    # DL026-DL028 + DL030 (runtime metric passes), DL021-DL025
+    # (flow-sensitive tier)
+    assert seen == {f"DL{i:03d}" for i in range(1, 31)}
 
 
 # ---- tier-1 self-run wrapper ----------------------------------------------
@@ -706,11 +767,11 @@ def test_dnetlint_self_run_clean(tmp_path):
     report = json.loads(out.read_text())
     assert report["clean"] is True
     assert report["files_scanned"] > 100
-    # the FULL 28-check catalog ran: DL001-DL009 AST, DL010-DL020 +
-    # DL026-DL028 runtime metric passes, DL021-DL025 flow-sensitive tier —
-    # a check cannot silently fall out of the suite
+    # the FULL 30-check catalog ran: DL001-DL009 + DL029 AST, DL010-DL020
+    # + DL026-DL028 + DL030 runtime metric passes, DL021-DL025
+    # flow-sensitive tier — a check cannot silently fall out of the suite
     assert sorted(report["checks_run"]) == [
-        f"DL{i:03d}" for i in range(1, 29)
+        f"DL{i:03d}" for i in range(1, 31)
     ]
     assert report["findings"] == []
     # the merged runtime-sanitizer section: the full DS catalog is always
