@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: tier1 dnetlint dnetlint-diff dnetlint-report bench-compare
+.PHONY: tier1 dnetlint dnetlint-diff dnetlint-report bench-compare bench-fleet
 
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -21,6 +21,20 @@ tier1:
 bench-compare:
 	JAX_PLATFORMS=cpu $(PY) scripts/check_metrics_names.py
 	$(PY) scripts/bench_compare.py $(OLD) $(NEW) $(FAIL_ON)
+
+# fleet front-door legs (bench_serve --fleet 2): 1-replica vs 2-replica
+# vs mid-burst failover over MODEL (a checkpoint dir).  The r07 gates,
+# applied when diffing against a prior fleet record:
+#   make bench-compare OLD=BENCH_SERVE_r07.json NEW=<new>.json \
+#        FAIL_ON='--fail-on comparison.goodput_ratio=-10% \
+#                 --fail-on comparison.failover_http_5xx=+0 \
+#                 --fail-on comparison.ttft_p99_ms_two=+25%'
+# (goodput_ratio is the 2-replica/1-replica goodput multiple — the
+# >=1.8x scaling claim; failover_http_5xx=+0 is absolute: any 5xx during
+# the kill-mid-burst drill is a regression)
+bench-fleet:
+	JAX_PLATFORMS=cpu DNET_OBS_ENABLED=1 $(PY) bench_serve.py \
+		--model $(MODEL) --fleet 2 $(ARGS)
 
 dnetlint:
 	$(PY) scripts/dnetlint.py

@@ -83,6 +83,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp-collective", default="",
                    help="ring-inproc: TP collective mode for every shard "
                    "(auto|lossless|q8; '' = DNET_TP_COLLECTIVE default)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="drive the workload through the fleet front door "
+                   "(dnet_tpu/fleet/) THREE times — 1 replica, N replicas "
+                   "behind the least-loaded prefix-affine router, and the "
+                   "failover drill (kill r1 mid-burst; zero 5xx is the "
+                   "bar) — and emit one composite report with per-replica "
+                   "goodput and routing counters per leg")
+    p.add_argument("--fleet-pace-ms", type=float, default=40.0,
+                   help="fleet legs: emulated device-bound decode floor "
+                   "(DNET_FLEET_DECODE_PACE_MS).  On a real TPU ring the "
+                   "host WAITS on the device, so replicas scale across "
+                   "hosts; co-hosted CPU replicas would just contend for "
+                   "the same cores and show no scaling.  0 disables the "
+                   "floor (raw CPU contention).")
     p.add_argument("--max-seq", type=int, default=1024)
     p.add_argument("--param-dtype", default="bfloat16")
     p.add_argument("--out", default="", help="report path (default: next "
@@ -425,6 +439,171 @@ async def _run_ring_inproc(args, spec) -> dict:
     }
 
 
+async def _fleet_leg(args, spec, n_replicas: int, *,
+                     fail_after_s: float = None) -> dict:
+    """One fleet run: N fresh single-node replicas (full InferenceManager
+    + engine stacks over the SAME checkpoint), one FleetManager front
+    door, one loopback HTTP port, fresh obs books.  `fail_after_s` arms
+    the failover drill: a timer marks r1 dead mid-burst, and the router
+    must re-admit its in-flight streams on a survivor with zero 5xx."""
+    import os
+
+    import aiohttp
+
+    from dnet_tpu.api.http import ApiHTTPServer
+    from dnet_tpu.api.inference import InferenceManager
+    from dnet_tpu.api.model_manager import LocalModelManager
+    from dnet_tpu.config import get_settings, reset_settings_cache
+    from dnet_tpu.fleet import FleetManager
+    from dnet_tpu.loadgen import run_load
+    from dnet_tpu.obs import metric, reset_obs
+
+    os.environ["DNET_FLEET"] = str(n_replicas)
+    reset_settings_cache()
+    reset_obs()
+    api = get_settings().api
+    replicas = []
+    for _ in range(n_replicas):
+        inference = InferenceManager(
+            adapter=None,
+            request_timeout_s=api.request_timeout_s,
+            # legacy engine path: admission capacity == the slot pool
+            # (see _run_inprocess)
+            max_concurrent=min(
+                api.max_concurrent_requests, max(args.slots, 1)
+            ),
+        )
+        manager = LocalModelManager(
+            inference,
+            models_dir=api.models_dir,
+            max_seq=args.max_seq,
+            param_dtype=args.param_dtype,
+            batch_slots=args.slots,
+        )
+        await manager.load_model(args.model, max_seq=args.max_seq)
+        replicas.append((inference, manager))
+    fleet = FleetManager()
+    for i, (inference, _mgr) in enumerate(replicas):
+        fleet.add_replica(f"r{i}", inference)
+    server = ApiHTTPServer(replicas[0][0], replicas[0][1], fleet=fleet)
+    port = _free_port()
+    await server.start("127.0.0.1", port)
+    killer = None
+    if fail_after_s is not None:
+        async def _kill() -> None:
+            await asyncio.sleep(fail_after_s)
+            fleet.fail_replica("r1")
+
+        killer = asyncio.ensure_future(_kill())
+    try:
+        async with aiohttp.ClientSession(
+            base_url=f"http://127.0.0.1:{port}",
+            timeout=aiohttp.ClientTimeout(total=None),
+        ) as session:
+            result = await run_load(
+                session, spec, args.model,
+                include_rows=not args.no_rows,
+                meta={
+                    "mode": "fleet",
+                    "replicas": n_replicas,
+                    "failover_drill": fail_after_s is not None,
+                    "slots": args.slots,
+                    "max_seq": args.max_seq,
+                    "param_dtype": args.param_dtype,
+                },
+            )
+    finally:
+        if killer is not None:
+            killer.cancel()
+        await server.stop()
+        for _inf, mgr in replicas:
+            await mgr.unload_model()
+    report = result.report
+    # leg-local routing books (obs was reset at leg start, so absolute
+    # values ARE the leg totals) + the 5xx count the failover bar gates on
+    report["fleet_leg"] = {
+        "http_5xx": sum(
+            1 for o in result.outcomes if 500 <= o.status < 600
+        ),
+        "failovers_total": int(metric("dnet_fleet_failovers_total").value),
+        "affinity_hits_total": int(
+            metric("dnet_fleet_affinity_hits_total").value
+        ),
+    }
+    return report
+
+
+async def _run_fleet(args, spec) -> dict:
+    """Fleet front-door legs over the SAME seeded workload: one replica,
+    N replicas behind the least-loaded prefix-affine router, then the
+    mid-burst failover drill.
+
+    Admission queues are pinned DEEP (every request queues rather than
+    sheds, like the r04 ring legs), so each capacity leg drains the
+    identical workload and the goodput ratio is pure serving-rate
+    scaling: tokens over the wall-clock each fleet size needs to drain
+    the burst.  Decode runs under the DNET_FLEET_DECODE_PACE_MS floor
+    (--fleet-pace-ms): on real hardware the host waits on the device
+    and replicas scale across hosts, so the floor — which overlaps
+    across co-hosted replicas the way device time would — is what makes
+    a single-box fleet bench measure routing, not CPU contention."""
+    import os
+
+    from dnet_tpu.config import reset_settings_cache
+
+    n = max(args.fleet, 2)
+    admit_depth = str(spec.requests)
+    os.environ["DNET_ADMIT_QUEUE_DEPTH"] = admit_depth
+    os.environ["DNET_ADMIT_QUEUE_TIMEOUT_S"] = str(spec.timeout_s)
+    os.environ["DNET_FLEET_DECODE_PACE_MS"] = str(max(args.fleet_pace_ms, 0.0))
+    try:
+        one = await _fleet_leg(args, spec, 1)
+        two = await _fleet_leg(args, spec, n)
+        # kill r1 ~40% into the measured serving window of the healthy
+        # N-replica leg: late enough that it holds in-flight streams,
+        # early enough that the survivors serve meaningful post-failover
+        # load before the burst drains
+        two_serving = max(two["duration_s"] - spec.warmup_s, 0.0)
+        fail_at = spec.warmup_s + 0.4 * two_serving
+        failover = await _fleet_leg(args, spec, n, fail_after_s=fail_at)
+    finally:
+        for k in ("DNET_FLEET", "DNET_ADMIT_QUEUE_DEPTH",
+                  "DNET_ADMIT_QUEUE_TIMEOUT_S", "DNET_FLEET_DECODE_PACE_MS"):
+            os.environ.pop(k, None)
+        reset_settings_cache()
+    g1 = one["goodput"]["tok_s"]
+    g2 = two["goodput"]["tok_s"]
+    return {
+        "kind": "bench_serve_fleet",
+        "spec": one["spec"],
+        "meta": {
+            "mode": "fleet",
+            "model": args.model,
+            "replicas": n,
+            "failover_at_s": round(fail_at, 3),
+            "admit_queue_depth": admit_depth,
+            "decode_pace_ms": max(args.fleet_pace_ms, 0.0),
+        },
+        "one_replica": one,
+        "two_replica": two,
+        "failover": failover,
+        "comparison": {
+            "goodput_tok_s_one": g1,
+            "goodput_tok_s_two": g2,
+            "goodput_ratio": round(g2 / max(g1, 1e-9), 3),
+            "completed_one": one["requests"]["completed"],
+            "completed_two": two["requests"]["completed"],
+            "completed_failover": failover["requests"]["completed"],
+            "ttft_p99_ms_one": one["latency_ms"]["ttft"]["p99_ms"],
+            "ttft_p99_ms_two": two["latency_ms"]["ttft"]["p99_ms"],
+            "tpot_p99_ms_one": one["latency_ms"]["tpot"]["p99_ms"],
+            "tpot_p99_ms_two": two["latency_ms"]["tpot"]["p99_ms"],
+            "failover_http_5xx": failover["fleet_leg"]["http_5xx"],
+            "failovers_total": failover["fleet_leg"]["failovers_total"],
+        },
+    }
+
+
 async def _run_ring_tp(args, spec) -> dict:
     """Hybrid TP x PP legs over the SAME seeded workload and the SAME
     two-shard in-process ring as r04: the tp=1 baseline (directly
@@ -504,6 +683,21 @@ def _summarize_ring_tp(report: dict) -> str:
     ])
 
 
+def _summarize_fleet(report: dict) -> str:
+    c = report["comparison"]
+    fo = report["failover"]["fleet_leg"]
+    return "\n".join([
+        f"fleet legs ({report['meta']['replicas']} replicas): goodput "
+        f"{c['goodput_tok_s_one']} -> {c['goodput_tok_s_two']} tok/s "
+        f"({c['goodput_ratio']}x), completed {c['completed_one']} -> "
+        f"{c['completed_two']}",
+        f"ttft p99 ms: {c['ttft_p99_ms_one']} -> {c['ttft_p99_ms_two']}; "
+        f"tpot p99 ms: {c['tpot_p99_ms_one']} -> {c['tpot_p99_ms_two']}",
+        f"failover drill: {c['completed_failover']} completed, "
+        f"{fo['http_5xx']} HTTP 5xx, {fo['failovers_total']} failover(s)",
+    ])
+
+
 def _summarize_ring(report: dict) -> str:
     c = report["comparison"]
     return "\n".join([
@@ -525,6 +719,8 @@ def _summarize_ring(report: dict) -> str:
 
 
 def _summarize(report: dict) -> str:
+    if report.get("kind") == "bench_serve_fleet":
+        return _summarize_fleet(report)
     if report.get("kind") == "bench_serve_ring_tp":
         return _summarize_ring_tp(report)
     if report.get("kind") == "bench_serve_ring":
@@ -582,7 +778,12 @@ def main(argv=None) -> int:
 
     reset_settings_cache()
     spec = _spec_from(args)
-    if args.ring_inproc or args.ring_tp:
+    if args.fleet:
+        if args.base_url:
+            print("error: --fleet is an in-process mode", file=sys.stderr)
+            return 2
+        runner = _run_fleet
+    elif args.ring_inproc or args.ring_tp:
         if args.base_url:
             print("error: --ring-inproc/--ring-tp are in-process modes",
                   file=sys.stderr)

@@ -208,6 +208,13 @@ _REQUIRED_FAMILIES = (
     # structured wide events (obs/events.py) — the event-rate dashboards
     # and the vocabulary cross-check (pass 15) depend on this
     "dnet_events_total",
+    # fleet routing (dnet_tpu/fleet/) — the per-replica traffic/failover
+    # dashboards and the label cross-check (pass 16) depend on these
+    "dnet_fleet_requests_total",
+    "dnet_fleet_routed_total",
+    "dnet_fleet_affinity_hits_total",
+    "dnet_fleet_failovers_total",
+    "dnet_fleet_replicas",
 )
 
 
@@ -729,6 +736,28 @@ def check_event_labels(errors: list) -> int:
     )
 
 
+def check_fleet_labels(errors: list) -> int:
+    """Pass 16: the fleet-routing surface must agree with the declared
+    enums (fleet/states.py) both ways — a new replica state or routing
+    reason cannot ship without its pre-touched series, and a renamed one
+    cannot strand a stale label on dashboards.  The `replica` label of
+    dnet_fleet_requests_total is deployment-assigned (r0, r1, ...) and
+    intentionally NOT enum-checked."""
+    from dnet_tpu.fleet.states import REPLICA_STATES, ROUTE_REASONS
+    from dnet_tpu.obs import get_registry
+
+    text = get_registry().expose()
+    n = _cross_check_labels(
+        errors, text, "dnet_fleet_replicas", "state",
+        REPLICA_STATES, "fleet.states.REPLICA_STATES",
+    )
+    n += _cross_check_labels(
+        errors, text, "dnet_fleet_routed_total", "reason",
+        ROUTE_REASONS, "fleet.states.ROUTE_REASONS",
+    )
+    return n
+
+
 def main() -> int:
     """The scripts/check_metrics_names.py CLI contract, verbatim: exit 0
     and the 'ok: ...' summary on clean, the FAIL lines and exit 1 on
@@ -749,6 +778,7 @@ def main() -> int:
     n_tp = check_tp_labels(errors)
     n_seg = check_request_segment_labels(errors)
     n_evt = check_event_labels(errors)
+    n_fleet = check_fleet_labels(errors)
     if errors:
         for e in errors:
             print(f"FAIL {e}")
@@ -760,7 +790,7 @@ def main() -> int:
           f"{n_san} sanitizer labels, {n_sched} scheduler labels, "
           f"{n_jit} jit call sites, {n_wire} wire labels, "
           f"{n_tp} tp labels, {n_seg} critical-path labels, "
-          f"{n_evt} event labels, all conform")
+          f"{n_evt} event labels, {n_fleet} fleet labels, all conform")
     return 0
 
 
@@ -898,6 +928,13 @@ class EventLabelContract(_MetricsCheck):
     pass_name = "check_event_labels"
 
 
+class FleetLabelContract(_MetricsCheck):
+    code = "DL031"
+    name = "fleet-label-contract"
+    description = "fleet state/reason labels <-> declared enums, both ways"
+    pass_name = "check_fleet_labels"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -914,4 +951,5 @@ METRICS_CHECKS = [
     TpLabelContract(),
     RequestSegmentContract(),
     EventLabelContract(),
+    FleetLabelContract(),
 ]

@@ -88,10 +88,15 @@ class ApiHTTPServer:
         inference: InferenceManager,
         model_manager,
         cluster_manager=None,
+        fleet=None,
     ) -> None:
         self.inference = inference
         self.model_manager = model_manager
         self.cluster_manager = cluster_manager
+        # DNET_FLEET>1: a FleetManager routes decode endpoints across
+        # replicas; None (the default) keeps the single-ring path with
+        # zero new code between request and stream
+        self.fleet = fleet
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
@@ -114,6 +119,7 @@ class ApiHTTPServer:
         self.app.router.add_get("/v1/debug/trace", self.debug_trace_window)
         self.app.router.add_get("/v1/debug/trace/{rid}", self.debug_trace)
         self.app.router.add_get("/v1/debug/events", self.debug_events)
+        self.app.router.add_get("/v1/debug/fleet", self.debug_fleet)
         self._runner: Optional[web.AppRunner] = None
         # peers seen by earlier /v1/cluster/metrics scrapes: a peer that
         # leaves discovery must drop to scrape_ok 0, not freeze at 1
@@ -135,6 +141,16 @@ class ApiHTTPServer:
     # ---- decode-endpoint scaffolding ---------------------------------
     def _gate(self):
         """Shared pre-admission checks for decode endpoints (None = pass)."""
+        if self.fleet is not None:
+            # fleet mode: any serving replica admits the request — the
+            # router walks the candidates; only a fleet with NO serving
+            # replica falls through to the single-ring diagnostics below
+            # (which then describe the primary honestly)
+            if any(
+                h.serving and getattr(h.inference, "ready", False)
+                for h in self.fleet.handles()
+            ):
+                return None
         admission = self.inference.admission
         if admission.draining:
             # drain window (SIGTERM): in-flight streams finish; new work
@@ -170,7 +186,11 @@ class ApiHTTPServer:
         disconnects mid-stream closes it (GeneratorExit), which fans
         cancel + reset_cache out through the ring (InferenceManager) and
         frees the admission slot immediately."""
-        gen = self.inference.generate_stream(req)
+        route_info: dict = {}
+        if self.fleet is not None:
+            gen = self.fleet.stream(req, route_info)
+        else:
+            gen = self.inference.generate_stream(req)
         try:
             try:
                 first = await gen.__anext__()
@@ -178,14 +198,17 @@ class ApiHTTPServer:
                 first = None
             except Exception as exc:
                 return self._map_inference_errors(exc)
-            resp = web.StreamResponse(
-                status=200,
-                headers={
-                    "Content-Type": "text/event-stream",
-                    "Cache-Control": "no-cache",
-                    "Connection": "keep-alive",
-                },
-            )
+            headers = {
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+            if route_info.get("replica"):
+                # per-replica outcome attribution for loadgen/report: the
+                # serving replica is decided by first-chunk time (fleet
+                # routing fills route_info during admission)
+                headers["x-dnet-replica"] = route_info["replica"]
+            resp = web.StreamResponse(status=200, headers=headers)
             await resp.prepare(request)
 
             async def write_chunk(chunk) -> None:
@@ -240,6 +263,17 @@ class ApiHTTPServer:
             await gen.aclose()
 
     def _map_inference_errors(self, exc: Exception):
+        from dnet_tpu.fleet.router import FleetSheddingError
+
+        if isinstance(exc, FleetSheddingError):
+            # every fleet replica shed: same client contract as a single
+            # ring's capacity shed — 429 with the soonest honest Retry-After
+            return _json_error(
+                429,
+                str(exc),
+                "rate_limit_exceeded",
+                retry_after_s=exc.retry_after_s,
+            )
         if isinstance(exc, AdmissionRejected):
             status = 503 if exc.reason == "draining" else 429
             return _json_error(
@@ -283,11 +317,22 @@ class ApiHTTPServer:
             return await self._sse(
                 request, req, lambda c: [c.model_dump_json(exclude_none=True)]
             )
+        route_info: dict = {}
         try:
-            result = await self.inference.generate(req)
+            if self.fleet is not None:
+                result = await self.fleet.generate(req, route_info)
+            else:
+                result = await self.inference.generate(req)
         except Exception as exc:
             return self._map_inference_errors(exc)
-        return web.json_response(result.model_dump(exclude_none=True))
+        headers = (
+            {"x-dnet-replica": route_info["replica"]}
+            if route_info.get("replica")
+            else None
+        )
+        return web.json_response(
+            result.model_dump(exclude_none=True), headers=headers
+        )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         """Legacy /v1/completions: raw prompt, text_completion objects."""
@@ -332,11 +377,24 @@ class ApiHTTPServer:
                 return [json.dumps(out)]
 
             return await self._sse(request, req, reshape)
+        route_info: dict = {}
         try:
-            result = await self.inference.generate_completion(req)
+            if self.fleet is not None:
+                result = await self.fleet.generate(
+                    req, route_info, method="generate_completion"
+                )
+            else:
+                result = await self.inference.generate_completion(req)
         except Exception as exc:
             return self._map_inference_errors(exc)
-        return web.json_response(result.model_dump(exclude_none=True))
+        headers = (
+            {"x-dnet-replica": route_info["replica"]}
+            if route_info.get("replica")
+            else None
+        )
+        return web.json_response(
+            result.model_dump(exclude_none=True), headers=headers
+        )
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """Mean-pooled final-hidden-state embeddings (BEYOND the reference,
@@ -677,6 +735,30 @@ class ApiHTTPServer:
             body["admission"]["quarantine"] = list(
                 body.get("quarantine") or ()
             )
+        # fleet view: per-replica health snapshots aggregated at the front
+        # door.  Serving capacity below fleet size is "degraded" (some
+        # replica is down/draining); zero serving replicas wins outright —
+        # the single-ring fields above describe only the primary
+        if self.fleet is not None:
+            replicas = [h.snapshot() for h in self.fleet.handles()]
+            serving = sum(
+                1 for h in self.fleet.handles()
+                if h.serving and getattr(h.inference, "ready", False)
+            )
+            body["fleet"] = {
+                "size": len(replicas),
+                "serving": serving,
+                "replicas": replicas,
+            }
+            if serving == 0:
+                body["status"] = "draining" if admission.draining else "degraded"
+            elif serving < len(replicas):
+                if body.get("status") == "ok":
+                    body["status"] = "degraded"
+            elif body.get("status") == "draining" and serving > 0:
+                # the PRIMARY is draining but other replicas still serve:
+                # the front door as a whole is degraded, not out
+                body["status"] = "degraded"
         return web.json_response(body)
 
     async def metrics(self, request: web.Request) -> web.Response:
@@ -746,6 +828,26 @@ class ApiHTTPServer:
                 scrape_ok.labels(peer=gone).set(0.0)
             self._scraped_peers |= current
             sections.extend(scraped)
+        # fleet mode: in-process replicas share this registry (the
+        # replica-labeled dnet_fleet_* families are already in the api
+        # section), but their admission pictures are per-replica state the
+        # registry cannot carry — synthesize one section of replica-labeled
+        # gauges so queue skew between replicas shows up in one scrape
+        if self.fleet is not None:
+            lines = [
+                "# HELP dnet_fleet_admission_slots Per-replica admission "
+                "occupancy at scrape time (fleet front door)",
+                "# TYPE dnet_fleet_admission_slots gauge",
+            ]
+            for h in self.fleet.handles():
+                snap = h.snapshot()
+                for field in ("active", "queued", "capacity"):
+                    lines.append(
+                        f'dnet_fleet_admission_slots{{replica='
+                        f'"{h.replica_id}",kind="{field}"}} '
+                        f'{float(snap["admission"][field])}'
+                    )
+            sections.append(("fleet", "\n".join(lines) + "\n"))
         # the API section LAST-built but FIRST-emitted: exposing after the
         # scrapes lets this very response carry their scrape_ok outcomes
         get_slo_tracker().snapshot()
@@ -930,6 +1032,15 @@ class ApiHTTPServer:
             # own loss); the merged view reports only this node's
             events = merge_remote_events(events, remotes)
         return web.json_response({"events": events, "dropped": dropped})
+
+    async def debug_fleet(self, request: web.Request) -> web.Response:
+        """Fleet routing introspection: the affinity table, per-replica
+        health/load snapshots, and the epoch clock — the operator's view
+        of why requests land where they land.  `{"fleet": null}` outside
+        fleet mode (DNET_FLEET unset/1), mirroring /v1/topology's shape."""
+        if self.fleet is None:
+            return web.json_response({"fleet": None})
+        return web.json_response({"fleet": self.fleet.snapshot()})
 
     async def debug_trace(self, request: web.Request) -> web.Response:
         """One request as Chrome trace-event / Perfetto JSON

@@ -158,7 +158,24 @@ async def serve_async(args) -> None:
         inference.failure_monitor = monitor
         monitor.start()
 
-    http = ApiHTTPServer(inference, model_manager, cluster_manager)
+    fleet = None
+    if s.fleet.fleet > 1:
+        # DNET_FLEET=N: the front door routes across N replicas.  The
+        # stack built above becomes replica r0; additional replicas are
+        # attached programmatically (the in-process ring harness /
+        # bench_serve --fleet is the supported multi-replica deployment —
+        # one OS process per extra ring is future work).  Unset/1 never
+        # constructs the fleet layer: the single-ring path is untouched.
+        from dnet_tpu.fleet import FleetManager
+
+        fleet = FleetManager()
+        fleet.add_replica("r0", inference)
+        log.info(
+            "fleet mode: DNET_FLEET=%d, primary registered as r0 "
+            "(attach more replicas via FleetManager.add_replica)",
+            s.fleet.fleet,
+        )
+    http = ApiHTTPServer(inference, model_manager, cluster_manager, fleet=fleet)
     await http.start(args.host, args.http_port)
 
     preload = getattr(args, "model", "") or ""

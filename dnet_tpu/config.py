@@ -428,6 +428,41 @@ class SchedSettings(_EnvGroup):
 
 
 @dataclass
+class FleetSettings(_EnvGroup):
+    """Fleet routing (dnet_tpu/fleet/): N ring replicas behind one
+    prefix-affine, least-loaded front door.
+
+    ``DNET_FLEET=N`` (N > 1) puts the FleetManager in front of
+    /v1/chat/completions: requests route prefix-affinity-first (sticking
+    a conversation to the replica holding its COW prefix blocks), then
+    least-loaded by live admission occupancy; a replica that dies
+    mid-stream fails over to a survivor via deterministic replay.  The
+    default 1 keeps today's single-ring serve path byte-identical — the
+    fleet layer is never constructed.
+    """
+
+    env_prefix = "DNET_"
+    # replica count the front door expects; 1 = no fleet layer at all
+    fleet: int = 1
+    # bounded LRU affinity table: conversations tracked before the
+    # coldest sticky entry is evicted
+    fleet_affinity_capacity: int = 512
+    # leading prefix units (text chars) hashed into the affinity key
+    fleet_affinity_prefix: int = 256
+    # migrate in-flight streams off a dead replica via replay; off =
+    # a mid-stream death surfaces as an in-band stream error instead
+    fleet_failover: bool = True
+    # emulated device-bound decode: minimum wall-clock ms per batched
+    # decode step.  On a real TPU ring the host mostly WAITS on the
+    # device, so replicas scale across hosts; a CPU-only container has
+    # no such idle time and N in-process replicas just contend for the
+    # same cores.  A nonzero pace restores the device-bound regime for
+    # fleet scaling benches (every token still crosses the full
+    # engine/KV/admission/SSE path).  0 = off, no behavior change.
+    fleet_decode_pace_ms: float = 0.0
+
+
+@dataclass
 class SanSettings(_EnvGroup):
     """Runtime concurrency sanitizer (dnet_tpu/analysis/runtime/, "dsan").
 
@@ -630,6 +665,7 @@ class Settings:
     loadgen: LoadgenSettings = field(default_factory=LoadgenSettings.from_env)
     membership: MembershipSettings = field(default_factory=MembershipSettings.from_env)
     sched: SchedSettings = field(default_factory=SchedSettings.from_env)
+    fleet: FleetSettings = field(default_factory=FleetSettings.from_env)
     san: SanSettings = field(default_factory=SanSettings.from_env)
     tp: TpSettings = field(default_factory=TpSettings.from_env)
     chaos: ChaosSettings = field(default_factory=ChaosSettings.from_env)
@@ -652,6 +688,7 @@ for _cls in (
     LoadgenSettings,
     MembershipSettings,
     SchedSettings,
+    FleetSettings,
     SanSettings,
     TpSettings,
     ChaosSettings,

@@ -1,0 +1,43 @@
+"""Fleet routing: N ring replicas behind one prefix-affine front door.
+
+The data-parallel layer above the single pipelined ring: `ReplicaHandle`
+(replica.py) wraps one full serving stack, `FleetRouter` (router.py)
+orders candidates affinity-first then least-loaded, and `FleetManager`
+(manager.py) owns lifecycle, epoch fencing, and mid-stream failover.
+`DNET_FLEET=1` (the default) bypasses all of it — the single-ring serve
+path stays byte-identical.
+"""
+
+from dnet_tpu.fleet.states import REPLICA_STATES, ROUTE_REASONS
+
+__all__ = [
+    "AffinityTable",
+    "FleetManager",
+    "FleetRouter",
+    "FleetSheddingError",
+    "ReplicaHandle",
+    "REPLICA_STATES",
+    "ROUTE_REASONS",
+]
+
+# Lazy re-exports (PEP 562): obs/_register_core imports fleet.states to
+# pre-touch the label enums, which executes this __init__ — importing
+# manager/router eagerly here would pull admission.controller back in
+# while IT is still initializing (its module-scope metric() call is what
+# entered obs in the first place).  states.py stays eager (leaf, no deps).
+_LAZY = {
+    "AffinityTable": "router",
+    "FleetManager": "manager",
+    "FleetRouter": "router",
+    "FleetSheddingError": "router",
+    "ReplicaHandle": "replica",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"dnet_tpu.fleet.{mod}"), name)

@@ -1,0 +1,365 @@
+"""FleetManager: replica lifecycle + routed dispatch + mid-stream failover.
+
+The manager owns what the router must not: the replica table, the epoch
+clock, and the dispatch loop that walks the router's candidate order.
+
+- **Lifecycle** — `add_replica` mints a topology epoch for the new
+  handle (the same `EpochClock` fencing token activation frames carry);
+  `drain` flips the replica's admission into drain (in-flight work
+  finishes, no new routes); `fail_replica` marks it dead AND re-mints
+  its fence so any dispatch through a stale handle trips the counted
+  `fleet_route` stale-epoch rejection — a zombie replica cannot serve.
+- **Dispatch** — `stream()` walks the candidate plan: a replica that
+  sheds at admission falls through to the next one; only when every
+  replica sheds does the request fail with `FleetSheddingError`
+  (HTTP 429 + the largest Retry-After any replica offered).
+- **Failover** — a replica marked dead mid-stream is abandoned between
+  chunks and the SAME request re-admitted to a survivor.  Decode is
+  deterministic under greedy/seeded sampling (the PR 4 replay
+  invariant), so the survivor regenerates the identical text and the
+  wrapper suppresses the first `emitted` characters — the client's
+  committed SSE stream continues seamlessly, no 5xx, one `failover`
+  wide event and `dnet_fleet_failovers_total` tick per migration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional, Set, Tuple
+
+from dnet_tpu.admission.controller import AdmissionRejected
+from dnet_tpu.api.schemas import ChatCompletionChunk
+from dnet_tpu.config import get_settings
+from dnet_tpu.fleet.replica import ReplicaHandle
+from dnet_tpu.fleet.router import FleetRouter, FleetSheddingError
+from dnet_tpu.fleet.states import (
+    REPLICA_STATES,
+    ROUTE_AFFINITY,
+    ROUTE_FAILOVER,
+    STATE_ACTIVE,
+    STATE_DEAD,
+    STATE_DRAINING,
+    STATE_QUARANTINED,
+)
+from dnet_tpu.membership.epoch import EpochClock, is_stale, reject
+from dnet_tpu.obs import metric
+from dnet_tpu.obs.events import log_event
+from dnet_tpu.obs.phases import EVENT_FAILOVER, EVENT_ROUTED
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+
+class _ReplicaLost(Exception):
+    """Internal: the serving replica was fenced mid-stream."""
+
+
+class FleetManager:
+    def __init__(
+        self,
+        router: Optional[FleetRouter] = None,
+        failover: Optional[bool] = None,
+    ) -> None:
+        s = get_settings().fleet
+        self.router = router or FleetRouter(
+            affinity_capacity=s.fleet_affinity_capacity,
+            prefix_units=s.fleet_affinity_prefix,
+        )
+        self.failover_enabled = (
+            bool(s.fleet_failover) if failover is None else bool(failover)
+        )
+        self.clock = EpochClock()
+        self._handles: Dict[str, ReplicaHandle] = {}
+
+    # ---- lifecycle ------------------------------------------------------
+    def add_replica(self, replica_id: str, inference: Any) -> ReplicaHandle:
+        if replica_id in self._handles:
+            raise ValueError(f"duplicate replica id {replica_id!r}")
+        handle = ReplicaHandle(replica_id, inference, epoch=self.clock.mint())
+        self._handles[replica_id] = handle
+        self._sync_gauges()
+        log.info("fleet: replica %s added (epoch %d)", replica_id, handle.epoch)
+        return handle
+
+    def drain(self, replica_id: str) -> ReplicaHandle:
+        handle = self._handles[replica_id]
+        handle.state = STATE_DRAINING
+        handle.inference.admission.begin_drain()
+        self._sync_gauges()
+        return handle
+
+    def quarantine(self, replica_id: str) -> ReplicaHandle:
+        """Membership flagged the replica's ring (recovery in progress):
+        no new routes until `activate` — a recovering ring is just a
+        drained replica to the router."""
+        handle = self._handles[replica_id]
+        handle.state = STATE_QUARANTINED
+        self._sync_gauges()
+        return handle
+
+    def activate(self, replica_id: str) -> ReplicaHandle:
+        """Return a quarantined/drained replica to service under a FRESH
+        epoch, so frames minted before the outage stay fenced."""
+        handle = self._handles[replica_id]
+        handle.state = STATE_ACTIVE
+        handle.epoch = handle.fence = self.clock.mint()
+        self._sync_gauges()
+        return handle
+
+    def fail_replica(self, replica_id: str) -> ReplicaHandle:
+        """Mark the replica dead and fence it: its affinity entries are
+        evicted and its handle's fence re-minted, so in-flight streams
+        migrate at their next chunk and zombie dispatches are rejected."""
+        handle = self._handles[replica_id]
+        handle.state = STATE_DEAD
+        handle.fence = self.clock.mint()
+        evicted = self.router.affinity.evict_replica(replica_id)
+        self._sync_gauges()
+        log.warning(
+            "fleet: replica %s marked dead (fence %d, %d affinity entries evicted)",
+            replica_id, handle.fence, evicted,
+        )
+        return handle
+
+    def remove(self, replica_id: str) -> None:
+        self._handles.pop(replica_id, None)
+        self.router.affinity.evict_replica(replica_id)
+        self._sync_gauges()
+
+    def handles(self) -> List[ReplicaHandle]:
+        return list(self._handles.values())
+
+    def get(self, replica_id: str) -> Optional[ReplicaHandle]:
+        return self._handles.get(replica_id)
+
+    def __len__(self) -> int:
+        return len(self._handles)
+
+    def _sync_gauges(self) -> None:
+        counts = {state: 0 for state in REPLICA_STATES}
+        for handle in self._handles.values():
+            counts[handle.state] += 1
+        fam = metric("dnet_fleet_replicas")
+        for state, n in counts.items():
+            fam.labels(state=state).set(float(n))
+
+    # ---- introspection --------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The /v1/debug/fleet body: routing table + per-replica health."""
+        return {
+            "size": len(self._handles),
+            "epoch": self.clock.current,
+            "failover": self.failover_enabled,
+            "replicas": [h.snapshot() for h in self._handles.values()],
+            "affinity": {
+                "entries": len(self.router.affinity),
+                "capacity": self.router.affinity.capacity,
+                "table": self.router.affinity.snapshot(),
+            },
+        }
+
+    # ---- dispatch -------------------------------------------------------
+    def check_fence(self, handle: ReplicaHandle) -> None:
+        """Raise the counted stale-epoch rejection on a zombie dispatch."""
+        if is_stale(handle.fence, handle.epoch):
+            raise reject("fleet_route", handle.fence, handle.epoch)
+
+    def _record_route(
+        self,
+        key: str,
+        handle: ReplicaHandle,
+        reason: str,
+        route_info: Optional[Dict[str, str]],
+    ) -> None:
+        metric("dnet_fleet_requests_total").labels(
+            replica=handle.replica_id
+        ).inc()
+        metric("dnet_fleet_routed_total").labels(reason=reason).inc()
+        if reason == ROUTE_AFFINITY:
+            metric("dnet_fleet_affinity_hits_total").inc()
+        log_event(EVENT_ROUTED, replica=handle.replica_id, reason=reason, key=key)
+        self.router.record(key, handle.replica_id)
+        if route_info is not None:
+            route_info["replica"] = handle.replica_id
+            route_info["reason"] = reason
+
+    async def _acquire(
+        self, req: Any, key: str, exclude: Set[str] = frozenset()
+    ) -> Tuple[ReplicaHandle, AsyncIterator[ChatCompletionChunk], Optional[ChatCompletionChunk], str]:
+        """Walk the candidate plan until one replica admits the request:
+        returns (handle, generator, first chunk, reason).  Admission
+        happens on the generator's first __anext__, so a shed costs
+        nothing downstream and falls through to the next candidate."""
+        candidates = [h for h in self.handles() if h.replica_id not in exclude]
+        plan = self.router.plan(key, candidates)
+        retry_after_s = 1.0
+        for handle, reason in plan:
+            self.check_fence(handle)
+            gen = handle.inference.generate_stream(req)
+            try:
+                first = await gen.__anext__()
+            except AdmissionRejected as exc:
+                retry_after_s = max(retry_after_s, exc.retry_after_s)
+                await gen.aclose()
+                continue
+            except StopAsyncIteration:
+                first = None
+            return handle, gen, first, reason
+        raise FleetSheddingError(
+            f"all {len(plan)} fleet replicas shed the request", retry_after_s
+        )
+
+    async def _failover(
+        self, req: Any, key: str, victim: ReplicaHandle, emitted: int
+    ) -> Tuple[ReplicaHandle, AsyncIterator[ChatCompletionChunk], Optional[ChatCompletionChunk]]:
+        chosen, gen, first, _reason = await self._acquire(
+            req, key, exclude={victim.replica_id}
+        )
+        metric("dnet_fleet_failovers_total").inc()
+        metric("dnet_fleet_requests_total").labels(
+            replica=chosen.replica_id
+        ).inc()
+        metric("dnet_fleet_routed_total").labels(reason=ROUTE_FAILOVER).inc()
+        log_event(
+            EVENT_FAILOVER,
+            victim=victim.replica_id,
+            survivor=chosen.replica_id,
+            emitted_chars=int(emitted),
+        )
+        self.router.record(key, chosen.replica_id)
+        log.warning(
+            "fleet: failover %s -> %s after %d emitted chars",
+            victim.replica_id, chosen.replica_id, emitted,
+        )
+        return chosen, gen, first
+
+    async def stream(
+        self, req: Any, route_info: Optional[Dict[str, str]] = None
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        """The routed form of `InferenceManager.generate_stream`.
+
+        Yields the serving replica's chunks; when that replica is marked
+        dead mid-stream, replays the request on a survivor and suppresses
+        the already-emitted prefix of the regenerated text."""
+        key = self.router.affinity_key(req)
+        chosen, gen, pending, reason = await self._acquire(req, key)
+        self._record_route(key, chosen, reason, route_info)
+        stream_id: Optional[str] = None
+        emitted = 0        # content chars the client has seen
+        skip = 0           # replay chars still to suppress after failover
+        sent_role = False
+        replaying = False
+        try:
+            while True:
+                if pending is None:
+                    try:
+                        if chosen.state == STATE_DEAD:
+                            raise _ReplicaLost()
+                        pending = await gen.__anext__()
+                        if chosen.state == STATE_DEAD and pending.usage is None:
+                            # token minted by a replica fenced this tick:
+                            # drop it and migrate (final chunks pass — the
+                            # stream finished before the fence mattered)
+                            raise _ReplicaLost()
+                    except StopAsyncIteration:
+                        return
+                    except _ReplicaLost:
+                        pending = None
+                        if not self.failover_enabled:
+                            raise FleetSheddingError(
+                                f"replica {chosen.replica_id} died mid-stream "
+                                f"(failover disabled)"
+                            ) from None
+                        await gen.aclose()
+                        chosen, gen, pending = await self._failover(
+                            req, key, chosen, emitted
+                        )
+                        skip = emitted
+                        replaying = True
+                        continue
+                chunk = pending
+                pending = None
+                choice = chunk.choices[0] if chunk.choices else None
+                delta = choice.delta if choice is not None else None
+                content = (delta.content or "") if delta is not None else ""
+                final = chunk.usage is not None or (
+                    choice is not None and choice.finish_reason is not None
+                )
+                if skip > 0 and not final:
+                    if len(content) <= skip:
+                        skip -= len(content)
+                        continue
+                    content = content[skip:]
+                    skip = 0
+                    if delta is not None:
+                        delta.content = content
+                elif skip > 0 and final:
+                    # the replay produced no more text than the client
+                    # already has: pass the terminal chunk through as-is
+                    skip = 0
+                if replaying and delta is not None and sent_role:
+                    delta.role = None
+                if stream_id is None:
+                    stream_id = chunk.id
+                elif chunk.id != stream_id:
+                    chunk.id = stream_id
+                if delta is not None and delta.role:
+                    sent_role = True
+                emitted += len(content)
+                yield chunk
+        finally:
+            if gen is not None:
+                await gen.aclose()
+
+    async def generate(
+        self,
+        req: Any,
+        route_info: Optional[Dict[str, str]] = None,
+        method: str = "generate",
+    ) -> Any:
+        """The routed form of the non-streaming entry points (`generate`
+        or `generate_completion`): same candidate walk; a replica dying
+        mid-request retries whole on the next survivor (no partial
+        output was visible)."""
+        key = self.router.affinity_key(req)
+        excluded: Set[str] = set()
+        retry_after_s = 1.0
+        failed_over = False
+        while True:
+            candidates = [
+                h for h in self.handles() if h.replica_id not in excluded
+            ]
+            plan = self.router.plan(key, candidates)
+            admitted_none = True
+            for handle, reason in plan:
+                self.check_fence(handle)
+                try:
+                    resp = await getattr(handle.inference, method)(req)
+                except AdmissionRejected as exc:
+                    retry_after_s = max(retry_after_s, exc.retry_after_s)
+                    continue
+                except Exception:
+                    if handle.state == STATE_DEAD and self.failover_enabled:
+                        excluded.add(handle.replica_id)
+                        metric("dnet_fleet_failovers_total").inc()
+                        log_event(
+                            EVENT_FAILOVER,
+                            victim=handle.replica_id,
+                            survivor="",
+                            emitted_chars=0,
+                        )
+                        failed_over = True
+                        admitted_none = False
+                        break
+                    raise
+                self._record_route(
+                    key,
+                    handle,
+                    ROUTE_FAILOVER if failed_over else reason,
+                    route_info,
+                )
+                return resp
+            if admitted_none:
+                raise FleetSheddingError(
+                    f"all {len(plan)} fleet replicas shed the request",
+                    retry_after_s,
+                )

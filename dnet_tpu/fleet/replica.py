@@ -1,0 +1,76 @@
+"""One fleet replica: a full serving stack behind a routable handle.
+
+A `ReplicaHandle` wraps an `InferenceManager` (and through it the whole
+adapter stack — local engine or pipelined ring) the way the router needs
+to see it: a lifecycle state, an epoch fence, and a live load/health
+snapshot built from the same signals the single-ring server already
+exposes — admission queue depth and service-rate EMA (admission/
+controller.py), readiness, and drain state.  The handle owns no
+lifecycle itself; `FleetManager` transitions `state` and mints epochs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from dnet_tpu.fleet.states import STATE_ACTIVE
+
+
+class ReplicaHandle:
+    """A routable replica: id + inference stack + state + epoch fence.
+
+    `epoch` is minted by the FleetManager's EpochClock at activation and
+    never changes; `fence` is the epoch this slot currently honors — the
+    manager re-mints it when the replica dies, so `is_stale(fence,
+    epoch)` trips on any dispatch through a zombie handle (the same
+    fencing token activation frames carry, membership/epoch.py).
+    """
+
+    def __init__(self, replica_id: str, inference: Any, epoch: int = 0) -> None:
+        self.replica_id = str(replica_id)
+        self.inference = inference
+        self.state = STATE_ACTIVE
+        self.epoch = int(epoch)
+        self.fence = int(epoch)
+
+    # ---- routing signals ------------------------------------------------
+    @property
+    def admission(self):
+        return self.inference.admission
+
+    @property
+    def serving(self) -> bool:
+        """Eligible for new routes: active and not draining admission."""
+        return self.state == STATE_ACTIVE and not self.admission.draining
+
+    def load_score(self) -> Tuple[float, float]:
+        """Least-loaded sort key: (occupancy, estimated queue wait).
+
+        Occupancy is live slots+waiters over capacity — the admission
+        picture right now; the estimated wait (service-time EMA x queue
+        position, the Retry-After math) breaks occupancy ties toward the
+        replica with more SLO headroom, i.e. the faster queue."""
+        adm = self.admission
+        occupancy = (adm.active + adm.queued) / max(1, adm.capacity)
+        return (occupancy, adm.estimated_wait_s(adm.queued))
+
+    # ---- introspection --------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The per-replica health/load block /health and /v1/debug/fleet
+        aggregate — the federation-style signals, one level up."""
+        adm = self.admission
+        occupancy, est_wait_s = self.load_score()
+        return {
+            "replica": self.replica_id,
+            "state": self.state,
+            "epoch": self.epoch,
+            "ready": bool(getattr(self.inference, "ready", False)),
+            "admission": {
+                "active": adm.active,
+                "queued": adm.queued,
+                "capacity": adm.capacity,
+                "draining": adm.draining,
+            },
+            "load": round(occupancy, 4),
+            "est_wait_s": round(est_wait_s, 4),
+        }

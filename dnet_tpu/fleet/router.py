@@ -1,0 +1,137 @@
+"""Fleet routing policy: prefix-affinity first, then least-loaded.
+
+The front door's whole job is choosing which ring replica serves a
+request, and the order matters:
+
+1. **Prefix affinity** — hash the conversation's leading prefix
+   (`kv.prefix.prefix_affinity_key`: turn N+1 of a conversation starts
+   with turn N's first message, so the turns collide) and stick the
+   session to the replica whose paged pool already holds the shared COW
+   prefix blocks.  A cache hit there skips the whole shared-history
+   prefill; routing elsewhere silently re-pays it.  The table is a
+   bounded LRU; entries pointing at a lost replica are evicted so a
+   restarted conversation re-routes by load.
+2. **Least-loaded** — no sticky entry (or its replica stopped serving):
+   lowest live admission occupancy wins, with the estimated queue wait
+   (the service-rate EMA behind Retry-After) breaking ties toward the
+   replica with more SLO headroom.
+
+`plan()` returns the FULL candidate order, not one winner: the caller
+walks it so a replica that sheds at admission falls through to the next
+one, and only when every replica sheds does the request fail — with the
+typed `FleetSheddingError` the HTTP layer maps to 429 + Retry-After.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from dnet_tpu.api.inference import InferenceError
+from dnet_tpu.api.schemas import ChatCompletionRequest, CompletionRequest
+from dnet_tpu.fleet.replica import ReplicaHandle
+from dnet_tpu.fleet.states import ROUTE_AFFINITY, ROUTE_LEAST_LOADED
+from dnet_tpu.kv.prefix import prefix_affinity_key
+
+
+class FleetSheddingError(InferenceError):
+    """Every replica shed the request at admission.  Carries the largest
+    Retry-After any replica offered — the soonest ANY slot should open —
+    so the HTTP layer answers 429 with an honest backoff."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class AffinityTable:
+    """Bounded LRU of prefix-hash -> replica_id.
+
+    Insertion refreshes recency; capacity overflow evicts the coldest
+    conversation (its prefix blocks were the likeliest already evicted
+    from the replica's pool too).  `evict_replica` drops every entry
+    pointing at a lost replica — affinity must never outlive the cache
+    it points at."""
+
+    def __init__(self, capacity: int = 512) -> None:
+        self.capacity = max(int(capacity), 1)
+        self._map: "OrderedDict[str, str]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def get(self, key: str) -> Optional[str]:
+        rid = self._map.get(key)
+        if rid is not None:
+            self._map.move_to_end(key)
+        return rid
+
+    def put(self, key: str, replica_id: str) -> None:
+        self._map[key] = replica_id
+        self._map.move_to_end(key)
+        while len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+
+    def evict_replica(self, replica_id: str) -> int:
+        stale = [k for k, v in self._map.items() if v == replica_id]
+        for k in stale:
+            del self._map[k]
+        return len(stale)
+
+    def snapshot(self) -> Dict[str, str]:
+        return dict(self._map)
+
+
+class FleetRouter:
+    """The routing decision, separated from replica lifecycle (manager.py)
+    so the policy is unit-testable on fake handles."""
+
+    def __init__(self, affinity_capacity: int = 512, prefix_units: int = 256) -> None:
+        self.affinity = AffinityTable(affinity_capacity)
+        self.prefix_units = max(int(prefix_units), 1)
+
+    def affinity_key(
+        self, req: Union[ChatCompletionRequest, CompletionRequest]
+    ) -> str:
+        """The conversation identity: the FIRST message's leading text
+        (chat — every later turn of the conversation still starts with
+        it) or the prompt head (completions)."""
+        if isinstance(req, ChatCompletionRequest):
+            text = req.messages[0].text()
+        else:
+            p = req.prompt
+            text = p if isinstance(p, str) else (p[0] if p else "")
+        return prefix_affinity_key(text, self.prefix_units)
+
+    def plan(
+        self, key: str, handles: Sequence[ReplicaHandle]
+    ) -> List[Tuple[ReplicaHandle, str]]:
+        """Ordered (replica, reason) candidates for one request.
+
+        Affinity target first when it is still serving (a stale entry —
+        replica gone or not serving — is evicted instead); the rest
+        least-loaded.  Raises `FleetSheddingError` only when NO replica
+        is serving at all; per-replica admission sheds are the caller's
+        walk-the-list business."""
+        serving = [h for h in handles if h.serving]
+        if not serving:
+            raise FleetSheddingError("no serving replica in the fleet")
+        by_id = {h.replica_id: h for h in serving}
+        plan: List[Tuple[ReplicaHandle, str]] = []
+        sticky = self.affinity.get(key)
+        if sticky is not None:
+            if sticky in by_id:
+                plan.append((by_id[sticky], ROUTE_AFFINITY))
+            else:
+                self.affinity.evict_replica(sticky)
+        rest = sorted(
+            (h for h in serving if not plan or h is not plan[0][0]),
+            key=lambda h: (h.load_score(), h.replica_id),
+        )
+        plan.extend((h, ROUTE_LEAST_LOADED) for h in rest)
+        return plan
+
+    def record(self, key: str, replica_id: str) -> None:
+        """Stick the conversation to the replica that just served it —
+        its pool now holds the prefix blocks the next turn reuses."""
+        self.affinity.put(key, replica_id)

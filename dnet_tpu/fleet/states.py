@@ -1,0 +1,49 @@
+"""Declared label sets for the fleet-routing metric families.
+
+A LEAF module (like admission/reasons.py and membership/epoch.py):
+imported by `dnet_tpu.obs` to pre-touch one labeled series per value and
+by the metrics lint (pass DL031), which cross-checks the exposed label
+sets against these tuples BOTH directions — a new replica state or
+routing reason cannot ship without its series, and a renamed one cannot
+strand a stale label on dashboards.  Keep this module import-light so
+obs can pull the enums without a cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+# dnet_fleet_replicas{state=}: one gauge per lifecycle state, counting the
+# replicas currently in it (fleet/manager.py syncs on every transition).
+#   active      — serving; eligible for routing
+#   draining    — admission drains in-flight work; no new routes
+#   quarantined — membership flagged the ring (recovery in progress); a
+#                 recovering ring is just a drained replica to the router
+#   dead        — failed or removed; epoch-fenced so a zombie cannot serve
+STATE_ACTIVE = "active"
+STATE_DRAINING = "draining"
+STATE_QUARANTINED = "quarantined"
+STATE_DEAD = "dead"
+REPLICA_STATES: Tuple[str, ...] = (
+    STATE_ACTIVE,
+    STATE_DRAINING,
+    STATE_QUARANTINED,
+    STATE_DEAD,
+)
+
+# dnet_fleet_routed_total{reason=}: why the front door picked the replica
+# it picked (fleet/router.py policy order, checked in exactly this order).
+#   affinity     — the affinity table pinned this conversation's prefix to
+#                  the replica holding its COW prefix blocks
+#   least_loaded — no sticky entry (or its replica is gone): lowest live
+#                  admission load + estimated queue wait wins
+#   failover     — the original replica died mid-request; a survivor
+#                  re-served it via deterministic replay
+ROUTE_AFFINITY = "affinity"
+ROUTE_LEAST_LOADED = "least_loaded"
+ROUTE_FAILOVER = "failover"
+ROUTE_REASONS: Tuple[str, ...] = (
+    ROUTE_AFFINITY,
+    ROUTE_LEAST_LOADED,
+    ROUTE_FAILOVER,
+)

@@ -24,7 +24,8 @@ drops them.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+import hashlib
+from typing import List, Optional, Sequence, Tuple, Union
 
 from dnet_tpu.core.prefix_cache import PrefixIndex
 from dnet_tpu.kv.paged import BlockPool, KVPoolExhausted
@@ -32,6 +33,26 @@ from dnet_tpu.kv.store import BlockStore
 from dnet_tpu.utils.logger import get_logger
 
 log = get_logger()
+
+
+def prefix_affinity_key(prefix: Union[str, Sequence[int]], n_units: int = 256) -> str:
+    """Stable hash of a conversation's leading prefix units.
+
+    The fleet front door (fleet/router.py) keys its affinity table on
+    this: two requests that share a prompt prefix — turn N and turn N+1
+    of one conversation — hash to the same key, so the router can stick
+    them to the replica whose pool already holds the shared blocks.  The
+    front door has no tokenizer, so it hashes the first `n_units`
+    text characters (or token ids when the caller has them — the same
+    leading-run identity `PrefixIndex.lookup` matches on).
+    """
+    if isinstance(prefix, str):
+        raw = prefix[:n_units].encode("utf-8", errors="replace")
+    else:
+        raw = b"\x00".join(
+            str(int(t)).encode("ascii") for t in list(prefix)[:n_units]
+        )
+    return hashlib.sha256(raw).hexdigest()[:16]
 
 
 class PagedPrefixCache:

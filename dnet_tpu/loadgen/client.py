@@ -79,6 +79,9 @@ class RequestOutcome:
     # into /v1/debug/events?rid= and /v1/debug/timeline/{rid} — a failed
     # row's rid is a one-hop postmortem lookup, not a log grep
     rid: str = ""
+    # serving replica (fleet front door stamps `x-dnet-replica` on every
+    # routed response) — empty on single-ring runs, where no header exists
+    replica: str = ""
     itl_ms: List[float] = field(default_factory=list)  # inter-token gaps
     # per-request segment ledger from the final chunk's profile metrics
     # (obs/critical_path.py decompose) — server-side attribution riding
@@ -104,6 +107,8 @@ class RequestOutcome:
                 d["retry_after_s"] = self.retry_after_s
         if self.rid:
             d["rid"] = self.rid
+        if self.replica:
+            d["replica"] = self.replica
         if self.error:
             d["error"] = self.error[:200]
         if self.finish_reason:
@@ -165,6 +170,7 @@ async def _drive(session, planned, model, path, out: RequestOutcome) -> None:
     resp = await session.post(path, json=chat_body(planned, model))
     try:
         out.status = resp.status
+        out.replica = resp.headers.get("x-dnet-replica", "")
         if resp.status != 200:
             out.shed = resp.status in SHED_STATUSES
             try:

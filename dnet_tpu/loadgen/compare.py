@@ -215,7 +215,15 @@ def compare_records(
     """Full comparison: match legs, diff each pair, evaluate the gates.
 
     ``leg`` restricts to one named leg (must exist in both).  Gates run
-    against every matched leg — a regression in ANY leg fails."""
+    against every matched leg — a regression in ANY leg fails.  Rules
+    whose path starts with ``comparison.`` are RECORD-level: they gate
+    the multi-leg record's own cross-leg summary (e.g. the fleet
+    record's ``comparison.goodput_ratio``, the 2-replica/1-replica
+    scaling multiple) instead of being looked up inside each leg."""
+    leg_rules = tuple(
+        r for r in rules if not r.path.startswith("comparison.")
+    )
+    record_rules = tuple(r for r in rules if r.path.startswith("comparison."))
     o_legs, n_legs = legs(old), legs(new)
     if leg is not None:
         if leg not in o_legs or leg not in n_legs:
@@ -230,11 +238,16 @@ def compare_records(
     legs_out = {}
     for name in matched:
         d = diff_leg(o_legs[name], n_legs[name])
-        for rule in rules:
+        for rule in leg_rules:
             v = rule_violation(rule, o_legs[name], n_legs[name])
             if v is not None:
                 violations.append(f"[{name or 'report'}] {v}")
         legs_out[name or "report"] = d
+    if matched:
+        for rule in record_rules:
+            v = rule_violation(rule, old, new)
+            if v is not None:
+                violations.append(f"[record] {v}")
     return {
         "legs": legs_out,
         "unmatched_old": sorted(set(o_legs) - set(n_legs)),

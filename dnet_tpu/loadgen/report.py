@@ -174,6 +174,64 @@ def _critical_path_summary(completed: List[RequestOutcome]) -> dict:
     }
 
 
+def _fleet_summary(
+    measured: List[RequestOutcome],
+    completed: List[RequestOutcome],
+    shed: List[RequestOutcome],
+    failed: List[RequestOutcome],
+    window_s: float,
+    after: Optional[Dict[str, float]],
+    before: Optional[Dict[str, float]],
+) -> Optional[dict]:
+    """Per-replica breakdown of a fleet-routed run (ISSUE: fleet section).
+
+    Rows are attributed via the `x-dnet-replica` header the front door
+    stamps; the routing counters (`dnet_fleet_*`) ride next to them so a
+    disagreement between header attribution and the router's own ledger
+    is visible in the artifact.  Returns None when the run never touched
+    a fleet (no row carries a replica and no fleet counter moved) so
+    single-ring reports stay byte-identical.
+    """
+    replicas = sorted({o.replica for o in measured if o.replica})
+    counters = {}
+    if after is not None:
+        for key in ("affinity_hits", "failovers"):
+            d = metric_delta(after, before, f"dnet_fleet_{key}_total")
+            if d:
+                counters[key] = int(d)
+        for reason in ("affinity", "least_loaded", "failover"):
+            d = metric_delta(
+                after, before,
+                f'dnet_fleet_routed_total{{reason="{reason}"}}',
+            )
+            if d:
+                counters.setdefault("routed_by_reason", {})[reason] = int(d)
+    if not replicas and not counters:
+        return None
+    per_replica = {}
+    for rid in replicas:
+        mine = [o for o in completed if o.replica == rid]
+        tokens = sum(o.tokens_out for o in mine)
+        per_replica[rid] = {
+            "completed": len(mine),
+            "shed": sum(1 for o in shed if o.replica == rid),
+            "failed": sum(1 for o in failed if o.replica == rid),
+            "tokens_out": tokens,
+            "tok_s": round(tokens / window_s, 2),
+        }
+    routed = sum(
+        (counters.get("routed_by_reason") or {}).values()
+    )
+    hits = counters.get("affinity_hits", 0)
+    return {
+        "replicas": per_replica,
+        "counters": counters,
+        # fraction of routed requests served by their sticky replica —
+        # the prefix-affinity effectiveness number for the bench gate
+        "affinity_hit_rate": round(hits / routed, 4) if routed else 0.0,
+    }
+
+
 def _rel_gap(report_v: float, live_v: float) -> float:
     base = max(abs(live_v), 1e-9)
     return round((report_v - live_v) / base, 4)
@@ -291,6 +349,13 @@ def build_report(
             "attained": not slo.get("burning"),
             "burning": slo.get("burning", []),
         }
+
+    fleet = _fleet_summary(
+        measured, completed, shed, failed, window_s,
+        metrics_after, metrics_before,
+    )
+    if fleet is not None:
+        report["fleet"] = fleet
 
     if metrics_after is not None:
         report["phase_attribution"] = _phase_summary(

@@ -30,6 +30,7 @@ STALE_EPOCH_KINDS: Tuple[str, ...] = (
     "frame",        # shard ingress rejected an activation/relay frame
     "token_cb",     # API rejected a token callback minted under an old epoch
     "reset_cache",  # shard rejected a reset RPC from a different epoch
+    "fleet_route",  # fleet router fenced a dispatch to a zombie replica
 )
 
 # How a recovery round (failure re-solve or rejoin re-solve) ended.

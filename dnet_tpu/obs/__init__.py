@@ -506,6 +506,45 @@ def _register_core(reg: MetricsRegistry) -> None:
         "(1.0 = saturated tick; sched/flight.py)",
         buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
     )
+    # fleet routing (dnet_tpu/fleet/, DNET_FLEET=N): replica-state and
+    # routing-reason label sets are DECLARED in fleet/states.py (leaf) and
+    # cross-checked both ways by the metrics lint (pass DL031).  The
+    # `replica` label is operator-assigned (r0, r1, ...) — dynamic, so no
+    # pre-touch loop; the enum-valued families below get one.
+    from dnet_tpu.fleet.states import REPLICA_STATES, ROUTE_REASONS
+
+    reg.counter(
+        "dnet_fleet_requests_total",
+        "Requests the fleet front door dispatched, by serving replica "
+        "(fleet/manager.py; replica ids are deployment-assigned)",
+        labelnames=("replica",),
+    )
+    routed_fam = reg.counter(
+        "dnet_fleet_routed_total",
+        "Routing decisions by policy reason "
+        "(fleet/states.py ROUTE_REASONS; fleet/router.py)",
+        labelnames=("reason",),
+    )
+    for reason in ROUTE_REASONS:
+        routed_fam.labels(reason=reason)  # pre-touch: the lint checks these
+    reg.counter(
+        "dnet_fleet_affinity_hits_total",
+        "Requests routed by a sticky prefix-affinity entry to the replica "
+        "holding their COW prefix blocks (fleet/router.py)",
+    )
+    reg.counter(
+        "dnet_fleet_failovers_total",
+        "In-flight requests migrated off a dead replica to a survivor "
+        "via deterministic replay (fleet/manager.py)",
+    )
+    replicas_fam = reg.gauge(
+        "dnet_fleet_replicas",
+        "Fleet replicas by lifecycle state "
+        "(fleet/states.py REPLICA_STATES; fleet/manager.py)",
+        labelnames=("state",),
+    )
+    for state in REPLICA_STATES:
+        replicas_fam.labels(state=state)  # pre-touch: the lint checks these
 
 
 def _ensure_core() -> None:

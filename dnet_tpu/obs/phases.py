@@ -120,6 +120,10 @@ TP_OPS = ("all_reduce", "all_gather")
 #   resumed          — a mid-decode failure was transparently replayed
 #   recovery_round   — one auto-recovery re-solve round ended (outcome)
 #   epoch_fenced     — a stale-epoch message was fenced out (kind)
+#   routed           — the fleet front door chose a replica for a request
+#                      (replica + routing reason attached — fleet/router.py)
+#   failover         — in-flight work moved from a dead replica to a
+#                      survivor mid-stream (victim/survivor attached)
 EVENT_REQUEST_COMPLETE = "request_complete"
 EVENT_ADMITTED = "admitted"
 EVENT_SHED = "shed"
@@ -127,6 +131,8 @@ EVENT_PREEMPTED = "preempted"
 EVENT_RESUMED = "resumed"
 EVENT_RECOVERY_ROUND = "recovery_round"
 EVENT_EPOCH_FENCED = "epoch_fenced"
+EVENT_ROUTED = "routed"
+EVENT_FAILOVER = "failover"
 EVENT_NAMES = (
     EVENT_REQUEST_COMPLETE,
     EVENT_ADMITTED,
@@ -135,4 +141,6 @@ EVENT_NAMES = (
     EVENT_RESUMED,
     EVENT_RECOVERY_ROUND,
     EVENT_EPOCH_FENCED,
+    EVENT_ROUTED,
+    EVENT_FAILOVER,
 )

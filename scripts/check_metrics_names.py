@@ -34,6 +34,7 @@ from dnet_tpu.analysis.metrics_checks import (  # noqa: E402,F401 — re-exporte
     check_attribution_labels,
     check_chaos_points,
     check_federation,
+    check_fleet_labels,
     check_membership_labels,
     check_paged_conservation,
     check_registry,

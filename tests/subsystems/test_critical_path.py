@@ -405,6 +405,24 @@ def test_compare_records_violations_and_critical_path_diff():
     assert all(e["delta"] == 0 for e in d["metrics"].values())
 
 
+def test_compare_records_record_level_comparison_rules():
+    """`comparison.*` rules gate the multi-leg record's own cross-leg
+    summary (the fleet record's goodput ratio / failover 5xx count), not
+    a per-leg lookup — and a ratio that IMPROVED never trips."""
+    old = {"one": _report(50, 100), "two": _report(95, 100),
+           "comparison": {"goodput_ratio": 1.9, "failover_http_5xx": 0}}
+    new_bad = {"one": _report(50, 100), "two": _report(60, 100),
+               "comparison": {"goodput_ratio": 1.2, "failover_http_5xx": 2}}
+    rules = (parse_fail_rule("comparison.goodput_ratio=-10%"),
+             parse_fail_rule("comparison.failover_http_5xx=+0"))
+    res = compare_records(old, new_bad, rules=rules)
+    assert len(res["violations"]) == 2
+    assert all(v.startswith("[record]") for v in res["violations"])
+    new_ok = {"one": _report(50, 100), "two": _report(99, 100),
+              "comparison": {"goodput_ratio": 1.98, "failover_http_5xx": 0}}
+    assert compare_records(old, new_ok, rules=rules)["ok"] is True
+
+
 def test_bench_compare_cli_exit_codes(tmp_path, capsys):
     from scripts.bench_compare import main
 

@@ -1,0 +1,496 @@
+"""Fleet front door: routing policy units + 2-replica acceptance.
+
+Policy units run on fake handles (no JAX): affinity-then-least-loaded
+candidate order, LRU / replica-loss eviction, the typed all-shedding
+error, zombie fencing, and the mid-stream failover replay splice.
+
+The acceptance tests drive TWO full serving stacks (tiny model, CPU)
+behind one FleetManager through the real HTTP surface: both replicas
+serve a seeded burst, a mid-burst kill fails over with zero 5xx, turn 2
+of a conversation sticks to the prefix-holding replica, and DNET_FLEET
+unset keeps the single-ring SSE stream byte-identical (no fleet header,
+no fleet wrapper).
+"""
+
+import asyncio
+import json
+import re
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dnet_tpu.admission.controller import AdmissionRejected
+from dnet_tpu.api.http import ApiHTTPServer
+from dnet_tpu.api.inference import InferenceManager
+from dnet_tpu.api.model_manager import LocalModelManager
+from dnet_tpu.api.schemas import (
+    ChatChoiceDelta,
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatStreamChoice,
+    Usage,
+)
+from dnet_tpu.fleet import (
+    AffinityTable,
+    FleetManager,
+    FleetRouter,
+    FleetSheddingError,
+)
+from dnet_tpu.fleet.states import (
+    ROUTE_AFFINITY,
+    ROUTE_LEAST_LOADED,
+    STATE_DEAD,
+)
+from dnet_tpu.membership.epoch import StaleEpochError
+from dnet_tpu.obs import metric, reset_obs
+
+pytestmark = [pytest.mark.api, pytest.mark.http]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------- fakes
+
+
+class FakeAdmission:
+    def __init__(self, active=0, queued=0, capacity=4):
+        self.active = active
+        self.queued = queued
+        self.capacity = capacity
+        self.draining = False
+
+    def estimated_wait_s(self, position):
+        return 0.25 * (position + 1)
+
+    def begin_drain(self):
+        self.draining = True
+
+
+class FakeInference:
+    """Scripted replica stack: sheds at admission, or streams `chunks`."""
+
+    ready = True
+
+    def __init__(self, *, shed=False, chunks=None, retry_after=1.0,
+                 active=0, queued=0, capacity=4):
+        self.admission = FakeAdmission(active, queued, capacity)
+        self.shed = shed
+        self.chunks = chunks or []
+        self.retry_after = retry_after
+        self.streams_started = 0
+
+    def generate_stream(self, req):
+        async def gen():
+            if self.shed:
+                raise AdmissionRejected(
+                    "queue_full", "queue full", self.retry_after
+                )
+            self.streams_started += 1
+            for c in self.chunks:
+                yield c.model_copy(deep=True)
+
+        return gen()
+
+
+def chunk(cid, text=None, role=None, finish=None, usage=None):
+    delta = ChatChoiceDelta()
+    if role is not None:
+        delta.role = role
+    if text is not None:
+        delta.content = text
+    return ChatCompletionChunk(
+        id=cid,
+        choices=[ChatStreamChoice(delta=delta, finish_reason=finish)],
+        usage=usage,
+    )
+
+
+def chat_req(*contents, max_tokens=8):
+    msgs = []
+    for i, c in enumerate(contents):
+        msgs.append(
+            {"role": "user" if i % 2 == 0 else "assistant", "content": c}
+        )
+    return ChatCompletionRequest(
+        model="tiny", messages=msgs, max_tokens=max_tokens, temperature=0
+    )
+
+
+# ------------------------------------------------------- routing policy
+
+
+def test_plan_orders_affinity_first_then_least_loaded():
+    router = FleetRouter()
+    mgr = FleetManager(router=router)
+    h0 = mgr.add_replica("r0", FakeInference(active=3, queued=2))
+    h1 = mgr.add_replica("r1", FakeInference(active=1))
+    h2 = mgr.add_replica("r2", FakeInference(active=0))
+    req = chat_req("hello fleet")
+    key = router.affinity_key(req)
+
+    # no sticky entry: pure least-loaded (occupancy, est wait) order
+    plan = router.plan(key, mgr.handles())
+    assert [(h.replica_id, r) for h, r in plan] == [
+        ("r2", ROUTE_LEAST_LOADED),
+        ("r1", ROUTE_LEAST_LOADED),
+        ("r0", ROUTE_LEAST_LOADED),
+    ]
+
+    # sticky on the BUSIEST replica still wins the front of the plan —
+    # affinity beats load, that is the policy order under test
+    router.record(key, "r0")
+    plan = router.plan(key, mgr.handles())
+    assert (plan[0][0] is h0) and plan[0][1] == ROUTE_AFFINITY
+    assert [h.replica_id for h, _ in plan[1:]] == ["r2", "r1"]
+
+    # turn 2 of the same conversation (same first message) shares the key
+    turn2 = chat_req("hello fleet", "reply", "and more")
+    assert router.affinity_key(turn2) == key
+    # a draining replica drops out of the plan entirely
+    h1.inference.admission.begin_drain()
+    plan = router.plan(key, mgr.handles())
+    assert [h.replica_id for h, _ in plan] == ["r0", "r2"]
+    assert h2.serving
+
+
+def test_affinity_table_lru_and_replica_loss_eviction():
+    table = AffinityTable(capacity=2)
+    table.put("a", "r0")
+    table.put("b", "r1")
+    assert table.get("a") == "r0"  # refreshes recency
+    table.put("c", "r0")  # evicts coldest ("b")
+    assert table.get("b") is None
+    assert len(table) == 2
+    assert table.evict_replica("r0") == 2
+    assert len(table) == 0
+
+
+def test_fail_replica_evicts_affinity_and_reroutes():
+    router = FleetRouter()
+    mgr = FleetManager(router=router)
+    mgr.add_replica("r0", FakeInference())
+    mgr.add_replica("r1", FakeInference(active=2))
+    key = router.affinity_key(chat_req("sticky"))
+    router.record(key, "r0")
+    mgr.fail_replica("r0")
+    assert router.affinity.get(key) is None
+    plan = router.plan(key, mgr.handles())
+    assert [(h.replica_id, r) for h, r in plan] == [
+        ("r1", ROUTE_LEAST_LOADED)
+    ]
+
+
+def test_plan_with_no_serving_replica_is_typed():
+    router = FleetRouter()
+    with pytest.raises(FleetSheddingError):
+        router.plan("k", [])
+
+
+def test_all_replicas_shedding_raises_typed_429():
+    async def go():
+        reset_obs()
+        mgr = FleetManager()
+        mgr.add_replica("r0", FakeInference(shed=True, retry_after=2.0))
+        mgr.add_replica("r1", FakeInference(shed=True, retry_after=7.0))
+        gen = mgr.stream(chat_req("overload"))
+        with pytest.raises(FleetSheddingError) as ei:
+            await gen.__anext__()
+        # the LARGEST Retry-After any replica offered — the soonest any
+        # slot opens — feeds the 429 header
+        assert ei.value.retry_after_s == 7.0
+
+    run(go())
+
+
+def test_zombie_dispatch_is_fenced():
+    reset_obs()
+    mgr = FleetManager()
+    handle = mgr.add_replica("r0", FakeInference())
+    mgr.fail_replica("r0")
+    assert handle.state == STATE_DEAD
+    assert handle.fence != handle.epoch
+    with pytest.raises(StaleEpochError):
+        mgr.check_fence(handle)
+    assert (
+        metric("dnet_stale_epoch_rejected_total").labels(
+            kind="fleet_route"
+        ).value
+        == 1.0
+    )
+
+
+def test_midstream_failover_splices_replayed_text():
+    """Kill the serving replica between chunks: the survivor replays the
+    SAME deterministic request and the wrapper suppresses the chars the
+    client already has — one spliced stream, one id, one role."""
+
+    async def go():
+        reset_obs()
+        full = [
+            chunk("cid-b", role="assistant"),
+            chunk("cid-b", text="Hello"),
+            chunk("cid-b", text=" world"),
+            chunk("cid-b", finish="stop", usage=Usage(completion_tokens=2)),
+        ]
+        victim = FakeInference(chunks=[
+            chunk("cid-a", role="assistant"),
+            chunk("cid-a", text="Hel"),
+            chunk("cid-a", text="lo never-seen"),
+        ])
+        survivor = FakeInference(chunks=full)
+        mgr = FleetManager()
+        mgr.add_replica("r0", victim)
+        mgr.add_replica("r1", survivor)
+        # bias the router to start on r0
+        req = chat_req("failover me")
+        key = mgr.router.affinity_key(req)
+        mgr.router.record(key, "r0")
+
+        out = []
+        gen = mgr.stream(req)
+        async for c in gen:
+            out.append(c)
+            text = (c.choices[0].delta.content or "") if c.choices else ""
+            if "Hel" in text:
+                mgr.fail_replica("r0")
+        content = "".join(
+            (c.choices[0].delta.content or "") for c in out if c.choices
+        )
+        assert content == "Hello world"
+        roles = [
+            c.choices[0].delta.role
+            for c in out
+            if c.choices and c.choices[0].delta.role
+        ]
+        assert roles == ["assistant"]  # replayed role chunk stripped
+        assert {c.id for c in out} == {"cid-a"}  # ids spliced to stream id
+        assert out[-1].usage is not None
+        assert metric("dnet_fleet_failovers_total").value == 1.0
+        assert survivor.streams_started == 1
+
+    run(go())
+
+
+def test_failover_disabled_surfaces_typed_shed():
+    async def go():
+        reset_obs()
+        victim = FakeInference(chunks=[chunk("c", text="He")])
+        mgr = FleetManager(failover=False)
+        mgr.add_replica("r0", victim)
+        mgr.add_replica("r1", FakeInference(chunks=[]))
+        req = chat_req("no failover")
+        mgr.router.record(mgr.router.affinity_key(req), "r0")
+        gen = mgr.stream(req)
+        await gen.__anext__()
+        mgr.fail_replica("r0")
+        with pytest.raises(FleetSheddingError):
+            while True:
+                await gen.__anext__()
+
+    run(go())
+
+
+# ------------------------------------------------- 2-replica acceptance
+
+
+def _normalize_sse(raw: str) -> str:
+    raw = re.sub(r'"id":\s*"[^"]+"', '"id": "RID"', raw)
+    return re.sub(r'"created":\s*\d+', '"created": 0', raw)
+
+
+async def _replica_stack(tiny_llama_dir, slots=2):
+    inference = InferenceManager(
+        adapter=None, request_timeout_s=30.0, max_concurrent=slots
+    )
+    # byte tokenizer: a 3-message turn-2 conversation needs prompt room
+    manager = LocalModelManager(
+        inference, max_seq=256, param_dtype="float32", batch_slots=slots
+    )
+    await manager.load_model(str(tiny_llama_dir), max_seq=256)
+    return inference, manager
+
+
+@pytest.mark.e2e
+def test_two_replica_burst_failover_and_affinity(tiny_llama_dir):
+    async def go():
+        reset_obs()
+        inf0, mgr0 = await _replica_stack(tiny_llama_dir)
+        inf1, mgr1 = await _replica_stack(tiny_llama_dir)
+        fleet = FleetManager()
+        fleet.add_replica("r0", inf0)
+        fleet.add_replica("r1", inf1)
+        server = ApiHTTPServer(inf0, mgr0, fleet=fleet)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            async def fire(prompt, max_tokens=8):
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": prompt}],
+                        "max_tokens": max_tokens,
+                        "temperature": 0,
+                        "stream": True,
+                    },
+                )
+                raw = (await r.read()).decode()
+                return r, raw
+
+            # seeded burst: concurrent conversations spread across BOTH
+            # replicas (2 slots each — least-loaded must use r1 too)
+            results = await asyncio.gather(
+                *(fire(f"burst conversation {i}") for i in range(6))
+            )
+            statuses = [r.status for r, _ in results]
+            assert all(s in (200, 429) for s in statuses), statuses
+            replicas = {
+                r.headers.get("x-dnet-replica")
+                for r, _ in results
+                if r.status == 200
+            }
+            assert replicas == {"r0", "r1"}, replicas
+
+            # two turns of ONE conversation: turn 2 must land on the
+            # replica holding turn 1's prefix blocks, counted as a hit
+            r1, raw1 = await fire("affinity conversation")
+            assert r1.status == 200
+            sticky = r1.headers["x-dnet-replica"]
+            reply = "".join(
+                (json.loads(e[6:])["choices"][0]["delta"].get("content") or "")
+                for e in raw1.splitlines()
+                if e.startswith("data: ") and e != "data: [DONE]"
+            )
+            hits0 = metric("dnet_fleet_affinity_hits_total").value
+            r2body = {
+                "model": "tiny",
+                "messages": [
+                    {"role": "user", "content": "affinity conversation"},
+                    {"role": "assistant", "content": reply or "ok"},
+                    {"role": "user", "content": "and a second turn"},
+                ],
+                "max_tokens": 8,
+                "temperature": 0,
+                "stream": True,
+            }
+            r2 = await client.post("/v1/chat/completions", json=r2body)
+            await r2.read()
+            assert r2.status == 200
+            assert r2.headers["x-dnet-replica"] == sticky
+            assert metric("dnet_fleet_affinity_hits_total").value > hits0
+
+            # mid-burst kill: fire a burst, fail r1 while streams are in
+            # flight — zero 5xx (429/resume allowed), failover counted
+            async def killer():
+                await asyncio.sleep(0.3)
+                fleet.fail_replica("r1")
+
+            kill = asyncio.ensure_future(killer())
+            burst = await asyncio.gather(
+                *(fire(f"failover burst {i}", max_tokens=24)
+                  for i in range(6))
+            )
+            await kill
+            statuses = [r.status for r, _ in burst]
+            assert all(s < 500 for s in statuses), statuses
+            # post-kill traffic routes to the survivor only
+            r3, _ = await fire("post failover")
+            if r3.status == 200:
+                assert r3.headers["x-dnet-replica"] == "r0"
+            snap = fleet.snapshot()
+            states = {s["replica"]: s["state"] for s in snap["replicas"]}
+            assert states == {"r0": "active", "r1": "dead"}
+        finally:
+            await client.close()
+            await mgr0.unload_model()
+            await mgr1.unload_model()
+
+    run(go())
+
+
+@pytest.mark.e2e
+def test_fleet_off_keeps_single_ring_sse_byte_identical(tiny_llama_dir):
+    """DNET_FLEET unset/1: no fleet wrapper, no routing header, and the
+    greedy SSE bytes match a 1-replica fleet front door chunk for chunk
+    (ids/created normalized) — the wrapper adds routing, never content."""
+
+    async def go():
+        reset_obs()
+        body = {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "parity check"}],
+            "max_tokens": 8,
+            "temperature": 0,
+            "stream": True,
+        }
+
+        inference, manager = await _replica_stack(tiny_llama_dir)
+        plain_server = ApiHTTPServer(inference, manager)  # fleet=None
+        client = TestClient(TestServer(plain_server.app))
+        await client.start_server()
+        r = await client.post("/v1/chat/completions", json=body)
+        plain_raw = (await r.read()).decode()
+        assert r.status == 200
+        assert "x-dnet-replica" not in r.headers
+        await client.close()
+
+        fleet = FleetManager()
+        fleet.add_replica("r0", inference)
+        fleet_server = ApiHTTPServer(inference, manager, fleet=fleet)
+        client = TestClient(TestServer(fleet_server.app))
+        await client.start_server()
+        r = await client.post("/v1/chat/completions", json=body)
+        fleet_raw = (await r.read()).decode()
+        assert r.status == 200
+        assert r.headers["x-dnet-replica"] == "r0"
+        await client.close()
+        await manager.unload_model()
+
+        assert _normalize_sse(plain_raw) == _normalize_sse(fleet_raw)
+
+    run(go())
+
+
+@pytest.mark.e2e
+def test_debug_fleet_and_health_aggregate(tiny_llama_dir):
+    async def go():
+        reset_obs()
+        inf0, mgr0 = await _replica_stack(tiny_llama_dir)
+        inf1, mgr1 = await _replica_stack(tiny_llama_dir)
+        fleet = FleetManager()
+        fleet.add_replica("r0", inf0)
+        fleet.add_replica("r1", inf1)
+        server = ApiHTTPServer(inf0, mgr0, fleet=fleet)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            r = await client.get("/v1/debug/fleet")
+            snap = (await r.json())["fleet"]
+            assert snap["size"] == 2
+            assert {s["replica"] for s in snap["replicas"]} == {"r0", "r1"}
+            r = await client.get("/health")
+            h = await r.json()
+            assert h["fleet"]["size"] == 2 and h["fleet"]["serving"] == 2
+            r = await client.get("/v1/cluster/metrics")
+            text = await r.text()
+            # the federated section carries node="fleet" plus the
+            # replica-labeled admission picture for every replica
+            assert "dnet_fleet_admission_slots{" in text
+            assert 'replica="r1",kind="capacity"} 2.0' in text
+            # quarantine r1 (a recovering ring is a drained replica):
+            # health degrades, the router stops planning it
+            fleet.quarantine("r1")
+            h = await (await client.get("/health")).json()
+            assert h["fleet"]["serving"] == 1
+            assert h["status"] == "degraded"
+            fleet.activate("r1")
+            h = await (await client.get("/health")).json()
+            assert h["fleet"]["serving"] == 2
+        finally:
+            await client.close()
+            await mgr0.unload_model()
+            await mgr1.unload_model()
+
+    run(go())
