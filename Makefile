@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: tier1 dnetlint dnetlint-diff dnetlint-report bench-compare bench-fleet
+.PHONY: tier1 dnetlint dnetlint-diff dnetlint-report bench-compare bench-fleet chaos chaos-smoke
 
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -35,6 +35,22 @@ bench-compare:
 bench-fleet:
 	JAX_PLATFORMS=cpu DNET_OBS_ENABLED=1 $(PY) bench_serve.py \
 		--model $(MODEL) --fleet 2 $(ARGS)
+
+# chaos campaigns (scripts/chaos_campaign.py): the smoke slice is <= 8
+# cells over the fast scenarios and exits 1 on any invariant violation —
+# tier-1-friendly; `make chaos` runs the full (point x kind x scenario)
+# matrix plus the composed failover+resume cell and writes
+# CHAOS_r$(ROUND).json (slow: membership storms, two fleets of rings).
+# SEED pins the entire cell schedule and every repro string.
+SEED ?= 0
+ROUND ?= 1
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_campaign.py --smoke \
+		--seed $(SEED) --out CHAOS_smoke.json
+
+chaos:
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_campaign.py \
+		--seed $(SEED) --round $(ROUND) $(if $(MODEL),--model $(MODEL))
 
 dnetlint:
 	$(PY) scripts/dnetlint.py

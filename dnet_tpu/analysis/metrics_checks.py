@@ -345,6 +345,36 @@ def check_chaos_points(errors: list) -> int:
     return n
 
 
+def check_chaos_kinds(errors: list) -> int:
+    """Pass 5b: grammar self-test — every declared chaos KIND must parse
+    at every declared point (a kind added to the docs/campaign without a
+    parser, or a parser branch dropped in a refactor, fails here, not in
+    the middle of a chaos campaign)."""
+    from dnet_tpu.resilience.chaos import INJECTION_POINTS, KINDS, ChaosInjector
+
+    sample = {
+        "error": "0.5", "error_at": "3+5", "delay": "10ms", "partition": "2+3",
+    }
+    n = 0
+    for kind in KINDS:
+        n += 1
+        if kind not in sample:
+            errors.append(
+                f"chaos: kind {kind!r} has no grammar self-test sample "
+                f"(add one to check_chaos_kinds)"
+            )
+            continue
+        for point in INJECTION_POINTS:
+            try:
+                ChaosInjector(f"{point}:{kind}:{sample[kind]}", seed=1)
+            except ValueError as exc:
+                errors.append(
+                    f"chaos: declared kind {kind!r} fails to parse at "
+                    f"point {point!r}: {exc}"
+                )
+    return n
+
+
 def _cross_check_labels(
     errors: list, text: str, family: str, label: str, declared, where: str
 ) -> int:
@@ -768,6 +798,7 @@ def main() -> int:
     n_fed = check_federation(errors)
     n_pool = check_paged_conservation(errors)
     n_chaos = check_chaos_points(errors)
+    n_kinds = check_chaos_kinds(errors)
     n_admit = check_admission_labels(errors)
     n_member = check_membership_labels(errors)
     n_attr = check_attribution_labels(errors)
@@ -785,7 +816,8 @@ def main() -> int:
         return 1
     print(f"ok: {n_reg} registered families, {n_src} source-literal "
           f"registrations, {n_fed} federated samples, {n_pool} paged-pool "
-          f"audits, {n_chaos} chaos points, {n_admit} admission labels, "
+          f"audits, {n_chaos} chaos points, {n_kinds} chaos kinds, "
+          f"{n_admit} admission labels, "
           f"{n_member} membership labels, {n_attr} attribution labels, "
           f"{n_san} sanitizer labels, {n_sched} scheduler labels, "
           f"{n_jit} jit call sites, {n_wire} wire labels, "
@@ -935,6 +967,13 @@ class FleetLabelContract(_MetricsCheck):
     pass_name = "check_fleet_labels"
 
 
+class ChaosKindGrammar(_MetricsCheck):
+    code = "DL032"
+    name = "chaos-kind-grammar"
+    description = "every declared chaos kind parses at every point"
+    pass_name = "check_chaos_kinds"
+
+
 METRICS_CHECKS = [
     MetricRegistryNames(),
     MetricSourceLiterals(),
@@ -952,4 +991,5 @@ METRICS_CHECKS = [
     RequestSegmentContract(),
     EventLabelContract(),
     FleetLabelContract(),
+    ChaosKindGrammar(),
 ]

@@ -299,6 +299,13 @@ class ApiHTTPServer:
             return _json_error(422, str(exc), "invalid_request_error")
         if isinstance(exc, ServiceDegradedError):
             return _json_error(503, str(exc), "service_unavailable")
+        if isinstance(exc, ConnectionError):
+            # transport-class failure before any chunk was written (a
+            # broken channel, or an injected chaos fault at a pre-stream
+            # point like `admit`): the request never started, so it is
+            # retryable service unavailability — never a 500.  The chaos
+            # campaign's status-code contract pins this.
+            return _json_error(503, str(exc), "service_unavailable")
         if isinstance(exc, InferenceError):
             return _json_error(500, str(exc), "server_error")
         raise exc
@@ -692,8 +699,15 @@ class ApiHTTPServer:
 
     async def health(self, request: web.Request) -> web.Response:
         from dnet_tpu.obs import get_slo_tracker
+        from dnet_tpu.resilience.chaos import armed_summary
 
         body = HealthResponse(model=self.model_manager.current_model_id).model_dump()
+        # armed chaos is ALWAYS visible here: an operator reading /health
+        # during an incident must be able to tell injected faults from
+        # real ones at a glance (absent when no chaos is armed)
+        chaos = armed_summary()
+        if chaos is not None:
+            body["chaos"] = chaos
         # membership view: the installed topology's epoch and the fenced-out
         # (quarantined, still-probed) shards — a degraded-membership ring is
         # visible here and through the federation scrape at a glance

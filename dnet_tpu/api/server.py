@@ -26,6 +26,11 @@ async def serve_async(args) -> None:
     from dnet_tpu.analysis.runtime import serving as dsan_serving
 
     san = dsan_serving.install(asyncio.get_running_loop())
+    # fail fast on a malformed DNET_CHAOS (and bannerize an armed one)
+    # before the server takes traffic — never mid-request
+    from dnet_tpu.resilience.chaos import validate_startup
+
+    validate_startup(role="api")
     wq = getattr(args, "weight_quant_bits", None)
     weight_quant_bits = s.api.weight_quant_bits if wq is None else wq
     batch_slots = getattr(args, "batch_slots", None) or s.api.batch_slots

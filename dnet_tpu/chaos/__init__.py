@@ -1,0 +1,48 @@
+"""Chaos campaigns: exhaustive fault-sweep verification.
+
+The `dnet_tpu.resilience.chaos` module injects the faults; this package
+proves the system absorbs them.  `campaign` enumerates the deterministic
+(point x kind x scenario) matrix and drives each cell with a seeded
+workload; `invariants` audits every cell against the five system-wide
+families (status contract, resource conservation, metrics conservation,
+epoch coherence, SSE integrity); `scenarios` hosts the in-process
+serving stacks the cells run on.
+"""
+
+from dnet_tpu.chaos.campaign import (
+    COMPOSED_CELL_ID,
+    POINT_SCENARIOS,
+    SMOKE_CELLS,
+    Cell,
+    build_matrix,
+    run_campaign,
+    select_cells,
+    write_record,
+)
+from dnet_tpu.chaos.invariants import (
+    ALLOWED_STATUSES,
+    FAMILIES,
+    CellEvidence,
+    Violation,
+    audit_cell,
+)
+from dnet_tpu.chaos.scenarios import SCENARIOS, Scenario, build_scenario
+
+__all__ = [
+    "ALLOWED_STATUSES",
+    "COMPOSED_CELL_ID",
+    "FAMILIES",
+    "POINT_SCENARIOS",
+    "SCENARIOS",
+    "SMOKE_CELLS",
+    "Cell",
+    "CellEvidence",
+    "Scenario",
+    "Violation",
+    "audit_cell",
+    "build_matrix",
+    "build_scenario",
+    "run_campaign",
+    "select_cells",
+    "write_record",
+]

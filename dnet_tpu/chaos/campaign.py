@@ -1,0 +1,574 @@
+"""Deterministic chaos-campaign runner: the fault-sweep matrix.
+
+``build_matrix(seed)`` enumerates cells — (injection point x kind x
+seeded timing) x scenario — as a PURE function of the campaign seed:
+every cell's chaos spec, chaos seed, and workload seed are drawn from
+``random.Random(f"dnet-chaos-campaign:{seed}:{cell_id}")``, so the same
+seed always yields the identical schedule and identical copy-pasteable
+repro strings (pinned by test).  ``run_campaign`` drives each cell with
+the seeded loadgen workload over the cell's scenario stack, audits it
+against the five invariant families (invariants.py), and emits one
+``CHAOS_r<NN>.json`` record with per-cell outcome + minimal repro.
+
+A cell's lifecycle:
+
+    install_chaos(spec, seed)           # deterministic schedule
+    drive the seeded workload           # sequential: parity-comparable
+    [storm()]                           # membership/fleet event arc
+    clear_chaos(); heal(); quiesce()    # faults off, stack must recover
+    snapshot resources + metric deltas
+    audit_cell(...)                     # five families
+
+Each scenario runs its fault-free GOLDEN first — family 5 compares every
+faulted 200 stream against it (bytes for single-ring greedy stacks,
+assembled content across fleet splices).  A scenario that fails to heal
+after a cell is rebuilt from scratch so one wedged cell cannot cascade
+violations into its neighbours.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import re
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dnet_tpu.chaos.invariants import CellEvidence, audit_cell
+from dnet_tpu.chaos.scenarios import SCENARIOS, Scenario, build_scenario
+from dnet_tpu.resilience.chaos import (
+    INJECTION_POINTS,
+    KINDS,
+    clear_chaos,
+    get_chaos,
+    install_chaos,
+)
+from dnet_tpu.utils.logger import get_logger
+
+log = get_logger()
+
+#: which scenarios prove each point (>= 2 each — the acceptance bar).
+#: Transport/compute points live on the two-shard ring (both wire modes);
+#: control-plane points live on the elastic-membership ring; the fleet
+#: walk lives behind the front door.
+POINT_SCENARIOS: Dict[str, Tuple[str, ...]] = {
+    "admit": ("local", "sched"),
+    "send_activation": ("ring", "ring_wire"),
+    "token_cb": ("ring", "ring_wire"),
+    "shard_compute": ("ring", "ring_wire"),
+    "zombie_frame": ("ring", "ring_wire"),
+    "wire_encode": ("ring", "ring_wire"),
+    "wire_decode": ("ring", "ring_wire"),
+    "health_check": ("member", "member_auto"),
+    "rejoin": ("member", "member_auto"),
+    "update_topology": ("member", "member_auto"),
+    "fleet_dispatch": ("fleet", "fleet_sched"),
+}
+
+#: points whose per-request call volume is low (one-ish call per
+#: request/arc): early error_at indices and tight partition windows,
+#: or the fault would never fire inside a five-request cell
+_LOW_VOLUME = frozenset(
+    {"admit", "fleet_dispatch", "update_topology", "rejoin", "token_cb"}
+)
+
+#: the composed acceptance cell: fleet failover mid-stream stacked on
+#: in-ring shard resume, one campaign cell
+COMPOSED_CELL_ID = "fleet_ring:composed:failover+resume"
+
+_SCENARIO_ORDER = (
+    "local", "sched", "ring", "ring_wire", "member", "member_auto",
+    "fleet", "fleet_sched",
+)
+
+
+@dataclass(frozen=True)
+class Cell:
+    cell_id: str
+    scenario: str
+    point: str
+    kind: str
+    chaos_spec: str
+    chaos_seed: int
+    workload_seed: int
+    composed: bool = False
+
+    def repro(self, campaign_seed: int) -> str:
+        return (
+            f"DNET_CHAOS='{self.chaos_spec}' "
+            f"DNET_CHAOS_SEED={self.chaos_seed} "
+            f"python scripts/chaos_campaign.py "
+            f"--seed {campaign_seed} --cell '{self.cell_id}'"
+        )
+
+
+def _cell_rng(seed: int, cell_id: str) -> random.Random:
+    return random.Random(f"dnet-chaos-campaign:{seed}:{cell_id}")
+
+
+def _workload_seed(seed: int, scenario: str) -> int:
+    # per-SCENARIO (not per-cell): every cell must drive the exact
+    # workload its golden ran, or parity is vacuous
+    return random.Random(f"dnet-chaos-workload:{seed}:{scenario}").randrange(
+        1, 2**31
+    )
+
+
+def _spec_for(cell_id: str, point: str, kind: str, rng: random.Random) -> str:
+    low = point in _LOW_VOLUME
+    if kind == "error":
+        if point == "health_check":
+            # the probe loop runs ~50/s with fail_threshold 2: even a few
+            # percent keeps the monitor busy, while 20% would flap the
+            # ring into permanent reload starvation — an availability
+            # choice, not a fault-handling bug
+            prob = round(rng.uniform(0.02, 0.06), 3)
+        else:
+            prob = round(
+                rng.uniform(0.15, 0.35) if low else rng.uniform(0.08, 0.25), 3
+            )
+        return f"{point}:error:{prob}"
+    if kind == "error_at":
+        hits = sorted(
+            rng.sample(range(2, 6) if low else range(3, 13), 2)
+        )
+        return f"{point}:error_at:{hits[0]}+{hits[1]}"
+    if kind == "delay":
+        return f"{point}:delay:{rng.randrange(20, 61)}ms"
+    if kind == "partition":
+        start = rng.randrange(2, 5) if low else rng.randrange(3, 9)
+        width = rng.randrange(2, 5)
+        spec = f"{point}:partition:{start}+{width}"
+        if point == "send_activation":
+            # drop BOTH directions of the hop for the same window: the
+            # forward activation stream and the token return path fail
+            # together, then heal — a real link partition, not a one-way
+            # fault
+            spec += f",token_cb:partition:{start}+{width}"
+        return spec
+    raise ValueError(f"unknown kind {kind!r}")
+
+
+def build_matrix(seed: int = 0) -> List[Cell]:
+    """The full campaign, deterministically: every declared injection
+    point x every kind x (>=2) scenarios, plus the composed cell."""
+    for point in INJECTION_POINTS:
+        if point not in POINT_SCENARIOS:
+            raise ValueError(
+                f"injection point {point!r} has no campaign scenario "
+                f"mapping — add it to POINT_SCENARIOS"
+            )
+    cells: List[Cell] = []
+    for scenario in _SCENARIO_ORDER:
+        for point in INJECTION_POINTS:
+            if scenario not in POINT_SCENARIOS[point]:
+                continue
+            for kind in KINDS:
+                cell_id = f"{scenario}:{point}:{kind}"
+                rng = _cell_rng(seed, cell_id)
+                cells.append(Cell(
+                    cell_id=cell_id,
+                    scenario=scenario,
+                    point=point,
+                    kind=kind,
+                    chaos_spec=_spec_for(cell_id, point, kind, rng),
+                    chaos_seed=rng.randrange(1, 10_000),
+                    workload_seed=_workload_seed(seed, scenario),
+                ))
+    rng = _cell_rng(seed, COMPOSED_CELL_ID)
+    cells.append(Cell(
+        cell_id=COMPOSED_CELL_ID,
+        scenario="fleet_ring",
+        point="shard_compute",
+        kind="error_at",
+        chaos_spec=f"shard_compute:error_at:{rng.randrange(4, 9)}",
+        chaos_seed=rng.randrange(1, 10_000),
+        workload_seed=_workload_seed(seed, "fleet_ring"),
+        composed=True,
+    ))
+    return cells
+
+
+#: the tier-1-friendly smoke slice: <= 8 cells over the fast scenarios
+#: (no membership storms), still touching every invariant family
+SMOKE_CELLS = (
+    "local:admit:error_at",
+    "local:admit:delay",
+    "sched:admit:error",
+    "ring:send_activation:error_at",
+    "ring:shard_compute:error_at",
+    "ring:zombie_frame:error_at",
+    "ring:send_activation:partition",
+    "fleet:fleet_dispatch:error_at",
+)
+
+
+def select_cells(
+    cells: Sequence[Cell],
+    only: Optional[Sequence[str]] = None,
+    smoke: bool = False,
+) -> List[Cell]:
+    if only:
+        wanted = set(only)
+        picked = [c for c in cells if c.cell_id in wanted]
+        missing = wanted - {c.cell_id for c in picked}
+        if missing:
+            raise ValueError(f"unknown cell id(s): {sorted(missing)}")
+        return picked
+    if smoke:
+        return [c for c in cells if c.cell_id in SMOKE_CELLS]
+    return list(cells)
+
+
+# ---------------------------------------------------------------------------
+# the seeded per-cell workload
+# ---------------------------------------------------------------------------
+
+
+def cell_workload(workload_seed: int, requests: int = 5):
+    from dnet_tpu.loadgen.workload import Bucket, WorkloadSpec, schedule
+
+    spec = WorkloadSpec(
+        seed=workload_seed,
+        requests=requests,
+        rate_rps=50.0,
+        arrival="fixed",
+        buckets=(Bucket(6, 8),),
+        temperature=0.0,
+        timeout_s=30.0,
+    )
+    return schedule(spec)
+
+
+def _chat_body(planned, model: str) -> dict:
+    # profile=False on purpose: the final chunk's RequestMetrics carry
+    # wall-clock timings, which would break byte parity with the golden
+    return {
+        "model": model,
+        "messages": [{"role": "user", "content": planned.prompt}],
+        "max_tokens": planned.max_tokens,
+        "temperature": 0.0,
+        "stream": True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# metric bookkeeping (per-cell deltas over the exposition text)
+# ---------------------------------------------------------------------------
+
+
+def _expose() -> str:
+    from dnet_tpu.obs import get_registry
+
+    return get_registry().expose()
+
+
+def _metric_sum(text: str, family: str) -> float:
+    total = 0.0
+    for m in re.finditer(
+        rf"^{re.escape(family)}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
+        text, re.MULTILINE,
+    ):
+        total += float(m.group(1))
+    return total
+
+
+def _injected_counts(text0: str, text1: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for point in INJECTION_POINTS:
+        fam = f'dnet_chaos_injected_total{{point="{point}"}}'
+        pat = rf"^{re.escape(fam)} ([0-9.eE+-]+)$"
+        v0 = sum(
+            float(m.group(1)) for m in re.finditer(pat, text0, re.MULTILINE)
+        )
+        v1 = sum(
+            float(m.group(1)) for m in re.finditer(pat, text1, re.MULTILINE)
+        )
+        if v1 - v0 > 0:
+            out[point] = int(v1 - v0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cell + campaign execution
+# ---------------------------------------------------------------------------
+
+
+async def _drive(
+    scenario: Scenario, planned, *, storm: bool
+) -> List[Tuple[int, Dict[str, str], bytes]]:
+    """Sequential drive of the cell's workload (sequential => the golden
+    comparison is exact and quiesce is trivial).  Membership scenarios
+    run their storm arc mid-workload so the faults land on live recovery
+    machinery, not an idle ring."""
+    results = []
+    mid = max(len(planned) // 2, 1) if storm else None
+    for i, req in enumerate(planned):
+        if mid is not None and i == mid:
+            await scenario.storm()  # dnetlint: disable=DL024 the storm arc must land mid-workload, between requests, by definition
+        results.append(
+            await scenario.post_chat(  # dnetlint: disable=DL024 sequential ON PURPOSE: the golden comparison is per-index exact and quiesce must be trivial between cells
+                _chat_body(req, scenario.model),
+                timeout_s=scenario.client_timeout_s,
+            )
+        )
+    return results
+
+
+async def run_cell(
+    scenario: Scenario,
+    cell: Cell,
+    campaign_seed: int,
+    golden: Optional[List[Tuple[int, bytes]]],
+) -> Tuple[dict, bool]:
+    """One faulted cell on a running scenario.  Returns (record, healed);
+    healed=False tells the caller to rebuild the scenario."""
+    storm = cell.scenario.startswith("member")
+    planned = cell_workload(cell.workload_seed)
+    text0 = _expose()
+    t0 = time.perf_counter()
+    install_chaos(cell.chaos_spec, seed=cell.chaos_seed)
+    try:
+        raw_results = await _drive(scenario, planned, storm=storm)
+    finally:
+        clear_chaos()
+    healed = await scenario.heal()
+    quiesced = True
+    try:
+        await scenario.quiesce()
+    except TimeoutError:
+        quiesced = False
+    text1 = _expose()
+    injected = _injected_counts(text0, text1)
+    results = [(status, raw) for status, _hdrs, raw in raw_results]
+    ev = CellEvidence(
+        cell_id=cell.cell_id,
+        point=cell.point,
+        kind=cell.kind,
+        results=results,
+        golden=golden,
+        parity=scenario.parity,
+        snapshot=scenario.resources(),
+        injected=injected.get(cell.point, 0),
+        stale_delta=(
+            _metric_sum(text1, "dnet_stale_epoch_rejected_total")
+            - _metric_sum(text0, "dnet_stale_epoch_rejected_total")
+        ),
+        zombie_delta=(
+            _metric_sum(text1, "dnet_san_zombie_threads_total")
+            - _metric_sum(text0, "dnet_san_zombie_threads_total")
+        ),
+    )
+    violations = audit_cell(ev)
+    statuses: Dict[str, int] = {}
+    for status, _raw in results:
+        statuses[str(status)] = statuses.get(str(status), 0) + 1
+    record = {
+        "cell": cell.cell_id,
+        "scenario": cell.scenario,
+        "point": cell.point,
+        "kind": cell.kind,
+        "chaos": cell.chaos_spec,
+        "chaos_seed": cell.chaos_seed,
+        "workload_seed": cell.workload_seed,
+        "repro": cell.repro(campaign_seed),
+        "requests": len(results),
+        "statuses": statuses,
+        "injected": injected,
+        "stale_epoch_delta": ev.stale_delta,
+        "quiesced": quiesced,
+        "healed": healed,
+        "duration_s": round(time.perf_counter() - t0, 2),
+        "violations": [v.as_dict() for v in violations],
+        "ok": not violations,
+    }
+    return record, healed
+
+
+async def _run_golden(
+    scenario: Scenario, workload_seed: int, *, storm: bool
+) -> List[Tuple[int, bytes]]:
+    planned = cell_workload(workload_seed)
+    raw = await _drive(scenario, planned, storm=storm)
+    await scenario.heal()
+    await scenario.quiesce()
+    return [(status, body) for status, _hdrs, body in raw]
+
+
+async def _run_composed_cell(
+    model_dir: str, cell: Cell, campaign_seed: int
+) -> dict:
+    """The composed acceptance cell: one long greedy stream on a fleet of
+    two in-process rings; the serving replica is killed mid-stream WHILE
+    in-ring chaos forces shard-level resume — the spliced stream must
+    match the golden run's content exactly, with zero 5xx."""
+    from dnet_tpu.loadgen.workload import PlannedRequest
+
+    req = PlannedRequest(
+        index=0, t_s=0.0,
+        prompt="tell me a long story about rings",
+        prompt_tokens=7, max_tokens=24,
+    )
+
+    async def one_run(with_fault: bool):
+        scenario = build_scenario("fleet_ring", model_dir)
+        await scenario.start()
+        try:
+            killer = None
+            if with_fault:
+                install_chaos(cell.chaos_spec, seed=cell.chaos_seed)
+                killer = asyncio.ensure_future(
+                    scenario.kill_serving_replica(0.3)
+                )
+            try:
+                status, _hdrs, raw = await scenario.post_chat(
+                    _chat_body(req, scenario.model), timeout_s=120.0
+                )
+            finally:
+                clear_chaos()
+                victim = None
+                if killer is not None:
+                    victim = await killer
+            await scenario.quiesce()
+            return status, raw, scenario.resources(), victim
+        finally:
+            await scenario.stop()
+
+    t0 = time.perf_counter()
+    g_status, g_raw, _snap, _ = await one_run(with_fault=False)
+    text0 = _expose()
+    status, raw, snap, victim = await one_run(with_fault=True)
+    text1 = _expose()
+    injected = _injected_counts(text0, text1)
+    ev = CellEvidence(
+        cell_id=cell.cell_id,
+        point=cell.point,
+        kind=cell.kind,
+        results=[(status, raw)],
+        golden=[(g_status, g_raw)],
+        parity="content",
+        snapshot=snap,
+        injected=injected.get(cell.point, 0),
+        stale_delta=0.0,
+    )
+    violations = audit_cell(ev)
+    if status != 200 or g_status != 200:
+        from dnet_tpu.chaos.invariants import FAMILY_STATUS, Violation
+
+        violations.append(Violation(
+            FAMILY_STATUS, cell.cell_id,
+            f"composed cell must stream 200 end-to-end "
+            f"(golden={g_status}, faulted={status})",
+        ))
+    failovers = _metric_sum(text1, "dnet_fleet_failovers_total") - _metric_sum(
+        text0, "dnet_fleet_failovers_total"
+    )
+    return {
+        "cell": cell.cell_id,
+        "scenario": cell.scenario,
+        "point": cell.point,
+        "kind": cell.kind,
+        "chaos": cell.chaos_spec,
+        "chaos_seed": cell.chaos_seed,
+        "workload_seed": cell.workload_seed,
+        "repro": cell.repro(campaign_seed),
+        "requests": 1,
+        "statuses": {str(status): 1},
+        "injected": injected,
+        "victim": victim,
+        "failovers": failovers,
+        "quiesced": True,
+        "healed": True,
+        "duration_s": round(time.perf_counter() - t0, 2),
+        "violations": [v.as_dict() for v in violations],
+        "ok": not violations,
+    }
+
+
+async def run_campaign(
+    model_dir: str,
+    seed: int = 0,
+    only: Optional[Sequence[str]] = None,
+    smoke: bool = False,
+    round_no: int = 1,
+) -> dict:
+    """Run (a slice of) the matrix and return the CHAOS record."""
+    matrix = build_matrix(seed)
+    cells = select_cells(matrix, only=only, smoke=smoke)
+    by_scenario: Dict[str, List[Cell]] = {}
+    for cell in cells:
+        by_scenario.setdefault(cell.scenario, []).append(cell)
+    records: List[dict] = []
+    t_start = time.time()
+    for scenario_name in [*_SCENARIO_ORDER, "fleet_ring"]:
+        group = by_scenario.pop(scenario_name, [])
+        if not group:
+            continue
+        if scenario_name == "fleet_ring":
+            for cell in group:
+                log.info("chaos cell %s (composed)", cell.cell_id)
+                records.append(
+                    # dnetlint: disable=DL024 composed cells build a whole fleet of rings each: strictly serial by design
+                    await _run_composed_cell(model_dir, cell, seed)
+                )
+            continue
+        storm = scenario_name.startswith("member")
+        scenario = build_scenario(scenario_name, model_dir)
+        await scenario.start()  # dnetlint: disable=DL024 one scenario group at a time: each stack owns the process env scope
+        try:
+            golden = await _run_golden(
+                scenario, group[0].workload_seed, storm=storm
+            )
+            for cell in group:
+                log.info("chaos cell %s: %s", cell.cell_id, cell.chaos_spec)
+                # dnetlint: disable=DL024 cells share ONE scenario stack and must observe each other's heal barrier: serial by design
+                record, healed = await run_cell(scenario, cell, seed, golden)
+                records.append(record)
+                if not healed:
+                    log.warning(
+                        "scenario %s did not heal after %s; rebuilding",
+                        scenario_name, cell.cell_id,
+                    )
+                    await scenario.stop()  # dnetlint: disable=DL024 rebuild of the shared stack mid-group: inherently serial
+                    scenario = build_scenario(scenario_name, model_dir)
+                    await scenario.start()  # dnetlint: disable=DL024 rebuild of the shared stack mid-group: inherently serial
+                    golden = await _run_golden(
+                        scenario, group[0].workload_seed, storm=storm
+                    )
+        finally:
+            await scenario.stop()
+    n_violations = sum(len(r["violations"]) for r in records)
+    statuses: Dict[str, int] = {}
+    for r in records:
+        for k, v in r["statuses"].items():
+            statuses[k] = statuses.get(k, 0) + v
+    return {
+        "kind": "chaos_campaign",
+        "round": round_no,
+        "seed": seed,
+        "model": str(model_dir),
+        "smoke": smoke,
+        "matrix": {
+            "cells_total": len(matrix),
+            "cells_run": len(records),
+            "scenarios": sorted({c.scenario for c in cells}),
+            "points": sorted({c.point for c in cells}),
+            "kinds": sorted({c.kind for c in cells}),
+        },
+        "summary": {
+            "ok": sum(1 for r in records if r["ok"]),
+            "violations": n_violations,
+            "http_500": statuses.get("500", 0),
+            "statuses": statuses,
+            "duration_s": round(time.time() - t_start, 1),
+        },
+        "cells": records,
+    }
+
+
+def write_record(record: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=False)
+        f.write("\n")

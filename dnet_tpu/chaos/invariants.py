@@ -1,0 +1,378 @@
+"""The system-wide invariant auditor behind every chaos-campaign cell.
+
+Five families, each a pure function over what the cell observed — no
+family consults another's evidence, so a violation names exactly the
+contract that broke:
+
+1. ``status``    — injected transient faults may surface ONLY as
+                   200/429/499/503/504.  A 500 (or a connection that
+                   never answered, status 0) is a defect, full stop.
+2. ``resources`` — after quiesce the books balance: every BlockPool at
+                   ``used==0 ∧ free==total``, admission idle with an
+                   empty queue, every lane free, every per-nonce stream
+                   context closed, no new zombie threads.
+3. ``metrics``   — the registry-level check_metrics_names passes hold
+                   over the post-cell exposition (names, label contracts,
+                   chaos point/kind coverage).
+4. ``epoch``     — stale frames/tokens are COUNTED
+                   (``dnet_stale_epoch_rejected_total``), never served:
+                   a cell that injected zombie frames must show the
+                   rejection counter move.
+5. ``sse``       — every 200 stream is well-formed (one role chunk, one
+                   stream id, exactly one finish_reason, terminal
+                   ``[DONE]``), and a greedy faulted cell with resume
+                   enabled matches its fault-free golden run modulo
+                   rid/created.
+
+The negative-control tests (tests/subsystems/test_chaos_campaign.py)
+plant one defect per family — a leaked block, an unclosed stream, a
+forced 500, a parity break — and assert each fires exactly where
+planted; clean runs must report zero.  Same discipline as dnetlint:
+an auditor is only trusted once it has caught a planted bug.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dnet_tpu.chaos.scenarios import ResourceSnapshot
+
+#: the status-code contract: every acceptable way a faulted request may
+#: end.  500 is NEVER here; 0 (transport never answered) is not either.
+ALLOWED_STATUSES = frozenset({200, 429, 499, 503, 504})
+
+FAMILY_STATUS = "status"
+FAMILY_RESOURCES = "resources"
+FAMILY_METRICS = "metrics"
+FAMILY_EPOCH = "epoch"
+FAMILY_SSE = "sse"
+
+FAMILIES = (
+    FAMILY_STATUS, FAMILY_RESOURCES, FAMILY_METRICS, FAMILY_EPOCH,
+    FAMILY_SSE,
+)
+
+
+@dataclass(frozen=True)
+class Violation:
+    family: str
+    cell_id: str
+    detail: str
+
+    def as_dict(self) -> dict:
+        return {
+            "family": self.family, "cell": self.cell_id,
+            "detail": self.detail,
+        }
+
+
+# ---------------------------------------------------------------------------
+# family 1: status-code contract
+# ---------------------------------------------------------------------------
+
+
+def audit_statuses(cell_id: str, statuses: List[int]) -> List[Violation]:
+    out = []
+    for i, status in enumerate(statuses):
+        if status not in ALLOWED_STATUSES:
+            out.append(Violation(
+                FAMILY_STATUS, cell_id,
+                f"request {i} answered {status} "
+                f"(allowed: {sorted(ALLOWED_STATUSES)})",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# family 2: resource conservation
+# ---------------------------------------------------------------------------
+
+
+def audit_resources(
+    cell_id: str, snap: ResourceSnapshot, zombie_delta: float = 0.0
+) -> List[Violation]:
+    out = []
+    for name, (used, free, total) in snap.pools.items():
+        if used != 0 or free != total:
+            out.append(Violation(
+                FAMILY_RESOURCES, cell_id,
+                f"block pool {name}: used={used} free={free}/{total} "
+                f"after quiesce (want used=0, free=total)",
+            ))
+    for name, (active, queued) in snap.admission.items():
+        if active != 0 or queued != 0:
+            out.append(Violation(
+                FAMILY_RESOURCES, cell_id,
+                f"admission {name}: active={active} queued={queued} "
+                f"after quiesce (want 0/0)",
+            ))
+    for name, (free, slots) in snap.lanes.items():
+        if free != slots:
+            out.append(Violation(
+                FAMILY_RESOURCES, cell_id,
+                f"lanes {name}: {free}/{slots} free after quiesce "
+                f"(a lane leaked)",
+            ))
+    for name, open_streams in snap.streams.items():
+        if open_streams != 0:
+            out.append(Violation(
+                FAMILY_RESOURCES, cell_id,
+                f"stream manager {name}: {open_streams} per-nonce "
+                f"stream context(s) still open after quiesce",
+            ))
+    if zombie_delta > 0:
+        out.append(Violation(
+            FAMILY_RESOURCES, cell_id,
+            f"{int(zombie_delta)} zombie worker thread(s) leaked "
+            f"during the cell (dnet_san_zombie_threads_total moved)",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# family 3: metrics conservation (registry-level lint passes)
+# ---------------------------------------------------------------------------
+
+#: the check_metrics_names passes that read the LIVE registry (the
+#: source-scan and federation passes are file-level and run once per
+#: campaign, not per cell)
+_REGISTRY_PASS_NAMES = (
+    "check_registry",
+    "check_chaos_points",
+    "check_chaos_kinds",
+    "check_admission_labels",
+    "check_membership_labels",
+    "check_attribution_labels",
+    "check_san_labels",
+    "check_sched_labels",
+    "check_wire_labels",
+    "check_tp_labels",
+    "check_request_segment_labels",
+    "check_event_labels",
+    "check_fleet_labels",
+)
+
+
+def audit_metrics(cell_id: str) -> List[Violation]:
+    from dnet_tpu.analysis import metrics_checks as mc
+
+    errors: list = []
+    for pass_name in _REGISTRY_PASS_NAMES:
+        getattr(mc, pass_name)(errors)
+    return [Violation(FAMILY_METRICS, cell_id, e) for e in errors]
+
+
+# ---------------------------------------------------------------------------
+# family 4: epoch coherence
+# ---------------------------------------------------------------------------
+
+
+def audit_epoch(
+    cell_id: str,
+    point: str,
+    injected: int,
+    stale_delta: float,
+    kind: str = "",
+) -> List[Violation]:
+    """Stale state must be counted, never served.  For a cell that
+    injected zombie frames, every ERROR-flavored injection marks a frame
+    stale — the rejection counter must have moved.  A ``delay`` at the
+    same point only slows a current-epoch frame down; it is legitimately
+    served, so the must-be-fenced rule does not apply.  A negative delta
+    (counter reset mid-cell) is always a violation."""
+    out = []
+    if stale_delta < 0:
+        out.append(Violation(
+            FAMILY_EPOCH, cell_id,
+            f"dnet_stale_epoch_rejected_total went BACKWARD by "
+            f"{-stale_delta:g} during the cell",
+        ))
+    if (
+        point == "zombie_frame" and kind != "delay"
+        and injected > 0 and stale_delta <= 0
+    ):
+        out.append(Violation(
+            FAMILY_EPOCH, cell_id,
+            f"{injected} zombie frame(s) injected but "
+            f"dnet_stale_epoch_rejected_total never moved — a stale "
+            f"frame was admitted instead of fenced",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# family 5: SSE integrity + golden parity
+# ---------------------------------------------------------------------------
+
+
+def normalize_sse(raw: bytes) -> bytes:
+    """Scrub the per-run request id and mint time so byte parity means
+    'same tokens in the same frames', not 'same wall clock'."""
+    text = raw.decode("utf-8", errors="replace")
+    text = re.sub(r'"id": ?"[^"]*"', '"id": "chatcmpl-X"', text)
+    text = re.sub(r'"created": ?\d+', '"created": 0', text)
+    return text.encode()
+
+
+def parse_sse(raw: bytes) -> Tuple[List[dict], bool]:
+    """(chunks, saw_done) from one raw SSE body; malformed data lines
+    raise ValueError (the caller reports the family-5 violation)."""
+    chunks: List[dict] = []
+    saw_done = False
+    for line in raw.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line.startswith("data:"):
+            continue
+        payload = line[len("data:"):].strip()
+        if payload == "[DONE]":
+            saw_done = True
+            continue
+        if saw_done:
+            raise ValueError("data after [DONE]")
+        chunks.append(json.loads(payload))
+    return chunks, saw_done
+
+
+def stream_content(raw: bytes) -> Tuple[str, str]:
+    """(concatenated content, finish_reason) of one 200 stream."""
+    chunks, _ = parse_sse(raw)
+    content, finish = [], ""
+    for chunk in chunks:
+        for choice in chunk.get("choices") or ():
+            delta = choice.get("delta") or {}
+            if delta.get("content"):
+                content.append(delta["content"])
+            if choice.get("finish_reason"):
+                finish = choice["finish_reason"]
+    return "".join(content), finish
+
+
+def check_stream(cell_id: str, idx: int, raw: bytes) -> List[Violation]:
+    """Well-formedness of one 200 SSE body: single stream id, exactly one
+    role chunk, exactly one finish_reason, terminal [DONE]."""
+    out = []
+
+    def v(detail: str) -> None:
+        out.append(Violation(
+            FAMILY_SSE, cell_id, f"request {idx}: {detail}"
+        ))
+
+    try:
+        chunks, saw_done = parse_sse(raw)
+    except (ValueError, json.JSONDecodeError) as exc:
+        v(f"malformed SSE body: {exc}")
+        return out
+    if not chunks:
+        v("200 stream carried zero chunks")
+        return out
+    if not saw_done:
+        v("stream did not terminate with [DONE]")
+    ids = {c.get("id") for c in chunks if c.get("id")}
+    if len(ids) > 1:
+        v(f"{len(ids)} distinct stream ids in one stream: {sorted(ids)}")
+    roles = sum(
+        1
+        for c in chunks
+        for choice in (c.get("choices") or ())
+        if (choice.get("delta") or {}).get("role")
+    )
+    if roles != 1:
+        v(f"{roles} role chunk(s) (want exactly 1)")
+    finishes = sum(
+        1
+        for c in chunks
+        for choice in (c.get("choices") or ())
+        if choice.get("finish_reason")
+    )
+    if finishes != 1:
+        v(f"{finishes} finish_reason chunk(s) (want exactly 1)")
+    return out
+
+
+def audit_sse(
+    cell_id: str,
+    results: List[Tuple[int, bytes]],
+    golden: Optional[List[Tuple[int, bytes]]],
+    parity: str,
+) -> List[Violation]:
+    """Family 5 over one cell: every 200 stream well-formed; when a
+    golden run exists, every request that answered 200 in BOTH runs must
+    match it — byte-identical (modulo rid/created) in ``bytes`` mode,
+    same assembled content + finish_reason in ``content`` mode (fleet
+    failover may re-frame chunks across the splice; the TEXT the client
+    assembled must still be exact)."""
+    out = []
+    for idx, (status, raw) in enumerate(results):
+        if status == 200:
+            out.extend(check_stream(cell_id, idx, raw))
+    if golden is None or parity == "none":
+        return out
+    for idx, (status, raw) in enumerate(results):
+        if idx >= len(golden):
+            break
+        g_status, g_raw = golden[idx]
+        if status != 200 or g_status != 200:
+            continue
+        if parity == "bytes":
+            if normalize_sse(raw) != normalize_sse(g_raw):
+                out.append(Violation(
+                    FAMILY_SSE, cell_id,
+                    f"request {idx}: stream bytes diverge from the "
+                    f"fault-free golden run (greedy + resume must be "
+                    f"byte-identical modulo rid/created)",
+                ))
+        else:
+            try:
+                got = stream_content(raw)
+                want = stream_content(g_raw)
+            except (ValueError, json.JSONDecodeError):
+                continue  # well-formedness above already flagged it
+            if got != want:
+                out.append(Violation(
+                    FAMILY_SSE, cell_id,
+                    f"request {idx}: assembled content/finish diverges "
+                    f"from golden ({got[1]!r}, {len(got[0])} chars vs "
+                    f"{want[1]!r}, {len(want[0])} chars)",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the composite per-cell audit
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CellEvidence:
+    """Everything one campaign cell observed, handed to the auditor."""
+
+    cell_id: str
+    point: str
+    kind: str = ""
+    results: List[Tuple[int, bytes]] = field(default_factory=list)
+    golden: Optional[List[Tuple[int, bytes]]] = None
+    parity: str = "bytes"
+    snapshot: Optional[ResourceSnapshot] = None
+    injected: int = 0
+    stale_delta: float = 0.0
+    zombie_delta: float = 0.0
+    check_metrics: bool = True
+
+
+def audit_cell(ev: CellEvidence) -> List[Violation]:
+    out: List[Violation] = []
+    out.extend(
+        audit_statuses(ev.cell_id, [status for status, _ in ev.results])
+    )
+    if ev.snapshot is not None:
+        out.extend(audit_resources(ev.cell_id, ev.snapshot, ev.zombie_delta))
+    if ev.check_metrics:
+        out.extend(audit_metrics(ev.cell_id))
+    out.extend(
+        audit_epoch(ev.cell_id, ev.point, ev.injected, ev.stale_delta, ev.kind)
+    )
+    out.extend(audit_sse(ev.cell_id, ev.results, ev.golden, ev.parity))
+    return out

@@ -43,6 +43,7 @@ from dnet_tpu.fleet.states import (
 from dnet_tpu.membership.epoch import EpochClock, is_stale, reject
 from dnet_tpu.obs import metric
 from dnet_tpu.obs.events import log_event
+from dnet_tpu.resilience.chaos import inject_async as _chaos_inject
 from dnet_tpu.obs.phases import EVENT_FAILOVER, EVENT_ROUTED
 from dnet_tpu.utils.logger import get_logger
 
@@ -194,6 +195,13 @@ class FleetManager:
         retry_after_s = 1.0
         for handle, reason in plan:
             self.check_fence(handle)
+            try:
+                # chaos point: a fault dispatching to THIS candidate is a
+                # dead/unreachable replica — fall through to the next one;
+                # if every candidate faults, the shed below answers 429
+                await _chaos_inject("fleet_dispatch")
+            except ConnectionError:
+                continue
             gen = handle.inference.generate_stream(req)
             try:
                 first = await gen.__anext__()
@@ -332,6 +340,10 @@ class FleetManager:
             admitted_none = True
             for handle, reason in plan:
                 self.check_fence(handle)
+                try:
+                    await _chaos_inject("fleet_dispatch")
+                except ConnectionError:
+                    continue
                 try:
                     resp = await getattr(handle.inference, method)(req)
                 except AdmissionRejected as exc:

@@ -20,6 +20,12 @@ Spec grammar (comma-separated, one spec per point; later wins):
 - ``point:error_at:N`` — raise on exactly the Nth call (1-based;
   ``N+M+...`` lists several)
 - ``point:delay:D``    — sleep D per call (``50ms``, ``0.5s``, or seconds)
+- ``point:partition:S+W`` — a seeded outage window: calls S..S+W-1
+  (1-based) all raise, then the point heals and every later call passes.
+  Partitioning BOTH directions of a hop (``send_activation`` forward and
+  ``token_cb`` return) over the same window reproduces a network
+  partition of that link deterministically — recovery, delta
+  reconfiguration and resume all run against the healed ring.
 
 `ChaosError` subclasses `ConnectionError` so the retry policy's
 classification (resilience/policy.py) treats an injected fault exactly like
@@ -66,9 +72,20 @@ INJECTION_POINTS: Tuple[str, ...] = (
                         # Fires ASYNC at ingress before predecode (a delay
                         # parks that frame's admission, not the loop) and
                         # sync on the compute thread's fallback decode
+    "fleet_dispatch",   # FleetManager's per-candidate dispatch (both the
+                        # streaming _acquire walk and the non-streaming
+                        # generate walk): an injected error fails that
+                        # candidate exactly like a dead replica — the walk
+                        # falls through to the next; all faulted => the
+                        # fleet sheds (429), never a 500
+    "update_topology",  # shard delta-reconfig entry (Shard.update_topology
+                        # and the in-process membership harness): an error
+                        # fails the delta exactly like an unreachable
+                        # shard — the API's retry/full-load fallback runs
 )
 
-_KINDS = ("error", "error_at", "delay")
+KINDS: Tuple[str, ...] = ("error", "error_at", "delay", "partition")
+_KINDS = KINDS  # back-compat alias
 
 
 class ChaosError(ConnectionError):
@@ -91,6 +108,9 @@ class _PointSpec:
     prob: float = 0.0
     delay_s: float = 0.0
     at: Tuple[int, ...] = ()
+    # partition window: calls part_start..part_start+part_width-1 raise
+    part_start: int = 0
+    part_width: int = 0
 
 
 @dataclass
@@ -134,9 +154,26 @@ class ChaosInjector:
                 )
             elif kind == "delay":
                 out[point] = _PointSpec(kind, delay_s=_parse_duration(param))
+            elif kind == "partition":
+                try:
+                    start_s, width_s = param.split("+", 1)
+                    start, width = int(start_s), int(width_s)
+                except ValueError:
+                    raise ValueError(
+                        f"chaos partition param {param!r} must be S+W "
+                        "(1-based start call + window width)"
+                    ) from None
+                if start < 1 or width < 1:
+                    raise ValueError(
+                        f"chaos partition window {param!r} must have "
+                        "S >= 1 and W >= 1"
+                    )
+                out[point] = _PointSpec(
+                    kind, part_start=start, part_width=width
+                )
             else:
                 raise ValueError(
-                    f"unknown chaos kind {kind!r}; one of {', '.join(_KINDS)}"
+                    f"unknown chaos kind {kind!r}; one of {', '.join(KINDS)}"
                 )
         return out
 
@@ -158,6 +195,13 @@ class ChaosInjector:
             return ("error", 0.0)
         if sp.kind == "delay":
             return ("delay", sp.delay_s)
+        if (
+            sp.kind == "partition"
+            and sp.part_start <= n < sp.part_start + sp.part_width
+        ):
+            # inside the outage window every call fails; past it the
+            # point has healed and never fires again
+            return ("error", 0.0)
         return ("none", 0.0)
 
     def counters(self) -> Dict[str, int]:
@@ -196,6 +240,58 @@ def get_chaos() -> Optional[ChaosInjector]:
                 )
             _env_loaded = True
     return _active
+
+
+def validate_startup(role: str = "server") -> Optional[ChaosInjector]:
+    """Server-start gate: parse DNET_CHAOS NOW and fail fast on a
+    malformed spec (unknown point/kind) with the declared vocabulary in
+    the error, instead of silently deferring the ValueError to the first
+    injection mid-request.  When chaos IS armed, pre-touch every declared
+    point's counter series (so armed-but-never-fired points are visible
+    in the exposition) and log one prominent warning naming the armed
+    points — an injected fault must never masquerade as a real incident.
+    """
+    try:
+        c = get_chaos()
+    except ValueError as exc:
+        raise SystemExit(
+            f"malformed DNET_CHAOS: {exc}\n"
+            f"  declared points: {', '.join(INJECTION_POINTS)}\n"
+            f"  declared kinds:  {', '.join(KINDS)}"
+        ) from exc
+    if c is None:
+        return None
+    from dnet_tpu.obs import metric  # lazy: avoid import-time registry work
+
+    for point in INJECTION_POINTS:
+        metric("dnet_chaos_injected_total").labels(point=point)
+    log.warning(
+        "=" * 64 + "\n"
+        "CHAOS ARMED on this %s: spec=%r seed=%d points=%s\n"
+        "Faults below are INJECTED — check /health `chaos` before paging.\n"
+        + "=" * 64,
+        role, c.spec, c.seed,
+        ",".join(f"{p}:{sp.kind}" for p, sp in sorted(c.points.items())),
+    )
+    return c
+
+
+def armed_summary() -> Optional[Dict[str, object]]:
+    """The /health `chaos` section: active spec/seed and point->kind map,
+    or None when no chaos is armed (the section is omitted entirely)."""
+    try:
+        c = get_chaos()
+    except ValueError:
+        # malformed env spec outside the server path (validate_startup
+        # would have exited); surface that it is armed-but-broken
+        return {"spec": "<malformed>", "seed": 0, "points": {}}
+    if c is None:
+        return None
+    return {
+        "spec": c.spec,
+        "seed": c.seed,
+        "points": {p: sp.kind for p, sp in sorted(c.points.items())},
+    }
 
 
 def install_chaos(spec: str, seed: int = 0) -> ChaosInjector:

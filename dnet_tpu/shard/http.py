@@ -189,6 +189,11 @@ class ShardHTTPServer:
                 }
             if compute.prefix_snaps is not None:
                 mesh["prefix_cache"] = dict(compute.prefix_snaps.stats)
+        from dnet_tpu.resilience.chaos import armed_summary
+
+        chaos = armed_summary()
+        if chaos is not None:
+            mesh["chaos"] = chaos
         return web.json_response(
             {
                 "status": "ok",

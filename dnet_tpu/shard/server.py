@@ -88,7 +88,12 @@ class Shard:
         (4) rewires its next pointer.  Raises ValueError when the proof
         fails — the HTTP layer answers 409 and the API full-loads."""
         from dnet_tpu.api.model_manager import resolve_model_dir
+        from dnet_tpu.resilience.chaos import inject_async
 
+        # chaos point: a fault here is this shard unreachable for the
+        # delta — the API's call_with_retry runs, and a persistent fault
+        # ends in the full-reload fallback (the 409 path's twin)
+        await inject_async("update_topology")
         compute = self.runtime.compute
         if compute is None:
             raise ValueError("no model loaded; cannot delta-update")
@@ -145,6 +150,11 @@ async def serve_async(args) -> None:
     from dnet_tpu.analysis.runtime import serving as dsan_serving
 
     san = dsan_serving.install(asyncio.get_running_loop())
+    # fail fast on a malformed DNET_CHAOS (and bannerize an armed one)
+    # before any model state exists — never mid-request
+    from dnet_tpu.resilience.chaos import validate_startup
+
+    validate_startup(role="shard")
     shard_id = args.shard_name or f"shard-{socket.gethostname()}-{args.grpc_port}"
     runtime = ShardRuntime(shard_id, queue_size=args.queue_size)
     adapter = RingAdapter(

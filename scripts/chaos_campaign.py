@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Run the deterministic chaos campaign and emit CHAOS_r<NN>.json.
+
+    # the tier-1-friendly slice (<= 8 cells, fast scenarios only)
+    python scripts/chaos_campaign.py --smoke
+
+    # the full matrix against a real checkpoint, recorded as round 2
+    python scripts/chaos_campaign.py --model /path/to/ckpt --round 2
+
+    # replay one cell from a record's repro string
+    DNET_CHAOS='admit:error_at:3+5' DNET_CHAOS_SEED=4242 \
+        python scripts/chaos_campaign.py --cell 'local:admit:error_at'
+
+Without --model a random-weight tiny Llama checkpoint is generated in a
+temp dir (same fixture tier-1 uses), so the campaign runs anywhere the
+test suite does.  Exit status: 0 when every cell is green, 1 on any
+invariant violation, 2 on operator error.
+
+Note the DNET_CHAOS/DNET_CHAOS_SEED env vars in a repro string are
+informational — the campaign installs each cell's spec itself from the
+matrix, so `--seed N --cell ID` alone reproduces the cell bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DNET_OBS_ENABLED", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic chaos campaign over the fault matrix"
+    )
+    ap.add_argument("--model", default="", help="checkpoint dir (default: generated tiny llama)")
+    ap.add_argument("--seed", type=int, default=0, help="campaign seed (fixes the whole schedule)")
+    ap.add_argument("--round", type=int, default=1, dest="round_no", help="record number for CHAOS_r<NN>.json")
+    ap.add_argument("--smoke", action="store_true", help="run the <=8-cell smoke slice")
+    ap.add_argument("--cell", action="append", default=[], help="run only this cell id (repeatable)")
+    ap.add_argument("--list", action="store_true", help="print the cell schedule and exit")
+    ap.add_argument("--out", default="", help="output path (default CHAOS_r<NN>.json)")
+    args = ap.parse_args()
+
+    from dnet_tpu.chaos.campaign import build_matrix, run_campaign, select_cells, write_record
+
+    if args.list:
+        for cell in select_cells(build_matrix(args.seed), only=args.cell or None, smoke=args.smoke):
+            print(f"{cell.cell_id:44s} {cell.chaos_spec}")
+        return 0
+
+    tmp = None
+    model_dir = args.model
+    if not model_dir:
+        from tests.fakes.checkpoints import make_tiny_llama
+
+        tmp = tempfile.TemporaryDirectory(prefix="dnet-chaos-")
+        model_dir = tmp.name
+        make_tiny_llama(model_dir)
+        print(f"generated tiny llama checkpoint at {model_dir}")
+
+    try:
+        record = asyncio.run(run_campaign(
+            model_dir,
+            seed=args.seed,
+            only=args.cell or None,
+            smoke=args.smoke,
+            round_no=args.round_no,
+        ))
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+    out = args.out or f"CHAOS_r{args.round_no:02d}.json"
+    write_record(record, out)
+    s = record["summary"]
+    print(
+        f"chaos campaign: {record['matrix']['cells_run']} cells, "
+        f"{s['ok']} ok, {s['violations']} violations, "
+        f"{s['http_500']} http 500s, {s['duration_s']}s -> {out}"
+    )
+    for cell in record["cells"]:
+        if cell["violations"]:
+            print(f"  FAIL {cell['cell']}")
+            for v in cell["violations"]:
+                print(f"       [{v['family']}] {v['detail']}")
+            print(f"       repro: {cell['repro']}")
+    return 0 if s["violations"] == 0 else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        sys.exit(2)

@@ -1,0 +1,469 @@
+"""Chaos campaign: matrix determinism, invariant auditor negative
+controls, operator surfacing, and the tier-1 smoke slice.
+
+The negative controls are the auditor's auditors: plant one violation of
+each family on REAL objects (a leaked pool block, an unclosed stream
+context, a forced 500) and prove the family fires exactly there — and
+nowhere on a clean run.  An invariant harness that cannot catch a
+planted bug proves nothing about the cells it passes.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from dnet_tpu.chaos.campaign import (
+    COMPOSED_CELL_ID,
+    POINT_SCENARIOS,
+    SMOKE_CELLS,
+    build_matrix,
+    select_cells,
+)
+from dnet_tpu.chaos.invariants import (
+    ALLOWED_STATUSES,
+    FAMILY_EPOCH,
+    FAMILY_RESOURCES,
+    FAMILY_SSE,
+    FAMILY_STATUS,
+    CellEvidence,
+    audit_cell,
+    audit_resources,
+    audit_sse,
+    audit_statuses,
+    check_stream,
+    normalize_sse,
+)
+from dnet_tpu.chaos.scenarios import ResourceSnapshot
+from dnet_tpu.resilience.chaos import (
+    INJECTION_POINTS,
+    KINDS,
+    clear_chaos,
+    install_chaos,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    clear_chaos()
+    yield
+    clear_chaos()
+
+
+# ---- matrix determinism ---------------------------------------------------
+
+def test_matrix_is_a_pure_function_of_the_seed():
+    a = build_matrix(7)
+    b = build_matrix(7)
+    assert a == b
+    # and every drawn parameter actually depends on the seed
+    c = build_matrix(8)
+    assert [x.chaos_spec for x in a] != [x.chaos_spec for x in c]
+
+
+def test_matrix_covers_every_point_kind_and_two_scenarios():
+    cells = [c for c in build_matrix(0) if not c.composed]
+    for point in INJECTION_POINTS:
+        for kind in KINDS:
+            hits = [c for c in cells if c.point == point and c.kind == kind]
+            assert len(hits) >= 2, f"{point}:{kind} under-covered"
+            assert {c.scenario for c in hits} == set(POINT_SCENARIOS[point])
+    composed = [c for c in build_matrix(0) if c.composed]
+    assert [c.cell_id for c in composed] == [COMPOSED_CELL_ID]
+
+
+def test_seed0_schedule_and_repro_strings_are_pinned():
+    """The acceptance pin: same spec + seed => the identical cell
+    schedule and identical copy-pasteable repro strings, forever."""
+    by_id = {c.cell_id: c for c in build_matrix(0)}
+    c = by_id["local:admit:error_at"]
+    assert c.chaos_spec == "admit:error_at:2+3"
+    assert c.chaos_seed == 6831
+    assert c.repro(0) == (
+        "DNET_CHAOS='admit:error_at:2+3' DNET_CHAOS_SEED=6831 "
+        "python scripts/chaos_campaign.py --seed 0 "
+        "--cell 'local:admit:error_at'"
+    )
+    # the partition on the forward hop drops BOTH directions of the link
+    assert by_id["ring:send_activation:partition"].chaos_spec == (
+        "send_activation:partition:7+4,token_cb:partition:7+4"
+    )
+    assert by_id[COMPOSED_CELL_ID].point == "shard_compute"
+
+
+def test_smoke_slice_is_small_and_valid():
+    cells = select_cells(build_matrix(0), smoke=True)
+    assert 0 < len(cells) <= 8
+    assert {c.cell_id for c in cells} == set(SMOKE_CELLS)
+    with pytest.raises(ValueError, match="unknown cell"):
+        select_cells(build_matrix(0), only=["nope:nope:nope"])
+
+
+def test_every_cell_spec_parses_under_its_seed():
+    from dnet_tpu.resilience.chaos import ChaosInjector
+
+    for cell in build_matrix(3):
+        ChaosInjector(cell.chaos_spec, seed=cell.chaos_seed)
+
+
+# ---- negative controls ----------------------------------------------------
+
+def _snapshot_of(pool=None, streams=0):
+    snap = ResourceSnapshot()
+    if pool is not None:
+        snap.pools["kv"] = (pool.used, pool.free, pool.total)
+    snap.streams["s0"] = streams
+    snap.admission["api"] = (0, 0)
+    return snap
+
+
+def test_control_leaked_block_fires_resources_only_when_planted():
+    from dnet_tpu.kv.paged import BlockPool, PagedKVConfig
+
+    pool = BlockPool(PagedKVConfig(block_tokens=4, pool_blocks=8))
+    assert audit_resources("cell", _snapshot_of(pool)) == []  # clean: zero
+    leaked = pool.alloc(1)  # the planted leak: never freed
+    vs = audit_resources("cell", _snapshot_of(pool))
+    assert [v.family for v in vs] == [FAMILY_RESOURCES]
+    assert "used=1" in vs[0].detail
+    pool.free_blocks(leaked)
+    assert audit_resources("cell", _snapshot_of(pool)) == []
+
+
+def test_control_unclosed_stream_fires_resources():
+    from dnet_tpu.transport.stream_manager import StreamManager
+
+    class _Call:
+        def __init__(self):
+            self.done = asyncio.get_event_loop().create_future()
+
+        async def write(self, frame):
+            return None
+
+        async def read(self):
+            await self.done
+
+        async def done_writing(self):
+            return None
+
+        def cancel(self):
+            if not self.done.done():
+                self.done.cancel()
+
+    async def go():
+        sm = StreamManager(open_stream=_Call)
+        await sm.get_or_create("n1")  # the skipped close
+        planted = audit_resources(
+            "cell", _snapshot_of(streams=len(sm._streams))
+        )
+        await sm.end_stream("n1")
+        clean = audit_resources(
+            "cell", _snapshot_of(streams=len(sm._streams))
+        )
+        return planted, clean
+
+    planted, clean = asyncio.run(go())
+    assert [v.family for v in planted] == [FAMILY_RESOURCES]
+    assert "stream" in planted[0].detail
+    assert clean == []
+
+
+def test_control_forced_500_fires_status():
+    assert audit_statuses("cell", [200, 503, 429]) == []
+    vs = audit_statuses("cell", [200, 500])
+    assert [v.family for v in vs] == [FAMILY_STATUS]
+    assert "500" in vs[0].detail
+    # transport-level silence (client timeout) is a violation too: the
+    # server must ANSWER inside the budget, not merely avoid 500s
+    assert [v.family for v in audit_statuses("cell", [0])] == [FAMILY_STATUS]
+    assert 500 not in ALLOWED_STATUSES
+
+
+_GOLDEN_SSE = (
+    b'data: {"id": "chatcmpl-abc", "created": 11, "choices": [{"delta": '
+    b'{"role": "assistant"}, "finish_reason": null}]}\n\n'
+    b'data: {"id": "chatcmpl-abc", "created": 11, "choices": [{"delta": '
+    b'{"content": "hi"}, "finish_reason": null}]}\n\n'
+    b'data: {"id": "chatcmpl-abc", "created": 11, "choices": [{"delta": '
+    b'{}, "finish_reason": "stop"}]}\n\n'
+    b"data: [DONE]\n\n"
+)
+
+
+def test_control_tampered_stream_fires_sse():
+    assert check_stream("cell", 0, _GOLDEN_SSE) == []
+    # plant 1: the stream never terminates
+    vs = check_stream("cell", 0, _GOLDEN_SSE.replace(b"data: [DONE]\n\n", b""))
+    assert [v.family for v in vs] == [FAMILY_SSE]
+    # plant 2: finish_reason emitted twice
+    dup = _GOLDEN_SSE.replace(
+        b"data: [DONE]",
+        b'data: {"id": "chatcmpl-abc", "created": 11, "choices": '
+        b'[{"delta": {}, "finish_reason": "stop"}]}\n\ndata: [DONE]',
+    )
+    assert any("finish_reason" in v.detail for v in check_stream("c", 0, dup))
+
+
+def test_control_divergent_resume_bytes_fire_parity():
+    tampered = _GOLDEN_SSE.replace(b'"hi"', b'"ho"')
+    vs = audit_sse(
+        "cell", [(200, tampered)], [(200, _GOLDEN_SSE)], parity="bytes"
+    )
+    assert any(v.family == FAMILY_SSE and "golden" in v.detail for v in vs)
+    # rid/created churn is NOT divergence: resume mints fresh ids
+    rechurned = _GOLDEN_SSE.replace(b"chatcmpl-abc", b"chatcmpl-zzz").replace(
+        b'"created": 11', b'"created": 99'
+    )
+    assert normalize_sse(rechurned) == normalize_sse(_GOLDEN_SSE)
+    assert audit_sse(
+        "cell", [(200, rechurned)], [(200, _GOLDEN_SSE)], parity="bytes"
+    ) == []
+
+
+def test_control_uncounted_stale_frame_fires_epoch():
+    ev = CellEvidence(
+        cell_id="cell", point="zombie_frame", kind="error_at",
+        results=[(200, _GOLDEN_SSE)], golden=[(200, _GOLDEN_SSE)],
+        parity="bytes", snapshot=_snapshot_of(),
+        injected=2, stale_delta=0.0,  # injected but never counted
+    )
+    vs = [v for v in audit_cell(ev) if v.family == FAMILY_EPOCH]
+    assert len(vs) == 1 and "stale" in vs[0].detail
+    ev2 = CellEvidence(
+        cell_id="cell", point="zombie_frame", kind="error_at",
+        results=[(200, _GOLDEN_SSE)], golden=[(200, _GOLDEN_SSE)],
+        parity="bytes", snapshot=_snapshot_of(),
+        injected=2, stale_delta=2.0,
+    )
+    assert [v for v in audit_cell(ev2) if v.family == FAMILY_EPOCH] == []
+    # a DELAY at the same point never marks the frame stale — it is a
+    # current-epoch frame served late, and fencing it would be the bug
+    ev3 = CellEvidence(
+        cell_id="cell", point="zombie_frame", kind="delay",
+        results=[(200, _GOLDEN_SSE)], golden=[(200, _GOLDEN_SSE)],
+        parity="bytes", snapshot=_snapshot_of(),
+        injected=2, stale_delta=0.0,
+    )
+    assert [v for v in audit_cell(ev3) if v.family == FAMILY_EPOCH] == []
+
+
+def test_clean_cell_audits_to_zero_violations():
+    ev = CellEvidence(
+        cell_id="cell", point="admit",
+        results=[(200, _GOLDEN_SSE), (503, b"")],
+        golden=[(200, _GOLDEN_SSE)],
+        parity="bytes", snapshot=_snapshot_of(),
+        injected=1, stale_delta=0.0,
+    )
+    assert audit_cell(ev) == []
+
+
+# ---- chaos wiring: the new injection points -------------------------------
+
+def test_fleet_dispatch_fault_falls_through_to_next_replica():
+    from dnet_tpu.fleet import FleetManager
+
+    class _Admission:
+        active = 0
+        queued = 0
+        capacity = 8
+        draining = False
+
+        @staticmethod
+        def estimated_wait_s(n):
+            return 0.0
+
+    class _Inference:
+        def __init__(self, rid):
+            self.rid = rid
+            self.calls = 0
+            self.admission = _Admission()
+
+        async def generate(self, req):
+            self.calls += 1
+            return {"served_by": self.rid}
+
+    class _Req:
+        prompt = "x"
+        model = "m"
+        user = ""
+
+    async def go():
+        fleet = FleetManager()
+        infs = [_Inference("r0"), _Inference("r1")]
+        fleet.add_replica("r0", infs[0])
+        fleet.add_replica("r1", infs[1])
+        install_chaos("fleet_dispatch:error_at:1", seed=1)
+        resp = await fleet.generate(_Req())
+        # the faulted candidate was skipped, not surfaced to the client
+        assert sum(i.calls for i in infs) == 1
+        return resp
+
+    resp = asyncio.run(go())
+    assert resp["served_by"] in ("r0", "r1")
+
+
+def test_fleet_dispatch_all_faulted_sheds_429():
+    from dnet_tpu.fleet import FleetManager
+    from dnet_tpu.fleet.router import FleetSheddingError
+
+    class _Admission:
+        active = 0
+        queued = 0
+        capacity = 8
+        draining = False
+
+        @staticmethod
+        def estimated_wait_s(n):
+            return 0.0
+
+    class _Inference:
+        def __init__(self):
+            self.admission = _Admission()
+
+        async def generate(self, req):
+            return {}
+
+    class _Req:
+        prompt = "x"
+        model = "m"
+        user = ""
+
+    async def go():
+        fleet = FleetManager()
+        fleet.add_replica("r0", _Inference())
+        fleet.add_replica("r1", _Inference())
+        install_chaos("fleet_dispatch:error:1.0", seed=1)
+        with pytest.raises(FleetSheddingError):
+            await fleet.generate(_Req())
+
+    asyncio.run(go())
+
+
+def test_update_topology_chaos_fires_before_shard_state():
+    from dnet_tpu.resilience.chaos import ChaosError
+    from dnet_tpu.shard.server import Shard
+
+    shard = object.__new__(Shard)  # the fault must fire before any state
+    install_chaos("update_topology:error_at:1", seed=1)
+    with pytest.raises(ChaosError, match="update_topology"):
+        asyncio.run(Shard.update_topology(shard, {}))
+
+
+# ---- operator surfacing ---------------------------------------------------
+
+def test_shard_health_exposes_chaos_section():
+    from dnet_tpu.shard.http import ShardHTTPServer
+    from dnet_tpu.shard.runtime import ShardRuntime
+
+    class _Shard:
+        runtime = ShardRuntime("s0")
+
+    server = ShardHTTPServer(_Shard())
+
+    async def go():
+        clean = json.loads((await server.health(None)).text)
+        install_chaos("shard_compute:error:0.5", seed=3)
+        armed = json.loads((await server.health(None)).text)
+        return clean, armed
+
+    clean, armed = asyncio.run(go())
+    assert "chaos" not in clean  # unarmed: the section is omitted
+    assert armed["chaos"]["points"] == {"shard_compute": "error"}
+    assert armed["chaos"]["seed"] == 3
+
+
+# ---- the tier-1 smoke campaign (real model, local scenario only) ----------
+
+def test_tier1_local_campaign_cells_green(tiny_llama_dir):
+    """Two real faulted cells over the in-process single-node stack: the
+    fastest end-to-end proof that install -> drive -> audit -> heal holds
+    together, plus the API /health chaos section over live HTTP."""
+    import aiohttp
+
+    from dnet_tpu.chaos.campaign import run_campaign
+    from dnet_tpu.chaos.scenarios import build_scenario
+
+    record = asyncio.run(run_campaign(
+        str(tiny_llama_dir),
+        seed=0,
+        only=["local:admit:error_at", "local:admit:delay"],
+    ))
+    assert record["summary"]["violations"] == 0
+    assert record["summary"]["http_500"] == 0
+    by_cell = {c["cell"]: c for c in record["cells"]}
+    # error_at:2+3 under a 5-request workload: exactly 2 injected 503s
+    assert by_cell["local:admit:error_at"]["injected"] == {"admit": 2}
+    assert by_cell["local:admit:error_at"]["statuses"] == {"200": 3, "503": 2}
+    # the delay cell slows admission without changing any outcome
+    assert by_cell["local:admit:delay"]["statuses"] == {"200": 5}
+    for c in record["cells"]:
+        assert c["repro"].startswith("DNET_CHAOS=")
+
+    async def health_probe():
+        scenario = build_scenario("local", str(tiny_llama_dir))
+        await scenario.start()
+        try:
+            async with aiohttp.ClientSession(scenario.base_url) as s:
+                async with s.get("/health") as r:
+                    clean = await r.json()
+                install_chaos("admit:error:0.5", seed=5)
+                async with s.get("/health") as r:
+                    armed = await r.json()
+                clear_chaos()
+                # forced-500 control, end to end: a non-contract error out
+                # of the driver must surface as 500 so family 1 is provably
+                # non-vacuous against the real HTTP surface
+                scenario.inference.generate_stream = _boom
+                async with s.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": str(tiny_llama_dir),
+                        "messages": [{"role": "user", "content": "x"}],
+                        "max_tokens": 2, "stream": True,
+                    },
+                ) as r:
+                    forced = r.status
+        finally:
+            await scenario.stop()
+        return clean, armed, forced
+
+    def _boom(req):
+        raise RuntimeError("planted server fault")
+
+    clean, armed, forced = asyncio.run(health_probe())
+    assert "chaos" not in clean
+    assert armed["chaos"]["points"] == {"admit": "error"}
+    assert forced == 500
+    assert [v.family for v in audit_statuses("cell", [forced])] == [
+        FAMILY_STATUS
+    ]
+
+
+# ---- the slow end-to-end legs (full-matrix cells, storms, failover) -------
+
+@pytest.mark.slow
+def test_ring_and_member_and_composed_cells_green(tiny_llama_dir):
+    from dnet_tpu.chaos.campaign import run_campaign
+
+    record = asyncio.run(run_campaign(
+        str(tiny_llama_dir),
+        seed=0,
+        only=[
+            "ring:send_activation:partition",
+            "ring:zombie_frame:error_at",
+            "member:update_topology:error_at",
+            COMPOSED_CELL_ID,
+        ],
+    ))
+    assert record["summary"]["violations"] == 0
+    assert record["summary"]["http_500"] == 0
+    by_cell = {c["cell"]: c for c in record["cells"]}
+    zf = by_cell["ring:zombie_frame:error_at"]
+    assert zf["injected"].get("zombie_frame", 0) > 0
+    assert zf["stale_epoch_delta"] >= zf["injected"]["zombie_frame"]
+    composed = by_cell[COMPOSED_CELL_ID]
+    assert composed["failovers"] >= 1
+    assert composed["statuses"] == {"200": 1}

@@ -138,3 +138,84 @@ def test_cleared_chaos_is_inert():
     install_chaos("shard_compute:error:1.0")
     clear_chaos()
     chaos.inject("shard_compute")  # must not raise
+
+
+# ---- partition kind -------------------------------------------------------
+
+def test_partition_parses_window():
+    c = ChaosInjector("send_activation:partition:3+2", seed=0)
+    sp = c.points["send_activation"]
+    assert (sp.part_start, sp.part_width) == (3, 2)
+
+
+def test_partition_window_then_heals():
+    """Calls S..S+W-1 fail, everything before and after passes: the
+    partition drops a seeded window of traffic and then HEALS permanently
+    (unlike error_at, which names individual calls)."""
+    c = ChaosInjector("send_activation:partition:3+2", seed=0)
+    acts = [c.decide("send_activation")[0] for _ in range(8)]
+    assert acts == [
+        "none", "none", "error", "error", "none", "none", "none", "none",
+    ]
+
+
+def test_partition_rejects_bad_windows():
+    with pytest.raises(ValueError, match="S\\+W"):
+        ChaosInjector("send_activation:partition:3")
+    with pytest.raises(ValueError):
+        ChaosInjector("send_activation:partition:0+2")
+    with pytest.raises(ValueError):
+        ChaosInjector("send_activation:partition:3+0")
+
+
+def test_new_points_are_declared():
+    assert "fleet_dispatch" in INJECTION_POINTS
+    assert "update_topology" in INJECTION_POINTS
+    from dnet_tpu.resilience.chaos import KINDS
+
+    assert KINDS == ("error", "error_at", "delay", "partition")
+
+
+# ---- startup validation + operator surfacing ------------------------------
+
+def test_validate_startup_fails_fast_on_malformed_spec(monkeypatch):
+    from dnet_tpu.config import reset_settings_cache
+    from dnet_tpu.resilience.chaos import validate_startup
+
+    monkeypatch.setenv("DNET_CHAOS", "bogus_point:error:0.5")
+    reset_settings_cache()
+    clear_chaos()
+    chaos._env_loaded = False  # force the env re-read a fresh server does
+    try:
+        with pytest.raises(SystemExit) as exc_info:
+            validate_startup(role="api")
+        msg = str(exc_info.value)
+        # the operator gets the full vocabulary, not just "bad spec"
+        assert "declared points" in msg and "fleet_dispatch" in msg
+        assert "declared kinds" in msg and "partition" in msg
+    finally:
+        monkeypatch.delenv("DNET_CHAOS")
+        reset_settings_cache()
+        clear_chaos()
+
+
+def test_validate_startup_pretouches_every_point_counter():
+    from dnet_tpu.obs import get_registry
+    from dnet_tpu.resilience.chaos import validate_startup
+
+    install_chaos("shard_compute:error:0.5")
+    validate_startup(role="api")
+    text = get_registry().expose()
+    for point in INJECTION_POINTS:
+        # armed-but-never-fired points must still be visible series
+        assert f'dnet_chaos_injected_total{{point="{point}"}}' in text
+
+
+def test_armed_summary_roundtrip():
+    from dnet_tpu.resilience.chaos import armed_summary
+
+    assert armed_summary() is None  # unarmed: /health omits the section
+    install_chaos("admit:delay:10ms,fleet_dispatch:error:0.5", seed=7)
+    s = armed_summary()
+    assert s["seed"] == 7
+    assert s["points"] == {"admit": "delay", "fleet_dispatch": "error"}

@@ -745,10 +745,10 @@ def test_check_codes_unique_and_documented():
         assert c.code not in seen, f"duplicate check code {c.code}"
         seen.add(c.code)
         assert c.description, f"{c.code} has no description"
-    # the full 31-check catalog: DL001-DL009 + DL029 (AST), DL010-DL020 +
-    # DL026-DL028 + DL030-DL031 (runtime metric passes), DL021-DL025
+    # the full 32-check catalog: DL001-DL009 + DL029 (AST), DL010-DL020 +
+    # DL026-DL028 + DL030-DL032 (runtime metric passes), DL021-DL025
     # (flow-sensitive tier)
-    assert seen == {f"DL{i:03d}" for i in range(1, 32)}
+    assert seen == {f"DL{i:03d}" for i in range(1, 33)}
 
 
 # ---- tier-1 self-run wrapper ----------------------------------------------
@@ -767,11 +767,11 @@ def test_dnetlint_self_run_clean(tmp_path):
     report = json.loads(out.read_text())
     assert report["clean"] is True
     assert report["files_scanned"] > 100
-    # the FULL 31-check catalog ran: DL001-DL009 + DL029 AST, DL010-DL020
-    # + DL026-DL028 + DL030-DL031 runtime metric passes, DL021-DL025
+    # the FULL 32-check catalog ran: DL001-DL009 + DL029 AST, DL010-DL020
+    # + DL026-DL028 + DL030-DL032 runtime metric passes, DL021-DL025
     # flow-sensitive tier — a check cannot silently fall out of the suite
     assert sorted(report["checks_run"]) == [
-        f"DL{i:03d}" for i in range(1, 32)
+        f"DL{i:03d}" for i in range(1, 33)
     ]
     assert report["findings"] == []
     # the merged runtime-sanitizer section: the full DS catalog is always
